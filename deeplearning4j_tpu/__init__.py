@@ -1,0 +1,21 @@
+"""deeplearning4j_tpu — a TPU-native deep-learning framework.
+
+Brand-new framework with the capability surface of Deeplearning4j 0.4-rc3
+(reference: /root/reference — builder-style declarative configs, sequential and
+DAG network containers, SGD-family updaters and second-order solvers, data
+pipelines, evaluation/early-stopping, checkpointing, gradient checks,
+data-parallel distributed training, Word2Vec-family NLP, DeepWalk, clustering,
+t-SNE, UI, CLI) — designed idiomatically for TPUs: JAX jit/grad/vmap/scan for
+compute, pjit/shard_map collectives over ICI/DCN device meshes for scale-out,
+Pallas kernels for hot paths, and host-side Python for data/control planes.
+"""
+
+__version__ = "0.1.0"
+
+from deeplearning4j_tpu.nn.conf import (  # noqa: F401
+    NeuralNetConfiguration,
+    MultiLayerConfiguration,
+    ComputationGraphConfiguration,
+)
+from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork  # noqa: F401
+from deeplearning4j_tpu.nn.graph import ComputationGraph  # noqa: F401

@@ -1,0 +1,11 @@
+"""Data pipeline (reference deeplearning4j-core/.../datasets)."""
+
+from deeplearning4j_tpu.datasets.api import DataSet, MultiDataSet  # noqa: F401
+from deeplearning4j_tpu.datasets.iterators import (  # noqa: F401
+    DataSetIterator,
+    ExistingDataSetIterator,
+    ListDataSetIterator,
+    MultipleEpochsIterator,
+    SamplingDataSetIterator,
+)
+from deeplearning4j_tpu.datasets.async_iterator import AsyncDataSetIterator  # noqa: F401

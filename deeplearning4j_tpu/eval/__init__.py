@@ -1,0 +1,5 @@
+"""Evaluation metrics (reference eval/)."""
+
+from deeplearning4j_tpu.eval.confusion import ConfusionMatrix  # noqa: F401
+from deeplearning4j_tpu.eval.evaluation import Evaluation  # noqa: F401
+from deeplearning4j_tpu.eval.regression import RegressionEvaluation  # noqa: F401

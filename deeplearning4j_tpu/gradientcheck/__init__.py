@@ -1,0 +1,5 @@
+from deeplearning4j_tpu.gradientcheck.gradient_check_util import (  # noqa: F401
+    GradientCheckUtil,
+    check_gradients,
+    check_gradients_graph,
+)

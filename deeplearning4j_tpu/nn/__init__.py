@@ -1,0 +1,1 @@
+"""NN core: configs, params, layers, containers (reference nn/ tree)."""

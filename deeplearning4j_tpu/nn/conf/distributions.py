@@ -1,0 +1,62 @@
+"""Weight distributions (reference conf/distribution/*: Normal, Uniform,
+Binomial, Gaussian)."""
+
+from __future__ import annotations
+
+import dataclasses
+
+import jax
+
+from deeplearning4j_tpu.nn.conf.serde import register_config
+
+
+@register_config
+@dataclasses.dataclass
+class Distribution:
+    def sample(self, rng, shape, dtype):
+        raise NotImplementedError
+
+
+@register_config
+@dataclasses.dataclass
+class NormalDistribution(Distribution):
+    """Gaussian with given mean/std (reference NormalDistribution)."""
+
+    mean: float = 0.0
+    std: float = 1.0
+
+    def sample(self, rng, shape, dtype):
+        return self.mean + self.std * jax.random.normal(rng, shape, dtype)
+
+
+# The reference has both GaussianDistribution and NormalDistribution (aliases).
+GaussianDistribution = register_config(name="GaussianDistribution")(
+    dataclasses.make_dataclass(
+        "GaussianDistribution", [("mean", float, 0.0), ("std", float, 1.0)],
+        bases=(NormalDistribution,),
+    )
+)
+
+
+@register_config
+@dataclasses.dataclass
+class UniformDistribution(Distribution):
+    lower: float = -1.0
+    upper: float = 1.0
+
+    def sample(self, rng, shape, dtype):
+        return jax.random.uniform(
+            rng, shape, dtype, minval=self.lower, maxval=self.upper
+        )
+
+
+@register_config
+@dataclasses.dataclass
+class BinomialDistribution(Distribution):
+    number_of_trials: int = 1
+    probability_of_success: float = 0.5
+
+    def sample(self, rng, shape, dtype):
+        return jax.random.binomial(
+            rng, self.number_of_trials, self.probability_of_success, shape
+        ).astype(dtype)

@@ -1,0 +1,154 @@
+"""Input preprocessors — shape adapters between layer kinds.
+
+Reference: conf/preprocessor/* (13 adapters: CnnToFeedForward,
+FeedForwardToCnn, FeedForwardToRnn, RnnToFeedForward, CnnToRnn, RnnToCnn,
+...). Each is a pure reshape/transpose; jax.grad differentiates through
+them so there is no hand-written backprop() method as in the reference.
+
+Layouts (TPU-first, see conf/inputs.py): CNN = NHWC, RNN = [batch, time, f].
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import jax.numpy as jnp
+
+from deeplearning4j_tpu.nn.conf.inputs import InputType
+from deeplearning4j_tpu.nn.conf.serde import register_config
+
+
+@register_config
+@dataclasses.dataclass
+class InputPreProcessor:
+    def pre_process(self, x):
+        return x
+
+    def get_output_type(self, input_type: InputType) -> InputType:
+        return input_type
+
+
+@register_config
+@dataclasses.dataclass
+class CnnToFeedForwardPreProcessor(InputPreProcessor):
+    height: int = 0
+    width: int = 0
+    channels: int = 0
+
+    def pre_process(self, x):
+        return x.reshape(x.shape[0], -1)
+
+    def get_output_type(self, input_type: InputType) -> InputType:
+        return InputType.feed_forward(input_type.flat_size())
+
+
+@register_config
+@dataclasses.dataclass
+class FeedForwardToCnnPreProcessor(InputPreProcessor):
+    height: int = 0
+    width: int = 0
+    channels: int = 1
+
+    def pre_process(self, x):
+        if x.ndim == 4:
+            return x
+        return x.reshape(x.shape[0], self.height, self.width, self.channels)
+
+    def get_output_type(self, input_type: InputType) -> InputType:
+        return InputType.convolutional(self.height, self.width, self.channels)
+
+
+@register_config
+@dataclasses.dataclass
+class FeedForwardToRnnPreProcessor(InputPreProcessor):
+    """[batch*time, f] → [batch, time, f] is impossible without time; here the
+    network keeps RNN activations 3-D throughout, so this adapter broadcasts
+    a 2-D input to a single-timestep sequence."""
+
+    def pre_process(self, x):
+        if x.ndim == 3:
+            return x
+        return x[:, None, :]
+
+    def get_output_type(self, input_type: InputType) -> InputType:
+        return InputType.recurrent(input_type.flat_size())
+
+
+@register_config
+@dataclasses.dataclass
+class RnnToFeedForwardPreProcessor(InputPreProcessor):
+    """[batch, time, f] → applied per-timestep: dense layers operate on the
+    last axis, so this is an identity marker kept for reference parity
+    (the reference reshapes to [batch*time, f] — RnnToFeedForwardPreProcessor)."""
+
+    def pre_process(self, x):
+        return x
+
+    def get_output_type(self, input_type: InputType) -> InputType:
+        return InputType.recurrent(input_type.flat_size())
+
+
+@register_config
+@dataclasses.dataclass
+class CnnToRnnPreProcessor(InputPreProcessor):
+    def pre_process(self, x):
+        # NHWC → [batch, 1, h*w*c]: a CNN frame becomes one timestep
+        return x.reshape(x.shape[0], 1, -1)
+
+    def get_output_type(self, input_type: InputType) -> InputType:
+        return InputType.recurrent(input_type.flat_size())
+
+
+@register_config
+@dataclasses.dataclass
+class RnnToCnnPreProcessor(InputPreProcessor):
+    height: int = 0
+    width: int = 0
+    channels: int = 1
+
+    def pre_process(self, x):
+        # [batch, time, f] → fold time into batch → NHWC
+        b, t, f = x.shape
+        return x.reshape(b * t, self.height, self.width, self.channels)
+
+    def get_output_type(self, input_type: InputType) -> InputType:
+        return InputType.convolutional(self.height, self.width, self.channels)
+
+
+@register_config
+@dataclasses.dataclass
+class ReshapePreProcessor(InputPreProcessor):
+    """Generic reshape (keeps batch dim)."""
+
+    shape: tuple = ()
+
+    def pre_process(self, x):
+        return x.reshape((x.shape[0],) + tuple(self.shape))
+
+
+@register_config
+@dataclasses.dataclass
+class ComposableInputPreProcessor(InputPreProcessor):
+    """Chain of preprocessors (reference ComposableInputPreProcessor)."""
+
+    processors: list = dataclasses.field(default_factory=list)
+
+    def pre_process(self, x):
+        for p in self.processors:
+            x = p.pre_process(x)
+        return x
+
+    def get_output_type(self, input_type: InputType) -> InputType:
+        for p in self.processors:
+            input_type = p.get_output_type(input_type)
+        return input_type
+
+
+@register_config
+@dataclasses.dataclass
+class BinomialSamplingPreProcessor(InputPreProcessor):
+    """Reference BinomialSamplingPreProcessor — kept as identity + note;
+    stochastic binarization is applied in the RBM layer itself with keyed RNG."""
+
+    def pre_process(self, x):
+        return jnp.clip(x, 0.0, 1.0)

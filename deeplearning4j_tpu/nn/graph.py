@@ -1,0 +1,351 @@
+"""ComputationGraph — the DAG container for multi-input/multi-output nets.
+
+Reference: nn/graph/ComputationGraph.java (~2,500 LoC): topological
+sort:235,458-483, init:219-231, fit:545-672, forward over topo order:886,
+backprop:958-977; vertex impls under graph/vertex/impl/*.
+
+TPU-native: the topo-order forward IS the traced jaxpr (SURVEY.md §3.2);
+vertices are pure functions; backward is jax.grad of the summed output
+losses; the whole step is one jitted donated computation.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from deeplearning4j_tpu.datasets.api import DataSet, MultiDataSet
+from deeplearning4j_tpu.datasets.async_iterator import AsyncDataSetIterator
+from deeplearning4j_tpu.datasets.iterators import DataSetIterator, ListDataSetIterator
+from deeplearning4j_tpu.nn.conf.graph_conf import (
+    ComputationGraphConfiguration,
+    DuplicateToTimeSeriesVertexConf,
+    ElementWiseVertexConf,
+    LastTimeStepVertexConf,
+    LayerVertexConf,
+    MergeVertexConf,
+    PreprocessorVertexConf,
+    ScaleVertexConf,
+    StackVertexConf,
+    SubsetVertexConf,
+    UnstackVertexConf,
+)
+from deeplearning4j_tpu.nn.conf.layers import BaseOutputLayer
+from deeplearning4j_tpu.nn.layers import get_impl, l1_l2_penalty
+from deeplearning4j_tpu.nn.training import make_train_step
+from deeplearning4j_tpu.nn.updater import build_optimizer
+
+_DTYPES = {"float32": jnp.float32, "bfloat16": jnp.bfloat16, "float64": jnp.float64}
+
+
+class ComputationGraph:
+    def __init__(self, conf: ComputationGraphConfiguration):
+        self.conf = conf
+        self.topo = conf.topological_order()
+        self.layer_vertices = {
+            name: v for name, v in conf.vertices.items() if isinstance(v, LayerVertexConf)
+        }
+        self.impls = {name: get_impl(v.layer) for name, v in self.layer_vertices.items()}
+        self.output_layer_names = [
+            n for n in conf.network_outputs
+            if n in self.layer_vertices
+            and isinstance(self.layer_vertices[n].layer, BaseOutputLayer)
+        ]
+        self.params = None
+        self.state = None
+        self.opt_state = None
+        self.tx = None
+        self.listeners = []
+        self.iteration_count = 0
+        self.score_value = float("nan")
+        self._train_step = None
+        self._output_jit = None
+        self._rng = None
+        self._mesh = None
+
+    @property
+    def compute_dtype(self):
+        return _DTYPES[self.conf.conf.dtype]
+
+    @property
+    def param_dtype(self):
+        return _DTYPES[self.conf.conf.param_dtype]
+
+    def init(self, seed: Optional[int] = None):
+        g = self.conf.conf
+        key = jax.random.PRNGKey(g.seed if seed is None else seed)
+        self._rng = jax.random.fold_in(key, 1)
+        params, state = {}, {}
+        names = sorted(self.layer_vertices)
+        keys = jax.random.split(key, max(len(names), 1))
+        for name, k in zip(names, keys):
+            v = self.layer_vertices[name]
+            p, s = self.impls[name].init(v.layer, k, self.param_dtype)
+            params[name] = p
+            state[name] = s
+        self.params = params
+        self.state = state
+        self.tx = build_optimizer(
+            g, {n: v.layer for n, v in self.layer_vertices.items()})
+        self.opt_state = self.tx.init(params)
+        return self
+
+    def set_listeners(self, *listeners):
+        self.listeners = list(listeners)
+
+    def set_mesh(self, mesh):
+        self._mesh = mesh
+        self._train_step = None
+
+    def set_optimizer(self, tx):
+        self.tx = tx
+        self.opt_state = tx.init(self.params)
+        self._train_step = None
+
+    def _next_rng(self):
+        self._rng, k = jax.random.split(self._rng)
+        return k
+
+    # --------------------------------------------------------------- forward
+    def _vertex_forward(self, name, vconf, inputs, params, state, train, rng,
+                        masks, acts):
+        """Non-layer vertex semantics (reference graph/vertex/impl/*)."""
+        if isinstance(vconf, MergeVertexConf):
+            return jnp.concatenate(inputs, axis=-1)
+        if isinstance(vconf, ElementWiseVertexConf):
+            op = vconf.op
+            out = inputs[0]
+            for x in inputs[1:]:
+                if op == "add":
+                    out = out + x
+                elif op == "subtract":
+                    out = out - x
+                elif op == "product":
+                    out = out * x
+                elif op == "max":
+                    out = jnp.maximum(out, x)
+                elif op == "average":
+                    out = out + x
+                else:
+                    raise ValueError(f"elementwise op {op}")
+            if op == "average":
+                out = out / len(inputs)
+            return out
+        if isinstance(vconf, SubsetVertexConf):
+            return inputs[0][..., vconf.from_idx:vconf.to_idx + 1]
+        if isinstance(vconf, PreprocessorVertexConf):
+            return vconf.preprocessor.pre_process(inputs[0])
+        if isinstance(vconf, ScaleVertexConf):
+            return inputs[0] * vconf.scale
+        if isinstance(vconf, LastTimeStepVertexConf):
+            x = inputs[0]  # [B, T, f]
+            mask = masks.get(vconf.mask_input) if vconf.mask_input else None
+            if mask is None:
+                return x[:, -1, :]
+            idx = jnp.maximum(jnp.sum(mask.astype(jnp.int32), axis=1) - 1, 0)
+            return x[jnp.arange(x.shape[0]), idx, :]
+        if isinstance(vconf, DuplicateToTimeSeriesVertexConf):
+            ref = acts[vconf.reference_input]
+            T = ref.shape[1]
+            return jnp.broadcast_to(
+                inputs[0][:, None, :], (inputs[0].shape[0], T, inputs[0].shape[1]))
+        if isinstance(vconf, StackVertexConf):
+            return jnp.concatenate(inputs, axis=0)
+        if isinstance(vconf, UnstackVertexConf):
+            return jnp.split(inputs[0], vconf.stack_size, axis=0)[vconf.from_idx]
+        raise ValueError(f"Unhandled vertex type {type(vconf).__name__} for '{name}'")
+
+    def _forward(self, params, state, input_dict, *, train, rng, masks=None,
+                 collect=False):
+        masks = masks or {}
+        acts = {}
+        cdtype = self.compute_dtype
+        for k, v in input_dict.items():
+            v = jnp.asarray(v)
+            if jnp.issubdtype(v.dtype, jnp.floating):
+                v = v.astype(cdtype)
+            acts[k] = v
+        new_state = {}
+        names = [n for n in self.topo if n not in self.conf.network_inputs]
+        rngs = (jax.random.split(rng, max(len(names), 1)) if rng is not None
+                else [None] * len(names))
+        for name, k in zip(names, rngs):
+            vconf = self.conf.vertices[name]
+            inputs = [acts[i] for i in self.conf.vertex_inputs[name]]
+            if isinstance(vconf, LayerVertexConf):
+                x = inputs[0]
+                if vconf.preprocessor is not None:
+                    x = vconf.preprocessor.pre_process(x)
+                p = params.get(name, {})
+                if cdtype != self.param_dtype:
+                    p = jax.tree.map(
+                        lambda a: a.astype(cdtype)
+                        if jnp.issubdtype(a.dtype, jnp.floating) else a, p)
+                in_mask = masks.get(self.conf.vertex_inputs[name][0])
+                y, s = self.impls[name].apply(
+                    vconf.layer, p, state.get(name, {}), x, train=train, rng=k,
+                    mask=in_mask)
+                acts[name] = y
+                new_state[name] = s
+            else:
+                acts[name] = self._vertex_forward(
+                    name, vconf, inputs, params, state, train, k, masks, acts)
+        for n in self.layer_vertices:
+            new_state.setdefault(n, state.get(n, {}))
+        if collect:
+            return acts, new_state
+        return [acts[o] for o in self.conf.network_outputs], new_state
+
+    def _loss(self, params, state, rng, batch, train=True):
+        """Sum of output-layer losses + L1/L2 (reference
+        computeGradientAndScore:816)."""
+        input_dict = dict(zip(self.conf.network_inputs, batch["features"]))
+        masks = {}
+        if batch.get("features_masks") is not None:
+            masks = {k: m for k, m in zip(self.conf.network_inputs,
+                                          batch["features_masks"]) if m is not None}
+        n_out = len(self.conf.network_outputs)
+        if rng is not None:
+            keys = jax.random.split(rng, n_out + 1)
+            k_body, k_outs = keys[0], keys[1:]
+        else:
+            k_body, k_outs = None, [None] * n_out
+        acts, new_state = self._forward(
+            params, state, input_dict, train=train, rng=k_body, masks=masks,
+            collect=True)
+        loss = 0.0
+        labels_list = batch["labels"]
+        lmasks = batch.get("labels_masks") or [None] * len(labels_list)
+        for out_name, labels, lmask, k_out in zip(
+                self.conf.network_outputs, labels_list, lmasks, k_outs):
+            vconf = self.conf.vertices[out_name]
+            if not isinstance(vconf, LayerVertexConf) or not isinstance(
+                    vconf.layer, BaseOutputLayer):
+                raise ValueError(f"Output '{out_name}' is not an output layer")
+            x = acts[self.conf.vertex_inputs[out_name][0]]
+            if vconf.preprocessor is not None:
+                x = vconf.preprocessor.pre_process(x)
+            loss = loss + self.impls[out_name].loss(
+                vconf.layer, params[out_name], x, labels, train=train, rng=k_out,
+                mask=lmask)
+        for name, v in self.layer_vertices.items():
+            loss = loss + l1_l2_penalty(v.layer, params[name])
+        return loss, (new_state, {})
+
+    # ------------------------------------------------------------------- fit
+    @staticmethod
+    def _to_mds(ds):
+        if isinstance(ds, MultiDataSet):
+            return ds
+        return MultiDataSet([ds.features], [ds.labels],
+                            None if ds.features_mask is None else [ds.features_mask],
+                            None if ds.labels_mask is None else [ds.labels_mask])
+
+    def _batch_dict(self, mds: MultiDataSet):
+        b = {
+            "features": tuple(jnp.asarray(f) for f in mds.features),
+            "labels": tuple(jnp.asarray(l) for l in mds.labels),
+        }
+        if mds.features_masks is not None:
+            b["features_masks"] = tuple(
+                None if m is None else jnp.asarray(m) for m in mds.features_masks)
+        if mds.labels_masks is not None:
+            b["labels_masks"] = tuple(
+                None if m is None else jnp.asarray(m) for m in mds.labels_masks)
+        return b
+
+    def fit(self, data, labels=None, epochs: int = 1):
+        if self.params is None:
+            self.init()
+        if labels is not None:
+            data = DataSet(data, labels)
+        if isinstance(data, (DataSet, MultiDataSet)):
+            data = ListDataSetIterator([data])
+        it = data
+        if isinstance(it, DataSetIterator) and it.async_supported() and not isinstance(
+                it, AsyncDataSetIterator):
+            it = AsyncDataSetIterator(it)
+        if self._train_step is None:
+            confs = {n: v.layer for n, v in self.layer_vertices.items()}
+            self._train_step = make_train_step(self._loss, self.tx, confs,
+                                               mesh=self._mesh)
+        g = self.conf.conf
+        for _ in range(epochs):
+            it.reset()
+            while it.has_next():
+                mds = self._to_mds(it.next())
+                batch = self._batch_dict(mds)
+                for _i in range(max(1, g.iterations)):
+                    self.params, self.opt_state, self.state, loss, _ = self._train_step(
+                        self.params, self.opt_state, self.state, self._next_rng(),
+                        batch)
+                    self.score_value = float(loss)
+                    self.iteration_count += 1
+                    for lst in self.listeners:
+                        lst.iteration_done(self, self.iteration_count)
+        return self
+
+    # ------------------------------------------------------------- inference
+    def output(self, *inputs, train: bool = False):
+        """Outputs for given inputs (reference output). Returns a list (one
+        per network output), or the single array if one output."""
+        input_dict = dict(zip(self.conf.network_inputs, inputs))
+        if self._output_jit is None:
+            def _out(params, state, input_dict):
+                ys, _ = self._forward(params, state, input_dict, train=False, rng=None)
+                return ys
+            self._output_jit = jax.jit(_out)
+        ys = self._output_jit(self.params, self.state,
+                              {k: jnp.asarray(v) for k, v in input_dict.items()})
+        return ys[0] if len(ys) == 1 else ys
+
+    def predict(self, *inputs):
+        out = self.output(*inputs)
+        if isinstance(out, list):
+            return [np.asarray(jnp.argmax(o, axis=-1)) for o in out]
+        return np.asarray(jnp.argmax(out, axis=-1))
+
+    def score(self, ds=None, training: bool = False):
+        if ds is None:
+            return self.score_value
+        mds = self._to_mds(ds)
+        loss, _ = self._loss(self.params, self.state, None, self._batch_dict(mds),
+                             train=training)
+        return float(loss)
+
+    def evaluate(self, it):
+        from deeplearning4j_tpu.eval.evaluation import Evaluation
+
+        ev = Evaluation()
+        if isinstance(it, (DataSet, MultiDataSet)):
+            it = ListDataSetIterator([it])
+        it.reset()
+        while it.has_next():
+            ds = it.next()
+            mds = self._to_mds(ds)
+            out = self.output(*mds.features)
+            outs = out if isinstance(out, list) else [out]
+            ev.eval(mds.labels[0], np.asarray(outs[0]),
+                    mask=None if mds.labels_masks is None else mds.labels_masks[0])
+        return ev
+
+    def num_params(self) -> int:
+        return sum(int(np.prod(p.shape)) for p in jax.tree.leaves(self.params))
+
+    def params_flat(self):
+        leaves = jax.tree.leaves(self.params)
+        return (np.concatenate([np.asarray(l).ravel() for l in leaves])
+                if leaves else np.zeros(0))
+
+    def set_params_flat(self, flat):
+        leaves, treedef = jax.tree.flatten(self.params)
+        out, off = [], 0
+        for l in leaves:
+            n = int(np.prod(l.shape))
+            out.append(jnp.asarray(flat[off:off + n], l.dtype).reshape(l.shape))
+            off += n
+        self.params = jax.tree.unflatten(treedef, out)

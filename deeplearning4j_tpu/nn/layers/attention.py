@@ -1,0 +1,89 @@
+"""Transformer building blocks: LayerNormalization, SelfAttentionLayer.
+
+New capabilities for the Transformer north star (SURVEY.md §7 step 6) — no
+reference analogue. Attention computes per-head scaled dot product over
+[batch, time, features]; XLA fuses the softmax chain. A ring-attention
+sequence-parallel variant lives in deeplearning4j_tpu/parallel/ring_attention.py
+and is selected by the parallel plan, not the layer config.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from deeplearning4j_tpu.nn.conf.layers import LayerNormalization, SelfAttentionLayer
+from deeplearning4j_tpu.nn.layers.base import LayerImpl, apply_dropout, register_impl
+from deeplearning4j_tpu.nn.weights import init_weights
+from deeplearning4j_tpu.ops.activations import get_activation
+
+
+@register_impl(LayerNormalization)
+class LayerNormImpl(LayerImpl):
+    def init(self, conf, rng, dtype):
+        n = conf.n_out or conf.n_in
+        return {"gamma": jnp.ones((n,), dtype), "beta": jnp.zeros((n,), dtype)}, {}
+
+    def apply(self, conf, params, state, x, *, train=False, rng=None, mask=None):
+        mu = jnp.mean(x, axis=-1, keepdims=True)
+        var = jnp.var(x, axis=-1, keepdims=True)
+        xn = (x - mu) * jax.lax.rsqrt(var + conf.eps)
+        return xn * params["gamma"] + params["beta"], state
+
+
+def dot_product_attention(q, k, v, *, causal, mask=None, dropout=0.0, rng=None,
+                          train=False):
+    """q,k,v: [B, H, T, D]. Returns [B, H, T, D]. Computed in f32 for the
+    softmax (bf16-safe), outputs cast back to q.dtype."""
+    d = q.shape[-1]
+    scores = jnp.einsum("bhqd,bhkd->bhqk", q, k,
+                        preferred_element_type=jnp.float32) / jnp.sqrt(float(d))
+    T = q.shape[2]
+    if causal:
+        cm = jnp.tril(jnp.ones((T, T), bool))
+        scores = jnp.where(cm, scores, -1e30)
+    if mask is not None:
+        # mask: [B, T] keyed on keys
+        scores = jnp.where(mask[:, None, None, :].astype(bool), scores, -1e30)
+    w = jax.nn.softmax(scores, axis=-1)
+    if dropout and train and rng is not None:
+        keep = jax.random.bernoulli(rng, 1.0 - dropout, w.shape)
+        w = jnp.where(keep, w / (1.0 - dropout), 0.0)
+    out = jnp.einsum("bhqk,bhkd->bhqd", w.astype(v.dtype), v)
+    return out.astype(q.dtype)
+
+
+@register_impl(SelfAttentionLayer)
+class SelfAttentionImpl(LayerImpl):
+    def init(self, conf, rng, dtype):
+        k1, k2 = jax.random.split(rng)
+        n_in, n = conf.n_in, conf.n_out
+        return {
+            "Wqkv": init_weights(k1, (n_in, 3 * n), conf.weight_init, conf.dist,
+                                 dtype, fan_in=n_in, fan_out=n),
+            "bqkv": jnp.zeros((3 * n,), dtype),
+            "Wo": init_weights(k2, (n, n), conf.weight_init, conf.dist, dtype),
+            "bo": jnp.zeros((n,), dtype),
+        }, {}
+
+    def apply(self, conf, params, state, x, *, train=False, rng=None, mask=None):
+        if conf.dropout:
+            rng, sub = jax.random.split(rng) if rng is not None else (None, None)
+            x = apply_dropout(x, conf.dropout, sub, train=train)
+        B, T, _ = x.shape
+        H = conf.n_heads
+        n = conf.n_out
+        D = n // H
+        qkv = x @ params["Wqkv"] + params["bqkv"]  # [B, T, 3n]
+        q, k, v = jnp.split(qkv, 3, axis=-1)
+
+        def heads(t):
+            return t.reshape(B, T, H, D).transpose(0, 2, 1, 3)
+
+        out = dot_product_attention(
+            heads(q), heads(k), heads(v), causal=conf.causal, mask=mask,
+            dropout=conf.attention_dropout, rng=rng, train=train,
+        )
+        out = out.transpose(0, 2, 1, 3).reshape(B, T, n)
+        y = out @ params["Wo"] + params["bo"]
+        return get_activation(conf.activation or "identity")(y), state

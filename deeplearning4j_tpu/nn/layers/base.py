@@ -1,0 +1,89 @@
+"""Layer implementation protocol + registry.
+
+The reference pairs every conf class with a hand-written layer impl holding
+forward AND analytic backward (nn/layers/*, e.g. BaseLayer.java:361 preOutput,
+:161 backward gemm) wired through LayerFactories. Here an impl provides only:
+
+- init(conf, rng, dtype)    -> (params pytree, state pytree)
+- apply(conf, params, state, x, train, rng, mask) -> (y, new_state)
+
+Backward is always jax.grad through apply — there is no backprop code
+anywhere in this framework. `state` carries non-trained buffers (BatchNorm
+running stats); layers without state return {}.
+
+Dropout/DropConnect (reference util/Dropout.java, inverted dropout applied to
+the layer input at BaseLayer) is implemented here once, with keyed PRNG.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+_IMPL_REGISTRY: dict[type, "LayerImpl"] = {}
+
+
+def register_impl(conf_cls):
+    def wrap(impl_cls):
+        _IMPL_REGISTRY[conf_cls] = impl_cls()
+        return impl_cls
+
+    return wrap
+
+
+def get_impl(conf) -> "LayerImpl":
+    for cls in type(conf).__mro__:
+        impl = _IMPL_REGISTRY.get(cls)
+        if impl is not None:
+            return impl
+    raise ValueError(f"No layer implementation registered for {type(conf).__name__}")
+
+
+class LayerImpl:
+    """Stateless singleton holding pure init/apply for one layer kind."""
+
+    def init(self, conf, rng, dtype):
+        return {}, {}
+
+    def apply(self, conf, params, state, x, *, train=False, rng=None, mask=None):
+        raise NotImplementedError
+
+    # pretrain interface (AutoEncoder/RBM): returns (loss, params-grad-ready fn)
+    def pretrain_loss(self, conf, params, x, rng):
+        raise NotImplementedError(f"{type(self).__name__} is not a pretrain layer")
+
+
+def apply_dropout(x, rate, rng, *, train):
+    """Inverted dropout on the layer input (reference util/Dropout.applyDropout:31)."""
+    if not train or rate in (None, 0.0) or rng is None:
+        return x
+    keep = 1.0 - rate
+    m = jax.random.bernoulli(rng, keep, x.shape)
+    return jnp.where(m, x / keep, 0.0)
+
+
+def apply_dropconnect(w, rate, rng, *, train):
+    """DropConnect: drop weights instead of activations (reference Dropout.java)."""
+    if not train or rate in (None, 0.0) or rng is None:
+        return w
+    keep = 1.0 - rate
+    m = jax.random.bernoulli(rng, keep, w.shape)
+    return jnp.where(m, w / keep, 0.0)
+
+
+def l1_l2_penalty(conf, params):
+    """Per-layer L1/L2 regularization on weight params only (reference
+    BaseLayer calcL1/calcL2 — biases excluded)."""
+    pen = 0.0
+    l1 = getattr(conf, "l1", 0.0) or 0.0
+    l2 = getattr(conf, "l2", 0.0) or 0.0
+    if l1 == 0.0 and l2 == 0.0:
+        return 0.0
+    for name, p in params.items():
+        if name.startswith("b") or name in ("gamma", "beta", "mean", "var"):
+            continue
+        if l1:
+            pen = pen + l1 * jnp.sum(jnp.abs(p))
+        if l2:
+            pen = pen + 0.5 * l2 * jnp.sum(p * p)
+    return pen

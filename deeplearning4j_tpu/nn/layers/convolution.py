@@ -1,0 +1,154 @@
+"""Convolutional layer implementations: Conv2D, Subsampling (pooling),
+BatchNormalization, LocalResponseNormalization.
+
+Reference: layers/convolution/ConvolutionLayer.java (im2col→gemm :120-151),
+subsampling/SubsamplingLayer.java, normalization/BatchNormalization.java
+(:96-205), normalization/LocalResponseNormalization.java.
+
+TPU-first: NHWC layout; conv is one `lax.conv_general_dilated` (XLA maps it
+onto the MXU directly — no im2col materialization); pooling is
+`lax.reduce_window`. Backward via jax.grad.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from deeplearning4j_tpu.nn.conf.enums import ConvolutionMode, PoolingType
+from deeplearning4j_tpu.nn.conf.layers import (
+    BatchNormalization,
+    ConvolutionLayer,
+    LocalResponseNormalization,
+    SubsamplingLayer,
+)
+from deeplearning4j_tpu.nn.layers.base import LayerImpl, apply_dropout, register_impl
+from deeplearning4j_tpu.nn.weights import init_weights
+from deeplearning4j_tpu.ops.activations import get_activation
+
+_DIMS = ("NHWC", "HWIO", "NHWC")
+
+
+def _padding(conf):
+    mode = conf.convolution_mode
+    if mode in (ConvolutionMode.SAME, "same"):
+        return "SAME"
+    if mode in (ConvolutionMode.VALID, "valid"):
+        return "VALID"
+    p = conf.padding
+    return [(int(p[0]), int(p[0])), (int(p[1]), int(p[1]))]
+
+
+@register_impl(ConvolutionLayer)
+class ConvolutionImpl(LayerImpl):
+    def init(self, conf, rng, dtype):
+        kh, kw = conf.kernel_size
+        shape = (int(kh), int(kw), conf.n_in, conf.n_out)
+        fan_in = conf.n_in * kh * kw
+        fan_out = conf.n_out * kh * kw
+        W = init_weights(rng, shape, conf.weight_init, conf.dist, dtype,
+                         fan_in=fan_in, fan_out=fan_out)
+        b = jnp.full((conf.n_out,), conf.bias_init or 0.0, dtype)
+        return {"W": W, "b": b}, {}
+
+    def apply(self, conf, params, state, x, *, train=False, rng=None, mask=None):
+        if conf.dropout:
+            x = apply_dropout(x, conf.dropout, rng, train=train)
+        z = lax.conv_general_dilated(
+            x, params["W"],
+            window_strides=tuple(int(s) for s in conf.stride),
+            padding=_padding(conf),
+            rhs_dilation=tuple(int(d) for d in conf.dilation),
+            dimension_numbers=_DIMS,
+            preferred_element_type=jnp.float32 if x.dtype == jnp.bfloat16 else None,
+        )
+        z = (z + params["b"]).astype(x.dtype)
+        return get_activation(conf.activation)(z), state
+
+
+@register_impl(SubsamplingLayer)
+class SubsamplingImpl(LayerImpl):
+    def apply(self, conf, params, state, x, *, train=False, rng=None, mask=None):
+        kh, kw = (int(k) for k in conf.kernel_size)
+        sh, sw = (int(s) for s in conf.stride)
+        pad = _padding(conf)
+        if isinstance(pad, list):
+            pad4 = [(0, 0), pad[0], pad[1], (0, 0)]
+        else:
+            pad4 = pad
+        window = (1, kh, kw, 1)
+        strides = (1, sh, sw, 1)
+        pt = conf.pooling_type
+        if pt in (PoolingType.MAX, "max"):
+            return (
+                lax.reduce_window(x, -jnp.inf, lax.max, window, strides, pad4),
+                state,
+            )
+        if pt in (PoolingType.SUM, "sum"):
+            return lax.reduce_window(x, 0.0, lax.add, window, strides, pad4), state
+        if pt in (PoolingType.AVG, "avg"):
+            s = lax.reduce_window(x, 0.0, lax.add, window, strides, pad4)
+            ones = jnp.ones_like(x)
+            denom = lax.reduce_window(ones, 0.0, lax.add, window, strides, pad4)
+            return s / denom, state
+        if pt in (PoolingType.PNORM, "pnorm"):
+            p = float(conf.pnorm)
+            s = lax.reduce_window(jnp.abs(x) ** p, 0.0, lax.add, window, strides, pad4)
+            return s ** (1.0 / p), state
+        if pt in (PoolingType.NONE, "none"):
+            return x, state
+        raise ValueError(f"pooling type {pt}")
+
+
+@register_impl(BatchNormalization)
+class BatchNormImpl(LayerImpl):
+    """Train: normalize by batch stats, update running stats in `state`
+    (reference :191-197). Eval: use running stats. For NHWC input the stats
+    are per-channel; for 2-D input per-feature."""
+
+    def init(self, conf, rng, dtype):
+        n = conf.n_out or conf.n_in
+        params = {}
+        if not conf.lock_gamma_beta:
+            params["gamma"] = jnp.full((n,), conf.gamma, dtype)
+            params["beta"] = jnp.full((n,), conf.beta, dtype)
+        state = {"mean": jnp.zeros((n,), jnp.float32), "var": jnp.ones((n,), jnp.float32),
+                 "count": jnp.zeros((), jnp.float32)}
+        return params, state
+
+    def apply(self, conf, params, state, x, *, train=False, rng=None, mask=None):
+        axes = tuple(range(x.ndim - 1))  # all but channel/feature
+        if train:
+            mean = jnp.mean(x.astype(jnp.float32), axis=axes)
+            var = jnp.var(x.astype(jnp.float32), axis=axes)
+            decay = conf.decay
+            new_state = {
+                "mean": decay * state["mean"] + (1 - decay) * mean,
+                "var": decay * state["var"] + (1 - decay) * var,
+                "count": state["count"] + 1,
+            }
+        else:
+            mean, var = state["mean"], state["var"]
+            new_state = state
+        xn = (x - mean.astype(x.dtype)) * lax.rsqrt(var + conf.eps).astype(x.dtype)
+        if "gamma" in params:
+            xn = xn * params["gamma"] + params["beta"]
+        return get_activation(conf.activation or "identity")(xn), new_state
+
+
+@register_impl(LocalResponseNormalization)
+class LRNImpl(LayerImpl):
+    """Cross-channel LRN on NHWC: y = x / (k + alpha*sum_local x^2)^beta."""
+
+    def apply(self, conf, params, state, x, *, train=False, rng=None, mask=None):
+        n = int(conf.n)
+        half = n // 2
+        sq = x * x
+        # sum over a window of `n` adjacent channels via reduce_window on last axis
+        window = (1,) * (x.ndim - 1) + (n,)
+        strides = (1,) * x.ndim
+        pad = [(0, 0)] * (x.ndim - 1) + [(half, n - 1 - half)]
+        ssum = lax.reduce_window(sq, 0.0, lax.add, window, strides, pad)
+        denom = (conf.k + conf.alpha * ssum) ** conf.beta
+        return x / denom, state

@@ -1,0 +1,220 @@
+"""Recurrent layer implementations: GravesLSTM (peepholes), LSTM, bidirectional
+LSTM, GRU.
+
+Reference: layers/recurrent/GravesLSTM.java + LSTMHelpers.java (fwd
+activateHelper:50 — per-timestep loop with gate ops :155-180; bwd
+backpropGradientHelper:210 — manual BPTT), GravesBidirectionalLSTM.java,
+GRU.java; peephole parameter layout GravesLSTMParamInitializer.java:86-87
+(input W nIn×4nL, recurrent W nL×(4nL+3) — the +3 columns are peepholes).
+
+TPU-first design:
+- activations are [batch, time, features]
+- the input projection for ALL timesteps is one large [B*T, n_in]×[n_in, 4n]
+  matmul (MXU-friendly), hoisted out of the recurrence
+- the recurrence itself is `lax.scan` (compiled once, no Python loop)
+- backward is jax.grad through the scan — no hand-written BPTT
+- peepholes are stored as three [n_out] vectors rather than packed columns
+- masking: a [B, T] mask freezes the carry and zeroes output at masked steps
+  (reference per-layer mask support / setLayerMaskArrays)
+- streaming inference (reference rnnTimeStep:2147): `step()` advances one
+  timestep with an explicit carry pytree returned to the host
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from deeplearning4j_tpu.nn.conf.layers import (
+    GravesBidirectionalLSTM,
+    GravesLSTM,
+    GRU,
+    LSTM,
+)
+from deeplearning4j_tpu.nn.layers.base import LayerImpl, apply_dropout, register_impl
+from deeplearning4j_tpu.nn.weights import init_weights
+from deeplearning4j_tpu.ops.activations import get_activation
+
+_SIGMOID = jax.nn.sigmoid
+
+
+def _lstm_init(conf, rng, dtype, peephole):
+    n_in, n = conf.n_in, conf.n_out
+    k1, k2, k3 = jax.random.split(rng, 3)
+    params = {
+        "W": init_weights(k1, (n_in, 4 * n), conf.weight_init, conf.dist, dtype,
+                          fan_in=n_in, fan_out=n),
+        "RW": init_weights(k2, (n, 4 * n), conf.weight_init, conf.dist, dtype,
+                           fan_in=n, fan_out=n),
+        "b": jnp.zeros((4 * n,), dtype).at[n:2 * n].set(conf.forget_gate_bias_init),
+    }
+    if peephole:
+        # p_i, p_f act on c_{t-1}; p_o on c_t (Graves 2013 eqs. 7-9)
+        params["pi"] = jnp.zeros((n,), dtype)
+        params["pf"] = jnp.zeros((n,), dtype)
+        params["po"] = jnp.zeros((n,), dtype)
+    return params, {}
+
+
+def _lstm_cell(params, act, peephole):
+    n = params["RW"].shape[0]
+
+    def cell(carry, zx_m):
+        h, c = carry
+        zx, m = zx_m  # zx: [B, 4n] precomputed x-projection; m: [B, 1] mask
+        z = zx + h @ params["RW"]
+        zi, zf, zg, zo = (z[:, :n], z[:, n:2 * n], z[:, 2 * n:3 * n], z[:, 3 * n:])
+        if peephole:
+            zi = zi + c * params["pi"]
+            zf = zf + c * params["pf"]
+        i = _SIGMOID(zi)
+        f = _SIGMOID(zf)
+        g = jnp.tanh(zg)
+        c_new = f * c + i * g
+        if peephole:
+            zo = zo + c_new * params["po"]
+        o = _SIGMOID(zo)
+        h_new = o * act(c_new)
+        if m is not None:
+            h_new = jnp.where(m, h_new, h)
+            c_new = jnp.where(m, c_new, c)
+        return (h_new, c_new), h_new
+
+    return cell
+
+
+def _scan_time(cell, carry, zx, mask, reverse=False):
+    # zx: [B, T, 4n] → scan over axis 1 via transpose to [T, B, 4n]
+    zx_t = jnp.swapaxes(zx, 0, 1)
+    m_t = None
+    if mask is not None:
+        m_t = jnp.swapaxes(mask.astype(bool)[..., None], 0, 1)  # [T, B, 1]
+    else:
+        m_t = jnp.ones((zx_t.shape[0], zx_t.shape[1], 1), bool)
+    carry, ys = jax.lax.scan(cell, carry, (zx_t, m_t), reverse=reverse)
+    return carry, jnp.swapaxes(ys, 0, 1)  # [B, T, n]
+
+
+class _BaseLSTMImpl(LayerImpl):
+    peephole = False
+
+    def init(self, conf, rng, dtype):
+        return _lstm_init(conf, rng, dtype, self.peephole)
+
+    def initial_carry(self, conf, batch, dtype=jnp.float32):
+        n = conf.n_out
+        return (jnp.zeros((batch, n), dtype), jnp.zeros((batch, n), dtype))
+
+    def apply(self, conf, params, state, x, *, train=False, rng=None, mask=None,
+              initial_carry=None, return_carry=False):
+        if conf.dropout:
+            x = apply_dropout(x, conf.dropout, rng, train=train)
+        act = get_activation(conf.activation or "tanh")
+        zx = x @ params["W"] + params["b"]  # [B, T, 4n] — one big MXU matmul
+        carry = initial_carry or self.initial_carry(conf, x.shape[0], x.dtype)
+        cell = _lstm_cell(params, act, self.peephole)
+        carry, ys = _scan_time(cell, carry, zx, mask)
+        if return_carry:
+            return ys, state, carry
+        return ys, state
+
+    def step(self, conf, params, carry, x_t):
+        """One streaming timestep (reference rnnTimeStep). x_t: [B, n_in]."""
+        act = get_activation(conf.activation or "tanh")
+        zx = x_t @ params["W"] + params["b"]
+        cell = _lstm_cell(params, act, self.peephole)
+        carry, h = cell(carry, (zx, None))
+        return carry, h
+
+
+@register_impl(GravesLSTM)
+class GravesLSTMImpl(_BaseLSTMImpl):
+    peephole = True
+
+
+@register_impl(LSTM)
+class LSTMImpl(_BaseLSTMImpl):
+    peephole = False
+
+
+@register_impl(GravesBidirectionalLSTM)
+class BiLSTMImpl(LayerImpl):
+    """Forward + backward Graves LSTMs, outputs summed (reference
+    GravesBidirectionalLSTM merges directions additively)."""
+
+    def init(self, conf, rng, dtype):
+        kf, kb = jax.random.split(rng)
+        pf, _ = _lstm_init(conf, kf, dtype, peephole=True)
+        pb, _ = _lstm_init(conf, kb, dtype, peephole=True)
+        return {"fwd": pf, "bwd": pb}, {}
+
+    def apply(self, conf, params, state, x, *, train=False, rng=None, mask=None):
+        if conf.dropout:
+            x = apply_dropout(x, conf.dropout, rng, train=train)
+        act = get_activation(conf.activation or "tanh")
+        n = conf.n_out
+        outs = []
+        for key, reverse in (("fwd", False), ("bwd", True)):
+            p = params[key]
+            zx = x @ p["W"] + p["b"]
+            carry = (jnp.zeros((x.shape[0], n), x.dtype), jnp.zeros((x.shape[0], n), x.dtype))
+            cell = _lstm_cell(p, act, True)
+            _, ys = _scan_time(cell, carry, zx, mask, reverse=reverse)
+            outs.append(ys)
+        return outs[0] + outs[1], state
+
+
+@register_impl(GRU)
+class GRUImpl(LayerImpl):
+    """GRU (reference layers/recurrent/GRU.java): r/u gates + candidate."""
+
+    def init(self, conf, rng, dtype):
+        n_in, n = conf.n_in, conf.n_out
+        k1, k2 = jax.random.split(rng)
+        return {
+            "W": init_weights(k1, (n_in, 3 * n), conf.weight_init, conf.dist, dtype,
+                              fan_in=n_in, fan_out=n),
+            "RW": init_weights(k2, (n, 3 * n), conf.weight_init, conf.dist, dtype,
+                               fan_in=n, fan_out=n),
+            "b": jnp.zeros((3 * n,), dtype),
+        }, {}
+
+    def initial_carry(self, conf, batch, dtype=jnp.float32):
+        return jnp.zeros((batch, conf.n_out), dtype)
+
+    def _cell(self, conf, params):
+        n = conf.n_out
+        act = get_activation(conf.activation or "tanh")
+
+        def cell(h, zx_m):
+            zx, m = zx_m
+            zr = zx[:, :n] + h @ params["RW"][:, :n]
+            zu = zx[:, n:2 * n] + h @ params["RW"][:, n:2 * n]
+            r = _SIGMOID(zr)
+            u = _SIGMOID(zu)
+            zc = zx[:, 2 * n:] + (r * h) @ params["RW"][:, 2 * n:]
+            c = act(zc)
+            h_new = u * h + (1 - u) * c
+            if m is not None:
+                h_new = jnp.where(m, h_new, h)
+            return h_new, h_new
+
+        return cell
+
+    def apply(self, conf, params, state, x, *, train=False, rng=None, mask=None,
+              initial_carry=None, return_carry=False):
+        if conf.dropout:
+            x = apply_dropout(x, conf.dropout, rng, train=train)
+        zx = x @ params["W"] + params["b"]
+        h0 = initial_carry if initial_carry is not None else self.initial_carry(
+            conf, x.shape[0], x.dtype)
+        carry, ys = _scan_time(self._cell(conf, params), h0, zx, mask)
+        if return_carry:
+            return ys, state, carry
+        return ys, state
+
+    def step(self, conf, params, carry, x_t):
+        zx = x_t @ params["W"] + params["b"]
+        cell = self._cell(conf, params)
+        h, y = cell(carry, (zx, None))
+        return h, y

@@ -1,0 +1,432 @@
+"""MultiLayerNetwork — the sequential-stack container.
+
+Reference: nn/multilayer/MultiLayerNetwork.java (2,367 LoC): init:349,
+fit(DataSetIterator):1011, pretrain:165, feedForward:614, backprop:1065,
+computeGradientAndScore:1781, doTruncatedBPTT, rnnTimeStep:2147,
+evaluate:2311, output:1500-1582, setLayerMaskArrays.
+
+TPU-native redesign:
+- params/state/opt_state are pytrees keyed by layer name (the reference's
+  flat 1×N view vector with per-layer views is replaced by the pytree
+  idiom; `params_flat`/`set_params_flat` provide the flat view for
+  parameter-averaging parity and serialization)
+- forward/backward/update is ONE jitted donated XLA computation
+  (SURVEY.md §3.1 TPU mapping); jax.grad replaces calcBackpropGradients
+- every data iterator is wrapped in AsyncDataSetIterator for host prefetch
+  (reference MultiLayerNetwork.fit:1014)
+- TBPTT runs the jitted step per truncation segment with explicit RNN
+  carries (stop-gradient between segments)
+- rnnTimeStep keeps a carry pytree on the host between calls
+"""
+
+from __future__ import annotations
+
+import copy
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from deeplearning4j_tpu.datasets.api import DataSet
+from deeplearning4j_tpu.datasets.async_iterator import AsyncDataSetIterator
+from deeplearning4j_tpu.datasets.iterators import DataSetIterator, ListDataSetIterator
+from deeplearning4j_tpu.nn.conf.enums import BackpropType, OptimizationAlgorithm
+from deeplearning4j_tpu.nn.conf.layers import (
+    BaseOutputLayer,
+    BaseRecurrentLayer,
+    RnnOutputLayer,
+)
+from deeplearning4j_tpu.nn.conf.neural_net_configuration import MultiLayerConfiguration
+from deeplearning4j_tpu.nn.layers import get_impl, l1_l2_penalty
+from deeplearning4j_tpu.nn.training import make_train_step
+from deeplearning4j_tpu.nn.updater import build_optimizer
+
+_DTYPES = {"float32": jnp.float32, "bfloat16": jnp.bfloat16, "float64": jnp.float64,
+           "float16": jnp.float16}
+
+
+class MultiLayerNetwork:
+    def __init__(self, conf: MultiLayerConfiguration):
+        self.conf = conf
+        self.layer_confs = list(conf.layers)
+        self.layer_names = [
+            lc.name if lc.name else f"layer_{i}" for i, lc in enumerate(self.layer_confs)
+        ]
+        self.impls = [get_impl(lc) for lc in self.layer_confs]
+        self.params = None
+        self.state = None
+        self.opt_state = None
+        self.tx = None
+        self.listeners = []
+        self.iteration_count = 0
+        self.epoch_count = 0
+        self._train_step = None
+        self._output_jit = None
+        self._rng = None
+        self._rnn_carries = None  # streaming inference state
+        self._mesh = None
+        self.score_value = float("nan")
+
+    # ------------------------------------------------------------------ init
+    @property
+    def param_dtype(self):
+        return _DTYPES[self.conf.conf.param_dtype]
+
+    @property
+    def compute_dtype(self):
+        return _DTYPES[self.conf.conf.dtype]
+
+    def init(self, seed: Optional[int] = None):
+        """Allocate parameters (reference init:349)."""
+        g = self.conf.conf
+        key = jax.random.PRNGKey(g.seed if seed is None else seed)
+        self._rng = jax.random.fold_in(key, 1)
+        params, state = {}, {}
+        keys = jax.random.split(key, max(len(self.layer_confs), 1))
+        for name, lc, impl, k in zip(self.layer_names, self.layer_confs, self.impls, keys):
+            p, s = impl.init(lc, k, self.param_dtype)
+            params[name] = p
+            state[name] = s
+        self.params = params
+        self.state = state
+        self.tx = build_optimizer(g, dict(zip(self.layer_names, self.layer_confs)))
+        self.opt_state = self.tx.init(params)
+        return self
+
+    def set_optimizer(self, tx: optax.GradientTransformation):
+        """Custom updater hook (reference Updater.CUSTOM)."""
+        self.tx = tx
+        self.opt_state = tx.init(self.params)
+        self._train_step = None
+
+    def set_listeners(self, *listeners):
+        self.listeners = list(listeners)
+
+    def set_mesh(self, mesh):
+        """Enable data-parallel training over a jax.sharding.Mesh with a
+        'data' axis (replaces the Spark parameter-averaging master)."""
+        self._mesh = mesh
+        self._train_step = None
+
+    # --------------------------------------------------------------- forward
+    def _next_rng(self):
+        self._rng, k = jax.random.split(self._rng)
+        return k
+
+    def _forward(self, params, state, x, *, train, rng, mask=None,
+                 carries=None, collect=False, to_layer=None):
+        """Walk the stack (reference feedForwardToLayer:637). Returns
+        (activations list if collect else final activation, new_state,
+        new_carries)."""
+        g = self.conf.conf
+        cdtype = self.compute_dtype
+        if jnp.issubdtype(jnp.asarray(x).dtype, jnp.floating):
+            x = jnp.asarray(x, cdtype)
+        acts = []
+        new_state = {}
+        new_carries = {}
+        n_layers = len(self.layer_confs) if to_layer is None else to_layer
+        rngs = (jax.random.split(rng, max(n_layers, 1)) if rng is not None
+                else [None] * n_layers)
+        for i in range(n_layers):
+            name, lc, impl = self.layer_names[i], self.layer_confs[i], self.impls[i]
+            proc = self.conf.get_preprocessor(i)
+            if proc is not None:
+                x = proc.pre_process(x)
+            p = params.get(name, {})
+            if cdtype != self.param_dtype:
+                p = jax.tree.map(
+                    lambda a: a.astype(cdtype)
+                    if jnp.issubdtype(a.dtype, jnp.floating) else a, p)
+            want_carry = (carries is not None and isinstance(lc, BaseRecurrentLayer)
+                          and hasattr(impl, "initial_carry"))
+
+            def run(p_, s_, x_, _impl=impl, _lc=lc, _rng=rngs[i], _wc=want_carry,
+                    _carry=(carries.get(name) if want_carry else None)):
+                kw = {"initial_carry": _carry, "return_carry": True} if _wc else {}
+                return _impl.apply(_lc, p_, s_, x_, train=train, rng=_rng,
+                                   mask=mask, **kw)
+
+            if g.remat:
+                run = jax.checkpoint(run)
+            out = run(p, state.get(name, {}), x)
+            if want_carry:
+                x, s, carry = out
+                new_carries[name] = carry
+            else:
+                x, s = out
+            new_state[name] = s
+            if collect:
+                acts.append(x)
+        # passthrough state for layers beyond to_layer
+        for j in range(n_layers, len(self.layer_confs)):
+            new_state[self.layer_names[j]] = state.get(self.layer_names[j], {})
+        if collect:
+            return acts, new_state, new_carries
+        return x, new_state, new_carries
+
+    def _loss(self, params, state, rng, batch, train=True):
+        """Forward to the output layer's loss + L1/L2 (reference
+        computeGradientAndScore:1781). Returns (loss, (new_state, extras));
+        extras holds RNN carries when batch supplies `carries` (TBPTT)."""
+        x = batch["features"]
+        labels = batch["labels"]
+        fmask = batch.get("features_mask")
+        lmask = batch.get("labels_mask")
+        carries = batch.get("carries")
+        out_conf = self.layer_confs[-1]
+        if not isinstance(out_conf, BaseOutputLayer):
+            raise ValueError("Last layer must be an OutputLayer to compute a score")
+        n = len(self.layer_confs)
+        k_body, k_out = (jax.random.split(rng) if rng is not None else (None, None))
+        h, new_state, new_carries = self._forward(
+            params, state, x, train=train, rng=k_body, mask=fmask,
+            carries=carries, to_layer=n - 1)
+        proc = self.conf.get_preprocessor(n - 1)
+        if proc is not None:
+            h = proc.pre_process(h)
+        out_impl = self.impls[-1]
+        out_name = self.layer_names[-1]
+        mask = lmask if lmask is not None else (
+            fmask if isinstance(out_conf, RnnOutputLayer) else None)
+        loss = out_impl.loss(out_conf, params[out_name], h, labels, train=train,
+                             rng=k_out, mask=mask)
+        new_state[out_name] = state.get(out_name, {})
+        # L1/L2 (reference BaseLayer calcL1/calcL2 summed into score)
+        for name, lc in zip(self.layer_names, self.layer_confs):
+            loss = loss + l1_l2_penalty(lc, params[name])
+        extras = {"carries": new_carries} if carries is not None else {}
+        return loss, (new_state, extras)
+
+    # ------------------------------------------------------------------- fit
+    def _get_train_step(self):
+        if self._train_step is None:
+            confs = dict(zip(self.layer_names, self.layer_confs))
+            self._train_step = make_train_step(self._loss, self.tx, confs,
+                                               mesh=self._mesh)
+        return self._train_step
+
+    @staticmethod
+    def _batch_dict(ds: DataSet):
+        b = {"features": jnp.asarray(ds.features), "labels": jnp.asarray(ds.labels)}
+        if ds.features_mask is not None:
+            b["features_mask"] = jnp.asarray(ds.features_mask)
+        if ds.labels_mask is not None:
+            b["labels_mask"] = jnp.asarray(ds.labels_mask)
+        return b
+
+    def fit(self, data, labels=None, epochs: int = 1):
+        """Train (reference fit(DataSetIterator):1011). Accepts a
+        DataSetIterator, a DataSet, or (features, labels) arrays."""
+        if self.params is None:
+            self.init()
+        if labels is not None:
+            data = DataSet(data, labels)
+        if isinstance(data, DataSet):
+            data = ListDataSetIterator([data])
+        it = data
+        if isinstance(it, DataSetIterator) and it.async_supported() and not isinstance(
+                it, AsyncDataSetIterator):
+            it = AsyncDataSetIterator(it)
+        if self.conf.pretrain:
+            self.pretrain(it)
+            it.reset()
+        if not self.conf.backprop:
+            return self
+        step = self._get_train_step()
+        g = self.conf.conf
+        for _ in range(epochs):
+            it.reset()
+            while it.has_next():
+                ds = it.next()
+                if (self.conf.backprop_type in (BackpropType.TRUNCATED_BPTT, "truncated_bptt")
+                        and np.asarray(ds.features).ndim == 3
+                        and ds.features.shape[1] > self.conf.tbptt_fwd_length):
+                    self._fit_tbptt(ds, step)
+                    continue
+                batch = self._batch_dict(ds)
+                # reference runs `iterations` optimizer passes per minibatch
+                # (StochasticGradientDescent.java:55)
+                for _i in range(max(1, g.iterations)):
+                    self.params, self.opt_state, self.state, loss, _ = step(
+                        self.params, self.opt_state, self.state,
+                        self._next_rng(), batch)
+                    self.score_value = float(loss)
+                    self.iteration_count += 1
+                    for lst in self.listeners:
+                        lst.iteration_done(self, self.iteration_count)
+            self.epoch_count += 1
+        return self
+
+    def _initial_carries(self, batch_size):
+        """Zero carries for every recurrent layer (keyed by layer name)."""
+        carries = {}
+        for name, lc, impl in zip(self.layer_names, self.layer_confs, self.impls):
+            if isinstance(lc, BaseRecurrentLayer) and hasattr(impl, "initial_carry"):
+                carries[name] = impl.initial_carry(lc, batch_size, self.compute_dtype)
+        return carries
+
+    def _fit_tbptt(self, ds: DataSet, step):
+        """Truncated BPTT (reference doTruncatedBPTT): slide a window of
+        tbptt_fwd_length over time. RNN carries flow between segments
+        (threaded through the jitted step as batch inputs/extras) but
+        gradients do not — each segment is one jitted step, so the gradient
+        truncation length equals the forward window (the reference's default
+        fwdLen == backLen configuration)."""
+        T = ds.features.shape[1]
+        L = self.conf.tbptt_fwd_length
+        carries = self._initial_carries(ds.features.shape[0])
+        for t0 in range(0, T, L):
+            sub = DataSet(
+                ds.features[:, t0:t0 + L],
+                ds.labels[:, t0:t0 + L] if ds.labels.ndim == 3 else ds.labels,
+                None if ds.features_mask is None else ds.features_mask[:, t0:t0 + L],
+                None if ds.labels_mask is None else ds.labels_mask[:, t0:t0 + L],
+            )
+            batch = self._batch_dict(sub)
+            batch["carries"] = carries
+            self.params, self.opt_state, self.state, loss, extras = step(
+                self.params, self.opt_state, self.state, self._next_rng(), batch)
+            carries = extras.get("carries", carries)
+            self.score_value = float(loss)
+            self.iteration_count += 1
+            for lst in self.listeners:
+                lst.iteration_done(self, self.iteration_count)
+
+    # -------------------------------------------------------------- pretrain
+    def pretrain(self, it, epochs: int = 1):
+        """Greedy layer-wise pretraining (reference pretrain:165): for each
+        pretrain layer (RBM/AutoEncoder), train on the activations of the
+        stack below it."""
+        if self.params is None:
+            self.init()
+        if isinstance(it, DataSet):
+            it = ListDataSetIterator([it])
+        for i, (name, lc, impl) in enumerate(
+                zip(self.layer_names, self.layer_confs, self.impls)):
+            if not lc.is_pretrain_layer():
+                continue
+            tx = build_optimizer(self.conf.conf, {name: lc})
+            opt = tx.init(self.params[name])
+
+            def ptrain_loss(p, rng, x):
+                return impl.pretrain_loss(lc, p, x, rng)
+
+            @jax.jit
+            def pstep(p, opt_state, rng, x):
+                loss, grads = jax.value_and_grad(ptrain_loss)(p, rng, x)
+                updates, opt_state = tx.update(grads, opt_state, p)
+                return optax.apply_updates(p, updates), opt_state, loss
+
+            featurize = None
+            if i > 0:
+                featurize = jax.jit(
+                    lambda p, s, x: self._forward(p, s, x, train=False, rng=None,
+                                                  to_layer=i)[0])
+            for _ in range(epochs):
+                it.reset()
+                while it.has_next():
+                    ds = it.next()
+                    x = jnp.asarray(ds.features, self.compute_dtype)
+                    if featurize is not None:
+                        x = featurize(self.params, self.state, x)
+                    p_new, opt, loss = pstep(self.params[name], opt, self._next_rng(), x)
+                    self.params = dict(self.params, **{name: p_new})
+                    self.score_value = float(loss)
+        return self
+
+    # ------------------------------------------------------------- inference
+    def feed_forward(self, x, train: bool = False):
+        """All layer activations (reference feedForward:614)."""
+        acts, _, _ = self._forward(self.params, self.state, jnp.asarray(x),
+                                   train=train, rng=self._next_rng() if train else None,
+                                   collect=True)
+        return acts
+
+    def output(self, x, train: bool = False, mask=None):
+        """Network output (reference output:1500-1582)."""
+        if self._output_jit is None:
+            def _out(params, state, x, mask):
+                y, _, _ = self._forward(params, state, x, train=False, rng=None,
+                                        mask=mask)
+                return y
+            self._output_jit = jax.jit(_out)
+        if train:
+            y, _, _ = self._forward(self.params, self.state, jnp.asarray(x),
+                                    train=True, rng=self._next_rng(), mask=mask)
+            return y
+        return self._output_jit(self.params, self.state, jnp.asarray(x), mask)
+
+    def predict(self, x):
+        """Class indices (reference predict)."""
+        return np.asarray(jnp.argmax(self.output(x), axis=-1))
+
+    def score(self, dataset: DataSet = None, training: bool = False):
+        """Loss on a dataset (reference score()). training=False uses
+        inference-mode forward (BatchNorm running stats, no dropout)."""
+        if dataset is None:
+            return self.score_value
+        batch = self._batch_dict(dataset)
+        loss, _ = self._loss(self.params, self.state, None, batch, train=training)
+        return float(loss)
+
+    def evaluate(self, it, top_n: int = 1):
+        """Classification evaluation (reference evaluate:2311)."""
+        from deeplearning4j_tpu.eval.evaluation import Evaluation
+
+        ev = Evaluation()
+        if isinstance(it, DataSet):
+            it = ListDataSetIterator([it])
+        it.reset()
+        while it.has_next():
+            ds = it.next()
+            out = self.output(ds.features)
+            ev.eval(ds.labels, np.asarray(out), mask=ds.labels_mask)
+        return ev
+
+    # ------------------------------------------------- streaming RNN inference
+    def rnn_clear_previous_state(self):
+        self._rnn_carries = None
+
+    def rnn_time_step(self, x):
+        """Stateful single/multi-step inference (reference rnnTimeStep:2147).
+        x: [batch, n_in] (one step) or [batch, time, n_in]."""
+        x = jnp.asarray(x, self.compute_dtype)
+        single = x.ndim == 2
+        if single:
+            x = x[:, None, :]
+        carries = self._rnn_carries or {}
+        y, _, new_carries = self._forward(self.params, self.state, x, train=False,
+                                          rng=None, carries=carries)
+        self._rnn_carries = {**carries, **new_carries}
+        return y[:, -1, :] if single and y.ndim == 3 else y
+
+    # -------------------------------------------------------- params plumbing
+    def num_params(self) -> int:
+        return sum(int(np.prod(p.shape)) for p in jax.tree.leaves(self.params))
+
+    def params_flat(self) -> np.ndarray:
+        """Flat parameter vector (reference params():deterministic layer order)
+        for averaging/serialization parity."""
+        leaves = jax.tree.leaves(self.params)
+        return np.concatenate([np.asarray(l).ravel() for l in leaves]) if leaves else np.zeros(0)
+
+    def set_params_flat(self, flat: np.ndarray):
+        leaves, treedef = jax.tree.flatten(self.params)
+        out, off = [], 0
+        for l in leaves:
+            n = int(np.prod(l.shape))
+            out.append(jnp.asarray(flat[off:off + n], l.dtype).reshape(l.shape))
+            off += n
+        self.params = jax.tree.unflatten(treedef, out)
+
+    def clone(self) -> "MultiLayerNetwork":
+        net = MultiLayerNetwork(copy.deepcopy(self.conf))
+        net.init()
+        if self.params is not None:
+            net.params = jax.tree.map(lambda x: x, self.params)
+            net.state = jax.tree.map(lambda x: x, self.state)
+            net.opt_state = self.opt_state
+        return net

@@ -1,0 +1,73 @@
+"""Jitted training step assembly.
+
+The reference's inner optimization block (SURVEY.md §3.1: computeGradientAndScore
+→ updater → stepFunction.step) becomes ONE donated-buffer XLA computation:
+loss+grad via jax.value_and_grad, gradient normalization, optax update,
+parameter application. The host keeps only the minibatch loop.
+
+Data parallelism: when a `mesh` is given, the step is jitted with batch
+inputs sharded over the mesh's 'data' axis and params replicated — XLA
+inserts the gradient allreduce over ICI automatically (the BASELINE.json
+"param-avg → ICI allreduce" goal; replaces
+SparkDl4jMultiLayer.runIteration's broadcast/accumulator round-trip,
+reference spark/impl/multilayer/SparkDl4jMultiLayer.java:365-452).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import optax
+
+from deeplearning4j_tpu.nn.updater import normalize_gradients
+
+
+def make_train_step(loss_fn, tx, layer_confs_by_name, mesh=None,
+                    donate=True):
+    """loss_fn(params, state, rng, batch) -> (loss, (new_state, extras)).
+
+    batch is a dict pytree {features, labels, features_mask?, labels_mask?,
+    carries?}; extras carries auxiliary outputs (e.g. RNN carries for TBPTT).
+    Returns step(params, opt_state, state, rng, batch) -> (params, opt_state,
+    state, loss, extras).
+    """
+
+    def step(params, opt_state, state, rng, batch):
+        (loss, aux), grads = jax.value_and_grad(loss_fn, has_aux=True)(
+            params, state, rng, batch
+        )
+        new_state, extras = aux if isinstance(aux, tuple) else (aux, {})
+        grads = normalize_gradients(grads, layer_confs_by_name)
+        updates, opt_state = tx.update(grads, opt_state, params)
+        params = optax.apply_updates(params, updates)
+        return params, opt_state, new_state, loss, extras
+
+    donate_argnums = (0, 1, 2) if donate else ()
+    if mesh is not None:
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        repl = NamedSharding(mesh, P())
+        data = NamedSharding(mesh, P("data"))
+        # sharding pytree prefixes: one sharding per argument applies to all
+        # its leaves — batch leaves are sharded on the 'data' mesh axis
+        return jax.jit(
+            step,
+            donate_argnums=donate_argnums,
+            in_shardings=(repl, repl, repl, repl, data),
+            out_shardings=(repl, repl, repl, repl, repl),
+        )
+    return jax.jit(step, donate_argnums=donate_argnums)
+
+
+def make_eval_step(output_fn):
+    """output_fn(params, state, features, mask) -> activations."""
+    return jax.jit(partial(output_fn))
+
+
+def tree_cast(tree, dtype):
+    return jax.tree.map(
+        lambda x: x.astype(dtype) if jnp.issubdtype(x.dtype, jnp.floating) else x,
+        tree,
+    )

@@ -1,0 +1,57 @@
+"""Weight initialization schemes (reference nn/weights/WeightInit.java +
+WeightInitUtil.java: DISTRIBUTION, NORMALIZED, SIZE, UNIFORM, VI, ZERO,
+XAVIER, RELU)."""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from deeplearning4j_tpu.nn.conf.enums import WeightInit
+
+
+def init_weights(rng, shape, scheme, dist=None, dtype=jnp.float32,
+                 fan_in=None, fan_out=None):
+    """Sample a weight array per the named scheme.
+
+    fan_in/fan_out default to shape[0]/shape[-1] (dense convention); conv
+    layers pass receptive-field-scaled fans explicitly.
+    """
+    shape = tuple(shape)
+    if fan_in is None:
+        fan_in = shape[0] if len(shape) > 1 else shape[0]
+    if fan_out is None:
+        fan_out = shape[-1]
+    s = scheme if isinstance(scheme, str) else scheme.value
+    s = s.lower()
+    if s == WeightInit.ZERO:
+        return jnp.zeros(shape, dtype)
+    if s == WeightInit.DISTRIBUTION:
+        if dist is None:
+            raise ValueError("WeightInit.DISTRIBUTION requires a Distribution")
+        return dist.sample(rng, shape, dtype)
+    if s == WeightInit.XAVIER:
+        # Glorot normal: N(0, 2/(fan_in+fan_out)) — reference WeightInitUtil
+        std = jnp.sqrt(2.0 / (fan_in + fan_out))
+        return std * jax.random.normal(rng, shape, dtype)
+    if s == WeightInit.RELU:
+        # He normal: N(0, 2/fan_in)
+        std = jnp.sqrt(2.0 / fan_in)
+        return std * jax.random.normal(rng, shape, dtype)
+    if s == WeightInit.LECUN:
+        std = jnp.sqrt(1.0 / fan_in)
+        return std * jax.random.normal(rng, shape, dtype)
+    if s == WeightInit.UNIFORM:
+        a = 1.0 / jnp.sqrt(jnp.asarray(float(fan_in)))
+        return jax.random.uniform(rng, shape, dtype, minval=-a, maxval=a)
+    if s == WeightInit.VI:
+        # reference "variance init": uniform scaled by fan sum
+        r = jnp.sqrt(6.0 / (fan_in + fan_out))
+        return jax.random.uniform(rng, shape, dtype, minval=-r, maxval=r)
+    if s == WeightInit.SIZE:
+        r = jnp.sqrt(6.0 / (fan_in + fan_out))
+        return jax.random.uniform(rng, shape, dtype, minval=-r, maxval=r)
+    if s == WeightInit.NORMALIZED:
+        u = jax.random.uniform(rng, shape, dtype) - 0.5
+        return u / jnp.asarray(float(fan_in), dtype)
+    raise ValueError(f"Unknown weight init '{scheme}'")

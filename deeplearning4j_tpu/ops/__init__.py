@@ -1,0 +1,25 @@
+"""Op surface — the tensor substrate audit (SURVEY.md §7 step 1).
+
+The reference delegates all math to the external ND4J `INDArray` API
+(gemm, BLAS level-1, named transforms, im2col/col2im convolution, RNG —
+see reference deeplearning4j-core/pom.xml:53-59 and SURVEY.md §2.1).
+Here `jax.numpy`/`jax.lax` IS the array substrate: ops lower straight to
+XLA:TPU. This package pins the op surface the framework relies on:
+
+- activations:  named activation registry ("relu", "tanh", ... — the
+  reference resolves transforms by string name through its op executioner)
+- losses:      LossFunctions equivalents (reference ND4J LossFunctions)
+- conv:        lax.conv_general_dilated / reduce_window replace
+               Convolution.im2col/col2im (reference ConvolutionLayer.java:125,151)
+"""
+
+from deeplearning4j_tpu.ops.activations import Activations, get_activation
+from deeplearning4j_tpu.ops.losses import LossFunction, compute_loss, loss_fn
+
+__all__ = [
+    "Activations",
+    "get_activation",
+    "LossFunction",
+    "compute_loss",
+    "loss_fn",
+]

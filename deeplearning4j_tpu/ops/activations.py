@@ -1,0 +1,105 @@
+"""Named activation registry.
+
+The reference resolves activations by string name through the ND4J op
+executioner (e.g. "sigmoid"/"tanh" in LSTMHelpers.java:155-180, builder
+default "sigmoid" at NeuralNetConfiguration.java:339). Here each name maps
+to a pure jnp function that XLA fuses into adjacent matmuls — no custom
+derivative code is needed anywhere (jax.grad supplies every backward).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def _softmax(x):
+    # Row-wise softmax over the feature (last) axis, numerically stable.
+    return jax.nn.softmax(x, axis=-1)
+
+
+def _leakyrelu(x):
+    return jax.nn.leaky_relu(x, negative_slope=0.01)
+
+
+def _hardtanh(x):
+    return jnp.clip(x, -1.0, 1.0)
+
+
+def _hardsigmoid(x):
+    return jnp.clip(0.2 * x + 0.5, 0.0, 1.0)
+
+
+def _cube(x):
+    return x * x * x
+
+
+def _rectifiedtanh(x):
+    return jnp.maximum(0.0, jnp.tanh(x))
+
+
+_REGISTRY = {
+    "sigmoid": jax.nn.sigmoid,
+    "tanh": jnp.tanh,
+    "relu": jax.nn.relu,
+    "leakyrelu": _leakyrelu,
+    "softmax": _softmax,
+    "identity": lambda x: x,
+    "linear": lambda x: x,
+    "softplus": jax.nn.softplus,
+    "softsign": jax.nn.soft_sign,
+    "elu": jax.nn.elu,
+    "selu": jax.nn.selu,
+    "gelu": jax.nn.gelu,
+    "silu": jax.nn.silu,
+    "swish": jax.nn.silu,
+    "exp": jnp.exp,
+    "cube": _cube,
+    "hardtanh": _hardtanh,
+    "hardsigmoid": _hardsigmoid,
+    "rectifiedtanh": _rectifiedtanh,
+    "abs": jnp.abs,
+    "sqrt": jnp.sqrt,
+    "sin": jnp.sin,
+    "cos": jnp.cos,
+    "sign": jnp.sign,
+    "negative": jnp.negative,
+    "log": jnp.log,
+    "floor": jnp.floor,
+    "round": jnp.round,
+    "step": lambda x: (x > 0).astype(x.dtype),
+}
+
+
+class Activations:
+    """Enum-style constants for the activation names."""
+
+    SIGMOID = "sigmoid"
+    TANH = "tanh"
+    RELU = "relu"
+    LEAKYRELU = "leakyrelu"
+    SOFTMAX = "softmax"
+    IDENTITY = "identity"
+    SOFTPLUS = "softplus"
+    SOFTSIGN = "softsign"
+    ELU = "elu"
+    GELU = "gelu"
+    HARDTANH = "hardtanh"
+    CUBE = "cube"
+
+
+def get_activation(name):
+    """Resolve an activation by name. Accepts a callable as passthrough."""
+    if callable(name):
+        return name
+    try:
+        return _REGISTRY[name.lower()]
+    except KeyError:
+        raise ValueError(
+            f"Unknown activation '{name}'. Known: {sorted(_REGISTRY)}"
+        ) from None
+
+
+def register_activation(name, fn):
+    """Register a custom activation (reference allows custom transforms)."""
+    _REGISTRY[name.lower()] = fn

@@ -1,0 +1,130 @@
+"""Loss functions — ND4J `LossFunctions` equivalents.
+
+The reference's output layers score via external ND4J LossFunctions (used by
+BaseOutputLayer; SURVEY.md §2.1 L0 row). Names follow the reference's
+LossFunction enum (MSE, XENT, MCXENT, NEGATIVELOGLIKELIHOOD, EXPLL,
+RMSE_XENT, SQUARED_LOSS, RECONSTRUCTION_CROSSENTROPY, CUSTOM).
+
+Every loss here is a pure function of (labels, preactivation-or-activation)
+suitable for jax.grad; losses that fuse with their canonical activation
+(softmax+MCXENT, sigmoid+XENT) provide a numerically-stable fused path on
+logits — the TPU-native improvement over computing on activated outputs.
+
+All losses support an optional broadcastable `mask` (the reference's
+per-timestep label masking — MultiLayerNetwork.setLayerMaskArrays,
+Evaluation.evalTimeSeries at eval/Evaluation.java:189-221).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+_EPS = 1e-8
+
+
+class LossFunction:
+    """Enum-style constants matching the reference's LossFunctions.LossFunction."""
+
+    MSE = "mse"
+    L1 = "l1"
+    XENT = "xent"  # binary cross entropy
+    MCXENT = "mcxent"  # multi-class cross entropy
+    NEGATIVELOGLIKELIHOOD = "negativeloglikelihood"
+    EXPLL = "expll"  # exponential log likelihood (poisson)
+    RMSE_XENT = "rmse_xent"
+    SQUARED_LOSS = "squared_loss"
+    RECONSTRUCTION_CROSSENTROPY = "reconstruction_crossentropy"
+    HINGE = "hinge"
+    SQUARED_HINGE = "squared_hinge"
+    KL_DIVERGENCE = "kl_divergence"
+    COSINE_PROXIMITY = "cosine_proximity"
+    POISSON = "poisson"
+    MEAN_ABSOLUTE_ERROR = "mae"
+
+
+def _masked_mean(per_example, mask):
+    """Mean over examples; if mask given, weight rows and renormalize."""
+    if mask is None:
+        return jnp.mean(per_example)
+    mask = jnp.broadcast_to(mask, per_example.shape) if mask.ndim == per_example.ndim else mask
+    while mask.ndim < per_example.ndim:
+        mask = mask[..., None]
+    m = jnp.broadcast_to(mask, per_example.shape).astype(per_example.dtype)
+    return jnp.sum(per_example * m) / jnp.maximum(jnp.sum(m), 1.0)
+
+
+def compute_loss(name, labels, output, mask=None, *, logits=None):
+    """Compute a scalar loss.
+
+    `output` is the activated output; for softmax/sigmoid output layers pass
+    `logits` (the preactivation) as well so the fused stable path is used.
+    """
+    name = name.lower()
+    if name in (LossFunction.MCXENT, LossFunction.NEGATIVELOGLIKELIHOOD):
+        if logits is not None:
+            logp = jax.nn.log_softmax(logits, axis=-1)
+        else:
+            logp = jnp.log(jnp.clip(output, _EPS, 1.0))
+        per = -jnp.sum(labels * logp, axis=-1)
+        return _masked_mean(per, mask)
+    if name == LossFunction.XENT:
+        if logits is not None:
+            # stable sigmoid BCE on logits
+            per = jnp.sum(
+                jnp.maximum(logits, 0) - logits * labels + jnp.log1p(jnp.exp(-jnp.abs(logits))),
+                axis=-1,
+            )
+        else:
+            o = jnp.clip(output, _EPS, 1.0 - _EPS)
+            per = -jnp.sum(labels * jnp.log(o) + (1 - labels) * jnp.log1p(-o), axis=-1)
+        return _masked_mean(per, mask)
+    if name in (LossFunction.MSE, LossFunction.SQUARED_LOSS):
+        per = jnp.sum((labels - output) ** 2, axis=-1)
+        if name == LossFunction.MSE:
+            per = per / output.shape[-1]
+        return _masked_mean(per, mask)
+    if name in (LossFunction.L1, LossFunction.MEAN_ABSOLUTE_ERROR):
+        per = jnp.sum(jnp.abs(labels - output), axis=-1)
+        if name == LossFunction.MEAN_ABSOLUTE_ERROR:
+            per = per / output.shape[-1]
+        return _masked_mean(per, mask)
+    if name == LossFunction.RMSE_XENT:
+        o = jnp.clip(output, _EPS, 1.0 - _EPS)
+        xent = -(labels * jnp.log(o) + (1 - labels) * jnp.log1p(-o))
+        per = jnp.sqrt(jnp.sum(xent**2, axis=-1) + _EPS)
+        return _masked_mean(per, mask)
+    if name in (LossFunction.RECONSTRUCTION_CROSSENTROPY,):
+        o = jnp.clip(output, _EPS, 1.0 - _EPS)
+        per = -jnp.sum(labels * jnp.log(o) + (1 - labels) * jnp.log1p(-o), axis=-1)
+        return _masked_mean(per, mask)
+    if name in (LossFunction.EXPLL, LossFunction.POISSON):
+        o = jnp.clip(output, _EPS, None)
+        per = jnp.sum(o - labels * jnp.log(o), axis=-1)
+        return _masked_mean(per, mask)
+    if name == LossFunction.HINGE:
+        per = jnp.sum(jnp.maximum(0.0, 1.0 - labels * output), axis=-1)
+        return _masked_mean(per, mask)
+    if name == LossFunction.SQUARED_HINGE:
+        per = jnp.sum(jnp.maximum(0.0, 1.0 - labels * output) ** 2, axis=-1)
+        return _masked_mean(per, mask)
+    if name == LossFunction.KL_DIVERGENCE:
+        o = jnp.clip(output, _EPS, 1.0)
+        t = jnp.clip(labels, _EPS, 1.0)
+        per = jnp.sum(t * (jnp.log(t) - jnp.log(o)), axis=-1)
+        return _masked_mean(per, mask)
+    if name == LossFunction.COSINE_PROXIMITY:
+        ln = labels / (jnp.linalg.norm(labels, axis=-1, keepdims=True) + _EPS)
+        on = output / (jnp.linalg.norm(output, axis=-1, keepdims=True) + _EPS)
+        per = -jnp.sum(ln * on, axis=-1)
+        return _masked_mean(per, mask)
+    raise ValueError(f"Unknown loss function '{name}'")
+
+
+def loss_fn(name):
+    """Return a closure computing the named loss."""
+
+    def fn(labels, output, mask=None, logits=None):
+        return compute_loss(name, labels, output, mask, logits=logits)
+
+    return fn

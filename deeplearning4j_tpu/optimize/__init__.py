@@ -1,0 +1,9 @@
+"""Optimization: listeners + second-order solvers (reference optimize/)."""
+
+from deeplearning4j_tpu.optimize.listeners import (  # noqa: F401
+    CollectScoresIterationListener,
+    IterationListener,
+    ParamAndGradientIterationListener,
+    PerformanceListener,
+    ScoreIterationListener,
+)

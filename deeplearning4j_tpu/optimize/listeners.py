@@ -1,0 +1,102 @@
+"""Iteration listeners — the observability extension point.
+
+Reference: optimize/api/IterationListener.java (`iterationDone(Model, int)`),
+listeners/ScoreIterationListener.java, ParamAndGradientIterationListener.java;
+fired per iteration from StochasticGradientDescent.java:67. UI listeners
+(histogram/flow) build on the same hook (deeplearning4j_tpu/ui/).
+"""
+
+from __future__ import annotations
+
+import logging
+import time
+
+logger = logging.getLogger("deeplearning4j_tpu")
+
+
+class IterationListener:
+    def iteration_done(self, model, iteration: int) -> None:
+        raise NotImplementedError
+
+
+class ScoreIterationListener(IterationListener):
+    """Log the score every N iterations (reference ScoreIterationListener)."""
+
+    def __init__(self, print_iterations: int = 10, printer=None):
+        self.n = max(1, print_iterations)
+        self.printer = printer or (lambda s: logger.info(s))
+
+    def iteration_done(self, model, iteration):
+        if iteration % self.n == 0:
+            self.printer(f"Score at iteration {iteration} is {model.score_value}")
+
+
+class CollectScoresIterationListener(IterationListener):
+    """Collect (iteration, score) pairs in memory (reference
+    CollectScoresIterationListener)."""
+
+    def __init__(self, frequency: int = 1):
+        self.frequency = max(1, frequency)
+        self.scores: list[tuple[int, float]] = []
+
+    def iteration_done(self, model, iteration):
+        if iteration % self.frequency == 0:
+            self.scores.append((iteration, model.score_value))
+
+
+class PerformanceListener(IterationListener):
+    """Per-iteration wall-clock + throughput (new for the TPU build —
+    SURVEY.md §5 notes the reference has no profiling)."""
+
+    def __init__(self, frequency: int = 10, printer=None):
+        self.frequency = max(1, frequency)
+        self.printer = printer or (lambda s: logger.info(s))
+        self._last_time = None
+        self._last_iter = 0
+
+    def iteration_done(self, model, iteration):
+        now = time.perf_counter()
+        if self._last_time is not None and iteration % self.frequency == 0:
+            dt = now - self._last_time
+            its = iteration - self._last_iter
+            if dt > 0 and its > 0:
+                self.printer(
+                    f"iter {iteration}: {its / dt:.2f} it/s, score {model.score_value:.5f}")
+            self._last_time, self._last_iter = now, iteration
+        elif self._last_time is None:
+            self._last_time, self._last_iter = now, iteration
+
+
+class ParamAndGradientIterationListener(IterationListener):
+    """Parameter statistics per iteration (reference
+    ParamAndGradientIterationListener; gradients are internal to the jitted
+    step here, so this reports parameter norms/means)."""
+
+    def __init__(self, frequency: int = 1, printer=None):
+        import jax
+        import numpy as np
+
+        self._jax, self._np = jax, np
+        self.frequency = max(1, frequency)
+        self.printer = printer or (lambda s: logger.info(s))
+
+    def iteration_done(self, model, iteration):
+        if iteration % self.frequency:
+            return
+        np = self._np
+        for name, layer in (model.params or {}).items():
+            for pname, arr in layer.items():
+                a = np.asarray(arr)
+                self.printer(
+                    f"iter {iteration} {name}/{pname}: "
+                    f"mean {a.mean():.3e} absmax {np.abs(a).max():.3e} "
+                    f"l2 {np.linalg.norm(a.ravel()):.3e}")
+
+
+class ComposableIterationListener(IterationListener):
+    def __init__(self, *listeners):
+        self.listeners = listeners
+
+    def iteration_done(self, model, iteration):
+        for l in self.listeners:
+            l.iteration_done(model, iteration)

@@ -1,0 +1,1 @@
+from deeplearning4j_tpu.util.model_serializer import ModelSerializer  # noqa: F401

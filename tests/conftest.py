@@ -1,0 +1,42 @@
+"""Test configuration: force a pure-CPU JAX with 8 virtual devices so
+sharding tests run without TPU hardware (SURVEY.md §4 item 5 — the reference
+simulates clusters with Spark local[*]; XLA host devices play that role).
+
+The environment's sitecustomize registers an `axon` TPU backend in every
+python process; merely setting JAX_PLATFORMS=cpu is not enough because the
+axon get_backend hook initializes all backends (including the TPU tunnel)
+on first lookup. De-register the axon factory before any backend init.
+"""
+
+import os
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+import jax  # noqa: E402
+
+# sitecustomize imports jax before conftest runs, so the env var above is
+# too late for jax's config — update it through the config API instead.
+jax.config.update("jax_platforms", "cpu")
+
+try:  # pragma: no cover - only relevant inside the axon image
+    from jax._src import xla_bridge as _xb
+
+    if not _xb.backends_are_initialized():
+        _xb._backend_factories.pop("axon", None)
+except Exception:
+    pass
+
+import numpy as np  # noqa: E402
+import pytest  # noqa: E402
+
+assert jax.default_backend() == "cpu"
+
+
+@pytest.fixture
+def rng():
+    return np.random.default_rng(42)

@@ -1,0 +1,110 @@
+"""Config/serialization tests (reference test strategy §4 item 3: builder →
+JSON → fromJson round-trips)."""
+
+import dataclasses
+
+from deeplearning4j_tpu.nn.conf import (
+    ComputationGraphConfiguration,
+    ConvolutionLayer,
+    DenseLayer,
+    GravesLSTM,
+    InputType,
+    MultiLayerConfiguration,
+    NeuralNetConfiguration,
+    OutputLayer,
+    SubsamplingLayer,
+    Updater,
+    WeightInit,
+)
+
+
+def build_mlp_conf():
+    return (
+        NeuralNetConfiguration.builder()
+        .seed(7)
+        .learning_rate(0.05)
+        .updater(Updater.ADAM)
+        .weight_init(WeightInit.XAVIER)
+        .l2(1e-4)
+        .list()
+        .layer(DenseLayer(n_in=4, n_out=8, activation="relu"))
+        .layer(OutputLayer(n_in=8, n_out=3, activation="softmax",
+                           loss_function="mcxent"))
+        .build()
+    )
+
+
+def test_builder_inheritance():
+    conf = build_mlp_conf()
+    assert conf.conf.seed == 7
+    assert len(conf.layers) == 2
+    # global defaults inherited by layers
+    assert conf.layers[0].weight_init == "xavier"
+    assert conf.layers[0].l2 == 1e-4
+    # explicit per-layer values kept
+    assert conf.layers[0].activation == "relu"
+    assert conf.layers[1].activation == "softmax"
+
+
+def test_json_round_trip():
+    conf = build_mlp_conf()
+    s = conf.to_json()
+    back = MultiLayerConfiguration.from_json(s)
+    assert dataclasses.asdict(back) == dataclasses.asdict(conf)
+
+
+def test_cnn_shape_inference():
+    """ConvolutionLayerSetup analogue: n_in + preprocessors auto-derived."""
+    conf = (
+        NeuralNetConfiguration.builder()
+        .seed(1)
+        .list()
+        .layer(ConvolutionLayer(n_out=6, kernel_size=(5, 5), stride=(1, 1),
+                                activation="relu"))
+        .layer(SubsamplingLayer(kernel_size=(2, 2), stride=(2, 2)))
+        .layer(DenseLayer(n_out=32, activation="relu"))
+        .layer(OutputLayer(n_out=10, activation="softmax"))
+        .set_input_type(InputType.convolutional(28, 28, 1))
+        .build()
+    )
+    conv = conf.layers[0]
+    assert conv.n_in == 1
+    dense = conf.layers[2]
+    # 28 -5+1 = 24 → pool/2 → 12 → 12*12*6
+    assert dense.n_in == 12 * 12 * 6
+    assert conf.layers[3].n_in == 32
+    # a CnnToFeedForward preprocessor was inserted before the dense layer
+    assert conf.get_preprocessor(2) is not None
+
+
+def test_graph_builder_topo_and_json():
+    g = (
+        NeuralNetConfiguration.builder()
+        .seed(3)
+        .graph_builder()
+        .add_inputs("in")
+        .add_layer("d1", DenseLayer(n_in=4, n_out=8, activation="relu"), "in")
+        .add_layer("d2", DenseLayer(n_in=4, n_out=8, activation="relu"), "in")
+        .add_layer("out", OutputLayer(n_in=16, n_out=3, activation="softmax"), "d1", "d2")
+        .set_outputs("out")
+        .build()
+    )
+    order = g.topological_order()
+    assert order.index("in") < order.index("d1")
+    assert order.index("d1") < order.index("out")
+    s = g.to_json()
+    back = ComputationGraphConfiguration.from_json(s)
+    assert dataclasses.asdict(back) == dataclasses.asdict(g)
+
+
+def test_rnn_shape_inference():
+    conf = (
+        NeuralNetConfiguration.builder()
+        .list()
+        .layer(GravesLSTM(n_out=16, activation="tanh"))
+        .layer(OutputLayer(n_out=5, activation="softmax"))
+        .set_input_type(InputType.recurrent(10))
+        .build()
+    )
+    assert conf.layers[0].n_in == 10
+    assert conf.layers[1].n_in == 16

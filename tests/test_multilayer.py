@@ -1,0 +1,160 @@
+"""MultiLayerNetwork integration tests (reference test strategy §4 item 4:
+MultiLayerTest, convergence smoke tests on tiny data)."""
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.datasets.api import DataSet
+from deeplearning4j_tpu.datasets.iterators import ListDataSetIterator
+from deeplearning4j_tpu.nn.conf import (
+    DenseLayer,
+    GravesLSTM,
+    InputType,
+    NeuralNetConfiguration,
+    OutputLayer,
+    RnnOutputLayer,
+    Updater,
+)
+from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+from deeplearning4j_tpu.optimize.listeners import CollectScoresIterationListener
+
+
+def make_xor_data(n=128, seed=0):
+    rng = np.random.default_rng(seed)
+    x = rng.uniform(-1, 1, size=(n, 2)).astype(np.float32)
+    y = ((x[:, 0] > 0) ^ (x[:, 1] > 0)).astype(np.int64)
+    labels = np.eye(2, dtype=np.float32)[y]
+    return DataSet(x, labels)
+
+
+def test_mlp_learns_xor():
+    conf = (
+        NeuralNetConfiguration.builder()
+        .seed(42)
+        .learning_rate(0.1)
+        .updater(Updater.ADAM)
+        .list()
+        .layer(DenseLayer(n_in=2, n_out=16, activation="tanh"))
+        .layer(OutputLayer(n_in=16, n_out=2, activation="softmax",
+                           loss_function="mcxent"))
+        .build()
+    )
+    net = MultiLayerNetwork(conf).init()
+    ds = make_xor_data()
+    collector = CollectScoresIterationListener()
+    net.set_listeners(collector)
+    net.fit(ListDataSetIterator([ds]), epochs=150)
+    first = collector.scores[0][1]
+    last = collector.scores[-1][1]
+    assert last < first * 0.5, f"score did not decrease: {first} -> {last}"
+    ev = net.evaluate(ds)
+    assert ev.accuracy() > 0.9
+
+
+def test_output_shapes_and_predict():
+    conf = (
+        NeuralNetConfiguration.builder()
+        .list()
+        .layer(DenseLayer(n_in=4, n_out=8))
+        .layer(OutputLayer(n_in=8, n_out=3, activation="softmax"))
+        .build()
+    )
+    net = MultiLayerNetwork(conf).init()
+    x = np.random.default_rng(0).normal(size=(5, 4)).astype(np.float32)
+    out = np.asarray(net.output(x))
+    assert out.shape == (5, 3)
+    assert np.allclose(out.sum(axis=1), 1.0, atol=1e-5)
+    preds = net.predict(x)
+    assert preds.shape == (5,)
+    acts = net.feed_forward(x)
+    assert len(acts) == 2 and acts[0].shape == (5, 8)
+
+
+def test_num_params_and_flat_round_trip():
+    conf = (
+        NeuralNetConfiguration.builder()
+        .list()
+        .layer(DenseLayer(n_in=4, n_out=8))
+        .layer(OutputLayer(n_in=8, n_out=3))
+        .build()
+    )
+    net = MultiLayerNetwork(conf).init()
+    # (4*8+8) + (8*3+3) = 40 + 27
+    assert net.num_params() == 67
+    flat = net.params_flat()
+    assert flat.shape == (67,)
+    flat2 = flat * 2.0
+    net.set_params_flat(flat2)
+    assert np.allclose(net.params_flat(), flat2)
+
+
+def test_rnn_fit_and_time_step():
+    T, B, F = 6, 4, 3
+    rng = np.random.default_rng(1)
+    x = rng.normal(size=(B, T, F)).astype(np.float32)
+    # predict sign of first feature per step
+    y = (x[..., :1] > 0).astype(np.float32)
+    labels = np.concatenate([y, 1 - y], axis=-1)
+    conf = (
+        NeuralNetConfiguration.builder()
+        .seed(12)
+        .learning_rate(0.05)
+        .updater(Updater.ADAM)
+        .list()
+        .layer(GravesLSTM(n_out=8, activation="tanh"))
+        .layer(RnnOutputLayer(n_out=2, activation="softmax"))
+        .set_input_type(InputType.recurrent(F))
+        .build()
+    )
+    net = MultiLayerNetwork(conf).init()
+    net.fit(DataSet(x, labels), epochs=30)
+    out = np.asarray(net.output(x))
+    assert out.shape == (B, T, 2)
+    # streaming matches batch forward
+    net.rnn_clear_previous_state()
+    stream_out = []
+    for t in range(T):
+        stream_out.append(np.asarray(net.rnn_time_step(x[:, t, :])))
+    stream = np.stack(stream_out, axis=1)
+    assert np.allclose(stream, out, atol=1e-4)
+
+
+def test_masking_in_loss_and_eval():
+    B, T, F = 3, 5, 2
+    rng = np.random.default_rng(3)
+    x = rng.normal(size=(B, T, F)).astype(np.float32)
+    labels = np.zeros((B, T, 2), np.float32)
+    labels[..., 0] = 1
+    mask = np.ones((B, T), np.float32)
+    mask[:, 3:] = 0
+    conf = (
+        NeuralNetConfiguration.builder()
+        .list()
+        .layer(GravesLSTM(n_out=4))
+        .layer(RnnOutputLayer(n_out=2, activation="softmax"))
+        .set_input_type(InputType.recurrent(F))
+        .build()
+    )
+    net = MultiLayerNetwork(conf).init()
+    ds = DataSet(x, labels, features_mask=mask, labels_mask=mask)
+    net.fit(ds, epochs=2)
+    ev = net.evaluate(ds)
+    assert ev.examples == int(mask.sum())
+
+
+@pytest.mark.parametrize("updater", ["sgd", "adam", "rmsprop", "adagrad",
+                                     "adadelta", "nesterovs"])
+def test_all_updaters_run(updater):
+    conf = (
+        NeuralNetConfiguration.builder()
+        .updater(updater)
+        .learning_rate(0.01)
+        .list()
+        .layer(DenseLayer(n_in=2, n_out=4))
+        .layer(OutputLayer(n_in=4, n_out=2, activation="softmax"))
+        .build()
+    )
+    net = MultiLayerNetwork(conf).init()
+    ds = make_xor_data(32)
+    net.fit(ds, epochs=2)
+    assert np.isfinite(net.score_value)

@@ -1,0 +1,73 @@
+"""Benchmark harness — prints ONE JSON line for the driver.
+
+Measures LeNet-5/MNIST training throughput (images/sec/chip) through the
+stock fit-path train step — BASELINE.json metric #1. The reference publishes
+no numbers (BASELINE.md), so `vs_baseline` is the ratio against the nominal
+target recorded on first successful TPU run (TARGET_IMG_PER_SEC below);
+until re-measured it doubles as the regression guard between rounds.
+
+Runs on whatever backend jax initializes (real TPU chip under the driver;
+CPU fallback works for local smoke testing via JAX_PLATFORMS=cpu).
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+import time
+
+import numpy as np
+
+# Nominal reference point: DL4J 0.4 LeNet/MNIST CPU training throughput is
+# O(100) images/sec (no published number — BASELINE.md); a single TPU chip
+# should beat that by >100x. Updated once a real-TPU measurement lands.
+TARGET_IMG_PER_SEC = 20000.0
+
+BATCH = 512
+WARMUP = 5
+STEPS = 30
+
+
+def main() -> int:
+    import jax
+    import jax.numpy as jnp
+
+    from deeplearning4j_tpu.models.lenet import lenet5
+
+    backend = jax.default_backend()
+    net = lenet5(dtype="bfloat16" if backend == "tpu" else "float32")
+    net.init()
+
+    rng = np.random.default_rng(0)
+    x = rng.random((BATCH, 28, 28, 1), dtype=np.float32)
+    y = np.eye(10, dtype=np.float32)[rng.integers(0, 10, BATCH)]
+    batch = {"features": jnp.asarray(x), "labels": jnp.asarray(y)}
+
+    step = net._get_train_step()
+    params, opt_state, state = net.params, net.opt_state, net.state
+    key = jax.random.PRNGKey(0)
+
+    for i in range(WARMUP):
+        key, k = jax.random.split(key)
+        params, opt_state, state, loss, _ = step(params, opt_state, state, k, batch)
+    jax.block_until_ready(loss)
+
+    t0 = time.perf_counter()
+    for i in range(STEPS):
+        key, k = jax.random.split(key)
+        params, opt_state, state, loss, _ = step(params, opt_state, state, k, batch)
+    jax.block_until_ready(loss)
+    dt = time.perf_counter() - t0
+
+    imgs_per_sec = BATCH * STEPS / dt
+    print(json.dumps({
+        "metric": f"lenet_mnist_images_per_sec_{backend}",
+        "value": round(imgs_per_sec, 1),
+        "unit": "images/sec/chip",
+        "vs_baseline": round(imgs_per_sec / TARGET_IMG_PER_SEC, 3),
+    }))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

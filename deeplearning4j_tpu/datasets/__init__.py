@@ -2,6 +2,7 @@
 
 from deeplearning4j_tpu.datasets.api import DataSet, MultiDataSet  # noqa: F401
 from deeplearning4j_tpu.datasets.iterators import (  # noqa: F401
+    ArrayDataSetIterator,
     DataSetIterator,
     ExistingDataSetIterator,
     ListDataSetIterator,
@@ -9,3 +10,6 @@ from deeplearning4j_tpu.datasets.iterators import (  # noqa: F401
     SamplingDataSetIterator,
 )
 from deeplearning4j_tpu.datasets.async_iterator import AsyncDataSetIterator  # noqa: F401
+from deeplearning4j_tpu.datasets.mnist import MnistDataSetIterator  # noqa: F401
+from deeplearning4j_tpu.datasets.cifar import CifarDataSetIterator  # noqa: F401
+from deeplearning4j_tpu.datasets.iris import IrisDataSetIterator  # noqa: F401

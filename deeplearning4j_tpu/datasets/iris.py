@@ -1,0 +1,34 @@
+"""Iris pipeline (reference fetchers/IrisDataFetcher.java + base/IrisUtils.java
++ iterator/impl/IrisDataSetIterator.java). Loads the classic 150x4 set from
+scikit-learn's bundled copy (no network); normalization matches the
+reference's fetcher (feature-wise standardization optional)."""
+
+from __future__ import annotations
+
+import numpy as np
+
+from deeplearning4j_tpu.datasets.api import DataSet
+from deeplearning4j_tpu.datasets.iterators import ArrayDataSetIterator
+
+
+def load_iris_dataset(normalize: bool = True, shuffle: bool = True, seed: int = 123):
+    from sklearn.datasets import load_iris  # bundled data, no download
+
+    d = load_iris()
+    x = d.data.astype(np.float32)
+    y = np.eye(3, dtype=np.float32)[d.target]
+    if normalize:
+        x = (x - x.mean(axis=0)) / (x.std(axis=0) + 1e-8)
+    if shuffle:
+        rng = np.random.default_rng(seed)
+        p = rng.permutation(len(x))
+        x, y = x[p], y[p]
+    return DataSet(x, y)
+
+
+class IrisDataSetIterator(ArrayDataSetIterator):
+    def __init__(self, batch_size: int = 150, num_examples: int = 150,
+                 normalize: bool = True, seed: int = 123):
+        ds = load_iris_dataset(normalize=normalize, seed=seed)
+        super().__init__(ds.features[:num_examples], ds.labels[:num_examples],
+                         batch_size, n_outcomes=3)

@@ -65,6 +65,45 @@ class DataSetIterator:
         return self.next()
 
 
+class ArrayDataSetIterator(DataSetIterator):
+    """In-memory array slicing iterator — the shared engine behind the
+    MNIST/CIFAR/Iris iterators (one copy of the batching contract)."""
+
+    def __init__(self, features, labels, batch_size: int, n_outcomes: int = -1):
+        super().__init__()
+        self._x = features
+        self._y = labels
+        self._batch = batch_size
+        self._outcomes = n_outcomes
+        self._i = 0
+
+    def has_next(self):
+        return self._i < len(self._x)
+
+    def next(self, num=None):
+        n = num or self._batch
+        sl = slice(self._i, self._i + n)
+        self._i += n
+        return self._apply_pre(DataSet(self._x[sl], self._y[sl]))
+
+    def reset(self):
+        self._i = 0
+
+    def batch(self):
+        return self._batch
+
+    def total_examples(self):
+        return len(self._x)
+
+    def input_columns(self):
+        return int(np.prod(self._x.shape[1:]))
+
+    def total_outcomes(self):
+        if self._outcomes > 0:
+            return self._outcomes
+        return int(self._y.shape[-1]) if self._y is not None else -1
+
+
 class ListDataSetIterator(DataSetIterator):
     """Iterate a pre-batched or single DataSet list (reference
     ListDataSetIterator)."""

@@ -135,10 +135,11 @@ class InMemoryModelSaver(ModelSaver):
     @staticmethod
     def _snapshot(net):
         import jax
+        import jax.numpy as jnp
 
         snap = copy.copy(net)
-        snap.params = jax.tree.map(lambda x: x, net.params)
-        snap.state = jax.tree.map(lambda x: x, net.state)
+        snap.params = jax.tree.map(jnp.copy, net.params)
+        snap.state = jax.tree.map(jnp.copy, net.state)
         return snap
 
     def save_best_model(self, net, score):

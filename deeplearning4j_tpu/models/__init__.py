@@ -1,0 +1,7 @@
+"""Model zoo: the BASELINE.json benchmark configs expressed in the builder
+API (LeNet-5/MNIST, VGG-16/CIFAR-10, ResNet-20 DP, 6-layer Transformer LM)."""
+
+from deeplearning4j_tpu.models.lenet import lenet5  # noqa: F401
+from deeplearning4j_tpu.models.vgg import vgg16  # noqa: F401
+from deeplearning4j_tpu.models.resnet import resnet20  # noqa: F401
+from deeplearning4j_tpu.models.transformer import transformer_lm  # noqa: F401

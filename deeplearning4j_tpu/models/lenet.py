@@ -1,0 +1,39 @@
+"""LeNet-5 on MNIST (BASELINE.json config 1) via the sequential builder API —
+the minimum end-to-end slice model (SURVEY.md §7 step 4)."""
+
+from __future__ import annotations
+
+from deeplearning4j_tpu.nn.conf import (
+    ConvolutionLayer,
+    DenseLayer,
+    InputType,
+    NeuralNetConfiguration,
+    OutputLayer,
+    SubsamplingLayer,
+    Updater,
+)
+from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+
+
+def lenet5(seed: int = 12345, learning_rate: float = 1e-3,
+           updater: str = Updater.ADAM, dtype: str = "float32") -> MultiLayerNetwork:
+    conf = (
+        NeuralNetConfiguration.builder()
+        .seed(seed)
+        .learning_rate(learning_rate)
+        .updater(updater)
+        .weight_init("xavier")
+        .dtype(dtype)
+        .list()
+        .layer(ConvolutionLayer(n_out=20, kernel_size=(5, 5), stride=(1, 1),
+                                activation="relu"))
+        .layer(SubsamplingLayer(kernel_size=(2, 2), stride=(2, 2)))
+        .layer(ConvolutionLayer(n_out=50, kernel_size=(5, 5), stride=(1, 1),
+                                activation="relu"))
+        .layer(SubsamplingLayer(kernel_size=(2, 2), stride=(2, 2)))
+        .layer(DenseLayer(n_out=500, activation="relu"))
+        .layer(OutputLayer(n_out=10, activation="softmax", loss_function="mcxent"))
+        .set_input_type(InputType.convolutional(28, 28, 1))
+        .build()
+    )
+    return MultiLayerNetwork(conf)

@@ -1,0 +1,90 @@
+"""6-layer Transformer LM (BASELINE.json config 5 / north star) via the DAG
+builder API — the structural successor of the reference's ComputationGraph
+wiring (SURVEY.md §3.2: "attention blocks = new vertex/layer types in the
+DAG"). Pre-norm blocks:
+
+  x → Embedding → +PosEnc → [LN → MHSA → +res → LN → FF(gelu) → FF → +res]×L
+    → LN → RnnOutput(softmax, mcxent over vocab)
+
+Designed MXU-first: one fused QKV matmul per block, bf16-ready via the
+config dtype policy, remat-able via .remat(True) for long sequences.
+"""
+
+from __future__ import annotations
+
+from deeplearning4j_tpu.nn.conf import (
+    DenseLayer,
+    ElementWiseVertexConf,
+    EmbeddingLayer,
+    InputType,
+    LayerNormalization,
+    NeuralNetConfiguration,
+    RnnOutputLayer,
+    SelfAttentionLayer,
+    Updater,
+)
+from deeplearning4j_tpu.nn.conf.layers import PositionalEncodingLayer
+from deeplearning4j_tpu.nn.graph import ComputationGraph
+
+
+def transformer_lm(vocab_size: int = 10000, d_model: int = 256,
+                   n_heads: int = 8, n_layers: int = 6, d_ff: int = 1024,
+                   max_length: int = 512, dropout: float = 0.0,
+                   seed: int = 12345, learning_rate: float = 3e-4,
+                   dtype: str = "float32", remat: bool = False) -> ComputationGraph:
+    g = (
+        NeuralNetConfiguration.builder()
+        .seed(seed)
+        .learning_rate(learning_rate)
+        .updater(Updater.ADAM)
+        .weight_init("xavier")
+        .dtype(dtype)
+        .remat(remat)
+        .graph_builder()
+        .add_inputs("tokens")
+    )
+    g.add_layer("embed", EmbeddingLayer(n_in=vocab_size, n_out=d_model,
+                                        activation="identity", has_bias=False),
+                "tokens")
+    g.add_layer("posenc", PositionalEncodingLayer(max_length=max_length,
+                                                  n_features=d_model), "embed")
+    prev = "posenc"
+    for i in range(n_layers):
+        b = f"blk{i}"
+        g.add_layer(f"{b}_ln1", LayerNormalization(n_in=d_model, n_out=d_model),
+                    prev)
+        g.add_layer(f"{b}_attn", SelfAttentionLayer(
+            n_in=d_model, n_out=d_model, n_heads=n_heads, causal=True,
+            dropout=dropout, attention_dropout=dropout,
+            activation="identity"), f"{b}_ln1")
+        g.add_vertex(f"{b}_res1", ElementWiseVertexConf(op="add"),
+                     prev, f"{b}_attn")
+        g.add_layer(f"{b}_ln2", LayerNormalization(n_in=d_model, n_out=d_model),
+                    f"{b}_res1")
+        g.add_layer(f"{b}_ff1", DenseLayer(n_in=d_model, n_out=d_ff,
+                                           activation="gelu", dropout=dropout),
+                    f"{b}_ln2")
+        g.add_layer(f"{b}_ff2", DenseLayer(n_in=d_ff, n_out=d_model,
+                                           activation="identity"), f"{b}_ff1")
+        g.add_vertex(f"{b}_res2", ElementWiseVertexConf(op="add"),
+                     f"{b}_res1", f"{b}_ff2")
+        prev = f"{b}_res2"
+    g.add_layer("ln_f", LayerNormalization(n_in=d_model, n_out=d_model), prev)
+    g.add_layer("out", RnnOutputLayer(n_in=d_model, n_out=vocab_size,
+                                      activation="softmax",
+                                      loss_function="mcxent"), "ln_f")
+    g.set_outputs("out")
+    g.set_input_types(tokens=InputType.recurrent(1))
+    return ComputationGraph(g.build())
+
+
+def transformer_flops_per_token(vocab_size, d_model, n_layers, d_ff, seq_len):
+    """Analytic forward+backward FLOPs per token for MFU accounting
+    (backward ≈ 2x forward; attention quadratic term included)."""
+    per_layer = (
+        4 * d_model * d_model * 3  # qkv + out proj (2*d*d mults ×2 matmul ops)
+        + 2 * d_model * d_ff * 2  # two FF matmuls
+        + 2 * 2 * seq_len * d_model  # qk^T and attn@v per token
+    )
+    fwd = n_layers * per_layer + 2 * d_model * vocab_size
+    return 3 * fwd  # fwd + bwd(2x)

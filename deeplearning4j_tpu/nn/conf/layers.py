@@ -311,6 +311,24 @@ class LayerNormalization(FeedForwardLayer):
 
 @register_config
 @dataclasses.dataclass
+class PositionalEncodingLayer(Layer):
+    """Adds positional information to [batch, time, features] — sinusoidal
+    (param-free) or learned. New capability for the Transformer north star."""
+
+    learned: bool = False
+    max_length: int = 2048
+    n_features: int = 0
+
+    def set_n_in(self, input_type: InputType) -> None:
+        if self.n_features == 0:
+            self.n_features = input_type.flat_size()
+
+    def get_output_type(self, input_type: InputType) -> InputType:
+        return input_type
+
+
+@register_config
+@dataclasses.dataclass
 class SelfAttentionLayer(BaseRecurrentLayer):
     """Multi-head self-attention over [batch, time, features] — new capability
     for the Transformer north star (SURVEY.md §7 step 6). Supports causal

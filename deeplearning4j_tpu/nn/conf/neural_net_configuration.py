@@ -280,12 +280,19 @@ class ListBuilder:
 
 
 def _expected_kind(layer: Layer) -> str:
+    from deeplearning4j_tpu.nn.conf.layers import (
+        ActivationLayer,
+        DropoutLayer,
+        PositionalEncodingLayer,
+    )
+
     if isinstance(layer, (ConvolutionLayer, SubsamplingLayer, LocalResponseNormalization)):
         return "convolutional"
     if isinstance(layer, (BaseRecurrentLayer, RnnOutputLayer, SelfAttentionLayer)):
         return "recurrent"
-    if isinstance(layer, BatchNormalization):
-        return "any"
+    if isinstance(layer, (BatchNormalization, ActivationLayer, DropoutLayer,
+                          PositionalEncodingLayer)):
+        return "any"  # shape-preserving: accept any input kind
     return "feedforward"
 
 

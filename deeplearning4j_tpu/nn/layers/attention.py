@@ -12,7 +12,11 @@ from __future__ import annotations
 import jax
 import jax.numpy as jnp
 
-from deeplearning4j_tpu.nn.conf.layers import LayerNormalization, SelfAttentionLayer
+from deeplearning4j_tpu.nn.conf.layers import (
+    LayerNormalization,
+    PositionalEncodingLayer,
+    SelfAttentionLayer,
+)
 from deeplearning4j_tpu.nn.layers.base import LayerImpl, apply_dropout, register_impl
 from deeplearning4j_tpu.nn.weights import init_weights
 from deeplearning4j_tpu.ops.activations import get_activation
@@ -29,6 +33,34 @@ class LayerNormImpl(LayerImpl):
         var = jnp.var(x, axis=-1, keepdims=True)
         xn = (x - mu) * jax.lax.rsqrt(var + conf.eps)
         return xn * params["gamma"] + params["beta"], state
+
+
+@register_impl(PositionalEncodingLayer)
+class PositionalEncodingImpl(LayerImpl):
+    def init(self, conf, rng, dtype):
+        if conf.learned:
+            pe = 0.02 * jax.random.normal(
+                rng, (conf.max_length, conf.n_features), dtype)
+            return {"pe": pe}, {}
+        return {}, {}
+
+    @staticmethod
+    def _sinusoidal(T, d, dtype):
+        pos = jnp.arange(T)[:, None].astype(jnp.float32)
+        dim = jnp.arange(0, d, 2).astype(jnp.float32)
+        angle = pos / jnp.power(10000.0, dim / d)
+        pe = jnp.zeros((T, d), jnp.float32)
+        pe = pe.at[:, 0::2].set(jnp.sin(angle))
+        pe = pe.at[:, 1::2].set(jnp.cos(angle[:, : d // 2]))
+        return pe.astype(dtype)
+
+    def apply(self, conf, params, state, x, *, train=False, rng=None, mask=None):
+        T, d = x.shape[1], x.shape[2]
+        if conf.learned:
+            pe = params["pe"][:T]
+        else:
+            pe = self._sinusoidal(T, d, x.dtype)
+        return x + pe, state
 
 
 def dot_product_attention(q, k, v, *, causal, mask=None, dropout=0.0, rng=None,

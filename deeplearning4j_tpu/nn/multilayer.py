@@ -426,7 +426,7 @@ class MultiLayerNetwork:
         net = MultiLayerNetwork(copy.deepcopy(self.conf))
         net.init()
         if self.params is not None:
-            net.params = jax.tree.map(lambda x: x, self.params)
-            net.state = jax.tree.map(lambda x: x, self.state)
+            net.params = jax.tree.map(jnp.copy, self.params)
+            net.state = jax.tree.map(jnp.copy, self.state)
             net.opt_state = self.opt_state
         return net

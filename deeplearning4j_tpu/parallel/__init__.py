@@ -1,0 +1,23 @@
+"""Distributed training (replaces reference deeplearning4j-scaleout:
+dl4j-spark parameter averaging, scaleout-akka actors, Hazelcast state,
+ZooKeeper config — SURVEY.md §2.4).
+
+On TPU the whole communication backend is XLA collectives compiled over the
+ICI mesh (DCN across slices); the host control plane is jax.distributed.
+"""
+
+from deeplearning4j_tpu.parallel.mesh import (  # noqa: F401
+    make_mesh,
+    replicate,
+    shard_batch,
+)
+from deeplearning4j_tpu.parallel.data_parallel import (  # noqa: F401
+    DataParallelTrainer,
+    ParameterAveragingTrainer,
+)
+from deeplearning4j_tpu.parallel.tensor_parallel import (  # noqa: F401
+    TRANSFORMER_TP_RULES,
+    shard_params,
+    sharding_for,
+)
+from deeplearning4j_tpu.parallel.ring_attention import ring_attention  # noqa: F401

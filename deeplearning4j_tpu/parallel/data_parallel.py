@@ -1,0 +1,183 @@
+"""Data-parallel training over the ICI mesh.
+
+Two modes, mirroring the reference's two Spark training masters
+(SURVEY.md §2.4 DP-1/DP-2, spark/impl/multilayer/SparkDl4jMultiLayer.java):
+
+1. **Allreduce (the TPU-native mode)** — DataParallelTrainer: the batch is
+   sharded over the mesh 'data' axis, params replicated; XLA inserts the
+   gradient allreduce (psum over ICI) inside the single jitted step. This is
+   BASELINE.json's "param-avg → ICI allreduce" replacement: no driver
+   round-trip, no O(model) host traffic per round
+   (vs SparkDl4jMultiLayer.runIteration:365-452 broadcast + accumulator).
+
+2. **Parameter averaging (semantic parity mode)** — ParameterAveragingTrainer:
+   each mesh slot holds its own replica params and updater state, runs k
+   local steps (shard_map, no cross-replica collective), then averages
+   params AND updater state with pmean every k steps — exactly the
+   reference's AVERAGE_EACH_ITERATION/averagingFrequency semantics including
+   UpdaterAggregator state merging (:421-427), for the allreduce-vs-param-avg
+   benchmark.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import optax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from deeplearning4j_tpu.datasets.api import DataSet
+from deeplearning4j_tpu.datasets.iterators import DataSetIterator, ListDataSetIterator
+
+
+class DataParallelTrainer:
+    """Allreduce DP wrapper around a network (MultiLayerNetwork or
+    ComputationGraph): `trainer.fit(iterator)` == network.fit with the step
+    compiled over the mesh."""
+
+    def __init__(self, net, mesh: Mesh):
+        if "data" not in mesh.axis_names:
+            raise ValueError("mesh needs a 'data' axis")
+        self.net = net
+        self.mesh = mesh
+        net.set_mesh(mesh)
+
+    def fit(self, data, epochs: int = 1):
+        return self.net.fit(data, epochs=epochs)
+
+
+class ParameterAveragingTrainer:
+    """Reference-parity parameter averaging (k local steps then average).
+
+    Params/opt-state live stacked with a leading replica axis sharded over
+    the mesh 'data' axis; shard_map keeps local steps collective-free and a
+    pmean implements the averaging round. This reproduces what the Spark
+    master did each `averagingFrequency` iterations — broadcast is implicit
+    (the averaged value IS the new replica value).
+    """
+
+    def __init__(self, net, mesh: Mesh, averaging_frequency: int = 1,
+                 average_updater_state: bool = True):
+        self.net = net
+        self.mesh = mesh
+        self.k = max(1, averaging_frequency)
+        self.average_updater = average_updater_state
+        self.n_replicas = mesh.shape["data"]
+        if net.params is None:
+            net.init()
+        self._stacked_params = self._stack(net.params)
+        self._stacked_opt = self._stack(net.opt_state)
+        self._stacked_state = self._stack(net.state)
+        self._local_steps = 0
+        self._warned_truncation = False
+        self._build_steps()
+
+    def _stack(self, tree):
+        n = self.n_replicas
+        stacked = jax.tree.map(lambda x: jnp.broadcast_to(x[None], (n,) + x.shape),
+                               tree)
+        sh = NamedSharding(self.mesh, P("data"))
+        return jax.tree.map(lambda x: jax.device_put(x, sh), stacked)
+
+    def _build_steps(self):
+        net, mesh = self.net, self.mesh
+        tx = net.tx
+        from jax import shard_map
+
+        @partial(shard_map, mesh=mesh,
+                 in_specs=(P("data"), P("data"), P("data"), P("data"), P()),
+                 out_specs=(P("data"), P("data"), P("data"), P("data")))
+        def local_step(params, opt_state, state, batch, rng):
+            # leading replica axis has size 1 inside the shard — strip it
+            params = jax.tree.map(lambda x: x[0], params)
+            opt_state = jax.tree.map(lambda x: x[0], opt_state)
+            state = jax.tree.map(lambda x: x[0], state)
+            (loss, aux), grads = jax.value_and_grad(
+                lambda p: net._loss(p, state, rng, batch), has_aux=True)(params)
+            new_state = aux[0] if isinstance(aux, tuple) else aux
+            updates, opt_state = tx.update(grads, opt_state, params)
+            params = optax.apply_updates(params, updates)
+            add = jax.tree.map(lambda x: x[None], (params, opt_state, new_state))
+            return add[0], add[1], add[2], loss[None]
+
+        self._local_step = jax.jit(local_step)
+
+        def average(params, opt_state, state):
+            def avg_float(x):
+                if jnp.issubdtype(x.dtype, jnp.floating):
+                    return jnp.mean(x, axis=0, keepdims=True) * jnp.ones_like(x)
+                return x
+
+            avg_p = jax.tree.map(avg_float, params)
+            # per-layer state (BatchNorm running stats) averages like params
+            avg_s = jax.tree.map(avg_float, state)
+            if self.average_updater:
+                # average float updater state (moments); keep int counters
+                avg_o = jax.tree.map(avg_float, opt_state)
+            else:
+                avg_o = opt_state
+            return avg_p, avg_o, avg_s
+
+        self._average = jax.jit(average)
+
+    def fit(self, data, epochs: int = 1):
+        """Each incoming minibatch is split across replicas (the RDD
+        partition analogue); every k local steps the replicas are averaged."""
+        if isinstance(data, DataSet):
+            data = ListDataSetIterator([data])
+        it: DataSetIterator = data
+        n = self.n_replicas
+        for _ in range(epochs):
+            it.reset()
+            while it.has_next():
+                ds = it.next()
+                b = ds.num_examples()
+                per = b // n
+                if per == 0:
+                    raise ValueError(
+                        f"batch of {b} examples cannot be split over {n} "
+                        f"replicas — use batches of at least {n} examples")
+                if per * n != b and not self._warned_truncation:
+                    import warnings
+
+                    warnings.warn(
+                        f"batch size {b} is not divisible by {n} replicas; "
+                        f"the last {b - per * n} examples of each such batch "
+                        f"are dropped", stacklevel=2)
+                    self._warned_truncation = True
+                batch = {
+                    "features": jnp.asarray(ds.features[:per * n]),
+                    "labels": jnp.asarray(ds.labels[:per * n]),
+                }
+                if ds.features_mask is not None:
+                    batch["features_mask"] = jnp.asarray(ds.features_mask[:per * n])
+                if ds.labels_mask is not None:
+                    batch["labels_mask"] = jnp.asarray(ds.labels_mask[:per * n])
+                batch = jax.tree.map(
+                    lambda x: jax.device_put(
+                        x, NamedSharding(self.mesh, P("data"))), batch)
+                rng = self.net._next_rng()
+                (self._stacked_params, self._stacked_opt, self._stacked_state,
+                 losses) = self._local_step(
+                    self._stacked_params, self._stacked_opt, self._stacked_state,
+                    batch, rng)
+                self.net.score_value = float(jnp.mean(losses))
+                self.net.iteration_count += 1
+                self._local_steps += 1
+                if self._local_steps % self.k == 0:
+                    (self._stacked_params, self._stacked_opt,
+                     self._stacked_state) = self._average(
+                        self._stacked_params, self._stacked_opt,
+                        self._stacked_state)
+                for lst in self.net.listeners:
+                    lst.iteration_done(self.net, self.net.iteration_count)
+        self.sync_to_network()
+        return self.net
+
+    def sync_to_network(self):
+        """Write replica-0 (post-averaging) params/state back to the net."""
+        self.net.params = jax.tree.map(lambda x: x[0], self._stacked_params)
+        self.net.state = jax.tree.map(lambda x: x[0], self._stacked_state)
+        return self.net

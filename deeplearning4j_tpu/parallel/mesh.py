@@ -1,0 +1,54 @@
+"""Device mesh utilities.
+
+The mesh replaces the reference's cluster topology (Spark executors / Akka
+workers). Axis conventions used throughout the framework:
+
+- "data"  : data parallelism (gradient allreduce over ICI — replaces
+            SparkDl4jMultiLayer parameter averaging)
+- "model" : tensor parallelism (attention heads / FF hidden sharded)
+- "seq"   : sequence/context parallelism (ring attention)
+
+Multi-host: call jax.distributed.initialize() first (the control plane the
+reference delegated to Spark/ZooKeeper); jax.devices() then spans hosts and
+the same mesh code scales from 1 chip to a multi-slice pod.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+
+def make_mesh(axes: dict[str, int] | None = None, *, devices=None) -> Mesh:
+    """Build a Mesh from {axis: size}; -1 means 'all remaining devices'.
+
+    make_mesh({"data": -1})                 # pure DP over every chip
+    make_mesh({"data": 2, "model": 4})      # 2-way DP x 4-way TP
+    """
+    devices = list(devices if devices is not None else jax.devices())
+    axes = dict(axes or {"data": -1})
+    sizes = list(axes.values())
+    n_fixed = int(np.prod([s for s in sizes if s != -1])) if sizes else 1
+    if -1 in sizes:
+        rem = len(devices) // max(n_fixed, 1)
+        sizes = [rem if s == -1 else s for s in sizes]
+    total = int(np.prod(sizes))
+    if total > len(devices):
+        raise ValueError(f"mesh {dict(zip(axes, sizes))} needs {total} devices, "
+                         f"have {len(devices)}")
+    arr = np.asarray(devices[:total]).reshape(sizes)
+    return Mesh(arr, tuple(axes.keys()))
+
+
+def shard_batch(batch, mesh: Mesh, axis: str = "data"):
+    """Place a host batch pytree with its leading dim sharded over `axis`."""
+    sharding = NamedSharding(mesh, P(axis))
+    return jax.tree.map(lambda x: jax.device_put(x, sharding), batch)
+
+
+def replicate(tree, mesh: Mesh):
+    """Fully replicate a pytree over the mesh."""
+    sharding = NamedSharding(mesh, P())
+    return jax.tree.map(lambda x: jax.device_put(x, sharding), tree)

@@ -1,0 +1,94 @@
+"""Ring attention — sequence/context parallelism over the ICI mesh.
+
+New capability (SURVEY.md §5: the reference's only long-sequence mechanisms
+are TBPTT and masking; ring attention is the TPU-era answer for sequences
+that don't fit one chip). Design per the blockwise-attention family:
+sequence sharded over the mesh "seq" axis, K/V blocks rotated around the
+ring with `lax.ppermute` while each shard accumulates its queries' output
+with the online-softmax (log-sum-exp) recurrence, so the full [T, T] score
+matrix never materializes and each hop overlaps compute with ICI transfer.
+
+`ring_attention` is the per-shard function (call inside shard_map);
+`ring_self_attention` wraps it in shard_map over a mesh.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+_NEG = -1e30
+
+
+def ring_attention(q, k, v, *, axis_name: str = "seq", causal: bool = True):
+    """Per-shard blockwise attention. q,k,v: [B, H, Tl, D] local blocks of a
+    sequence sharded over `axis_name`. Returns [B, H, Tl, D].
+
+    Runs n_shards steps; at each step attends local q against the visiting
+    k/v block, then rotates k/v one hop around the ring.
+    """
+    n = lax.psum(1, axis_name)
+    idx = lax.axis_index(axis_name)
+    B, H, Tl, D = q.shape
+    scale = 1.0 / jnp.sqrt(jnp.asarray(D, jnp.float32))
+    q32 = q.astype(jnp.float32)
+
+    q_pos = idx * Tl + jnp.arange(Tl)
+
+    # derive the accumulators from q so they carry the 'seq' varying-axis
+    # tag that shard_map's type system expects of per-shard state
+    o0 = jnp.zeros_like(q32)
+    m0 = jnp.full_like(q32[..., 0], _NEG)
+    l0 = jnp.zeros_like(q32[..., 0])
+    perm = [(j, (j + 1) % n) for j in range(n)]
+
+    def step(carry, i):
+        o, m, l, k_cur, v_cur = carry
+        src = (idx - i) % n  # which block the visiting k/v belongs to
+        scores = jnp.einsum("bhqd,bhkd->bhqk", q32, k_cur.astype(jnp.float32)) * scale
+        if causal:
+            k_pos = src * Tl + jnp.arange(Tl)
+            mask = q_pos[:, None] >= k_pos[None, :]
+            scores = jnp.where(mask[None, None], scores, _NEG)
+        m_new = jnp.maximum(m, scores.max(axis=-1))
+        p = jnp.exp(scores - m_new[..., None])
+        corr = jnp.exp(m - m_new)
+        l_new = l * corr + p.sum(axis=-1)
+        o_new = o * corr[..., None] + jnp.einsum(
+            "bhqk,bhkd->bhqd", p, v_cur.astype(jnp.float32))
+        k_nxt = lax.ppermute(k_cur, axis_name, perm)
+        v_nxt = lax.ppermute(v_cur, axis_name, perm)
+        return (o_new, m_new, l_new, k_nxt, v_nxt), None
+
+    (o, m, l, _, _), _ = lax.scan(step, (o0, m0, l0, k, v), jnp.arange(n))
+    return (o / jnp.maximum(l, 1e-30)[..., None]).astype(q.dtype)
+
+
+def ring_self_attention(q, k, v, mesh: Mesh, *, causal: bool = True,
+                        seq_axis: str = "seq"):
+    """Whole-sequence entry point: q,k,v [B, H, T, D] (T divisible by the
+    seq-axis size). shard_maps the ring over the mesh."""
+    from jax import shard_map
+
+    spec = P(None, None, seq_axis, None)
+    fn = shard_map(
+        partial(ring_attention, axis_name=seq_axis, causal=causal),
+        mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
+    )
+    return fn(q, k, v)
+
+
+def sequence_sharded_attention_reference(q, k, v, *, causal: bool = True):
+    """Unsharded reference for tests: plain softmax attention in f32."""
+    D = q.shape[-1]
+    s = jnp.einsum("bhqd,bhkd->bhqk", q.astype(jnp.float32),
+                   k.astype(jnp.float32)) / jnp.sqrt(float(D))
+    if causal:
+        T = q.shape[2]
+        s = jnp.where(jnp.tril(jnp.ones((T, T), bool))[None, None], s, _NEG)
+    w = jax.nn.softmax(s, axis=-1)
+    return jnp.einsum("bhqk,bhkd->bhqd", w, v.astype(jnp.float32)).astype(q.dtype)

@@ -1,0 +1,90 @@
+"""Tensor parallelism — Megatron-style sharding rules applied as pjit
+shardings on the param pytree (new capability, SURVEY.md §2.4: the
+reference has no TP; this is additive for the Transformer north star).
+
+The pjit idiom: place params with NamedShardings, jit the (unchanged) train
+step, and XLA SPMD propagates shardings through the computation, inserting
+the allreduces where the contracted dimension is sharded — column-parallel
+QKV/FF1 followed by row-parallel Out/FF2 yields exactly one psum per block
+per direction, riding ICI.
+"""
+
+from __future__ import annotations
+
+import re
+
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+# {param-name regex -> PartitionSpec} for the transformer_lm param tree.
+# Column-parallel: hidden/output dim sharded; row-parallel: input dim sharded.
+TRANSFORMER_TP_RULES = [
+    (r".*_attn/Wqkv$", P(None, "model")),   # column: heads sharded
+    (r".*_attn/bqkv$", P("model")),
+    (r".*_attn/Wo$", P("model", None)),     # row: contraction sharded → psum
+    (r".*_attn/bo$", P()),
+    (r".*_ff1/W$", P(None, "model")),       # column
+    (r".*_ff1/b$", P("model")),
+    (r".*_ff2/W$", P("model", None)),       # row
+    (r".*_ff2/b$", P()),
+    (r"embed/W$", P(None, "model")),        # vocab embedding sharded on d_model
+    (r"out/W$", P(None, "model")),          # lm head vocab-sharded on output
+    (r"out/b$", P("model")),
+]
+
+
+def _flatten_names(params, prefix=""):
+    out = {}
+    for k, v in params.items():
+        name = f"{prefix}{k}"
+        if isinstance(v, dict):
+            out.update(_flatten_names(v, name + "/"))
+        else:
+            out[name] = v
+    return out
+
+
+def sharding_for(name: str, mesh: Mesh, rules=None) -> NamedSharding:
+    """Resolve the sharding for one param name (replicated if no rule or the
+    'model' axis is absent/size-1)."""
+    rules = rules if rules is not None else TRANSFORMER_TP_RULES
+    if "model" in mesh.axis_names and mesh.shape["model"] > 1:
+        for pat, spec in rules:
+            if re.match(pat, name):
+                return NamedSharding(mesh, spec)
+    return NamedSharding(mesh, P())
+
+
+def shard_params(params, mesh: Mesh, rules=None):
+    """device_put every param with its rule's sharding. Returns the same
+    pytree, now laid out for TP; jit of the train step with these as inputs
+    lets XLA propagate and insert the collectives."""
+    def place(path_name, leaf):
+        return jax.device_put(leaf, sharding_for(path_name, mesh, rules))
+
+    def walk(tree, prefix=""):
+        out = {}
+        for k, v in tree.items():
+            name = f"{prefix}{k}"
+            if isinstance(v, dict):
+                out[k] = walk(v, name + "/")
+            else:
+                out[k] = place(name, v)
+        return out
+
+    return walk(params)
+
+
+def param_shardings(params, mesh: Mesh, rules=None):
+    """Pytree of NamedShardings matching `params` (for jit in_shardings)."""
+    def walk(tree, prefix=""):
+        out = {}
+        for k, v in tree.items():
+            name = f"{prefix}{k}"
+            if isinstance(v, dict):
+                out[k] = walk(v, name + "/")
+            else:
+                out[k] = sharding_for(name, mesh, rules)
+        return out
+
+    return walk(params)

@@ -235,8 +235,11 @@ class MultiLayerNetwork:
             it.reset()
         if not self.conf.backprop:
             return self
-        step = self._get_train_step()
         g = self.conf.conf
+        if str(g.optimization_algo) != str(
+                OptimizationAlgorithm.STOCHASTIC_GRADIENT_DESCENT):
+            return self._fit_with_solver(it, epochs)
+        step = self._get_train_step()
         for _ in range(epochs):
             it.reset()
             while it.has_next():
@@ -257,6 +260,28 @@ class MultiLayerNetwork:
                     self.iteration_count += 1
                     for lst in self.listeners:
                         lst.iteration_done(self, self.iteration_count)
+            self.epoch_count += 1
+        return self
+
+    def _fit_with_solver(self, it, epochs: int):
+        """Second-order / line-search training path (reference Solver.java
+        dispatch on OptimizationAlgorithm — CG/LBFGS/line-GD run multiple
+        line-searched passes per minibatch instead of the fused SGD step)."""
+        from deeplearning4j_tpu.optimize.solvers import Solver
+
+        if self.conf.backprop_type in (BackpropType.TRUNCATED_BPTT,
+                                       "truncated_bptt"):
+            raise ValueError(
+                "TRUNCATED_BPTT requires STOCHASTIC_GRADIENT_DESCENT; "
+                "second-order solvers would differentiate the full sequence")
+        solver = Solver(self)
+        for _ in range(epochs):
+            it.reset()
+            while it.has_next():
+                ds = it.next()
+                solver.optimize(self._batch_dict(ds), rng=self._next_rng())
+                for lst in self.listeners:
+                    lst.iteration_done(self, self.iteration_count)
             self.epoch_count += 1
         return self
 

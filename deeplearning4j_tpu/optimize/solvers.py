@@ -1,0 +1,435 @@
+"""Solvers — the reference's optimize/ package, TPU-native.
+
+Reference surface (SURVEY.md §2.2 "optimize" row):
+- Solver.java:48,55 — builder + dispatch on OptimizationAlgorithm
+- solvers/BaseOptimizer.java — gradientAndScore:150, optimize loop:191,
+  termination checks
+- solvers/StochasticGradientDescent.java:53-75
+- solvers/BackTrackLineSearch.java — Armijo backtracking
+- solvers/ConjugateGradient.java, solvers/LBFGS.java,
+  solvers/LineGradientDescent.java
+- stepfunctions/*, terminations/* (Eps, Norm2, ZeroDirection)
+
+TPU-native redesign: the reference hand-threads INDArray views through a
+mutable optimizer object. Here each solver is a pure function over a FLAT
+parameter vector (ravel_pytree of the param pytree): one jitted
+value-and-grad closure + jitted line-search (lax.while_loop — no
+data-dependent python control flow inside jit). Curvature history (L-BFGS)
+and conjugate directions live in fixed-shape device buffers so the whole
+multi-iteration solve stays on-device. The updater (Adam/momentum — applied
+in BaseOptimizer.updateGradientAccordingToParams:276 in the reference) is
+intentionally NOT applied inside second-order solvers; like the reference,
+SGD is the path that composes with updaters (nn/training.py), while
+CG/L-BFGS/line-GD use raw gradients + line search.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from functools import partial
+from typing import Callable, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.flatten_util import ravel_pytree
+
+from deeplearning4j_tpu.nn.conf.enums import OptimizationAlgorithm
+
+
+# --------------------------------------------------------------------------
+# Step functions (reference optimize/stepfunctions/*)
+# --------------------------------------------------------------------------
+class StepFunction:
+    """step(params, direction, step_size) -> new params (pure)."""
+
+    sign = 1.0
+
+    def step(self, params, direction, step):
+        return params + self.sign * step * direction
+
+
+class DefaultStepFunction(StepFunction):
+    sign = 1.0
+
+
+class NegativeDefaultStepFunction(StepFunction):
+    """The SGD default (reference NegativeDefaultStepFunction): params -= update."""
+
+    sign = -1.0
+
+
+class GradientStepFunction(StepFunction):
+    sign = 1.0
+
+
+class NegativeGradientStepFunction(StepFunction):
+    sign = -1.0
+
+
+STEP_FUNCTIONS = {
+    "default": DefaultStepFunction,
+    "negative_default": NegativeDefaultStepFunction,
+    "gradient": GradientStepFunction,
+    "negative_gradient": NegativeGradientStepFunction,
+}
+
+
+# --------------------------------------------------------------------------
+# Termination conditions (reference optimize/terminations/*)
+# --------------------------------------------------------------------------
+class TerminationCondition:
+    def terminate(self, new_score, old_score, direction) -> bool:
+        raise NotImplementedError
+
+
+class EpsTermination(TerminationCondition):
+    """|new - old| < eps*|old| + tol (reference EpsTermination)."""
+
+    def __init__(self, eps: float = 1e-4, tol: float = 1e-8):
+        self.eps, self.tol = eps, tol
+
+    def terminate(self, new_score, old_score, direction):
+        return abs(new_score - old_score) < self.eps * abs(old_score) + self.tol
+
+
+class Norm2Termination(TerminationCondition):
+    """||direction||_2 < tolerance (reference Norm2Termination)."""
+
+    def __init__(self, gradient_tolerance: float = 1e-6):
+        self.tol = gradient_tolerance
+
+    def terminate(self, new_score, old_score, direction):
+        return float(jnp.linalg.norm(direction)) < self.tol
+
+
+class ZeroDirection(TerminationCondition):
+    def terminate(self, new_score, old_score, direction):
+        return float(jnp.max(jnp.abs(direction))) == 0.0
+
+
+DEFAULT_TERMINATIONS = (ZeroDirection(), EpsTermination())
+
+
+# --------------------------------------------------------------------------
+# Backtracking line search (reference solvers/BackTrackLineSearch.java)
+# --------------------------------------------------------------------------
+def backtrack_line_search(loss_f, x, f0, g, direction, *, initial_step=1.0,
+                          rho=0.5, c1=1e-4, max_iters=16, min_step=1e-10):
+    """Armijo backtracking, fully on-device via lax.while_loop.
+
+    loss_f: flat-vector scalar loss. Finds t such that
+    f(x + t*d) <= f0 + c1*t*<g,d>; halves t (rho) up to max_iters times.
+    Returns (t, f(x + t*d)) — t == 0.0 if no decrease found.
+    """
+    slope = jnp.vdot(g, direction)
+
+    def cond(carry):
+        t, ft, it = carry
+        return jnp.logical_and(
+            it < max_iters,
+            jnp.logical_and(t > min_step, ft > f0 + c1 * t * slope),
+        )
+
+    def body(carry):
+        t, _, it = carry
+        t = t * rho
+        return t, loss_f(x + t * direction), it + 1
+
+    t0 = jnp.asarray(initial_step, x.dtype)
+    t, ft, _ = jax.lax.while_loop(cond, body, (t0, loss_f(x + t0 * direction), 0))
+    ok = ft <= f0 + c1 * t * slope
+    return jnp.where(ok, t, 0.0), jnp.where(ok, ft, f0)
+
+
+# --------------------------------------------------------------------------
+# Solver results
+# --------------------------------------------------------------------------
+@dataclass
+class SolveResult:
+    x: jnp.ndarray
+    score: float
+    iterations: int
+    converged: bool
+
+
+# --------------------------------------------------------------------------
+# Base optimizer: host loop over jitted (value_and_grad + line-searched step)
+# --------------------------------------------------------------------------
+class BaseOptimizer:
+    """Shared machinery (reference solvers/BaseOptimizer.java).
+
+    loss_f: flat-vector -> scalar, pure & jittable (already closed over the
+    minibatch). Subclasses define `direction(g, aux)` and curvature updates.
+    """
+
+    def __init__(self, loss_f: Callable, max_iterations: int = 10,
+                 step_function: Optional[StepFunction] = None,
+                 terminations: Sequence[TerminationCondition] = DEFAULT_TERMINATIONS,
+                 listeners=(), initial_step: float = 1.0):
+        self.loss_f = loss_f
+        self.vg = jax.jit(jax.value_and_grad(loss_f))
+        self.max_iterations = max_iterations
+        self.step_function = step_function or NegativeDefaultStepFunction()
+        self.terminations = list(terminations)
+        self.listeners = list(listeners)
+        self.initial_step = initial_step
+        self.score_value = float("nan")
+
+        sign = self.step_function.sign
+
+        @jax.jit
+        def _line_step(x, f0, g, direction):
+            # search along sign*direction (NegativeDefault steps downhill
+            # along +gradient-style directions)
+            d = sign * direction
+            t, ft = backtrack_line_search(loss_f, x, f0, g, d,
+                                          initial_step=initial_step)
+            return x + t * d, ft, t
+
+        self._line_step = _line_step
+
+    # subclass API ---------------------------------------------------------
+    def init_aux(self, x, g):
+        return None
+
+    def direction(self, x, g, aux):
+        """Return (direction pointing DOWNHILL-when-negated, new aux)."""
+        return g, aux
+
+    def update_aux(self, aux, x_old, x_new, g_old, g_new):
+        return aux
+
+    # main loop (reference BaseOptimizer.optimize:191) ----------------------
+    def optimize(self, x0) -> SolveResult:
+        x = jnp.asarray(x0)
+        f, g = self.vg(x)
+        aux = self.init_aux(x, g)
+        old_f = float("inf")
+        converged = False
+        i = 0
+        for i in range(1, self.max_iterations + 1):
+            d, aux = self.direction(x, g, aux)
+            x_new, f_new, t = self._line_step(x, f, g, d)
+            if float(t) == 0.0:  # no decrease along d — give up (ref: step==0)
+                converged = True
+                break
+            f_new_f = float(f_new)
+            _, g_new = self.vg(x_new)
+            aux = self.update_aux(aux, x, x_new, g, g_new)
+            x, old_f, f, g = x_new, float(f), f_new, g_new
+            self.score_value = f_new_f
+            for lst in self.listeners:
+                lst.iteration_done(self, i)
+            if any(tc.terminate(f_new_f, old_f, d) for tc in self.terminations):
+                converged = True
+                break
+        return SolveResult(x, float(f), i, converged)
+
+
+class LineGradientDescent(BaseOptimizer):
+    """Steepest descent + line search (reference LineGradientDescent.java)."""
+
+
+class ConjugateGradient(BaseOptimizer):
+    """Polak-Ribiere nonlinear CG with automatic restarts
+    (reference solvers/ConjugateGradient.java)."""
+
+    def init_aux(self, x, g):
+        return {"d_prev": jnp.zeros_like(g), "g_prev": jnp.zeros_like(g),
+                "first": True}
+
+    def direction(self, x, g, aux):
+        if aux["first"]:
+            return g, dict(aux, first=False)
+        g_prev, d_prev = aux["g_prev"], aux["d_prev"]
+        beta = jnp.maximum(
+            jnp.vdot(g, g - g_prev) / jnp.maximum(jnp.vdot(g_prev, g_prev), 1e-30),
+            0.0,  # PR+ restart
+        )
+        return g + beta * d_prev, aux
+
+    def update_aux(self, aux, x_old, x_new, g_old, g_new):
+        # direction used this iteration is reconstructed next call from g_prev/d_prev
+        d_used = self._last_d if hasattr(self, "_last_d") else g_old
+        return {"d_prev": d_used, "g_prev": g_old, "first": False}
+
+    def optimize(self, x0):
+        # track the direction actually used so update_aux can store it
+        orig_direction = self.direction
+
+        def tracked(x, g, aux):
+            d, aux = orig_direction(x, g, aux)
+            self._last_d = d
+            return d, aux
+
+        self.direction = tracked
+        try:
+            return super().optimize(x0)
+        finally:
+            self.direction = orig_direction
+
+
+class LBFGS(BaseOptimizer):
+    """L-BFGS two-loop recursion with an m-deep history (reference
+    solvers/LBFGS.java). History buffers are fixed-shape device arrays so the
+    two-loop recursion jits cleanly (lax.fori_loop over the ring buffer)."""
+
+    def __init__(self, loss_f, max_iterations: int = 10, m: int = 10, **kw):
+        super().__init__(loss_f, max_iterations, **kw)
+        self.m = m
+
+        @partial(jax.jit, static_argnames=())
+        def two_loop(g, S, Y, rho, count, head):
+            """Standard two-loop recursion over ring buffers S (m,n), Y (m,n).
+            Returns H*g (an ASCENT direction scaled by curvature)."""
+            m = S.shape[0]
+            q = g
+            alphas = jnp.zeros((m,), g.dtype)
+
+            def bwd(j, carry):
+                q, alphas = carry
+                idx = (head - 1 - j) % m
+                valid = j < count
+                a = rho[idx] * jnp.vdot(S[idx], q)
+                a = jnp.where(valid, a, 0.0)
+                q = q - a * Y[idx]
+                return q, alphas.at[idx].set(a)
+
+            q, alphas = jax.lax.fori_loop(0, m, bwd, (q, alphas))
+            # initial Hessian scaling gamma = s'y / y'y of the newest pair
+            newest = (head - 1) % m
+            gamma = jnp.where(
+                count > 0,
+                jnp.vdot(S[newest], Y[newest])
+                / jnp.maximum(jnp.vdot(Y[newest], Y[newest]), 1e-30),
+                1.0,
+            )
+            r = gamma * q
+
+            def fwd(j, r):
+                idx = (head - count + j) % m
+                valid = j < count
+                b = rho[idx] * jnp.vdot(Y[idx], r)
+                upd = (alphas[idx] - b) * S[idx]
+                return r + jnp.where(valid, 1.0, 0.0) * upd
+
+            return jax.lax.fori_loop(0, m, fwd, r)
+
+        self._two_loop = two_loop
+
+    def init_aux(self, x, g):
+        n = g.shape[0]
+        return {
+            "S": jnp.zeros((self.m, n), g.dtype),
+            "Y": jnp.zeros((self.m, n), g.dtype),
+            "rho": jnp.zeros((self.m,), g.dtype),
+            "count": 0,
+            "head": 0,
+        }
+
+    def direction(self, x, g, aux):
+        d = self._two_loop(g, aux["S"], aux["Y"], aux["rho"], aux["count"],
+                           aux["head"])
+        return d, aux
+
+    def update_aux(self, aux, x_old, x_new, g_old, g_new):
+        s = x_new - x_old
+        y = g_new - g_old
+        sy = float(jnp.vdot(s, y))
+        if sy <= 1e-10:  # curvature condition failed — skip the pair
+            return aux
+        h = aux["head"]
+        return {
+            "S": aux["S"].at[h].set(s),
+            "Y": aux["Y"].at[h].set(y),
+            "rho": aux["rho"].at[h].set(1.0 / sy),
+            "count": min(aux["count"] + 1, self.m),
+            "head": (h + 1) % self.m,
+        }
+
+
+class StochasticGradientDescent(BaseOptimizer):
+    """Plain SGD steps (reference StochasticGradientDescent.java:53-75).
+    Networks normally use the fused jitted train step (nn/training.py); this
+    exists for Solver-API parity and uses a fixed learning-rate step."""
+
+    def __init__(self, loss_f, max_iterations=10, lr=0.1, **kw):
+        super().__init__(loss_f, max_iterations, **kw)
+        self.lr = lr
+
+        @jax.jit
+        def sgd_step(x):
+            f, g = jax.value_and_grad(loss_f)(x)
+            return x - lr * g, f
+
+        self._sgd_step = sgd_step
+
+    def optimize(self, x0):
+        x = jnp.asarray(x0)
+        f = float("nan")
+        for i in range(1, self.max_iterations + 1):
+            x, fv = self._sgd_step(x)
+            f = float(fv)
+            self.score_value = f
+            for lst in self.listeners:
+                lst.iteration_done(self, i)
+        return SolveResult(x, f, self.max_iterations, True)
+
+
+_OPTIMIZERS = {
+    OptimizationAlgorithm.STOCHASTIC_GRADIENT_DESCENT: StochasticGradientDescent,
+    OptimizationAlgorithm.LINE_GRADIENT_DESCENT: LineGradientDescent,
+    OptimizationAlgorithm.CONJUGATE_GRADIENT: ConjugateGradient,
+    OptimizationAlgorithm.LBFGS: LBFGS,
+}
+
+
+# --------------------------------------------------------------------------
+# Solver — dispatch + network integration (reference Solver.java:48,55)
+# --------------------------------------------------------------------------
+class Solver:
+    """Optimizes a network's parameters on one batch with the configured
+    algorithm. Usage (mirrors reference Solver.Builder().model(m).build()):
+
+        Solver(model).optimize(batch_dict, rng)   # mutates model.params
+    """
+
+    def __init__(self, model, algorithm: Optional[str] = None,
+                 max_iterations: Optional[int] = None, listeners=()):
+        self.model = model
+        g = model.conf.conf
+        self.algorithm = str(algorithm or g.optimization_algo)
+        self.max_iterations = max_iterations or max(1, g.iterations)
+        # listeners here receive the OPTIMIZER (per inner line-search
+        # iteration, score_value only) — network listeners are fired by the
+        # container once per minibatch, with the network as model
+        self.listeners = list(listeners)
+
+    def get_optimizer(self, loss_f) -> BaseOptimizer:
+        cls = _OPTIMIZERS[OptimizationAlgorithm(self.algorithm)]
+        kw = {}
+        if cls is StochasticGradientDescent:
+            kw["lr"] = self.model.conf.conf.learning_rate
+        return cls(loss_f, max_iterations=self.max_iterations,
+                   listeners=self.listeners, **kw)
+
+    def optimize(self, batch, rng=None):
+        m = self.model
+        flat, unravel = ravel_pytree(m.params)
+
+        def loss_f(x):
+            loss, _ = m._loss(unravel(x), m.state, rng, batch, train=True)
+            return loss
+
+        opt = self.get_optimizer(loss_f)
+        res = opt.optimize(flat)
+        m.params = unravel(res.x)
+        # one forward at the solution to refresh layer state (BatchNorm
+        # running stats etc.) — the flat loss closure discards it
+        _, (new_state, _) = m._loss(m.params, m.state, rng, batch, train=True)
+        m.state = new_state
+        m.score_value = res.score
+        if hasattr(m, "iteration_count"):
+            m.iteration_count += res.iterations
+        return res

@@ -1,0 +1,100 @@
+"""Solver tests — reference optimize/solvers/* behavior (SURVEY.md §2.2).
+
+Convergence on a convex quadratic + Rosenbrock (standard solver fixtures),
+line-search Armijo property, and end-to-end network fit with each
+OptimizationAlgorithm (reference tests ran LBFGS/CG on Iris-sized nets).
+"""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.optimize.solvers import (
+    ConjugateGradient,
+    EpsTermination,
+    LBFGS,
+    LineGradientDescent,
+    Solver,
+    StochasticGradientDescent,
+    backtrack_line_search,
+)
+
+
+def quad(x):
+    # condition number ~100
+    scales = jnp.linspace(1.0, 100.0, x.shape[0])
+    return 0.5 * jnp.sum(scales * x * x)
+
+
+def rosenbrock(x):
+    return jnp.sum(100.0 * (x[1:] - x[:-1] ** 2) ** 2 + (1.0 - x[:-1]) ** 2)
+
+
+@pytest.mark.parametrize("cls,iters,tol", [
+    # steepest descent on a kappa=100 quadratic is intrinsically slow
+    (LineGradientDescent, 200, 1e-3),
+    # Armijo (inexact) line search limits CG's conjugacy in float32
+    (ConjugateGradient, 60, 1e-4),
+    (LBFGS, 40, 1e-5),
+])
+def test_quadratic_convergence(cls, iters, tol):
+    x0 = jnp.ones(10)
+    res = cls(quad, max_iterations=iters,
+              terminations=[EpsTermination(1e-10, 1e-12)]).optimize(x0)
+    assert res.score < tol, f"{cls.__name__} stalled at {res.score}"
+
+
+def test_lbfgs_rosenbrock():
+    x0 = jnp.zeros(8)
+    res = LBFGS(rosenbrock, max_iterations=300, m=10,
+                terminations=[EpsTermination(1e-12, 1e-14)]).optimize(x0)
+    assert res.score < 1e-3
+
+
+def test_sgd_solver_descends():
+    res = StochasticGradientDescent(quad, max_iterations=50, lr=0.005).optimize(
+        jnp.ones(10))
+    assert res.score < float(quad(jnp.ones(10)))
+
+
+def test_line_search_armijo():
+    import jax
+
+    x = jnp.ones(5)
+    f0, g = jax.value_and_grad(quad)(x)
+    t, ft = backtrack_line_search(quad, x, f0, g, -g)
+    assert float(t) > 0
+    assert float(ft) <= float(f0) - 1e-4 * float(t) * float(jnp.vdot(g, g)) + 1e-6
+
+
+@pytest.mark.parametrize("algo", ["lbfgs", "conjugate_gradient",
+                                  "line_gradient_descent"])
+def test_network_fit_with_solver(algo, rng):
+    """End-to-end: tiny dense net trained by each solver reduces loss
+    (reference GradientCheckTests ran these algos on Iris-sized nets)."""
+    from deeplearning4j_tpu.nn.conf.neural_net_configuration import (
+        NeuralNetConfiguration,
+    )
+    from deeplearning4j_tpu.nn.conf.layers import DenseLayer, OutputLayer
+    from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+
+    x = rng.standard_normal((32, 4)).astype(np.float32)
+    w = rng.standard_normal((4, 3)).astype(np.float32)
+    y = np.eye(3, dtype=np.float32)[np.argmax(x @ w, axis=1)]
+
+    conf = (NeuralNetConfiguration.builder()
+            .seed(12345)
+            .optimization_algo(algo)
+            .iterations(8)
+            .list()
+            .layer(DenseLayer(n_in=4, n_out=8, activation="tanh"))
+            .layer(OutputLayer(n_in=8, n_out=3, activation="softmax",
+                               loss_function="mcxent"))
+            .build())
+    net = MultiLayerNetwork(conf).init()
+    before = net.score(__import__("deeplearning4j_tpu.datasets.api",
+                                  fromlist=["DataSet"]).DataSet(x, y))
+    net.fit(x, y, epochs=2)
+    after = net.score(__import__("deeplearning4j_tpu.datasets.api",
+                                 fromlist=["DataSet"]).DataSet(x, y))
+    assert after < before

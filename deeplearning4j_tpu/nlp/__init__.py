@@ -1,0 +1,18 @@
+"""NLP stack — reference deeplearning4j-nlp (SURVEY.md §2.3).
+
+Host side (pure Python): tokenization, sentence/document iterators, vocab
+construction, Huffman coding, co-occurrence counting.
+Device side (JAX/XLA): batched skip-gram/CBOW/GloVe updates as dense
+gather → matmul → scatter-add steps (the reference's per-pair HogWild
+BLAS-1 loop does not map to TPU — SURVEY.md §3.4 TPU mapping).
+"""
+
+from deeplearning4j_tpu.nlp.vocab import Huffman, VocabCache, VocabConstructor, VocabWord
+from deeplearning4j_tpu.nlp.word2vec import Word2Vec
+from deeplearning4j_tpu.nlp.paragraph_vectors import ParagraphVectors
+from deeplearning4j_tpu.nlp.glove import Glove
+
+__all__ = [
+    "Huffman", "VocabCache", "VocabConstructor", "VocabWord",
+    "Word2Vec", "ParagraphVectors", "Glove",
+]

@@ -1,0 +1,155 @@
+"""GloVe — global vectors from co-occurrence statistics.
+
+Reference: models/glove/Glove.java + AbstractCoOccurrences.java
+(co-occurrence counting with 1/distance weighting, shuffled batches,
+AdaGrad per-element updates — SURVEY.md §2.3).
+
+TPU design: co-occurrence counting stays host-side (dict accumulation over
+windows, as the reference spills binary CoOccurrence files); training is
+batched weighted-least-squares on device — gather rows, compute
+f(X)·(w·w̃ + b + b̃ − log X)², AdaGrad scatter updates. Final vectors are
+w + w̃ (standard GloVe practice).
+"""
+
+from __future__ import annotations
+
+from collections import defaultdict
+from typing import Dict, Iterable, List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from functools import partial
+
+from deeplearning4j_tpu.nlp.sequencevectors import SequenceVectors
+from deeplearning4j_tpu.nlp.vocab import VocabConstructor
+
+
+class AbstractCoOccurrences:
+    """Symmetric windowed co-occurrence counts with 1/d weighting
+    (reference glove/AbstractCoOccurrences.java)."""
+
+    def __init__(self, window_size: int = 15, symmetric: bool = True):
+        self.window_size = window_size
+        self.symmetric = symmetric
+        self.counts: Dict[Tuple[int, int], float] = defaultdict(float)
+
+    def accumulate(self, idx: np.ndarray):
+        n = idx.size
+        for i in range(n):
+            for j in range(max(0, i - self.window_size), i):
+                w = 1.0 / (i - j)
+                a, b = int(idx[i]), int(idx[j])
+                self.counts[(a, b)] += w
+                if self.symmetric:
+                    self.counts[(b, a)] += w
+
+    def arrays(self):
+        if not self.counts:
+            return (np.zeros(0, np.int32), np.zeros(0, np.int32),
+                    np.zeros(0, np.float32))
+        ij = np.array(list(self.counts.keys()), np.int32)
+        x = np.array(list(self.counts.values()), np.float32)
+        return ij[:, 0].copy(), ij[:, 1].copy(), x
+
+
+@partial(jax.jit, donate_argnums=(0, 1, 2, 3, 4, 5, 6, 7))
+def glove_step(W, Wc, b, bc, hW, hWc, hb, hbc, i, j, logx, fx, lr):
+    """AdaGrad step on a batch of (i, j, X_ij) triples."""
+    wi, wj = W[i], Wc[j]                                  # [B, D]
+    diff = jnp.einsum("bd,bd->b", wi, wj) + b[i] + bc[j] - logx
+    wdiff = fx * diff                                     # [B]
+    loss = 0.5 * jnp.sum(wdiff * diff)
+
+    gwi = wdiff[:, None] * wj
+    gwj = wdiff[:, None] * wi
+    gb = wdiff
+
+    # AdaGrad: accumulate squared grads, scale updates
+    hW = hW.at[i].add(gwi ** 2)
+    hWc = hWc.at[j].add(gwj ** 2)
+    hb = hb.at[i].add(gb ** 2)
+    hbc = hbc.at[j].add(gb ** 2)
+    W = W.at[i].add(-lr * gwi / jnp.sqrt(hW[i] + 1e-8))
+    Wc = Wc.at[j].add(-lr * gwj / jnp.sqrt(hWc[j] + 1e-8))
+    b = b.at[i].add(-lr * gb / jnp.sqrt(hb[i] + 1e-8))
+    bc = bc.at[j].add(-lr * gb / jnp.sqrt(hbc[j] + 1e-8))
+    return W, Wc, b, bc, hW, hWc, hb, hbc, loss
+
+
+class Glove(SequenceVectors):
+    """GloVe trainer with the SequenceVectors query API (similarity,
+    words_nearest). Builder mirrors reference Glove.Builder (xMax, alpha,
+    shuffle, symmetric)."""
+
+    def __init__(self, layer_size: int = 100, window_size: int = 15,
+                 min_word_frequency: int = 1, epochs: int = 25,
+                 learning_rate: float = 0.05, x_max: float = 100.0,
+                 alpha: float = 0.75, batch_size: int = 4096,
+                 seed: int = 123, symmetric: bool = True, shuffle: bool = True,
+                 vocab_limit: Optional[int] = None):
+        super().__init__(layer_size=layer_size, window_size=window_size,
+                         min_word_frequency=min_word_frequency, epochs=epochs,
+                         learning_rate=learning_rate, batch_size=batch_size,
+                         seed=seed, negative=0, use_hs=False,
+                         vocab_limit=vocab_limit)
+        self.x_max = x_max
+        self.alpha = alpha
+        self.symmetric = symmetric
+        self.shuffle = shuffle
+        self.use_hs = False  # glove has no output tree
+
+    def _init_from_vocab(self):
+        V = self.vocab.num_words()
+        if V == 0:
+            raise ValueError("Empty vocabulary")
+        from deeplearning4j_tpu.nlp.lookup import InMemoryLookupTable
+
+        self.lookup_table = InMemoryLookupTable(V, self.layer_size,
+                                                seed=self.seed, negative=0)
+
+    def fit(self, sequences):
+        seq_list = [list(s) for s in sequences]
+        if self.vocab is None:
+            self.build_vocab(seq_list)
+        V = self.vocab.num_words()
+        D = self.layer_size
+
+        cooc = AbstractCoOccurrences(self.window_size, self.symmetric)
+        for tokens in seq_list:
+            idx = self._sequence_indices(tokens)
+            if idx.size:
+                cooc.accumulate(idx)
+        ii, jj, xx = cooc.arrays()
+        if ii.size == 0:
+            raise ValueError("No co-occurrences — corpus too small")
+        logx = np.log(xx)
+        fx = np.minimum(1.0, (xx / self.x_max) ** self.alpha).astype(np.float32)
+
+        key = jax.random.PRNGKey(self.seed)
+        k1, k2 = jax.random.split(key)
+        scale = 0.5 / D
+        W = (jax.random.uniform(k1, (V, D)) - 0.5) * 2 * scale
+        Wc = (jax.random.uniform(k2, (V, D)) - 0.5) * 2 * scale
+        b = jnp.zeros(V)
+        bc = jnp.zeros(V)
+        hW = jnp.full((V, D), 1e-8)
+        hWc = jnp.full((V, D), 1e-8)
+        hb = jnp.full(V, 1e-8)
+        hbc = jnp.full(V, 1e-8)
+
+        B = self.batch_size
+        n = ii.size
+        for _ in range(self.epochs):
+            order = self._rng.permutation(n) if self.shuffle else np.arange(n)
+            for s in range(0, n, B):
+                sel = order[s:s + B]
+                if sel.size < B:  # pad tail to keep one compiled shape
+                    sel = np.concatenate(
+                        [sel, self._rng.integers(0, n, B - sel.size)])
+                (W, Wc, b, bc, hW, hWc, hb, hbc, loss) = glove_step(
+                    W, Wc, b, bc, hW, hWc, hb, hbc,
+                    ii[sel], jj[sel], logx[sel], fx[sel], self.learning_rate)
+                self.loss_history.append(float(loss) / B)
+        self.lookup_table.set_vectors(np.asarray(W + Wc))
+        return self

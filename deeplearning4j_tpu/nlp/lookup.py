@@ -1,0 +1,245 @@
+"""Embedding lookup table + batched device training steps.
+
+Reference (SURVEY.md §2.3):
+- embeddings/inmemory/InMemoryLookupTable.java:62 — syn0/syn1/syn1Neg,
+  init rand(vocab,dim).subi(0.5).divi(dim):133, expTable sigmoid lookup
+- embeddings/learning/impl/elements/SkipGram.java:160-229 — per-pair
+  hierarchical-softmax dot/axpy + negative sampling (HogWild, BLAS-1)
+- embeddings/learning/impl/elements/CBOW.java
+- embeddings/reader/impl/{BasicModelUtils,FlatModelUtils} — wordsNearest
+
+TPU-native redesign (SURVEY.md §3.4 TPU mapping): the reference updates one
+(word, context) pair at a time with racing threads. Here a whole batch of
+pairs becomes ONE jitted computation: gather rows → dense dot products →
+sigmoid losses → scatter-add updates (`.at[].add` sums duplicate indices,
+which XLA lowers to an on-device scatter). No expTable — the MXU/VPU
+computes sigmoids directly. Gradients are CLOSED-FORM (the σ(x)−label form
+the reference hand-codes), applied with plain SGD exactly like word2vec.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def _sigmoid(x):
+    return jax.nn.sigmoid(x)
+
+
+MAX_ROW_STEP = 0.1  # trust-region cap on a row's per-batch movement
+
+
+def _scatter_update(table, idx, grads, lr, weight=None):
+    """Apply -lr * per-row SUM of gradients, with a per-row step-norm cap.
+
+    The reference (and word2vec) applies pairs sequentially, so a word seen
+    k times in a batch moves k small lr-steps. The batched sum reproduces
+    that k*lr*avg_grad movement, but for degenerate corpora (tiny vocab →
+    hundreds of duplicates per batch) the summed step overshoots the
+    logistic-loss stability bound and diverges. Capping each row's step
+    L2-norm (trust region) keeps sequential-SGD-speed learning for
+    realistic sparse duplication and bounded steps in the worst case.
+    Masked/padding entries must carry zero grads (they add nothing to the
+    row sums). idx [N], grads [N, D]."""
+    del weight  # masked grads are zeroed by callers
+    sums = jnp.zeros_like(table).at[idx].add(grads.astype(table.dtype))
+    step = lr * sums
+    n = jnp.linalg.norm(step, axis=1, keepdims=True)
+    step = step * jnp.minimum(1.0, MAX_ROW_STEP / jnp.maximum(n, 1e-12))
+    return table - step
+
+
+# --------------------------------------------------------------------------
+# Skip-gram with negative sampling — batched
+# --------------------------------------------------------------------------
+@partial(jax.jit, donate_argnums=(0, 1))
+def sgns_step(syn0, syn1neg, center, context, negatives, lr):
+    """One SGD step on a batch of skip-gram pairs with K negatives each.
+
+    center [B], context [B], negatives [B,K] int32; lr scalar.
+    loss = -log σ(c·v_pos) - Σ_k log σ(-c·v_negk)   (word2vec SGNS)
+    """
+    c = syn0[center]                       # [B, D]
+    pos = syn1neg[context]                 # [B, D]
+    neg = syn1neg[negatives]               # [B, K, D]
+
+    pos_score = _sigmoid(jnp.einsum("bd,bd->b", c, pos))        # [B]
+    neg_score = _sigmoid(jnp.einsum("bd,bkd->bk", c, neg))      # [B, K]
+
+    g_pos = (pos_score - 1.0)[:, None]     # dL/d(c·pos)
+    g_neg = neg_score[:, :, None]          # dL/d(c·neg)
+
+    grad_c = g_pos * pos + jnp.einsum("bko,bkd->bd", g_neg, neg)
+    grad_pos = g_pos * c
+    grad_neg = g_neg * c[:, None, :]       # [B, K, D]
+
+    B, K = negatives.shape
+    syn0 = _scatter_update(syn0, center, grad_c, lr)
+    out_idx = jnp.concatenate([context, negatives.reshape(B * K)])
+    out_grad = jnp.concatenate([grad_pos, grad_neg.reshape(B * K, -1)])
+    syn1neg = _scatter_update(syn1neg, out_idx, out_grad, lr)
+
+    loss = -(jnp.sum(jnp.log(pos_score + 1e-10))
+             + jnp.sum(jnp.log(1.0 - neg_score + 1e-10)))
+    return syn0, syn1neg, loss / B
+
+
+# --------------------------------------------------------------------------
+# Skip-gram with hierarchical softmax — batched
+# --------------------------------------------------------------------------
+@partial(jax.jit, donate_argnums=(0, 1))
+def sg_hs_step(syn0, syn1, center, codes, points, mask, lr):
+    """Hierarchical-softmax step (reference SkipGram.iterateSample:181-197).
+
+    center [B]; codes [B,L] (0/1 per tree branch); points [B,L] inner-node
+    rows of syn1; mask [B,L] valid-depth mask.
+    loss = -Σ_d log σ((1-2*code_d) * c·syn1[point_d])
+    """
+    c = syn0[center]                       # [B, D]
+    nodes = syn1[points]                   # [B, L, D]
+    sign = 1.0 - 2.0 * codes.astype(c.dtype)                    # [B, L]
+    logit = jnp.einsum("bd,bld->bl", c, nodes)
+    p = _sigmoid(sign * logit)
+    m = mask.astype(c.dtype)
+
+    # dL/dlogit = -sign*(1-p)  (masked)
+    g = -sign * (1.0 - p) * m              # [B, L]
+    grad_c = jnp.einsum("bl,bld->bd", g, nodes)
+    grad_nodes = g[:, :, None] * c[:, None, :]
+
+    B, L = codes.shape
+    syn0 = _scatter_update(syn0, center, grad_c, lr)
+    # masked-out depths carry zero grads; route them to row 0 with weight 0
+    flat_pts = jnp.where(mask, points, 0).reshape(B * L)
+    syn1 = _scatter_update(
+        syn1, flat_pts, (grad_nodes * m[:, :, None]).reshape(B * L, -1), lr,
+        weight=None)
+
+    loss = -jnp.sum(jnp.log(p + 1e-10) * m)
+    return syn0, syn1, loss / B
+
+
+# --------------------------------------------------------------------------
+# CBOW — batched (negative sampling); also serves PV-DM with doc column
+# --------------------------------------------------------------------------
+@partial(jax.jit, donate_argnums=(0, 1))
+def cbow_ns_step(syn0, syn1neg, context, context_mask, target, negatives, lr):
+    """CBOW: mean of context vectors predicts the target
+    (reference CBOW.java). context [B,W] padded, context_mask [B,W],
+    target [B], negatives [B,K].
+    """
+    ctx = syn0[context]                                  # [B, W, D]
+    m = context_mask.astype(ctx.dtype)[:, :, None]
+    denom = jnp.maximum(m.sum(axis=1), 1.0)              # [B, 1]
+    h = (ctx * m).sum(axis=1) / denom                    # [B, D]
+
+    pos = syn1neg[target]
+    neg = syn1neg[negatives]
+    pos_score = _sigmoid(jnp.einsum("bd,bd->b", h, pos))
+    neg_score = _sigmoid(jnp.einsum("bd,bkd->bk", h, neg))
+
+    g_pos = (pos_score - 1.0)[:, None]
+    g_neg = neg_score[:, :, None]
+    grad_h = g_pos * pos + jnp.einsum("bko,bkd->bd", g_neg, neg)   # [B, D]
+    grad_ctx = (grad_h[:, None, :] / denom[:, None, :]) * m        # [B, W, D]
+
+    B, W = context.shape
+    K = negatives.shape[1]
+    flat_ctx = jnp.where(context_mask, context, 0).reshape(B * W)
+    syn0 = _scatter_update(syn0, flat_ctx, grad_ctx.reshape(B * W, -1), lr,
+                         weight=None)
+    out_idx = jnp.concatenate([target, negatives.reshape(B * K)])
+    out_grad = jnp.concatenate(
+        [g_pos * h, (g_neg * h[:, None, :]).reshape(B * K, -1)])
+    syn1neg = _scatter_update(syn1neg, out_idx, out_grad, lr)
+
+    loss = -(jnp.sum(jnp.log(pos_score + 1e-10))
+             + jnp.sum(jnp.log(1.0 - neg_score + 1e-10)))
+    return syn0, syn1neg, loss / B
+
+
+# --------------------------------------------------------------------------
+# Inference-only variants (frozen syn1) for ParagraphVectors.infer_vector
+# --------------------------------------------------------------------------
+@jax.jit
+def infer_sgns_step(vec, syn1neg, context, negatives, lr):
+    """Train a single free vector against frozen output weights.
+    vec [D]; context [B]; negatives [B,K]."""
+    pos = syn1neg[context]                               # [B, D]
+    neg = syn1neg[negatives]                             # [B, K, D]
+    pos_score = _sigmoid(pos @ vec)                      # [B]
+    neg_score = _sigmoid(jnp.einsum("bkd,d->bk", neg, vec))
+    grad = ((pos_score - 1.0)[:, None] * pos).sum(0) + \
+        jnp.einsum("bk,bkd->d", neg_score, neg)
+    loss = -(jnp.sum(jnp.log(pos_score + 1e-10))
+             + jnp.sum(jnp.log(1.0 - neg_score + 1e-10)))
+    return vec - lr * grad, loss
+
+
+# --------------------------------------------------------------------------
+# The lookup table object
+# --------------------------------------------------------------------------
+class InMemoryLookupTable:
+    """Embedding storage (reference InMemoryLookupTable.java:62).
+
+    syn0: input embeddings [V, D]; syn1: HS inner nodes; syn1neg: NS output
+    embeddings. Device arrays — updates happen in the jitted steps above.
+    """
+
+    def __init__(self, vocab_size: int, vector_length: int,
+                 seed: int = 123, use_hs: bool = False, negative: int = 5,
+                 dtype=jnp.float32):
+        self.vocab_size = vocab_size
+        self.vector_length = vector_length
+        self.use_hs = use_hs
+        self.negative = negative
+        self.dtype = dtype
+        self.seed = seed
+        self.reset_weights()
+
+    def reset_weights(self):
+        key = jax.random.PRNGKey(self.seed)
+        # reference init: (rand - 0.5) / dim   (InMemoryLookupTable.java:133)
+        self.syn0 = ((jax.random.uniform(
+            key, (self.vocab_size, self.vector_length)) - 0.5)
+            / self.vector_length).astype(self.dtype)
+        self.syn1 = jnp.zeros((self.vocab_size, self.vector_length), self.dtype)
+        self.syn1neg = jnp.zeros(
+            (self.vocab_size, self.vector_length), self.dtype)
+
+    # vectors --------------------------------------------------------------
+    def vector(self, index: int) -> np.ndarray:
+        return np.asarray(self.syn0[index])
+
+    def vectors(self) -> np.ndarray:
+        return np.asarray(self.syn0)
+
+    def set_vectors(self, arr: np.ndarray):
+        self.syn0 = jnp.asarray(arr, self.dtype)
+        self.vocab_size, self.vector_length = arr.shape
+
+    # similarity (reference BasicModelUtils.wordsNearest — brute-force
+    # cosine; on TPU one normalized matmul + top_k) ------------------------
+    def _normed(self):
+        n = jnp.linalg.norm(self.syn0, axis=1, keepdims=True)
+        return self.syn0 / jnp.maximum(n, 1e-12)
+
+    def nearest(self, query_vec: np.ndarray, top_n: int = 10,
+                exclude=()) -> list:
+        normed = self._normed()
+        q = jnp.asarray(query_vec, self.dtype)
+        q = q / jnp.maximum(jnp.linalg.norm(q), 1e-12)
+        sims = normed @ q
+        if exclude:
+            sims = sims.at[jnp.asarray(list(exclude))].set(-jnp.inf)
+        vals, idx = jax.lax.top_k(sims, min(top_n, self.vocab_size))
+        return list(zip(np.asarray(idx).tolist(), np.asarray(vals).tolist()))
+
+    def similarity(self, i: int, j: int) -> float:
+        a, b = self.syn0[i], self.syn0[j]
+        denom = jnp.linalg.norm(a) * jnp.linalg.norm(b)
+        return float(jnp.vdot(a, b) / jnp.maximum(denom, 1e-12))

@@ -269,16 +269,21 @@ class MultiLayerNetwork:
         line-searched passes per minibatch instead of the fused SGD step)."""
         from deeplearning4j_tpu.optimize.solvers import Solver
 
-        if self.conf.backprop_type in (BackpropType.TRUNCATED_BPTT,
-                                       "truncated_bptt"):
-            raise ValueError(
-                "TRUNCATED_BPTT requires STOCHASTIC_GRADIENT_DESCENT; "
-                "second-order solvers would differentiate the full sequence")
+        tbptt = self.conf.backprop_type in (BackpropType.TRUNCATED_BPTT,
+                                            "truncated_bptt")
         solver = Solver(self)
         for _ in range(epochs):
             it.reset()
             while it.has_next():
                 ds = it.next()
+                # mirror the SGD path's condition: TBPTT only engages for
+                # 3-D sequences longer than the truncation window
+                if (tbptt and np.asarray(ds.features).ndim == 3
+                        and ds.features.shape[1] > self.conf.tbptt_fwd_length):
+                    raise ValueError(
+                        "TRUNCATED_BPTT requires "
+                        "STOCHASTIC_GRADIENT_DESCENT; second-order solvers "
+                        "would differentiate the full sequence")
                 solver.optimize(self._batch_dict(ds), rng=self._next_rng())
                 for lst in self.listeners:
                     lst.iteration_done(self, self.iteration_count)

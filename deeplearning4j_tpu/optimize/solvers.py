@@ -159,14 +159,17 @@ class SolveResult:
 class BaseOptimizer:
     """Shared machinery (reference solvers/BaseOptimizer.java).
 
-    loss_f: flat-vector -> scalar, pure & jittable (already closed over the
-    minibatch). Subclasses define `direction(g, aux)` and curvature updates.
+    loss_f(x, *args) -> scalar, pure & jittable. `*args` (minibatch, layer
+    state, rng, ...) are threaded through the jitted closures as TRACED
+    arguments so one optimizer instance serves every minibatch without
+    retracing. Subclasses define `direction(g, aux)` and curvature updates.
     """
 
     def __init__(self, loss_f: Callable, max_iterations: int = 10,
                  step_function: Optional[StepFunction] = None,
                  terminations: Sequence[TerminationCondition] = DEFAULT_TERMINATIONS,
-                 listeners=(), initial_step: float = 1.0):
+                 listeners=(), initial_step: float = 1.0,
+                 max_line_search_iterations: int = 16):
         self.loss_f = loss_f
         self.vg = jax.jit(jax.value_and_grad(loss_f))
         self.max_iterations = max_iterations
@@ -179,12 +182,18 @@ class BaseOptimizer:
         sign = self.step_function.sign
 
         @jax.jit
-        def _line_step(x, f0, g, direction):
+        def _line_step(x, f0, g, direction, *args):
             # search along sign*direction (NegativeDefault steps downhill
             # along +gradient-style directions)
             d = sign * direction
-            t, ft = backtrack_line_search(loss_f, x, f0, g, d,
-                                          initial_step=initial_step)
+            # descent guard (reference BackTrackLineSearch slope check):
+            # if <g,d> >= 0 the Armijo test could accept an uphill point —
+            # restart with steepest descent instead
+            d = jnp.where(jnp.vdot(g, d) < 0, d, -g)
+            f = lambda z: loss_f(z, *args)  # noqa: E731
+            t, ft = backtrack_line_search(
+                f, x, f0, g, d, initial_step=initial_step,
+                max_iters=max_line_search_iterations)
             return x + t * d, ft, t
 
         self._line_step = _line_step
@@ -197,26 +206,26 @@ class BaseOptimizer:
         """Return (direction pointing DOWNHILL-when-negated, new aux)."""
         return g, aux
 
-    def update_aux(self, aux, x_old, x_new, g_old, g_new):
+    def update_aux(self, aux, x_old, x_new, g_old, g_new, d_used):
         return aux
 
     # main loop (reference BaseOptimizer.optimize:191) ----------------------
-    def optimize(self, x0) -> SolveResult:
+    def optimize(self, x0, *args) -> SolveResult:
         x = jnp.asarray(x0)
-        f, g = self.vg(x)
+        f, g = self.vg(x, *args)
         aux = self.init_aux(x, g)
         old_f = float("inf")
         converged = False
         i = 0
         for i in range(1, self.max_iterations + 1):
             d, aux = self.direction(x, g, aux)
-            x_new, f_new, t = self._line_step(x, f, g, d)
+            x_new, f_new, t = self._line_step(x, f, g, d, *args)
             if float(t) == 0.0:  # no decrease along d — give up (ref: step==0)
                 converged = True
                 break
             f_new_f = float(f_new)
-            _, g_new = self.vg(x_new)
-            aux = self.update_aux(aux, x, x_new, g, g_new)
+            _, g_new = self.vg(x_new, *args)
+            aux = self.update_aux(aux, x, x_new, g, g_new, d)
             x, old_f, f, g = x_new, float(f), f_new, g_new
             self.score_value = f_new_f
             for lst in self.listeners:
@@ -249,25 +258,8 @@ class ConjugateGradient(BaseOptimizer):
         )
         return g + beta * d_prev, aux
 
-    def update_aux(self, aux, x_old, x_new, g_old, g_new):
-        # direction used this iteration is reconstructed next call from g_prev/d_prev
-        d_used = self._last_d if hasattr(self, "_last_d") else g_old
+    def update_aux(self, aux, x_old, x_new, g_old, g_new, d_used):
         return {"d_prev": d_used, "g_prev": g_old, "first": False}
-
-    def optimize(self, x0):
-        # track the direction actually used so update_aux can store it
-        orig_direction = self.direction
-
-        def tracked(x, g, aux):
-            d, aux = orig_direction(x, g, aux)
-            self._last_d = d
-            return d, aux
-
-        self.direction = tracked
-        try:
-            return super().optimize(x0)
-        finally:
-            self.direction = orig_direction
 
 
 class LBFGS(BaseOptimizer):
@@ -333,7 +325,7 @@ class LBFGS(BaseOptimizer):
                            aux["head"])
         return d, aux
 
-    def update_aux(self, aux, x_old, x_new, g_old, g_new):
+    def update_aux(self, aux, x_old, x_new, g_old, g_new, d_used):
         s = x_new - x_old
         y = g_new - g_old
         sy = float(jnp.vdot(s, y))
@@ -359,17 +351,17 @@ class StochasticGradientDescent(BaseOptimizer):
         self.lr = lr
 
         @jax.jit
-        def sgd_step(x):
-            f, g = jax.value_and_grad(loss_f)(x)
+        def sgd_step(x, *args):
+            f, g = jax.value_and_grad(loss_f)(x, *args)
             return x - lr * g, f
 
         self._sgd_step = sgd_step
 
-    def optimize(self, x0):
+    def optimize(self, x0, *args):
         x = jnp.asarray(x0)
         f = float("nan")
         for i in range(1, self.max_iterations + 1):
-            x, fv = self._sgd_step(x)
+            x, fv = self._sgd_step(x, *args)
             f = float(fv)
             self.score_value = f
             for lst in self.listeners:
@@ -407,23 +399,40 @@ class Solver:
         self.listeners = list(listeners)
 
     def get_optimizer(self, loss_f) -> BaseOptimizer:
+        g = self.model.conf.conf
         cls = _OPTIMIZERS[OptimizationAlgorithm(self.algorithm)]
         kw = {}
         if cls is StochasticGradientDescent:
-            kw["lr"] = self.model.conf.conf.learning_rate
+            kw["lr"] = g.learning_rate
+        else:
+            kw["max_line_search_iterations"] = max(
+                1, g.max_num_line_search_iterations)
         return cls(loss_f, max_iterations=self.max_iterations,
                    listeners=self.listeners, **kw)
 
+    def _get_cached(self, params):
+        """One optimizer + one unravel for the whole fit: state/rng/batch are
+        traced arguments of the jitted closures, so successive minibatches
+        reuse the compiled computation (no per-batch retrace)."""
+        if getattr(self, "_opt", None) is None:
+            _, self._unravel = ravel_pytree(params)
+            m = self.model
+            unravel = self._unravel
+
+            def loss_f(x, state, rng, batch):
+                loss, _ = m._loss(unravel(x), state, rng, batch, train=True)
+                return loss
+
+            self._opt = self.get_optimizer(loss_f)
+        return self._opt, self._unravel
+
     def optimize(self, batch, rng=None):
         m = self.model
-        flat, unravel = ravel_pytree(m.params)
-
-        def loss_f(x):
-            loss, _ = m._loss(unravel(x), m.state, rng, batch, train=True)
-            return loss
-
-        opt = self.get_optimizer(loss_f)
-        res = opt.optimize(flat)
+        opt, unravel = self._get_cached(m.params)
+        flat, _ = ravel_pytree(m.params)
+        if rng is None:
+            rng = jax.random.PRNGKey(0)
+        res = opt.optimize(flat, m.state, rng, batch)
         m.params = unravel(res.x)
         # one forward at the solution to refresh layer state (BatchNorm
         # running stats etc.) — the flat loss closure discards it

@@ -1,0 +1,269 @@
+"""NLP stack tests — modeled on the reference's test strategy (SURVEY.md §4
+item 6): Word2Vec end-to-end nearest-neighbor sanity, serializer
+round-trips, vocab construction, tokenizer/iterator unit tests.
+"""
+
+import os
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.nlp.text import (
+    BasicLineIterator,
+    CollectionSentenceIterator,
+    CommonPreprocessor,
+    DefaultTokenizerFactory,
+    EndingPreProcessor,
+    LabelAwareListSentenceIterator,
+    NGramTokenizer,
+    PrefetchingSentenceIterator,
+    SentenceTransformer,
+    get_stop_words,
+    input_homogenization,
+    windows,
+)
+from deeplearning4j_tpu.nlp.vocab import (
+    Huffman,
+    VocabConstructor,
+    VocabWord,
+    unigram_table,
+    sample_negatives,
+)
+
+
+# --------------------------------------------------------------- fixtures
+def synthetic_corpus(rng, n_sentences=300):
+    """Two word 'clusters' that co-occur within, never across — embeddings
+    must place same-cluster words closer (Word2VecTestsSmall analogue)."""
+    animals = ["cat", "dog", "mouse", "horse", "cow", "sheep"]
+    tech = ["cpu", "gpu", "ram", "disk", "cache", "bus"]
+    sents = []
+    for _ in range(n_sentences):
+        pool = animals if rng.random() < 0.5 else tech
+        sents.append(" ".join(rng.choice(pool, size=8)))
+    return sents, animals, tech
+
+
+# ------------------------------------------------------------- tokenizers
+def test_default_tokenizer_and_preprocessor():
+    f = DefaultTokenizerFactory()
+    f.set_token_pre_processor(CommonPreprocessor())
+    toks = f.create("Hello, World! 42 times").get_tokens()
+    assert toks == ["hello", "world", "times"]
+
+
+def test_ngram_tokenizer():
+    toks = NGramTokenizer("a b c", 1, 2).get_tokens()
+    assert "a b" in toks and "b c" in toks and "a" in toks
+
+
+def test_ending_preprocessor():
+    p = EndingPreProcessor()
+    assert p.pre_process("running") == "runn"
+    assert p.pre_process("cats") == "cat"
+
+
+def test_input_homogenization():
+    assert input_homogenization("Héllo, Wörld!") == "hello world"
+
+
+def test_windows():
+    ws = windows(["a", "b", "c", "d", "e"], window_size=4)
+    assert len(ws) == 5
+    assert ws[0].focus_word() == "a"
+    assert ws[2].words == ["a", "b", "c", "d", "e"]
+
+
+# -------------------------------------------------------------- iterators
+def test_basic_line_iterator(tmp_path):
+    p = tmp_path / "corpus.txt"
+    p.write_text("line one\nline two\nline three\n")
+    it = BasicLineIterator(str(p))
+    assert list(it) == ["line one", "line two", "line three"]
+    it.reset()
+    assert it.next_sentence() == "line one"
+
+
+def test_prefetching_iterator():
+    base = CollectionSentenceIterator([f"s{i}" for i in range(100)])
+    it = PrefetchingSentenceIterator(base, buffer_size=8)
+    assert sorted(list(it)) == sorted(f"s{i}" for i in range(100))
+
+
+def test_label_aware_iterator():
+    it = LabelAwareListSentenceIterator(["doc a", "doc b"], ["pos", "neg"])
+    docs = list(it)
+    assert [d.labels[0] for d in docs] == ["pos", "neg"]
+    assert it.get_labels_source().get_labels() == ["pos", "neg"]
+
+
+# ------------------------------------------------------------------ vocab
+def test_vocab_constructor_counts_and_filter():
+    seqs = [["a", "b", "a"], ["a", "c"], ["b", "a"]]
+    cache = (VocabConstructor(min_word_frequency=2)
+             .add_source(seqs).build_joint_vocabulary())
+    assert cache.index_of("a") == 0  # most frequent first
+    assert cache.word_frequency("a") == 4
+    assert not cache.contains_word("c")  # filtered at min freq 2
+
+
+def test_huffman_codes_prefix_free():
+    words = [VocabWord(w, c) for w, c in
+             [("a", 100), ("b", 50), ("c", 20), ("d", 10), ("e", 5)]]
+    Huffman(words).build()
+    codes = {w.word: "".join(map(str, w.code)) for w in words}
+    # frequent words get shorter codes
+    assert len(codes["a"]) <= len(codes["e"])
+    # prefix-free property
+    vals = list(codes.values())
+    for i, a in enumerate(vals):
+        for j, b in enumerate(vals):
+            if i != j:
+                assert not b.startswith(a)
+
+
+def test_unigram_table_sampling_distribution():
+    seqs = [["common"] * 90 + ["rare"] * 10]
+    cache = VocabConstructor().add_source(seqs).build_joint_vocabulary()
+    cum = unigram_table(cache)
+    rng = np.random.default_rng(0)
+    draws = sample_negatives(cum, (10000,), rng)
+    frac_common = (draws == cache.index_of("common")).mean()
+    # 90^.75 : 10^.75 ≈ 0.84 : 0.16
+    assert 0.75 < frac_common < 0.92
+
+
+# --------------------------------------------------------------- word2vec
+def test_word2vec_cluster_similarity(rng):
+    from deeplearning4j_tpu.nlp.word2vec import Word2Vec
+
+    sents, animals, tech = synthetic_corpus(rng)
+    w2v = (Word2Vec.builder()
+           .iterate(sents)
+           .layer_size(24).window_size(3).min_word_frequency(1)
+           .epochs(4).seed(7).negative_sample(5).batch_size(512)
+           .build())
+    w2v.fit()
+    assert w2v.vocab_size == 12
+    within = w2v.similarity("cat", "dog")
+    across = w2v.similarity("cat", "gpu")
+    assert within > across, (within, across)
+    nearest = w2v.words_nearest("cpu", 3)
+    assert all(w in ("gpu", "ram", "disk", "cache", "bus") for w in nearest)
+
+
+def test_word2vec_hierarchical_softmax(rng):
+    from deeplearning4j_tpu.nlp.word2vec import Word2Vec
+
+    sents, animals, tech = synthetic_corpus(rng, 200)
+    w2v = (Word2Vec.builder().iterate(sents).layer_size(16).window_size(3)
+           .epochs(3).seed(3).negative_sample(0).use_hierarchic_softmax()
+           .batch_size(256).build())
+    w2v.fit()
+    assert w2v.similarity("cat", "horse") > w2v.similarity("cat", "disk")
+
+
+def test_word2vec_cbow(rng):
+    from deeplearning4j_tpu.nlp.word2vec import Word2Vec
+
+    sents, _, _ = synthetic_corpus(rng, 200)
+    w2v = (Word2Vec.builder().iterate(sents).layer_size(16).window_size(3)
+           .epochs(3).seed(3).elements_learning_algorithm("CBOW")
+           .batch_size(256).build())
+    w2v.fit()
+    assert w2v.similarity("cow", "sheep") > w2v.similarity("cow", "cache")
+
+
+# ------------------------------------------------------------- serializer
+def test_serializer_roundtrips(tmp_path, rng):
+    from deeplearning4j_tpu.nlp.serializer import WordVectorSerializer
+    from deeplearning4j_tpu.nlp.word2vec import Word2Vec
+
+    sents, _, _ = synthetic_corpus(rng, 60)
+    w2v = (Word2Vec.builder().iterate(sents).layer_size(8).epochs(1)
+           .batch_size(128).build())
+    w2v.fit()
+
+    txt = str(tmp_path / "vecs.txt")
+    WordVectorSerializer.write_word_vectors(w2v, txt)
+    loaded = WordVectorSerializer.load_txt_vectors(txt)
+    np.testing.assert_allclose(loaded.get_word_vector("cat"),
+                               w2v.get_word_vector("cat"), atol=1e-5)
+
+    binp = str(tmp_path / "vecs.bin")
+    WordVectorSerializer.write_binary(w2v, binp)
+    loaded2 = WordVectorSerializer.load_google_model(binp)
+    np.testing.assert_allclose(loaded2.get_word_vector("dog"),
+                               w2v.get_word_vector("dog"), atol=1e-6)
+
+    full = str(tmp_path / "model.zip")
+    WordVectorSerializer.write_full_model(w2v, full)
+    loaded3 = WordVectorSerializer.read_full_model(full)
+    assert loaded3.vocab.num_words() == w2v.vocab.num_words()
+    np.testing.assert_allclose(np.asarray(loaded3.lookup_table.syn0),
+                               np.asarray(w2v.lookup_table.syn0), atol=1e-6)
+    assert loaded3.similarity("cat", "dog") == pytest.approx(
+        w2v.similarity("cat", "dog"), abs=1e-5)
+
+
+# ----------------------------------------------------- paragraph vectors
+def test_paragraph_vectors_dbow(rng):
+    from deeplearning4j_tpu.nlp.paragraph_vectors import ParagraphVectors
+
+    sents, animals, tech = synthetic_corpus(rng, 200)
+    labels = ["animal" if any(w in s.split() for w in animals) else "tech"
+              for s in sents]
+    pv = ParagraphVectors(layer_size=24, window_size=3, epochs=4, seed=5,
+                          negative=5, batch_size=512)
+    pv.fit(sents, labels)
+    assert set(pv.labels) == {"animal", "tech"}
+    assert (pv.similarity_to_label(["cat", "dog"], "animal")
+            > pv.similarity_to_label(["cat", "dog"], "tech"))
+    vec = pv.infer_vector("cat dog mouse")
+    assert vec.shape == (24,) and np.isfinite(vec).all()
+    assert pv.nearest_labels("cat dog horse cow", 1)[0] == "animal"
+
+
+# ------------------------------------------------------------------ glove
+def test_glove_cluster_similarity(rng):
+    from deeplearning4j_tpu.nlp.glove import Glove
+
+    sents, _, _ = synthetic_corpus(rng, 300)
+    glove = Glove(layer_size=16, window_size=5, epochs=15, seed=11,
+                  batch_size=1024)
+    glove.fit([s.split() for s in sents])
+    assert glove.similarity("cat", "dog") > glove.similarity("cat", "gpu")
+
+
+# ------------------------------------------------------------------ tfidf
+def test_tfidf_and_bow_vectorizers():
+    from deeplearning4j_tpu.nlp.bagofwords import (
+        BagOfWordsVectorizer,
+        TfidfVectorizer,
+    )
+
+    docs = ["the cat sat", "the dog ran", "the cat ran home"]
+    bow = BagOfWordsVectorizer().fit(docs)
+    v = bow.transform("cat cat dog")
+    assert v[bow.vocab.index_of("cat")] == 2
+    assert v[bow.vocab.index_of("dog")] == 1
+
+    tfidf = TfidfVectorizer().fit(docs)
+    v2 = tfidf.transform("the cat")
+    # 'the' appears in every doc → idf 0; 'cat' in 2 of 3 → positive
+    assert v2[tfidf.vocab.index_of("the")] == 0.0
+    assert v2[tfidf.vocab.index_of("cat")] > 0.0
+
+    ds = tfidf.vectorize(docs, ["a", "b", "a"])
+    assert ds.features.shape[0] == 3 and ds.labels.shape == (3, 2)
+
+
+def test_stop_words():
+    assert "the" in get_stop_words()
+
+
+def test_sentence_transformer_filters_stops():
+    st = SentenceTransformer(
+        CollectionSentenceIterator(["the cat sat on the mat"]),
+        stop_words=get_stop_words())
+    assert list(st) == [["cat", "sat", "mat"]]

@@ -180,6 +180,20 @@ def infer_sgns_step(vec, syn1neg, context, negatives, lr):
     return vec - lr * grad, loss
 
 
+@jax.jit
+def infer_hs_step(vec, syn1, codes, points, mask, lr):
+    """Hierarchical-softmax counterpart of infer_sgns_step: one free vector
+    against the frozen Huffman inner nodes. codes/points/mask [B, L]."""
+    nodes = syn1[points]                                 # [B, L, D]
+    sign = 1.0 - 2.0 * codes.astype(vec.dtype)
+    p = _sigmoid(sign * jnp.einsum("d,bld->bl", vec, nodes))
+    m = mask.astype(vec.dtype)
+    g = -sign * (1.0 - p) * m
+    grad = jnp.einsum("bl,bld->d", g, nodes)
+    loss = -jnp.sum(jnp.log(p + 1e-10) * m)
+    return vec - lr * grad, loss
+
+
 # --------------------------------------------------------------------------
 # The lookup table object
 # --------------------------------------------------------------------------

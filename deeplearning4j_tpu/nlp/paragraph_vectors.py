@@ -17,7 +17,7 @@ from typing import Dict, List, Optional, Sequence
 import jax.numpy as jnp
 import numpy as np
 
-from deeplearning4j_tpu.nlp.lookup import infer_sgns_step
+from deeplearning4j_tpu.nlp.lookup import infer_hs_step, infer_sgns_step
 from deeplearning4j_tpu.nlp.sequencevectors import SequenceVectors
 from deeplearning4j_tpu.nlp.text import (
     LabelAwareIterator,
@@ -36,14 +36,15 @@ class ParagraphVectors(SequenceVectors):
         algo = sequence_learning_algorithm.lower()
         kw.setdefault("elements_learning_algorithm",
                       "cbow" if algo == "dm" else "skipgram")
+        self.train_words = kw.pop("train_words", True)
         super().__init__(**kw)
         self.sequence_algorithm = algo
         self.labels: List[str] = []
         self._label_index: Dict[str, int] = {}
         self._doc_labels: List[List[str]] = []
+        self._max_labels_per_doc = 1
         self._iterator: Optional[LabelAwareIterator] = None
         self._factory: Optional[TokenizerFactory] = None
-        self.train_words = kw.get("train_words", True)
 
     # Builder is attached at module bottom (shares Word2Vec.Builder surface)
 
@@ -70,7 +71,9 @@ class ParagraphVectors(SequenceVectors):
         return len(self.labels)
 
     def _max_extra_context(self) -> int:
-        return 1 if self.sequence_algorithm == "dm" else 0
+        # PV-DM appends every doc label as a context column
+        return (self._max_labels_per_doc
+                if self.sequence_algorithm == "dm" else 0)
 
     # ----------------------------------------------------------- training
     def fit(self, docs=None, labels=None):
@@ -78,15 +81,19 @@ class ParagraphVectors(SequenceVectors):
         self._doc_labels = doc_labels
         # register labels before vocab init so syn0 gets the extra rows
         self.labels = sorted({l for ls in doc_labels for l in ls})
+        self._max_labels_per_doc = max(
+            (len(ls) for ls in doc_labels), default=1)
         self.build_vocab(seqs)
         V = self.vocab.num_words()
         self._label_index = {l: V + i for i, l in enumerate(self.labels)}
         label_rows = [[self._label_index[l] for l in ls] for ls in doc_labels]
 
         total = self.vocab.total_word_occurrences * self.epochs
+        done = 0.0
         for _ in range(self.epochs):
-            self._train_corpus(seqs, total,
-                               label_for_sequence=lambda si: label_rows[si])
+            done = self._train_corpus(
+                seqs, total, label_for_sequence=lambda si: label_rows[si],
+                words_done=done)
         return self
 
     # ----------------------------------------------------------- queries
@@ -131,11 +138,19 @@ class ParagraphVectors(SequenceVectors):
         vec = jnp.asarray(
             (rng.random(self.layer_size) - 0.5) / self.layer_size,
             self.lookup_table.dtype)
-        for _ in range(steps):
-            negs = sample_negatives(self._cum_table,
-                                    (idx.size, max(self.negative, 1)), rng)
-            vec, _ = infer_sgns_step(vec, self.lookup_table.syn1neg,
-                                     idx, negs, lr)
+        if self.use_hs:
+            codes, points, mask = (self._codes[idx], self._points[idx],
+                                   self._mask[idx])
+            for _ in range(steps):
+                vec, _ = infer_hs_step(vec, self.lookup_table.syn1,
+                                       codes, points, mask, lr)
+        else:
+            for _ in range(steps):
+                negs = sample_negatives(self._cum_table,
+                                        (idx.size, max(self.negative, 1)),
+                                        rng)
+                vec, _ = infer_sgns_step(vec, self.lookup_table.syn1neg,
+                                         idx, negs, lr)
         return np.asarray(vec)
 
 

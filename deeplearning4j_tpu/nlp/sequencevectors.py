@@ -36,6 +36,7 @@ from deeplearning4j_tpu.nlp.vocab import (
     VocabConstructor,
     keep_probabilities,
     sample_negatives,
+    subsample_mask,
     unigram_table,
 )
 
@@ -115,7 +116,7 @@ class SequenceVectors:
         idx = [self.vocab.index_of(t) for t in tokens]
         arr = np.array([i for i in idx if i >= 0], dtype=np.int32)
         if self.sampling > 0 and arr.size:
-            arr = arr[self._rng.random(arr.size) < self._keep_prob[arr]]
+            arr = arr[subsample_mask(arr, self._keep_prob, self._rng)]
         return arr
 
     def _pairs_for_sequence(self, idx: np.ndarray,
@@ -190,11 +191,11 @@ class SequenceVectors:
         self.loss_history.append(float(loss))
 
     def _train_corpus(self, sequences, total_words: float,
-                      label_for_sequence=None):
+                      label_for_sequence=None, words_done: float = 0.0):
         """One pass; label_for_sequence(seq_index) -> list of extra element
-        indices (ParagraphVectors hooks in here)."""
+        indices (ParagraphVectors hooks in here). words_done carries the
+        cross-epoch word count so alpha decays over the WHOLE run."""
         B = self.batch_size
-        words_done = 0.0
         if self.algorithm == "skipgram":
             buf_c = np.empty(0, np.int32)
             buf_x = np.empty(0, np.int32)
@@ -264,8 +265,9 @@ class SequenceVectors:
         total = self.vocab.total_word_occurrences * self.epochs
         done = 0.0
         for _ in range(self.epochs):
-            done += self._train_corpus(
-                corpus if seq_list is None else seq_list, total)
+            done = self._train_corpus(
+                corpus if seq_list is None else seq_list, total,
+                words_done=done)
         return self
 
     # ------------------------------------------------------- vector queries
@@ -292,7 +294,10 @@ class SequenceVectors:
         else:
             vec, exclude = np.asarray(word_or_vec), set()
         V = self.vocab.num_words()
-        hits = self.lookup_table.nearest(vec, top_n + len(exclude) + 1,
+        # non-word rows (e.g. ParagraphVectors labels) may dominate the
+        # neighborhood — fetch enough candidates to still return top_n words
+        extra = self.lookup_table.vocab_size - V
+        hits = self.lookup_table.nearest(vec, top_n + len(exclude) + extra,
                                          exclude=exclude)
         return [self.vocab.word_at_index(i) for i, _ in hits if i < V][:top_n]
 
@@ -312,6 +317,7 @@ class SequenceVectors:
                 vec -= self.lookup_table.vector(i)
                 exclude.add(i)
         V = self.vocab.num_words()
-        hits = self.lookup_table.nearest(vec, top_n + len(exclude) + 1,
+        extra = self.lookup_table.vocab_size - V
+        hits = self.lookup_table.nearest(vec, top_n + len(exclude) + extra,
                                          exclude=exclude)
         return [self.vocab.word_at_index(i) for i, _ in hits if i < V][:top_n]

@@ -133,6 +133,13 @@ class WordVectorSerializer:
             from deeplearning4j_tpu.nlp.vocab import unigram_table
 
             model._cum_table = unigram_table(cache)
+        if cfg["use_hs"]:
+            # rebuild the padded huffman arrays from the stored codes so a
+            # loaded model can continue training / infer
+            from deeplearning4j_tpu.nlp.vocab import Huffman
+
+            model._codes, model._points, model._mask = Huffman(
+                cache.vocab_words()).padded_arrays()
         return model
 
 

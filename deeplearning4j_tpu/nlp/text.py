@@ -291,13 +291,28 @@ class PrefetchingSentenceIterator(SentenceIterator):
 
     def _start(self):
         self._q: "queue.Queue" = queue.Queue(maxsize=self._size)
+        self._stop = threading.Event()
         self._next = None
+        q, stop, backend = self._q, self._stop, self._backend
 
         def produce():
-            self._backend.reset()
-            while self._backend.has_next():
-                self._q.put(self._backend.next_sentence())
-            self._q.put(self._DONE)
+            # locals only — a superseded producer can never touch the
+            # successor's queue
+            backend.reset()
+            while backend.has_next() and not stop.is_set():
+                item = backend.next_sentence()
+                while not stop.is_set():
+                    try:
+                        q.put(item, timeout=0.05)
+                        break
+                    except queue.Full:
+                        continue
+            while not stop.is_set():
+                try:
+                    q.put(self._DONE, timeout=0.05)
+                    break
+                except queue.Full:
+                    continue
 
         self._thread = threading.Thread(target=produce, daemon=True)
         self._thread.start()
@@ -316,7 +331,15 @@ class PrefetchingSentenceIterator(SentenceIterator):
         return s
 
     def reset(self):
-        self._thread.join(timeout=0.1)
+        # stop the old producer FULLY before restarting: both generations
+        # share the backend iterator, so they must never run concurrently
+        self._stop.set()
+        while self._thread.is_alive():
+            try:  # unblock a producer stuck on a full queue
+                self._q.get_nowait()
+            except queue.Empty:
+                pass
+            self._thread.join(timeout=0.01)
         self._start()
 
 

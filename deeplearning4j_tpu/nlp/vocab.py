@@ -206,8 +206,7 @@ class VocabConstructor:
         return cache
 
 
-def unigram_table(cache: VocabCache, table_size: int = 10_000_000,
-                  power: float = 0.75) -> np.ndarray:
+def unigram_table(cache: VocabCache, power: float = 0.75) -> np.ndarray:
     """Negative-sampling table: word index repeated ∝ count^0.75
     (reference InMemoryLookupTable.makeTable). Stored compactly as a
     cumulative-probability array sampled by searchsorted instead of the

@@ -267,3 +267,84 @@ def test_sentence_transformer_filters_stops():
         CollectionSentenceIterator(["the cat sat on the mat"]),
         stop_words=get_stop_words())
     assert list(st) == [["cat", "sat", "mat"]]
+
+
+# ------------------------------------------------- review-fix regressions
+def test_alpha_decays_across_epochs(rng):
+    """Learning rate must decay over the WHOLE run, not reset per epoch."""
+    from deeplearning4j_tpu.nlp.word2vec import Word2Vec
+
+    sents, _, _ = synthetic_corpus(rng, 50)
+    w2v = (Word2Vec.builder().iterate(sents).layer_size(8).epochs(5)
+           .batch_size(64).learning_rate(0.025).build())
+    seqs = [list(s) for s in w2v._sequences()]
+    w2v.build_vocab(seqs)
+    alphas = []
+    orig = w2v._alpha
+    w2v._alpha = lambda d, t: alphas.append(orig(d, t)) or orig(d, t)
+    w2v.fit(seqs)
+    assert alphas[-1] < 0.3 * alphas[0]  # decays well past 1/epochs
+
+
+def test_paragraph_vectors_hs_infer(rng):
+    """infer_vector must work on hierarchical-softmax models."""
+    from deeplearning4j_tpu.nlp.paragraph_vectors import ParagraphVectors
+
+    sents, animals, _ = synthetic_corpus(rng, 100)
+    labels = ["animal" if any(w in s.split() for w in animals) else "tech"
+              for s in sents]
+    pv = ParagraphVectors(layer_size=16, window_size=3, epochs=3, seed=5,
+                          negative=0, batch_size=256)  # HS mode
+    pv.fit(sents, labels)
+    vec = pv.infer_vector("cat dog mouse")
+    assert vec.shape == (16,) and np.isfinite(vec).all()
+    assert np.abs(vec).sum() > 0
+
+
+def test_paragraph_vectors_train_words_kwarg(rng):
+    from deeplearning4j_tpu.nlp.paragraph_vectors import ParagraphVectors
+
+    pv = ParagraphVectors(layer_size=8, train_words=False)
+    assert pv.train_words is False
+
+
+def test_words_nearest_with_many_labels(rng):
+    """Label rows must not crowd words out of words_nearest results."""
+    from deeplearning4j_tpu.nlp.paragraph_vectors import ParagraphVectors
+
+    sents, _, _ = synthetic_corpus(rng, 80)
+    pv = ParagraphVectors(layer_size=8, window_size=3, epochs=2, seed=5,
+                          batch_size=128)
+    pv.fit(sents)  # auto DOC_i label per sentence → 80 label rows vs 12 words
+    out = pv.words_nearest("cat", 5)
+    assert len(out) == 5
+    assert all(pv.vocab.contains_word(w) for w in out)
+
+
+def test_hs_model_resumes_after_reload(tmp_path, rng):
+    from deeplearning4j_tpu.nlp.serializer import WordVectorSerializer
+    from deeplearning4j_tpu.nlp.word2vec import Word2Vec
+
+    sents, _, _ = synthetic_corpus(rng, 40)
+    w2v = (Word2Vec.builder().iterate(sents).layer_size(8).epochs(1)
+           .negative_sample(0).use_hierarchic_softmax().batch_size(64)
+           .build())
+    w2v.fit()
+    p = str(tmp_path / "hs.zip")
+    WordVectorSerializer.write_full_model(w2v, p)
+    loaded = WordVectorSerializer.read_full_model(p)
+    loaded.fit([s.split() for s in sents[:10]])  # continue training
+    assert np.isfinite(np.asarray(loaded.lookup_table.syn0)).all()
+
+
+def test_prefetching_reset_no_race():
+    from deeplearning4j_tpu.nlp.text import (CollectionSentenceIterator,
+                                             PrefetchingSentenceIterator)
+
+    base = CollectionSentenceIterator([f"s{i}" for i in range(50)])
+    it = PrefetchingSentenceIterator(base, buffer_size=4)
+    for _ in range(5):
+        it.next_sentence()
+    it.reset()  # mid-stream reset while producer is active
+    out = list(it)
+    assert sorted(out) == sorted(f"s{i}" for i in range(50))

@@ -1,0 +1,29 @@
+"""Clustering + spatial-index algorithms (reference: deeplearning4j-core
+`org/deeplearning4j/clustering/` — kmeans, kdtree, vptree, quadtree, sptree).
+
+TPU-first split: KMeans assignment/update steps are jitted XLA computations
+(distances as one big matmul on the MXU); the spatial trees are host-side
+pointer structures used for nearest-neighbour queries and Barnes-Hut t-SNE —
+irregular tree walks don't map to the TPU and stay in NumPy, exactly the role
+they play in the reference (UI nearest-neighbors, BarnesHutTsne gradients).
+"""
+
+from .cluster import Cluster, ClusterSet, Point, PointClassification
+from .kmeans import KMeansClustering
+from .kdtree import KDTree, HyperRect
+from .vptree import VPTree
+from .quadtree import QuadTree
+from .sptree import SpTree
+
+__all__ = [
+    "Cluster",
+    "ClusterSet",
+    "Point",
+    "PointClassification",
+    "KMeansClustering",
+    "KDTree",
+    "HyperRect",
+    "VPTree",
+    "QuadTree",
+    "SpTree",
+]

@@ -356,3 +356,18 @@ def _conv_out_hw(h, w, kernel, stride, padding, mode, dilation):
         (h + 2 * padding[0] - kh) // stride[0] + 1,
         (w + 2 * padding[1] - kw) // stride[1] + 1,
     )
+
+
+def validate_layer_names(layer_conf) -> None:
+    """Eagerly resolve a layer conf's string-named activation / loss so a
+    typo'd name fails at init() with a named ValueError instead of at first
+    trace (the reference fails at conf time via its enums)."""
+    from deeplearning4j_tpu.ops.activations import get_activation
+    from deeplearning4j_tpu.ops.losses import validate_loss
+
+    act = getattr(layer_conf, "activation", None)
+    if act is not None:
+        get_activation(act)
+    loss = getattr(layer_conf, "loss_function", None)
+    if loss is not None:
+        validate_loss(loss)

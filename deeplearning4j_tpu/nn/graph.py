@@ -34,7 +34,7 @@ from deeplearning4j_tpu.nn.conf.graph_conf import (
     SubsetVertexConf,
     UnstackVertexConf,
 )
-from deeplearning4j_tpu.nn.conf.layers import BaseOutputLayer
+from deeplearning4j_tpu.nn.conf.layers import BaseOutputLayer, validate_layer_names
 from deeplearning4j_tpu.nn.layers import get_impl, l1_l2_penalty
 from deeplearning4j_tpu.nn.training import make_train_step
 from deeplearning4j_tpu.nn.updater import build_optimizer
@@ -84,6 +84,7 @@ class ComputationGraph:
         keys = jax.random.split(key, max(len(names), 1))
         for name, k in zip(names, keys):
             v = self.layer_vertices[name]
+            validate_layer_names(v.layer)
             p, s = self.impls[name].init(v.layer, k, self.param_dtype)
             params[name] = p
             state[name] = s

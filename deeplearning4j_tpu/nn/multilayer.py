@@ -37,6 +37,7 @@ from deeplearning4j_tpu.nn.conf.layers import (
     BaseOutputLayer,
     BaseRecurrentLayer,
     RnnOutputLayer,
+    validate_layer_names,
 )
 from deeplearning4j_tpu.nn.conf.neural_net_configuration import MultiLayerConfiguration
 from deeplearning4j_tpu.nn.layers import get_impl, l1_l2_penalty
@@ -84,6 +85,8 @@ class MultiLayerNetwork:
         key = jax.random.PRNGKey(g.seed if seed is None else seed)
         self._rng = jax.random.fold_in(key, 1)
         params, state = {}, {}
+        for lc in self.layer_confs:
+            validate_layer_names(lc)
         keys = jax.random.split(key, max(len(self.layer_confs), 1))
         for name, lc, impl, k in zip(self.layer_names, self.layer_confs, self.impls, keys):
             p, s = impl.init(lc, k, self.param_dtype)

@@ -43,6 +43,23 @@ class LossFunction:
     MEAN_ABSOLUTE_ERROR = "mae"
 
 
+KNOWN_LOSSES = frozenset(
+    v for k, v in vars(LossFunction).items() if not k.startswith("_")
+)
+
+
+def validate_loss(name) -> str:
+    """Eagerly validate a loss name (init-time check; compute_loss only
+    raises at trace time, too late for a good user error)."""
+    if callable(name):
+        return name
+    low = str(name).lower()
+    if low not in KNOWN_LOSSES:
+        raise ValueError(
+            f"Unknown loss function '{name}'. Known: {sorted(KNOWN_LOSSES)}")
+    return low
+
+
 def _masked_mean(per_example, mask):
     """Mean over examples; if mask given, weight rows and renormalize."""
     if mask is None:
@@ -59,7 +76,12 @@ def compute_loss(name, labels, output, mask=None, *, logits=None):
 
     `output` is the activated output; for softmax/sigmoid output layers pass
     `logits` (the preactivation) as well so the fused stable path is used.
+
+    A callable is the CUSTOM-loss path (reference LossFunction.CUSTOM):
+    fn(labels, output) -> per-example loss, masked-meaned here.
     """
+    if callable(name):
+        return _masked_mean(name(labels, output), mask)
     name = name.lower()
     if name in (LossFunction.MCXENT, LossFunction.NEGATIVELOGLIKELIHOOD):
         if logits is not None:

@@ -1,0 +1,13 @@
+"""Embedding visualisation (reference: deeplearning4j-core
+`org/deeplearning4j/plot/` — Tsne.java, BarnesHutTsne.java).
+
+Exact t-SNE runs fully on device as a jitted update loop (all-pairs
+affinities are dense matmul-shaped work the MXU eats); Barnes-Hut t-SNE uses
+the host-side SpTree for its O(N log N) force approximation, matching the
+reference's split between Tsne and BarnesHutTsne.
+"""
+
+from .tsne import Tsne
+from .barnes_hut_tsne import BarnesHutTsne
+
+__all__ = ["Tsne", "BarnesHutTsne"]

@@ -1,0 +1,135 @@
+"""Exact t-SNE, on-device (reference: plot/Tsne.java — calculate():72,
+per-iteration gains/momentum update :88-151, binary-search x2p():238).
+
+TPU-first design: the entire iteration — Student-t affinities over all
+pairs, gradient, gains, momentum — is one jitted step over [N, 2] arrays;
+the host loop only counts iterations and flips the early-exaggeration /
+momentum-switch scalars, which enter the step as traced args so one compiled
+program serves all phases. The perplexity binary search (x2p) is a
+vectorised fori_loop: every row's beta search step runs in lockstep on
+device instead of the reference's per-row Java loop.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+@jax.jit
+def _x2p(x, perplexity, tol=1e-5, iters=50):
+    """Conditional gaussian affinities P(j|i) with per-row variance found by
+    binary search on entropy (Tsne.java x2p:238). Vectorised: all rows
+    search concurrently; 50 bisection steps ≫ enough for 1e-5 tolerance."""
+    n = x.shape[0]
+    sum_x = jnp.sum(x * x, axis=1)
+    d2 = jnp.maximum(sum_x[:, None] + sum_x[None, :] - 2.0 * x @ x.T, 0.0)
+    log_u = jnp.log(perplexity)
+    eye = jnp.eye(n, dtype=bool)
+
+    def entropy_and_p(beta):
+        logits = -d2 * beta[:, None]
+        logits = jnp.where(eye, -jnp.inf, logits)
+        p = jax.nn.softmax(logits, axis=1)
+        # Shannon entropy H = -sum p log p (natural log, as the reference)
+        h = -jnp.sum(jnp.where(p > 0, p * jnp.log(p + 1e-30), 0.0), axis=1)
+        return h, p
+
+    def body(_, carry):
+        beta, lo, hi = carry
+        h, _ = entropy_and_p(beta)
+        too_high = h > log_u          # entropy too high → beta too small
+        new_lo = jnp.where(too_high, beta, lo)
+        new_hi = jnp.where(too_high, hi, beta)
+        new_beta = jnp.where(
+            too_high,
+            jnp.where(jnp.isinf(new_hi), beta * 2.0, (beta + new_hi) / 2.0),
+            (new_lo + beta) / 2.0,
+        )
+        return new_beta, new_lo, new_hi
+
+    beta0 = jnp.ones((n,))
+    lo0 = jnp.zeros((n,))
+    hi0 = jnp.full((n,), jnp.inf)
+    beta, _, _ = jax.lax.fori_loop(0, iters, body, (beta0, lo0, hi0))
+    _, p = entropy_and_p(beta)
+    return p
+
+
+@jax.jit
+def _tsne_step(y, iy, gains, p, momentum, min_gain, learning_rate):
+    """One t-SNE gradient step with the reference's gains/momentum scheme
+    (Tsne.java:124-151)."""
+    n = y.shape[0]
+    sum_y = jnp.sum(y * y, axis=1)
+    num = 1.0 / (1.0 + sum_y[:, None] + sum_y[None, :] - 2.0 * y @ y.T)
+    num = num * (1.0 - jnp.eye(n))
+    q = jnp.maximum(num / jnp.sum(num), 1e-12)
+    pq = (p - q) * num                                   # [N,N]
+    dy = 4.0 * (jnp.diag(jnp.sum(pq, axis=1)) - pq) @ y   # KL gradient
+    # gains: shrink where gradient keeps the velocity's sign, grow otherwise
+    same_sign = jnp.sign(dy) == jnp.sign(iy)
+    gains = jnp.where(same_sign, gains * 0.8, gains + 0.2)
+    gains = jnp.maximum(gains, min_gain)
+    iy = momentum * iy - learning_rate * (gains * dy)
+    y = y + iy
+    y = y - jnp.mean(y, axis=0, keepdims=True)
+    kl = jnp.sum(jnp.where(p > 0, p * jnp.log(p / q), 0.0))
+    return y, iy, gains, kl
+
+
+class Tsne:
+    """Exact t-SNE (plot/Tsne.java builder surface: maxIter, perplexity,
+    learningRate, stopLyingIteration, momentum switch at iter 20)."""
+
+    def __init__(self, max_iter: int = 1000, perplexity: float = 30.0,
+                 learning_rate: float = 500.0, initial_momentum: float = 0.5,
+                 final_momentum: float = 0.8, momentum_switch: int = 20,
+                 stop_lying_iteration: int = 250, exaggeration: float = 4.0,
+                 min_gain: float = 0.01, seed: int = 0):
+        self.max_iter = max_iter
+        self.perplexity = perplexity
+        self.learning_rate = learning_rate
+        self.initial_momentum = initial_momentum
+        self.final_momentum = final_momentum
+        self.momentum_switch = momentum_switch
+        self.stop_lying_iteration = stop_lying_iteration
+        self.exaggeration = exaggeration
+        self.min_gain = min_gain
+        self.seed = seed
+        self.kl_history: list[float] = []
+
+    def calculate(self, x, target_dimensions: int = 2,
+                  perplexity: float | None = None) -> np.ndarray:
+        """Embed x [N, D] → [N, target_dimensions] (Tsne.calculate:72)."""
+        x = jnp.asarray(np.asarray(x, dtype=np.float32))
+        n = x.shape[0]
+        perp = self.perplexity if perplexity is None else perplexity
+        p = _x2p(x, perp)
+        p = (p + p.T) / (2.0 * n)                 # symmetrise + normalise
+        p = jnp.maximum(p, 1e-12)
+
+        key = jax.random.PRNGKey(self.seed)
+        y = jax.random.normal(key, (n, target_dimensions)) * 1e-4
+        iy = jnp.zeros_like(y)
+        gains = jnp.ones_like(y)
+
+        self.kl_history = []
+        for i in range(self.max_iter):
+            momentum = (self.initial_momentum if i < self.momentum_switch
+                        else self.final_momentum)
+            lying = i < self.stop_lying_iteration
+            p_eff = p * self.exaggeration if lying else p
+            y, iy, gains, kl = _tsne_step(
+                y, iy, gains, p_eff, momentum, self.min_gain,
+                self.learning_rate)
+            if (i + 1) % 50 == 0:
+                self.kl_history.append(float(kl))
+        return np.asarray(y)
+
+    # reference alias (Tsne.plot → calculate)
+    def fit_transform(self, x, target_dimensions: int = 2) -> np.ndarray:
+        return self.calculate(x, target_dimensions)

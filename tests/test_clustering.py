@@ -1,0 +1,192 @@
+"""Clustering package tests (SURVEY.md §4 pattern: real math on tiny data;
+reference tests KDTreeTest/QuadTreeTest/SPTreeTest/VpTreeNodeTest)."""
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.clustering import (
+    HyperRect,
+    KDTree,
+    KMeansClustering,
+    Point,
+    QuadTree,
+    SpTree,
+    VPTree,
+)
+
+
+def _blobs(rng, k=3, per=40, dim=4, spread=0.15):
+    centers = rng.normal(size=(k, dim)) * 5.0
+    pts = np.concatenate(
+        [c + rng.normal(scale=spread, size=(per, dim)) for c in centers])
+    labels = np.repeat(np.arange(k), per)
+    return pts.astype(np.float32), labels, centers
+
+
+class TestKMeans:
+    def test_recovers_well_separated_blobs(self, rng):
+        pts, labels, _ = _blobs(rng)
+        cs = KMeansClustering(3, max_iterations=50, seed=1).apply_to(pts)
+        assert cs.cluster_count() == 3
+        # every true blob maps to exactly one predicted cluster
+        pred = np.empty(len(pts), dtype=int)
+        for ci, cluster in enumerate(cs.clusters):
+            for p in cluster.points:
+                pred[int(p.id)] = ci
+        for b in range(3):
+            assert len(set(pred[labels == b])) == 1
+        assert len({pred[labels == b][0] for b in range(3)}) == 3
+
+    def test_distortion_monotone_nonincreasing(self, rng):
+        pts, _, _ = _blobs(rng, k=2, per=30)
+        km = KMeansClustering(2, max_iterations=30, seed=3)
+        km.apply_to(pts)
+        h = km.distortion_history
+        assert all(h[i + 1] <= h[i] + 1e-3 for i in range(len(h) - 1))
+
+    def test_classify_point(self, rng):
+        pts, _, _ = _blobs(rng, k=2, per=20, dim=3)
+        cs = KMeansClustering(2, seed=0).apply_to(pts)
+        pc = cs.classify_point(Point(pts[0]), move=False)
+        assert pc.distance == pytest.approx(
+            float(np.linalg.norm(pts[0] - pc.cluster.center)))
+
+    def test_point_objects_roundtrip(self, rng):
+        pts = rng.normal(size=(10, 2)).astype(np.float32)
+        objs = Point.to_points(pts)
+        cs = KMeansClustering(2, seed=0).apply_to(objs)
+        total = sum(len(c.points) for c in cs.clusters)
+        assert total == 10
+
+    def test_setup_rejects_unknown_metric(self):
+        with pytest.raises(ValueError):
+            KMeansClustering.setup(2, distance_function="manhattan")
+
+
+class TestKDTree:
+    def test_knn_matches_bruteforce(self, rng):
+        pts = rng.normal(size=(200, 3))
+        tree = KDTree(3)
+        for p in pts:
+            tree.insert(p)
+        q = rng.normal(size=3)
+        got = [tuple(p) for _, p in tree.knn(q, 5)]
+        want_idx = np.argsort(np.linalg.norm(pts - q, axis=1))[:5]
+        want = [tuple(pts[i]) for i in want_idx]
+        assert got == want
+
+    def test_nn(self, rng):
+        pts = rng.normal(size=(50, 2))
+        tree = KDTree(2)
+        for p in pts:
+            tree.insert(p)
+        d, p = tree.nn(pts[7])
+        assert d == pytest.approx(0.0)
+        assert np.allclose(p, pts[7])
+
+    def test_range_query(self, rng):
+        pts = rng.uniform(-1, 1, size=(100, 2))
+        tree = KDTree(2)
+        for p in pts:
+            tree.insert(p)
+        rect = HyperRect(np.array([-0.5, -0.5]), np.array([0.5, 0.5]))
+        got = {tuple(p) for p in tree.range(rect)}
+        want = {tuple(p) for p in pts if rect.contains(p)}
+        assert got == want
+
+
+class TestVPTree:
+    def test_search_matches_bruteforce_euclidean(self, rng):
+        pts = rng.normal(size=(150, 5))
+        tree = VPTree(pts, seed=0)
+        q = rng.normal(size=5)
+        got = [i for _, i in tree.search(q, 7)]
+        want = list(np.argsort(np.linalg.norm(pts - q, axis=1))[:7])
+        assert got == want
+
+    def test_words_nearest_cosine(self, rng):
+        vecs = rng.normal(size=(20, 8))
+        labels = [f"w{i}" for i in range(20)]
+        tree = VPTree(vecs, labels=labels, metric="cosine", seed=0)
+        near = tree.words_nearest(vecs[3], 1)
+        assert near == ["w3"]
+
+
+class TestSpTree:
+    def test_build_and_com(self, rng):
+        pts = rng.normal(size=(64, 2))
+        tree = SpTree(pts)
+        assert tree.cum_size == 64
+        assert np.allclose(tree.center_of_mass, pts.mean(axis=0))
+        assert tree.depth() > 1
+
+    def test_non_edge_forces_match_exact_at_theta0(self, rng):
+        """theta=0 disables approximation → matches the exact O(N²) sums."""
+        pts = rng.normal(size=(40, 2))
+        tree = SpTree(pts)
+        i = 5
+        neg_f = np.zeros(2)
+        sum_q = tree.compute_non_edge_forces(i, theta=0.0, neg_f=neg_f)
+        diff = pts[i][None, :] - pts
+        d2 = np.sum(diff * diff, axis=1)
+        q = 1.0 / (1.0 + d2)
+        q[i] = 0.0
+        exact_sum_q = q.sum()
+        exact_neg = (q[:, None] ** 2 * diff).sum(axis=0)
+        assert sum_q == pytest.approx(exact_sum_q, rel=1e-9)
+        assert np.allclose(neg_f, exact_neg)
+
+    def test_theta_pruning_approximates(self, rng):
+        pts = rng.normal(size=(128, 2))
+        tree = SpTree(pts)
+        exact, approx = np.zeros(2), np.zeros(2)
+        sq_exact = tree.compute_non_edge_forces(0, 0.0, exact)
+        sq_approx = tree.compute_non_edge_forces(0, 0.5, approx)
+        assert sq_approx == pytest.approx(sq_exact, rel=0.1)
+        assert np.linalg.norm(approx - exact) < 0.1 * (np.linalg.norm(exact) + 1e-9)
+
+    def test_edge_forces(self, rng):
+        pts = rng.normal(size=(6, 2))
+        tree = SpTree(pts)
+        # one edge 0→1 with weight 2.0
+        rows = np.array([0, 1, 1, 1, 1, 1, 1])
+        cols = np.array([1])
+        vals = np.array([2.0])
+        pos_f = tree.compute_edge_forces(rows, cols, vals)
+        diff = pts[0] - pts[1]
+        want = 2.0 * diff / (1.0 + diff @ diff)
+        assert np.allclose(pos_f[0], want)
+        assert np.allclose(pos_f[2:], 0.0)
+
+
+class TestQuadTree:
+    def test_quadrants(self, rng):
+        pts = np.array([[-1.0, -1.0], [1.0, -1.0], [-1.0, 1.0], [1.0, 1.0],
+                        [0.5, 0.5]])
+        tree = QuadTree(pts)
+        assert tree.cum_size == 5
+        assert not tree.is_leaf
+        assert tree.north_east is not None and tree.north_east.cum_size >= 1
+
+    def test_requires_2d(self, rng):
+        with pytest.raises(ValueError):
+            QuadTree(rng.normal(size=(10, 3)))
+
+
+class TestRobustness:
+    def test_vptree_duplicate_rows(self):
+        """Regression: duplicate rows once stalled the median split."""
+        pts = np.zeros((1500, 3))
+        tree = VPTree(pts, seed=0)
+        assert len(tree.search(np.zeros(3), 3)) == 3
+
+    def test_kdtree_sorted_insertion(self):
+        """Regression: sorted input builds a deep chain; traversal must not
+        recurse."""
+        tree = KDTree(2)
+        for i in range(5000):
+            tree.insert(np.array([float(i), 0.0]))
+        got = [p[0] for _, p in tree.knn(np.array([4999.0, 0.0]), 3)]
+        assert sorted(got) == [4997.0, 4998.0, 4999.0]
+        rect = HyperRect(np.array([10.0, -1.0]), np.array([12.0, 1.0]))
+        assert len(tree.range(rect)) == 3

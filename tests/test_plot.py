@@ -1,0 +1,54 @@
+"""t-SNE tests: exact (device) and Barnes-Hut (host SpTree)."""
+
+import numpy as np
+
+from deeplearning4j_tpu.plot import BarnesHutTsne, Tsne
+
+
+def _two_clusters(rng, per=20, dim=10, sep=8.0):
+    a = rng.normal(size=(per, dim)) + sep
+    b = rng.normal(size=(per, dim)) - sep
+    x = np.concatenate([a, b]).astype(np.float32)
+    labels = np.repeat([0, 1], per)
+    return x, labels
+
+
+def _separation(y, labels):
+    """Ratio of between-cluster distance to mean within-cluster spread."""
+    c0, c1 = y[labels == 0], y[labels == 1]
+    between = np.linalg.norm(c0.mean(0) - c1.mean(0))
+    within = (c0.std() + c1.std()) / 2 + 1e-9
+    return between / within
+
+
+class TestExactTsne:
+    def test_separates_clusters(self, rng):
+        x, labels = _two_clusters(rng)
+        ts = Tsne(max_iter=300, perplexity=10.0, learning_rate=100.0, seed=0)
+        y = ts.calculate(x, 2)
+        assert y.shape == (40, 2)
+        assert np.isfinite(y).all()
+        assert _separation(y, labels) > 2.0
+
+    def test_kl_decreases(self, rng):
+        x, _ = _two_clusters(rng, per=15)
+        ts = Tsne(max_iter=400, perplexity=8.0, learning_rate=100.0,
+                  stop_lying_iteration=100, seed=1)
+        ts.calculate(x, 2)
+        h = ts.kl_divergences if hasattr(ts, "kl_divergences") else ts.kl_history
+        # after exaggeration stops (iter 100 → from the 2nd of the 50-spaced
+        # samples on) KL should be lower at the end than right after
+        assert h[-1] < h[2]
+
+
+class TestBarnesHutTsne:
+    def test_separates_clusters(self, rng):
+        x, labels = _two_clusters(rng, per=16, dim=8)
+        bh = BarnesHutTsne(max_iter=150, perplexity=5.0, theta=0.5,
+                           learning_rate=100.0, stop_lying_iteration=50,
+                           momentum_switch=50, seed=0)
+        y = bh.fit(x, 2)
+        assert y.shape == (32, 2)
+        assert np.isfinite(y).all()
+        assert _separation(y, labels) > 2.0
+        assert bh.get_data() is y

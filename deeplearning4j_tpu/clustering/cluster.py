@@ -93,11 +93,31 @@ class ClusterSet:
         cluster = self.clusters[idx]
         previously = any(p is point for p in cluster.points)
         if move and not previously:
+            # move semantics: a point belongs to exactly one cluster
+            for other in self.clusters:
+                other.points = [p for p in other.points if p is not point]
             cluster.add_point(point)
         return PointClassification(cluster, float(d[idx]), not previously)
 
     def classify_points(self, points: Sequence[Point], move: bool = True) -> List[PointClassification]:
-        return [self.classify_point(p, move=move) for p in points]
+        """Batch classify: one [N, K] distance computation, then the same
+        move semantics as classify_point."""
+        if not points:
+            return []
+        centers = self.centers
+        pts = np.stack([p.array for p in points])
+        d = np.linalg.norm(pts[:, None, :] - centers[None, :, :], axis=2)
+        idxs = np.argmin(d, axis=1)
+        out = []
+        for p, di, idx in zip(points, d, idxs):
+            cluster = self.clusters[int(idx)]
+            previously = any(q is p for q in cluster.points)
+            if move and not previously:
+                for other in self.clusters:
+                    other.points = [q for q in other.points if q is not p]
+                cluster.add_point(p)
+            out.append(PointClassification(cluster, float(di[idx]), not previously))
+        return out
 
     def inertia(self) -> float:
         """Sum of squared member→center distances (distortion cost)."""

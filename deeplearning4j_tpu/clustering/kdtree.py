@@ -85,6 +85,51 @@ class KDTree:
                 node = node.right
             depth += 1
 
+    def delete(self, point) -> bool:
+        """Remove one node holding `point`. Rebuilds the subtree rooted at
+        the removed node from its surviving points (median-split, so the
+        rebuilt subtree is balanced) — simpler and more robust than the
+        classic find-min replacement dance, and the reference's delete is a
+        rarely-hot path."""
+        point = np.asarray(point, dtype=np.float64)
+        parent: Optional[_Node] = None
+        node, depth, from_left = self.root, 0, False
+        while node is not None and not np.array_equal(node.point, point):
+            parent = node
+            dim = depth % self.dims
+            from_left = point[dim] < node.point[dim]
+            node = node.left if from_left else node.right
+            depth += 1
+        if node is None:
+            return False
+        # collect the subtree's points minus the deleted node, iteratively
+        pts: List[np.ndarray] = []
+        stack = [c for c in (node.left, node.right) if c is not None]
+        while stack:
+            cur = stack.pop()
+            pts.append(cur.point)
+            stack.extend(c for c in (cur.left, cur.right) if c is not None)
+        rebuilt = self._build_balanced(pts, depth)
+        if parent is None:
+            self.root = rebuilt
+        elif from_left:
+            parent.left = rebuilt
+        else:
+            parent.right = rebuilt
+        self.size -= 1
+        return True
+
+    def _build_balanced(self, pts: List[np.ndarray], depth: int) -> Optional[_Node]:
+        if not pts:
+            return None
+        dim = depth % self.dims
+        pts = sorted(pts, key=lambda p: p[dim])
+        mid = len(pts) // 2
+        node = _Node(pts[mid])
+        node.left = self._build_balanced(pts[:mid], depth + 1)
+        node.right = self._build_balanced(pts[mid + 1:], depth + 1)
+        return node
+
     def nn(self, point) -> Tuple[float, Optional[np.ndarray]]:
         """Nearest neighbour: (distance, point)."""
         res = self.knn(point, 1)

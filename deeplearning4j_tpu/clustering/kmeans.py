@@ -119,10 +119,9 @@ class KMeansClustering:
         pts = jnp.asarray(matrix)
         centers = self._init_centers(pts)
         self.distortion_history = []
-        assign = None
         prev = None
         for i in range(self.max_iterations):
-            centers, assign, distortion = _lloyd_step(pts, centers, self.k)
+            centers, _, distortion = _lloyd_step(pts, centers, self.k)
             distortion = float(distortion)
             self.distortion_history.append(distortion)
             self.iteration_count = i + 1
@@ -131,6 +130,10 @@ class KMeansClustering:
                 if abs(prev - distortion) / denom < self.variation_tolerance:
                     break
             prev = distortion
+        # final assignment against the FINAL centers — the in-loop assign is
+        # computed against the pre-update centers and would leave memberships
+        # inconsistent with the returned centers
+        _, assign, _ = _lloyd_step(pts, centers, self.k)
 
         centers_np = np.asarray(centers)
         assign_np = np.asarray(assign)

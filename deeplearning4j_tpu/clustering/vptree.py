@@ -19,9 +19,13 @@ def _distances(metric: str, items: np.ndarray, point: np.ndarray) -> np.ndarray:
     if metric == "euclidean":
         return np.linalg.norm(items - point[None, :], axis=1)
     if metric == "cosine":
-        # cosine *distance*: 1 - cosine similarity
+        # sqrt(2·(1−cos)) = euclidean distance between the normalised
+        # vectors: a true metric (1−cos violates the triangle inequality and
+        # would invalidate the VP prune bounds), monotone in cosine
+        # similarity so rankings match cosine nearest-neighbour queries.
         denom = (np.linalg.norm(items, axis=1) * np.linalg.norm(point) + 1e-12)
-        return 1.0 - (items @ point) / denom
+        cos = np.clip((items @ point) / denom, -1.0, 1.0)
+        return np.sqrt(np.maximum(2.0 * (1.0 - cos), 0.0))
     raise ValueError(f"unknown metric {metric}")
 
 

@@ -1,0 +1,34 @@
+"""Graph embeddings (reference: deeplearning4j-graph module, 3,295 LoC —
+SURVEY.md §2.5: graph/api/{IGraph,Vertex,Edge}, graph/graph/Graph.java,
+data/GraphLoader.java, iterator walkers, models/deepwalk/DeepWalk.java).
+
+Host-side graph storage + walk generation feeding the batched
+SequenceVectors engine (walks are just token sequences of vertex ids), so
+DeepWalk trains with the same jitted skip-gram device steps as Word2Vec —
+the TPU replacement for the reference's per-pair hierarchical-softmax
+HogWild updates.
+"""
+
+from .api import Edge, Vertex
+from .graph import Graph
+from .loader import GraphLoader
+from .walkers import (
+    NoEdgeHandling,
+    PopularityWalker,
+    RandomWalkIterator,
+    WeightedRandomWalkIterator,
+)
+from .deepwalk import DeepWalk, GraphVectorSerializer
+
+__all__ = [
+    "Edge",
+    "Vertex",
+    "Graph",
+    "GraphLoader",
+    "NoEdgeHandling",
+    "RandomWalkIterator",
+    "WeightedRandomWalkIterator",
+    "PopularityWalker",
+    "DeepWalk",
+    "GraphVectorSerializer",
+]

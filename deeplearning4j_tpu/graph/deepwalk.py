@@ -1,0 +1,124 @@
+"""DeepWalk node embeddings (reference: deeplearning4j-graph
+models/deepwalk/DeepWalk.java — skip-gram with hierarchical softmax over
+random walks, GraphHuffman coding; embeddings/InMemoryGraphLookupTable.java;
+GraphVectorSerializer.java).
+
+TPU-native: walks are generated host-side and fed to the SequenceVectors
+engine, so training is the same batched, jitted skip-gram device step as
+Word2Vec (hierarchical softmax by default, matching the reference) instead
+of per-pair BLAS-1 updates.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional
+
+import numpy as np
+
+from deeplearning4j_tpu.nlp.sequencevectors import SequenceVectors
+
+from .graph import Graph
+from .walkers import RandomWalkIterator, WeightedRandomWalkIterator, walk_sequences
+
+
+class DeepWalk:
+    """DeepWalk trainer (DeepWalk.java Builder: vectorSize, windowSize,
+    learningRate; fit(graph, walkLength))."""
+
+    class Builder:
+        def __init__(self):
+            self._kw = dict(vector_size=100, window_size=5,
+                            learning_rate=0.025, seed=0)
+
+        def vector_size(self, n: int):
+            self._kw["vector_size"] = n
+            return self
+
+        def window_size(self, n: int):
+            self._kw["window_size"] = n
+            return self
+
+        def learning_rate(self, lr: float):
+            self._kw["learning_rate"] = lr
+            return self
+
+        def seed(self, s: int):
+            self._kw["seed"] = s
+            return self
+
+        def build(self) -> "DeepWalk":
+            return DeepWalk(**self._kw)
+
+    @staticmethod
+    def builder() -> "DeepWalk.Builder":
+        return DeepWalk.Builder()
+
+    def __init__(self, vector_size: int = 100, window_size: int = 5,
+                 learning_rate: float = 0.025, seed: int = 0):
+        self.vector_size = vector_size
+        self.window_size = window_size
+        self.learning_rate = learning_rate
+        self.seed = seed
+        self.vectors: Optional[SequenceVectors] = None
+        self.num_vertices = 0
+
+    def fit(self, graph_or_walker, walk_length: int = 40,
+            walks_per_vertex: int = 1, epochs: int = 1,
+            weighted: bool = False) -> "DeepWalk":
+        """Generate walks and train (DeepWalk.fit(IGraph, walkLength)).
+        Accepts a Graph (builds the walker) or a walk iterator."""
+        if isinstance(graph_or_walker, Graph):
+            cls = WeightedRandomWalkIterator if weighted else RandomWalkIterator
+            walker = cls(graph_or_walker, walk_length, seed=self.seed)
+            self.num_vertices = graph_or_walker.num_vertices()
+        else:
+            walker = graph_or_walker
+            self.num_vertices = walker.graph.num_vertices()
+        seqs = walk_sequences(walker, walks_per_vertex)
+        # hierarchical softmax over vertex frequency, as the reference's
+        # GraphHuffman; every vertex is kept regardless of frequency
+        self.vectors = SequenceVectors(
+            layer_size=self.vector_size, window_size=self.window_size,
+            min_word_frequency=1, epochs=epochs,
+            learning_rate=self.learning_rate, negative=0, use_hs=True,
+            seed=self.seed)
+        self.vectors.fit(seqs)
+        return self
+
+    # ------------------------------------------------------------- queries
+    def get_vertex_vector(self, idx: int) -> np.ndarray:
+        vec = self.vectors.get_word_vector(str(idx))
+        if vec is None:
+            raise KeyError(f"vertex {idx} not in model")
+        return vec
+
+    def similarity(self, a: int, b: int) -> float:
+        return self.vectors.similarity(str(a), str(b))
+
+    def vertices_nearest(self, idx: int, top_n: int = 10) -> List[int]:
+        return [int(w) for w in self.vectors.words_nearest(str(idx), top_n)]
+
+
+class GraphVectorSerializer:
+    """Text format: one line per vertex `idx\tv0\tv1...`
+    (models/deepwalk/GraphVectorSerializer.writeGraphVectors)."""
+
+    @staticmethod
+    def write_graph_vectors(model: DeepWalk, path: str) -> None:
+        with open(path, "w") as f:
+            for i in range(model.num_vertices):
+                vec = model.vectors.get_word_vector(str(i))
+                if vec is None:
+                    continue
+                f.write(str(i) + "\t" + "\t".join(f"{v:.8g}" for v in vec)
+                        + "\n")
+
+    @staticmethod
+    def load_txt_vectors(path: str) -> dict:
+        out = {}
+        with open(path) as f:
+            for line in f:
+                parts = line.rstrip("\n").split("\t")
+                out[int(parts[0])] = np.array([float(v) for v in parts[1:]],
+                                              dtype=np.float32)
+        return out

@@ -190,3 +190,29 @@ class TestRobustness:
         assert sorted(got) == [4997.0, 4998.0, 4999.0]
         rect = HyperRect(np.array([10.0, -1.0]), np.array([12.0, 1.0]))
         assert len(tree.range(rect)) == 3
+
+    def test_kdtree_delete(self, ):
+        tree = KDTree(2)
+        pts = [np.array([float(i), float(i % 3)]) for i in range(30)]
+        for p in pts:
+            tree.insert(p)
+        assert tree.delete(pts[10])
+        assert tree.size == 29
+        d, _ = tree.nn(pts[10])
+        assert d > 0.0
+        assert not tree.delete(np.array([99.0, 99.0]))
+
+    def test_cluster_move_semantics(self):
+        from deeplearning4j_tpu.clustering import KMeansClustering
+        rng = np.random.default_rng(5)
+        pts = np.concatenate([rng.normal(size=(10, 2)) + 5,
+                              rng.normal(size=(10, 2)) - 5]).astype(np.float32)
+        cs = KMeansClustering(2, seed=0).apply_to(pts)
+        # re-classify every point: membership count stays exactly N
+        for c in cs.clusters:
+            for p in list(c.points):
+                cs.classify_point(p)
+        assert sum(len(c.points) for c in cs.clusters) == 20
+        results = cs.classify_points([c.points[0] for c in cs.clusters])
+        assert len(results) == 2
+        assert sum(len(c.points) for c in cs.clusters) == 20

@@ -1,0 +1,142 @@
+"""Graph package tests (reference test model: deeplearning4j-graph's
+TestGraph/TestDeepWalk — structural checks + embedding sanity on tiny
+graphs)."""
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.graph import (
+    DeepWalk,
+    Edge,
+    Graph,
+    GraphLoader,
+    GraphVectorSerializer,
+    NoEdgeHandling,
+    PopularityWalker,
+    RandomWalkIterator,
+    WeightedRandomWalkIterator,
+)
+
+
+def _two_cliques(n=6):
+    """Two n-cliques joined by a single bridge edge."""
+    g = Graph(2 * n)
+    for base in (0, n):
+        for i in range(n):
+            for j in range(i + 1, n):
+                g.add_edge(base + i, base + j)
+    g.add_edge(0, n)
+    return g
+
+
+class TestGraph:
+    def test_add_edge_undirected(self):
+        g = Graph(4)
+        g.add_edge(0, 1)
+        g.add_edge(Edge(1, 2, weight=2.0))
+        assert g.num_edges() == 2
+        assert g.get_vertex_degree(1) == 2
+        assert set(g.get_connected_vertex_indices(1)) == {0, 2}
+
+    def test_directed_edge(self):
+        g = Graph(3)
+        g.add_edge(0, 1, directed=True)
+        assert g.get_vertex_degree(0) == 1
+        assert g.get_vertex_degree(1) == 0
+
+    def test_out_of_range(self):
+        g = Graph(2)
+        with pytest.raises(ValueError):
+            g.add_edge(0, 5)
+
+    def test_loader_roundtrip(self, tmp_path):
+        p = tmp_path / "edges.txt"
+        p.write_text("# comment\n0 1\n1 2\n2 0\n")
+        g = GraphLoader.load_undirected_graph_edge_list_file(str(p), 3)
+        assert g.num_edges() == 3
+        assert g.get_vertex_degree(0) == 2
+
+        pw = tmp_path / "weighted.txt"
+        pw.write_text("0,1,0.5\n1,2,2.5\n")
+        gw = GraphLoader.load_weighted_edge_list_file(str(pw), 3, delimiter=",")
+        assert gw.get_edge_weights(1).tolist() == [0.5, 2.5]
+
+
+class TestWalkers:
+    def test_walk_length_and_validity(self):
+        g = _two_cliques(4)
+        it = RandomWalkIterator(g, walk_length=10, seed=1)
+        walks = list(it)
+        assert len(walks) == g.num_vertices()
+        for w in walks:
+            assert len(w) == 11
+            for a, b in zip(w[:-1], w[1:]):
+                assert b in g.get_connected_vertex_indices(a) or a == b
+
+    def test_dead_end_self_loop_and_exception(self):
+        g = Graph(2)
+        g.add_edge(0, 1, directed=True)
+        w = RandomWalkIterator(g, 5, seed=0)._walk_from(1)
+        assert w.tolist() == [1] * 6
+        with pytest.raises(RuntimeError):
+            RandomWalkIterator(
+                g, 5, no_edge_handling=NoEdgeHandling.EXCEPTION_ON_DISCONNECTED,
+            )._walk_from(1)
+
+    def test_dead_end_cutoff(self):
+        g = Graph(3)
+        g.add_edge(0, 1, directed=True)
+        it = RandomWalkIterator(g, 5, no_edge_handling=NoEdgeHandling.CUTOFF_ON_DISCONNECTED)
+        w = it._walk_from(0)
+        assert w.tolist() == [0, 1]
+
+    def test_weighted_walker_follows_weights(self):
+        g = Graph(3)
+        g.add_edge(0, 1, weight=1000.0)
+        g.add_edge(0, 2, weight=0.001)
+        it = WeightedRandomWalkIterator(g, 1, seed=0)
+        hits = [it._walk_from(0)[1] for _ in range(50)]
+        assert hits.count(1) >= 48
+
+    def test_popularity_walker_prefers_hubs(self):
+        g = Graph(5)
+        # vertex 1 is a hub (degree 3), vertex 2 a leaf (degree 1)
+        g.add_edge(0, 1)
+        g.add_edge(0, 2)
+        g.add_edge(1, 3)
+        g.add_edge(1, 4)
+        it = PopularityWalker(g, 1, seed=0)
+        hits = [it._walk_from(0)[1] for _ in range(200)]
+        assert hits.count(1) > hits.count(2)
+
+
+class TestDeepWalk:
+    def test_embeddings_cluster_by_clique(self):
+        g = _two_cliques(5)
+        dw = (DeepWalk.builder().vector_size(16).window_size(3)
+              .learning_rate(0.05).seed(7).build())
+        dw.fit(g, walk_length=20, walks_per_vertex=8, epochs=3)
+        # same-clique similarity should exceed cross-clique similarity
+        same = np.mean([dw.similarity(i, j)
+                        for i in range(5) for j in range(i + 1, 5)])
+        cross = np.mean([dw.similarity(i, 5 + j)
+                         for i in range(1, 5) for j in range(1, 5)])
+        assert same > cross
+
+    def test_vertex_vector_shape_and_nearest(self):
+        g = _two_cliques(4)
+        dw = DeepWalk(vector_size=8, window_size=2, seed=1)
+        dw.fit(g, walk_length=10, walks_per_vertex=4)
+        assert dw.get_vertex_vector(0).shape == (8,)
+        assert len(dw.vertices_nearest(0, 3)) == 3
+
+    def test_serializer_roundtrip(self, tmp_path):
+        g = _two_cliques(3)
+        dw = DeepWalk(vector_size=4, window_size=2, seed=2)
+        dw.fit(g, walk_length=8, walks_per_vertex=2)
+        path = str(tmp_path / "gv.txt")
+        GraphVectorSerializer.write_graph_vectors(dw, path)
+        loaded = GraphVectorSerializer.load_txt_vectors(path)
+        assert set(loaded) == set(range(6))
+        np.testing.assert_allclose(loaded[2], dw.get_vertex_vector(2),
+                                   rtol=1e-5)

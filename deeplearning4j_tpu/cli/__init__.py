@@ -1,0 +1,8 @@
+"""Command-line interface (reference: deeplearning4j-cli —
+driver/CommandLineInterfaceDriver.java, subcommands/{Train, Test,
+Predict}.java with args4j flags -conf/-input/-output/-model/-type;
+SURVEY.md §2.6 L10 row)."""
+
+from .driver import main
+
+__all__ = ["main"]

@@ -1,0 +1,169 @@
+"""CLI driver: train / test / predict on config files + CSV/SVMLight input
+(reference: cli/driver/CommandLineInterfaceDriver.java routing to
+subcommands/Train.java:66 with flags -conf -input -output -model -type
+:80-108, Test.java, Predict.java; Canova record readers supply the input).
+
+Usage:
+    python -m deeplearning4j_tpu.cli train   --conf conf.json --input d.csv \
+        --model out.zip --num-classes 3 [--epochs 5] [--batch 32]
+    python -m deeplearning4j_tpu.cli test    --model out.zip --input d.csv \
+        --num-classes 3
+    python -m deeplearning4j_tpu.cli predict --model out.zip --input d.csv \
+        --output preds.csv
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+
+import numpy as np
+
+
+def _build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="deeplearning4j_tpu",
+        description="Train/test/predict on declarative model configs")
+    sub = p.add_subparsers(dest="command", required=True)
+
+    def common(sp, model_required=True):
+        sp.add_argument("--input", "-i", required=True,
+                        help="input data file (CSV or SVMLight)")
+        sp.add_argument("--format", choices=["csv", "svmlight"],
+                        default="csv", help="input format (default csv)")
+        sp.add_argument("--model", "-m", required=model_required,
+                        help="model zip path")
+        sp.add_argument("--batch", type=int, default=32)
+        sp.add_argument("--label-index", type=int, default=-1,
+                        help="label column in CSV (default: last)")
+        sp.add_argument("--num-features", type=int, default=0,
+                        help="feature count (required for svmlight)")
+        sp.add_argument("--num-classes", type=int, default=-1,
+                        help="one-hot classes; omit for regression input")
+        sp.add_argument("--regression", action="store_true")
+
+    t = sub.add_parser("train", help="fit a model config on a dataset")
+    t.add_argument("--conf", "-c", required=True,
+                   help="model configuration JSON "
+                        "(MultiLayerConfiguration or ComputationGraph)")
+    t.add_argument("--type", choices=["multi_layer_network",
+                                      "computation_graph"],
+                   default="multi_layer_network")
+    t.add_argument("--epochs", type=int, default=1)
+    t.add_argument("--output", "-o", default=None,
+                   help="alias of --model for reference-flag parity")
+    common(t, model_required=False)
+
+    te = sub.add_parser("test", help="evaluate a trained model")
+    common(te)
+
+    pr = sub.add_parser("predict", help="write predictions for a dataset")
+    pr.add_argument("--output", "-o", required=True,
+                    help="predictions output CSV")
+    common(pr)
+    return p
+
+
+def _make_iterator(args):
+    from deeplearning4j_tpu.datasets.records import (
+        CSVRecordReader,
+        RecordReaderDataSetIterator,
+        SVMLightRecordReader,
+    )
+
+    if args.format == "svmlight":
+        if args.num_features <= 0:
+            raise SystemExit("--num-features is required for svmlight input")
+        reader = SVMLightRecordReader(args.input, args.num_features)
+    else:
+        reader = CSVRecordReader(args.input)
+    return RecordReaderDataSetIterator(
+        reader, args.batch,
+        label_index=args.label_index,
+        num_classes=args.num_classes,
+        regression=args.regression)
+
+
+def _load_model(path: str):
+    from deeplearning4j_tpu.util.model_serializer import ModelSerializer
+
+    return ModelSerializer.restore(path)
+
+
+def _cmd_train(args) -> int:
+    from deeplearning4j_tpu.nn.conf.graph_conf import (
+        ComputationGraphConfiguration,
+    )
+    from deeplearning4j_tpu.nn.conf.neural_net_configuration import (
+        MultiLayerConfiguration,
+    )
+    from deeplearning4j_tpu.nn.graph import ComputationGraph
+    from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+    from deeplearning4j_tpu.optimize.listeners import ScoreIterationListener
+    from deeplearning4j_tpu.util.model_serializer import ModelSerializer
+
+    with open(args.conf) as f:
+        conf_json = f.read()
+    if args.type == "computation_graph":
+        net = ComputationGraph(ComputationGraphConfiguration.from_json(conf_json))
+    else:
+        net = MultiLayerNetwork(MultiLayerConfiguration.from_json(conf_json))
+    net.init()
+    net.set_listeners(ScoreIterationListener(10, printer=print))
+
+    it = _make_iterator(args)
+    net.fit(it, epochs=args.epochs)
+
+    out = args.model or args.output
+    if not out:
+        raise SystemExit("need --model (or --output) to save the trained model")
+    ModelSerializer.write_model(net, out)
+    print(f"model saved to {out}")
+    return 0
+
+
+def _cmd_test(args) -> int:
+    net = _load_model(args.model)
+    it = _make_iterator(args)
+    ev = net.evaluate(it)
+    print(ev.stats())
+    return 0
+
+
+def _cmd_predict(args) -> int:
+    from deeplearning4j_tpu.datasets.records import (
+        CSVRecordReader,
+        SVMLightRecordReader,
+    )
+
+    net = _load_model(args.model)
+    # prediction input has no label column: every CSV value is a feature
+    # (svmlight rows still carry a label field; it is ignored)
+    if args.format == "svmlight":
+        if args.num_features <= 0:
+            raise SystemExit("--num-features is required for svmlight input")
+        feats = [f for _, f in SVMLightRecordReader(args.input,
+                                                    args.num_features)]
+    else:
+        feats = [np.asarray([float(v) for v in rec], np.float32)
+                 for rec in CSVRecordReader(args.input)]
+    x = np.stack(feats)
+    rows = []
+    for s in range(0, len(x), args.batch):
+        rows.append(np.asarray(net.output(x[s:s + args.batch])))
+    preds = np.concatenate(rows)
+    with open(args.output, "w") as f:
+        for row in preds:
+            f.write(",".join(f"{v:.8g}" for v in np.atleast_1d(row)) + "\n")
+    print(f"wrote {len(preds)} predictions to {args.output}")
+    return 0
+
+
+def main(argv=None) -> int:
+    args = _build_parser().parse_args(argv)
+    return {"train": _cmd_train, "test": _cmd_test,
+            "predict": _cmd_predict}[args.command](args)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -109,14 +109,23 @@ class ClusterSet:
         d = np.linalg.norm(pts[:, None, :] - centers[None, :, :], axis=2)
         idxs = np.argmin(d, axis=1)
         out = []
+        moving: List[Point] = []
         for p, di, idx in zip(points, d, idxs):
             cluster = self.clusters[int(idx)]
             previously = any(q is p for q in cluster.points)
             if move and not previously:
-                for other in self.clusters:
-                    other.points = [q for q in other.points if q is not p]
-                cluster.add_point(p)
+                moving.append(p)
             out.append(PointClassification(cluster, float(di[idx]), not previously))
+        if moving:
+            # strip all moving points in ONE pass per cluster, then append
+            # each to its target — the per-point variant would rebuild every
+            # cluster list N times
+            moving_ids = {id(p) for p in moving}
+            for c in self.clusters:
+                c.points = [q for q in c.points if id(q) not in moving_ids]
+            for p, idx in zip(points, idxs):
+                if id(p) in moving_ids:
+                    self.clusters[int(idx)].add_point(p)
         return out
 
     def inertia(self) -> float:

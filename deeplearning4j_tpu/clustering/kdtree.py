@@ -125,6 +125,10 @@ class KDTree:
         dim = depth % self.dims
         pts = sorted(pts, key=lambda p: p[dim])
         mid = len(pts) // 2
+        # descent invariant: strictly-less goes left, >= goes right — shift
+        # the split to the first duplicate so no equal value lands left
+        while mid > 0 and pts[mid - 1][dim] == pts[mid][dim]:
+            mid -= 1
         node = _Node(pts[mid])
         node.left = self._build_balanced(pts[:mid], depth + 1)
         node.right = self._build_balanced(pts[mid + 1:], depth + 1)

@@ -75,8 +75,15 @@ class Graph:
         return self._frozen_w[idx]
 
     def get_edges_out(self, idx: int) -> List[Edge]:
-        return [e for e in self._edges
-                if e.src == idx or (not e.directed and e.dst == idx)]
+        """Edges leaving `idx`, always oriented src=idx → dst=neighbour
+        (undirected edges stored as (a, idx) are returned reoriented)."""
+        out = []
+        for e in self._edges:
+            if e.src == idx:
+                out.append(e)
+            elif not e.directed and e.dst == idx:
+                out.append(Edge(idx, e.src, e.weight, e.directed, e.value))
+        return out
 
     def edges(self) -> Iterable[Edge]:
         return iter(self._edges)

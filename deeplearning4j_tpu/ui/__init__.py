@@ -1,0 +1,53 @@
+"""UI / observability (reference: deeplearning4j-ui-parent — UiServer.java
+Dropwizard app, deeplearning4j-ui-components chart-JSON protocol, weights/
+flow/activation/tsne/nearestneighbors views; SURVEY.md §2.6 L9 row).
+
+Host-side by nature. The Dropwizard/Jetty/Jersey stack is replaced by a
+stdlib ThreadingHTTPServer speaking the same declarative chart-JSON
+component protocol; listeners POST JSON snapshots exactly like the
+reference's HistogramIterationListener (HistogramIterationListener.java:206)
+or write straight to in-process storage when no server is running.
+"""
+
+from .components import (
+    ChartHistogram,
+    ChartHorizontalBar,
+    ChartLine,
+    ChartScatter,
+    ChartStackedArea,
+    Component,
+    ComponentDiv,
+    ComponentTable,
+    ComponentText,
+    DecoratorAccordion,
+    StyleChart,
+)
+from .storage import HistoryStorage, SessionStorage
+from .server import UiServer
+from .listeners import (
+    ActivationMeanIterationListener,
+    FlowIterationListener,
+    HistogramIterationListener,
+)
+from .standalone import StaticPageUtil
+
+__all__ = [
+    "ChartHistogram",
+    "ChartHorizontalBar",
+    "ChartLine",
+    "ChartScatter",
+    "ChartStackedArea",
+    "Component",
+    "ComponentDiv",
+    "ComponentTable",
+    "ComponentText",
+    "DecoratorAccordion",
+    "StyleChart",
+    "HistoryStorage",
+    "SessionStorage",
+    "UiServer",
+    "HistogramIterationListener",
+    "FlowIterationListener",
+    "ActivationMeanIterationListener",
+    "StaticPageUtil",
+]

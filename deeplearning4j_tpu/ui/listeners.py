@@ -1,0 +1,135 @@
+"""UI iteration listeners (reference: ui/weights/HistogramIterationListener
+.java — posts ModelAndGradient JSON :206; ui/flow/FlowIterationListener.java
+— ModelInfo/LayerInfo topology beans; activation/
+UpdateActivationIterationListener).
+
+Each listener builds a JSON-able snapshot per iteration and either POSTs it
+to a running UiServer (`url=...`) or writes it into a storage object
+(`storage=...`) for in-process use — the reference always needs the HTTP
+hop; going direct-to-storage is the embedded mode.
+"""
+
+from __future__ import annotations
+
+import json
+import urllib.request
+from typing import Optional
+
+import numpy as np
+
+from deeplearning4j_tpu.optimize.listeners import IterationListener
+
+from .storage import SessionStorage
+
+
+def _post(url: str, payload: dict) -> None:
+    req = urllib.request.Request(
+        url, data=json.dumps(payload).encode(),
+        headers={"Content-Type": "application/json"}, method="POST")
+    with urllib.request.urlopen(req, timeout=10):
+        pass
+
+
+class _BaseUiListener(IterationListener):
+    kind = ""
+
+    def __init__(self, url: Optional[str] = None,
+                 storage: Optional[SessionStorage] = None,
+                 session_id: str = "default", frequency: int = 1):
+        if url is None and storage is None:
+            raise ValueError("need url= (HTTP mode) or storage= (embedded)")
+        self.url = url.rstrip("/") if url else None
+        self.storage = storage
+        self.session_id = session_id
+        self.frequency = max(1, frequency)
+
+    def _emit(self, payload: dict) -> None:
+        if self.storage is not None:
+            self.storage.put(self.session_id, self.kind, payload)
+        if self.url is not None:
+            _post(f"{self.url}/{self.kind}/update?sid={self.session_id}",
+                  payload)
+
+    def iteration_done(self, model, iteration):
+        if iteration % self.frequency:
+            return
+        self._emit(self.snapshot(model, iteration))
+
+    def snapshot(self, model, iteration) -> dict:
+        raise NotImplementedError
+
+
+def _histogram(arr: np.ndarray, bins: int = 20) -> dict:
+    counts, edges = np.histogram(arr.ravel(), bins=bins)
+    return {"bins": edges.tolist(), "counts": counts.tolist()}
+
+
+class HistogramIterationListener(_BaseUiListener):
+    """Model param/update histograms + score curve
+    (weights/HistogramIterationListener.java; bean:
+    weights/ModelAndGradient.java)."""
+
+    kind = "weights"
+
+    def snapshot(self, model, iteration) -> dict:
+        params = {}
+        for lname, layer in (model.params or {}).items():
+            for pname, arr in layer.items():
+                params[f"{lname}_{pname}"] = _histogram(np.asarray(arr))
+        return {
+            "iteration": iteration,
+            "score": float(model.score_value),
+            "parameters": params,
+        }
+
+
+class FlowIterationListener(_BaseUiListener):
+    """Network topology + per-layer meta (flow/FlowIterationListener.java,
+    beans/{ModelInfo, LayerInfo})."""
+
+    kind = "flow"
+
+    def snapshot(self, model, iteration) -> dict:
+        layers = []
+        # MultiLayerNetwork: ordered layer_names; ComputationGraph: topo order
+        names = getattr(model, "layer_names", None)
+        if names is None and hasattr(model, "topo"):
+            names = [n for n in model.topo if n in (model.params or {})]
+        for i, name in enumerate(names or []):
+            layer_params = (model.params or {}).get(name, {})
+            n_params = int(sum(np.asarray(a).size for a in layer_params.values()))
+            layers.append({
+                "name": str(name),
+                "index": i,
+                "num_params": n_params,
+                "param_names": sorted(layer_params),
+            })
+        return {
+            "iteration": iteration,
+            "score": float(model.score_value),
+            "layers": layers,
+        }
+
+
+class ActivationMeanIterationListener(_BaseUiListener):
+    """Mean |activation| per layer on a probe batch
+    (plot/iterationlistener/ActivationMeanIterationListener +
+    ui/activation view).
+
+    The jitted train step doesn't expose intermediate activations, so this
+    listener runs its own forward pass on a fixed probe input every
+    `frequency` iterations (feedForwardToLayer collect mode)."""
+
+    kind = "activations"
+
+    def __init__(self, probe_input, **kw):
+        super().__init__(**kw)
+        self.probe_input = probe_input
+
+    def snapshot(self, model, iteration) -> dict:
+        acts = model.feed_forward(self.probe_input)
+        means = {}
+        for i, a in enumerate(acts):
+            arr = np.asarray(a)
+            means[f"layer_{i}"] = float(np.abs(arr).mean())
+        return {"iteration": iteration, "activation_means": means}

@@ -1,0 +1,92 @@
+"""CLI end-to-end tests (reference: deeplearning4j-cli test model — drive
+Train/Test/Predict subcommands on small CSV data)."""
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.cli import main
+
+
+@pytest.fixture
+def blob_csv(tmp_path, rng):
+    """Linearly separable 2-class CSV: 4 features + label column."""
+    n = 120
+    x = rng.normal(size=(n, 4)).astype(np.float32)
+    y = (x @ np.array([1.0, -1.0, 0.5, 0.0]) > 0).astype(int)
+    x[y == 1] += 1.5
+    path = tmp_path / "data.csv"
+    with open(path, "w") as f:
+        for row, label in zip(x, y):
+            f.write(",".join(f"{v:.6f}" for v in row) + f",{label}\n")
+    return str(path)
+
+
+@pytest.fixture
+def conf_json(tmp_path):
+    from deeplearning4j_tpu.nn.conf import NeuralNetConfiguration
+    from deeplearning4j_tpu.nn.conf.layers import DenseLayer, OutputLayer
+
+    conf = (NeuralNetConfiguration.builder().seed(3).learning_rate(0.1)
+            .updater("adam").list()
+            .layer(DenseLayer(n_in=4, n_out=16, activation="relu"))
+            .layer(OutputLayer(n_in=16, n_out=2, activation="softmax",
+                               loss_function="negativeloglikelihood"))
+            .build())
+    p = tmp_path / "conf.json"
+    p.write_text(conf.to_json())
+    return str(p)
+
+
+class TestCliRoundTrip:
+    def test_train_test_predict(self, tmp_path, blob_csv, conf_json, capsys):
+        model = str(tmp_path / "model.zip")
+        rc = main(["train", "--conf", conf_json, "--input", blob_csv,
+                   "--model", model, "--num-classes", "2", "--epochs", "10"])
+        assert rc == 0
+        assert (tmp_path / "model.zip").exists()
+
+        rc = main(["test", "--model", model, "--input", blob_csv,
+                   "--num-classes", "2"])
+        assert rc == 0
+        out = capsys.readouterr().out
+        assert "Accuracy" in out
+        acc = float([l for l in out.splitlines() if "Accuracy" in l][0]
+                    .split()[-1])
+        assert acc > 0.85
+
+        # features-only file for predict
+        feat_csv = tmp_path / "features.csv"
+        with open(blob_csv) as f, open(feat_csv, "w") as g:
+            for line in f:
+                g.write(",".join(line.strip().split(",")[:-1]) + "\n")
+        preds = str(tmp_path / "preds.csv")
+        rc = main(["predict", "--model", model, "--input", str(feat_csv),
+                   "--output", preds])
+        assert rc == 0
+        rows = [l.split(",") for l in open(preds).read().splitlines()]
+        assert len(rows) == 120
+        assert len(rows[0]) == 2
+        p = np.array([[float(v) for v in r] for r in rows])
+        np.testing.assert_allclose(p.sum(axis=1), 1.0, atol=1e-4)
+
+    def test_missing_model_flag_errors(self, blob_csv, conf_json):
+        with pytest.raises(SystemExit):
+            main(["train", "--conf", conf_json, "--input", blob_csv,
+                  "--num-classes", "2"])
+
+    def test_svmlight_input(self, tmp_path, conf_json):
+        rng = np.random.default_rng(0)
+        n = 60
+        x = rng.normal(size=(n, 4)).astype(np.float32)
+        y = (x[:, 0] > 0).astype(int)
+        x[y == 1, 0] += 2.0
+        svm = tmp_path / "d.svm"
+        with open(svm, "w") as f:
+            for row, label in zip(x, y):
+                feats = " ".join(f"{j + 1}:{v:.5f}" for j, v in enumerate(row))
+                f.write(f"{label} {feats}\n")
+        model = str(tmp_path / "m.zip")
+        rc = main(["train", "--conf", conf_json, "--input", str(svm),
+                   "--format", "svmlight", "--num-features", "4",
+                   "--model", model, "--num-classes", "2", "--epochs", "5"])
+        assert rc == 0

@@ -216,3 +216,17 @@ class TestRobustness:
         results = cs.classify_points([c.points[0] for c in cs.clusters])
         assert len(results) == 2
         assert sum(len(c.points) for c in cs.clusters) == 20
+
+    def test_kdtree_delete_with_duplicate_split_values(self):
+        """Regression: rebuild after delete must keep equal-valued points
+        findable (strict-< goes left invariant)."""
+        tree = KDTree(2)
+        pts = [np.array(v, dtype=float) for v in
+               [(5, 0), (2, 9), (2, 1), (3, 4), (2, 5), (1, 7)]]
+        for p in pts:
+            tree.insert(p)
+        assert tree.delete(pts[0])
+        assert tree.delete(pts[1])
+        assert tree.delete(pts[4])
+        rect = HyperRect(np.array([2.0, -10.0]), np.array([2.0, 10.0]))
+        assert len(tree.range(rect)) == 1  # only (2,1) remains

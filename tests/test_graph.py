@@ -140,3 +140,13 @@ class TestDeepWalk:
         assert set(loaded) == set(range(6))
         np.testing.assert_allclose(loaded[2], dw.get_vertex_vector(2),
                                    rtol=1e-5)
+
+
+class TestEdgesOut:
+    def test_undirected_edges_reoriented(self):
+        g = Graph(3)
+        g.add_edge(0, 1)
+        g.add_edge(1, 2)
+        edges = g.get_edges_out(1)
+        assert {e.src for e in edges} == {1}
+        assert {e.dst for e in edges} == {0, 2}

@@ -1,0 +1,185 @@
+"""UI tests: component JSON round-trips (reference ui-components tests),
+server endpoints over real HTTP, listeners attached to a training run
+(reference ui module tests use embedded Jetty the same way)."""
+
+import json
+import urllib.request
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.ui import (
+    ChartHistogram,
+    ChartLine,
+    ChartScatter,
+    Component,
+    ComponentDiv,
+    ComponentTable,
+    ComponentText,
+    DecoratorAccordion,
+    FlowIterationListener,
+    HistogramIterationListener,
+    HistoryStorage,
+    SessionStorage,
+    StaticPageUtil,
+    StyleChart,
+    UiServer,
+)
+
+
+def _get(url):
+    with urllib.request.urlopen(url, timeout=10) as r:
+        return json.loads(r.read())
+
+
+def _post(url, payload):
+    req = urllib.request.Request(
+        url, data=json.dumps(payload).encode(),
+        headers={"Content-Type": "application/json"}, method="POST")
+    with urllib.request.urlopen(req, timeout=10) as r:
+        return json.loads(r.read())
+
+
+class TestComponents:
+    def test_chart_line_roundtrip(self):
+        c = ChartLine(title="score", style=StyleChart(width=300, height=200))
+        c.add_series("train", [0, 1, 2], [1.0, 0.5, 0.25])
+        restored = Component.from_json(c.to_json())
+        assert isinstance(restored, ChartLine)
+        assert restored.title == "score"
+        assert restored.y == [[1.0, 0.5, 0.25]]
+        assert restored.style.width == 300
+
+    def test_histogram_of(self, rng):
+        h = ChartHistogram.of(rng.normal(size=1000), bins=10, title="w")
+        assert len(h.y_values) == 10
+        assert sum(h.y_values) == 1000
+        assert h.lower_bounds[0] < h.upper_bounds[-1]
+
+    def test_nested_div_roundtrip(self):
+        div = ComponentDiv(components=[
+            ComponentText(text="hello"),
+            DecoratorAccordion(title="acc", components=[
+                ComponentTable(header=["a"], content=[["1"]])]),
+        ])
+        restored = Component.from_json(div.to_json())
+        assert isinstance(restored.components[0], ComponentText)
+        inner = restored.components[1]
+        assert isinstance(inner, DecoratorAccordion)
+        assert isinstance(inner.components[0], ComponentTable)
+
+    def test_mismatched_series_raises(self):
+        with pytest.raises(ValueError):
+            ChartScatter().add_series("s", [1, 2], [1.0])
+
+
+class TestStorage:
+    def test_session_storage(self):
+        s = SessionStorage()
+        s.put("a", "weights", {"x": 1})
+        assert s.get("a", "weights") == {"x": 1}
+        assert s.get("a", "flow") is None
+        assert s.sessions() == ["a"]
+        assert s.object_types("a") == ["weights"]
+
+    def test_history_bounded(self):
+        h = HistoryStorage(max_history=3)
+        for i in range(5):
+            h.put("s", "weights", i)
+        assert h.history("s", "weights") == [2, 3, 4]
+        assert h.get("s", "weights") == 4
+
+
+class TestServer:
+    @pytest.fixture
+    def server(self):
+        srv = UiServer(port=0).start()
+        yield srv
+        srv.stop()
+
+    def test_post_and_get_weights(self, server):
+        payload = {"iteration": 3, "score": 0.5, "parameters": {}}
+        assert _post(f"{server.url}/weights/update?sid=s1", payload) == {"status": "ok"}
+        assert _get(f"{server.url}/weights/data?sid=s1") == payload
+        assert _get(f"{server.url}/sessions") == ["s1"]
+        # history endpoint
+        _post(f"{server.url}/weights/update?sid=s1", payload)
+        assert len(_get(f"{server.url}/weights/history?sid=s1")) == 2
+
+    def test_nearest_neighbors(self, server, rng):
+        vecs = np.eye(4) + 0.01 * rng.normal(size=(4, 4))
+        _post(f"{server.url}/nearestneighbors/vectors",
+              {"labels": ["a", "b", "c", "d"], "vectors": vecs.tolist()})
+        res = _post(f"{server.url}/nearestneighbors/query", {"word": "a", "k": 2})
+        assert len(res["words"]) == 2
+        assert "a" not in res["words"]
+
+    def test_unknown_word_404(self, server):
+        _post(f"{server.url}/nearestneighbors/vectors",
+              {"labels": ["a"], "vectors": [[1.0]]})
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            _post(f"{server.url}/nearestneighbors/query", {"word": "zzz"})
+        assert ei.value.code == 404
+
+    def test_index_page(self, server):
+        with urllib.request.urlopen(server.url, timeout=10) as r:
+            body = r.read().decode()
+        assert "deeplearning4j_tpu" in body
+
+
+class TestListeners:
+    def _tiny_net(self):
+        from deeplearning4j_tpu.nn.conf import NeuralNetConfiguration
+        from deeplearning4j_tpu.nn.conf.layers import DenseLayer, OutputLayer
+        from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+
+        conf = (NeuralNetConfiguration.builder().seed(1).learning_rate(0.1)
+                .list()
+                .layer(DenseLayer(n_in=4, n_out=8, activation="relu"))
+                .layer(OutputLayer(n_in=8, n_out=2, activation="softmax",
+                                   loss_function="negativeloglikelihood"))
+                .build())
+        return MultiLayerNetwork(conf).init()
+
+    def test_histogram_listener_embedded(self, rng):
+        from deeplearning4j_tpu.datasets.api import DataSet
+
+        net = self._tiny_net()
+        storage = HistoryStorage()
+        net.set_listeners(HistogramIterationListener(storage=storage))
+        x = rng.normal(size=(32, 4)).astype(np.float32)
+        y = np.eye(2, dtype=np.float32)[rng.integers(0, 2, 32)]
+        net.fit(DataSet(x, y))
+        snap = storage.get("default", "weights")
+        assert snap is not None
+        assert "score" in snap
+        assert any(k.endswith("_W") for k in snap["parameters"])
+        bins = next(iter(snap["parameters"].values()))
+        assert len(bins["bins"]) == len(bins["counts"]) + 1
+
+    def test_flow_listener_http(self, rng):
+        from deeplearning4j_tpu.datasets.api import DataSet
+
+        srv = UiServer(port=0).start()
+        try:
+            net = self._tiny_net()
+            net.set_listeners(FlowIterationListener(url=srv.url, session_id="t"))
+            x = rng.normal(size=(16, 4)).astype(np.float32)
+            y = np.eye(2, dtype=np.float32)[rng.integers(0, 2, 16)]
+            net.fit(DataSet(x, y))
+            snap = _get(f"{srv.url}/flow/data?sid=t")
+            assert len(snap["layers"]) == 2
+            assert snap["layers"][0]["num_params"] > 0
+        finally:
+            srv.stop()
+
+
+class TestStaticPage:
+    def test_render_html(self, tmp_path):
+        line = ChartLine(title="loss").add_series("t", [0, 1], [1.0, 0.5])
+        table = ComponentTable(header=["k", "v"], content=[["acc", "0.9"]])
+        html = StaticPageUtil.render_html([line, table], title="report")
+        assert "loss" in html and "renderComponent" in html
+        p = tmp_path / "r.html"
+        StaticPageUtil.save_html([line], str(p))
+        assert p.read_text().startswith("<!doctype html>")

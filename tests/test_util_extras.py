@@ -1,0 +1,155 @@
+"""Tests for util/text auxiliary components: Viterbi, moving windows,
+time-series utils, inverted index, tree parsing."""
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.nlp.invertedindex import InvertedIndex
+from deeplearning4j_tpu.nlp.treeparser import (
+    HeadWordFinder,
+    Tree,
+    TreeParser,
+    TreeVectorizer,
+    binarize,
+    collapse_unaries,
+)
+from deeplearning4j_tpu.util.moving_window import MovingWindowMatrix
+from deeplearning4j_tpu.util.time_series import (
+    moving_average,
+    reshape_time_series_mask_to_vector,
+)
+from deeplearning4j_tpu.util.viterbi import Viterbi
+
+
+class TestViterbi:
+    def test_smooths_isolated_flip(self):
+        """A single frame disagreeing with its sticky context is corrected."""
+        v = Viterbi([0, 1], meta_stability=0.95, p_correct=0.8)
+        obs = [0, 0, 0, 1, 0, 0, 0]
+        score, path = v.decode(obs)
+        assert path.tolist() == [0] * 7
+        assert score < 0  # log-likelihood
+
+    def test_keeps_sustained_switch(self):
+        v = Viterbi([0, 1], meta_stability=0.9, p_correct=0.99)
+        obs = [0, 0, 0, 1, 1, 1, 1]
+        _, path = v.decode(obs)
+        assert path.tolist() == obs
+
+    def test_binary_label_matrix_input(self):
+        v = Viterbi([0, 1, 2])
+        onehot = np.eye(3)[[0, 0, 1, 1, 2]]
+        _, path = v.decode(onehot)
+        assert path.tolist() == [0, 0, 1, 1, 2]
+
+    def test_requires_two_states(self):
+        with pytest.raises(ValueError):
+            Viterbi([0])
+
+
+class TestMovingWindow:
+    def test_window_count_and_content(self):
+        m = np.arange(16).reshape(4, 4)
+        w = MovingWindowMatrix(m, 2, 2).windows()
+        assert len(w) == 9
+        np.testing.assert_array_equal(w[0], [[0, 1], [4, 5]])
+        np.testing.assert_array_equal(w[-1], [[10, 11], [14, 15]])
+
+    def test_flattened_and_rotate(self):
+        m = np.arange(4).reshape(2, 2)
+        w = MovingWindowMatrix(m, 2, 2, add_rotate=True).windows(flattened=True)
+        assert len(w) == 4  # 1 window x 4 rotations
+        assert all(v.shape == (4,) for v in w)
+
+    def test_window_too_large(self):
+        with pytest.raises(ValueError):
+            MovingWindowMatrix(np.zeros((2, 2)), 3, 1)
+
+
+class TestTimeSeries:
+    def test_moving_average(self):
+        out = moving_average([1, 2, 3, 4, 5], 2)
+        np.testing.assert_allclose(out, [1.5, 2.5, 3.5, 4.5])
+
+    def test_mask_reshape(self):
+        mask = np.array([[1, 1, 0], [1, 0, 0]])
+        np.testing.assert_array_equal(
+            reshape_time_series_mask_to_vector(mask), [1, 1, 0, 1, 0, 0])
+
+
+class TestInvertedIndex:
+    def _index(self):
+        ix = InvertedIndex(seed=1)
+        ix.add_doc("the cat sat on the mat".split(), labels=["animals"])
+        ix.add_doc("the dog sat".split(), labels=["animals"])
+        ix.add_doc("stocks fell sharply".split(), labels=["finance"])
+        return ix
+
+    def test_postings_and_search(self):
+        ix = self._index()
+        assert ix.num_documents() == 3
+        assert ix.documents("sat") == [0, 1]
+        assert ix.search("the", "sat") == [0, 1]
+        assert ix.search("the", "stocks") == []
+
+    def test_tfidf_ranking(self):
+        ix = self._index()
+        hits = ix.tfidf_search("cat", "sat")
+        assert hits[0][0] == 0  # doc 0 has both terms
+        assert all(s > 0 for _, s in hits)
+
+    def test_minibatches_and_sample(self):
+        ix = self._index()
+        batches = list(ix.mini_batches(2))
+        assert [len(b) for b in batches] == [2, 1]
+        assert len(ix.sample()) > 0
+        words, labels = ix.document_with_labels(2)
+        assert labels == ["finance"]
+
+    def test_incremental_add_same_doc(self):
+        ix = InvertedIndex()
+        ix.add_words_to_doc(0, ["a", "b"])
+        ix.add_words_to_doc(0, ["b", "c"])
+        assert ix.document(0) == ["a", "b", "b", "c"]
+        assert ix.documents("b") == [0]  # no duplicate posting
+
+
+class TestTreeParser:
+    SENT = "(S (NP (DT the) (NN cat)) (VP (VBD sat) (PP (IN on) (NP (DT the) (NN mat)))))"
+
+    def test_parse_and_yield(self):
+        t = TreeParser.parse(self.SENT)
+        assert t.label == "S"
+        assert t.yield_words() == ["the", "cat", "sat", "on", "the", "mat"]
+        assert t.depth() >= 3
+
+    def test_roundtrip_to_string(self):
+        t = TreeParser.parse(self.SENT)
+        assert TreeParser.parse(t.to_string()).yield_words() == t.yield_words()
+
+    def test_binarize(self):
+        t = TreeParser.parse("(X (A a) (B b) (C c) (D d))")
+        b = binarize(t)
+        def max_arity(n):
+            if not n.children:
+                return 0
+            return max([len(n.children)] + [max_arity(c) for c in n.children])
+        assert max_arity(b) <= 2
+        assert b.yield_words() == ["a", "b", "c", "d"]
+
+    def test_collapse_unaries(self):
+        t = TreeParser.parse("(S (VP (NP (NN dog))))")
+        c = collapse_unaries(t)
+        assert c.label == "S_VP_NP"
+        assert c.yield_words() == ["dog"]
+
+    def test_head_word(self):
+        t = TreeParser.parse(self.SENT)
+        assert HeadWordFinder.find_head(t) == "mat"
+
+    def test_vectorizer(self):
+        t = TreeParser.parse("(S (A a) (B b))")
+        table = {"a": np.ones(4, np.float32), "b": np.zeros(4, np.float32)}
+        tv = TreeVectorizer(lambda w: table.get(w), dim=4)
+        np.testing.assert_allclose(tv.vectorize(t), 0.5 * np.ones(4))
+        assert len(tv.vectorize_all(t)) == 5  # S, A, a, B, b

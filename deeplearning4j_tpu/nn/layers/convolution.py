@@ -55,15 +55,18 @@ class ConvolutionImpl(LayerImpl):
     def apply(self, conf, params, state, x, *, train=False, rng=None, mask=None):
         if conf.dropout:
             x = apply_dropout(x, conf.dropout, rng, train=train)
+        # Keep operand/output dtypes uniform: a preferred_element_type that
+        # widens bf16->f32 breaks the conv *transpose* rule under jax.grad
+        # (f32 cotangent vs bf16 kernel). The TPU MXU accumulates bf16 convs
+        # in f32 internally regardless, so uniform bf16 loses nothing.
         z = lax.conv_general_dilated(
-            x, params["W"],
+            x, params["W"].astype(x.dtype),
             window_strides=tuple(int(s) for s in conf.stride),
             padding=_padding(conf),
             rhs_dilation=tuple(int(d) for d in conf.dilation),
             dimension_numbers=_DIMS,
-            preferred_element_type=jnp.float32 if x.dtype == jnp.bfloat16 else None,
         )
-        z = (z + params["b"]).astype(x.dtype)
+        z = z + params["b"].astype(z.dtype)
         return get_activation(conf.activation)(z), state
 
 

@@ -1,0 +1,57 @@
+"""Virtual-device platform forcing for cluster simulation.
+
+The reference simulates clusters with Spark ``local[*]`` executors inside one
+JVM (SURVEY.md §4.5); the JAX analogue is the XLA host platform with N
+virtual CPU devices. One shared helper so tests, the driver entry point, and
+multi-process launchers all do the same (fragile, jax-internals-touching)
+dance: set JAX_PLATFORMS=cpu + ``--xla_force_host_platform_device_count=N``
+and de-register the environment's `axon` TPU backend factory before any
+backend initialization (its get_backend hook otherwise initializes the TPU
+tunnel on first lookup).
+"""
+
+from __future__ import annotations
+
+import os
+import re
+
+_FLAG = "xla_force_host_platform_device_count"
+
+
+def ensure_cpu_devices(n: int) -> None:
+    """Force a pure-CPU JAX platform with at least ``n`` virtual devices.
+
+    Must run before jax initializes its backends; if they are already
+    initialized with >= n devices (of any platform) this is a no-op, and if
+    they are initialized with fewer an AssertionError explains the ordering
+    problem.
+    """
+    import jax
+
+    try:
+        from jax._src import xla_bridge as _xb
+
+        initialized = _xb.backends_are_initialized()
+    except Exception:  # pragma: no cover - jax internals moved
+        _xb = None
+        initialized = True
+
+    if initialized and len(jax.devices()) >= n:
+        return
+
+    flags = os.environ.get("XLA_FLAGS", "")
+    m = re.search(rf"--{_FLAG}=(\d+)", flags)
+    if m is None:
+        flags = (flags + f" --{_FLAG}={n}").strip()
+    elif int(m.group(1)) < n:
+        flags = flags.replace(m.group(0), f"--{_FLAG}={n}")
+    os.environ["XLA_FLAGS"] = flags
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    jax.config.update("jax_platforms", "cpu")
+    if _xb is not None and not _xb.backends_are_initialized():
+        _xb._backend_factories.pop("axon", None)
+
+    assert len(jax.devices()) >= n, (
+        f"need {n} devices, have {len(jax.devices())} "
+        f"(jax backends were initialized before ensure_cpu_devices({n}) "
+        f"could force the virtual CPU platform — call it earlier)")

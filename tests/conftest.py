@@ -2,35 +2,18 @@
 sharding tests run without TPU hardware (SURVEY.md §4 item 5 — the reference
 simulates clusters with Spark local[*]; XLA host devices play that role).
 
-The environment's sitecustomize registers an `axon` TPU backend in every
-python process; merely setting JAX_PLATFORMS=cpu is not enough because the
-axon get_backend hook initializes all backends (including the TPU tunnel)
-on first lookup. De-register the axon factory before any backend init.
+The platform-forcing dance lives in
+deeplearning4j_tpu.util.virtual_devices.ensure_cpu_devices, shared with
+__graft_entry__.dryrun_multichip. It must run before any jax backend
+initialization (sitecustomize registers an `axon` TPU backend whose
+get_backend hook initializes the TPU tunnel on first lookup).
 """
 
-import os
+from deeplearning4j_tpu.util.virtual_devices import ensure_cpu_devices
 
-os.environ["JAX_PLATFORMS"] = "cpu"
-flags = os.environ.get("XLA_FLAGS", "")
-if "xla_force_host_platform_device_count" not in flags:
-    os.environ["XLA_FLAGS"] = (
-        flags + " --xla_force_host_platform_device_count=8"
-    ).strip()
+ensure_cpu_devices(8)
 
 import jax  # noqa: E402
-
-# sitecustomize imports jax before conftest runs, so the env var above is
-# too late for jax's config — update it through the config API instead.
-jax.config.update("jax_platforms", "cpu")
-
-try:  # pragma: no cover - only relevant inside the axon image
-    from jax._src import xla_bridge as _xb
-
-    if not _xb.backends_are_initialized():
-        _xb._backend_factories.pop("axon", None)
-except Exception:
-    pass
-
 import numpy as np  # noqa: E402
 import pytest  # noqa: E402
 

@@ -1,0 +1,72 @@
+"""bf16 training-path tests.
+
+Round-1 postmortem: bench.py selects dtype="bfloat16" exactly when running
+on the real TPU chip, but no test exercised a bf16 value_and_grad step, so a
+conv-transpose dtype bug lived only on hardware (VERDICT Weak #1). These
+tests run the same bf16 path on CPU so the class of bug is caught pre-driver.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from deeplearning4j_tpu.models.lenet import lenet5
+from deeplearning4j_tpu.models.transformer import transformer_lm
+
+
+def _one_step(net, batch):
+    step = net._get_train_step()
+    key = jax.random.PRNGKey(0)
+    # the jitted step donates its buffers — write results back onto the net
+    net.params, net.opt_state, net.state, loss, _ = step(
+        net.params, net.opt_state, net.state, key, batch)
+    jax.block_until_ready(loss)
+    return net.params, float(loss)
+
+
+def test_lenet_bf16_train_step():
+    """value_and_grad of a bf16 conv net must not die in the conv transpose
+    rule (the exact failure mode of BENCH_r01)."""
+    net = lenet5(dtype="bfloat16")
+    net.init()
+    rng = np.random.default_rng(0)
+    x = rng.random((8, 28, 28, 1), dtype=np.float32)
+    y = np.eye(10, dtype=np.float32)[rng.integers(0, 10, 8)]
+    batch = {"features": jnp.asarray(x), "labels": jnp.asarray(y)}
+    params, loss = _one_step(net, batch)
+    assert np.isfinite(loss)
+    # master params stay f32 (mixed precision); compute casts to bf16
+    assert params["layer_0"]["W"].dtype == jnp.float32
+    out = net.output(np.asarray(batch["features"], np.float32))
+    assert np.all(np.isfinite(np.asarray(out, np.float32)))
+
+
+def test_lenet_bf16_multiple_steps_decrease_loss():
+    net = lenet5(dtype="bfloat16", learning_rate=1e-2)
+    net.init()
+    rng = np.random.default_rng(1)
+    x = rng.random((32, 28, 28, 1), dtype=np.float32)
+    y = np.eye(10, dtype=np.float32)[rng.integers(0, 10, 32)]
+    batch = {"features": jnp.asarray(x), "labels": jnp.asarray(y)}
+    step = net._get_train_step()
+    params, opt_state, state = net.params, net.opt_state, net.state
+    key = jax.random.PRNGKey(0)
+    losses = []
+    for _ in range(20):
+        key, k = jax.random.split(key)
+        params, opt_state, state, loss, _ = step(params, opt_state, state, k, batch)
+        losses.append(float(loss))
+    assert losses[-1] < losses[0]
+    assert all(np.isfinite(l) for l in losses)
+
+
+def test_transformer_bf16_train_step():
+    """The MFU bench runs the transformer in bf16 — keep that path tested."""
+    net = transformer_lm(vocab_size=64, d_model=32, n_heads=2, n_layers=2,
+                         d_ff=64, max_length=16, dtype="bfloat16")
+    net.init()
+    rng = np.random.default_rng(0)
+    toks = np.asarray(rng.integers(0, 64, (2, 16)), np.int32)
+    labels = np.eye(64, dtype=np.float32)[toks]
+    net.fit(toks, labels, epochs=2)
+    assert np.isfinite(net.score_value)

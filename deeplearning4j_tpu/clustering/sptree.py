@@ -90,12 +90,18 @@ class SpTree:
             self.children[c] = SpTree(self.data, Cell(corner, half.copy()),
                                       indices=[])
         moved = self.point_index
+        # this leaf may hold duplicates (cum_size counts them; the insert
+        # that triggered subdivision already bumped cum_size for the NEW
+        # point, which insert() will place afterwards) — re-insert the moved
+        # point once per absorbed copy so no mass is dropped
+        multiplicity = self.cum_size - 1
         self.point_index = None
         self.is_leaf = False
         if moved is not None:
-            for child in self.children:
-                if child.insert(moved):
-                    break
+            for _ in range(max(1, multiplicity)):
+                for child in self.children:
+                    if child.insert(moved):
+                        break
 
     def compute_non_edge_forces(self, point_index: int, theta: float,
                                 neg_f: np.ndarray) -> float:
@@ -111,9 +117,14 @@ class SpTree:
         max_width = float(self.cell.width.max() * 2.0)
         # Barnes-Hut criterion: treat cell as one body if compact enough
         if self.is_leaf or (max_width * max_width) < (theta * theta) * d2:
-            if self.is_leaf and self.point_index == point_index:
-                # leaf holding the query point itself (plus duplicates)
-                return 0.0
+            if self.is_leaf and (
+                    self.point_index == point_index
+                    or np.allclose(self.data[self.point_index], point)):
+                # leaf holding (a duplicate of) the query point: exclude only
+                # the query point itself. Remaining collapsed copies still
+                # count toward sum_Q (cum_size-1 bodies at d2=0 → q=1, zero
+                # net force) — the reference only short-circuits on size==1.
+                return float(self.cum_size - 1)
             q = 1.0 / (1.0 + d2)
             mult = self.cum_size * q
             sum_q = mult

@@ -11,13 +11,19 @@ from deeplearning4j_tpu.eval.confusion import ConfusionMatrix
 
 
 class Evaluation:
-    def __init__(self, n_classes: int | None = None, labels=None):
+    def __init__(self, n_classes: int | None = None, labels=None,
+                 top_n: int = 1):
         self.label_names = labels
         self._n = n_classes
         self.confusion: ConfusionMatrix | None = None
         if n_classes:
             self.confusion = ConfusionMatrix(range(n_classes))
         self.examples = 0
+        # top-N accuracy tracking (reference Evaluation(int topN) ctor:
+        # an example counts as top-N correct when the true class is among
+        # the N highest-probability predictions)
+        self.top_n = max(1, int(top_n))
+        self.top_n_correct = 0
 
     def _ensure(self, n):
         if self.confusion is None:
@@ -43,15 +49,26 @@ class Evaluation:
         pred = predictions.argmax(axis=-1)
         np.add.at(self.confusion.matrix, (actual, pred), 1)
         self.examples += len(actual)
+        if self.top_n > 1 and len(actual):
+            k = min(self.top_n, predictions.shape[-1])
+            top = np.argpartition(predictions, -k, axis=-1)[:, -k:]
+            self.top_n_correct += int((top == actual[:, None]).any(axis=-1).sum())
+        else:
+            self.top_n_correct += int((pred == actual).sum())
 
     def merge(self, other: "Evaluation"):
         """Merge partial evaluations (reference Evaluation.merge — used by
         distributed eval reduce)."""
         if other.confusion is None:
             return self
+        if other.top_n != self.top_n:
+            raise ValueError(
+                f"cannot merge Evaluation(top_n={other.top_n}) into "
+                f"Evaluation(top_n={self.top_n}) — counts are incompatible")
         self._ensure(other.confusion.matrix.shape[0])
         self.confusion.add_matrix(other.confusion)
         self.examples += other.examples
+        self.top_n_correct += other.top_n_correct
         return self
 
     # ----------------------------------------------------------- metrics
@@ -65,6 +82,13 @@ class Evaluation:
         if self.examples == 0:
             return 0.0
         return float(np.trace(self.confusion.matrix)) / self.examples
+
+    def top_n_accuracy(self) -> float:
+        """Fraction of examples whose true class is in the top-N predictions
+        (reference Evaluation.topNAccuracy)."""
+        if self.examples == 0:
+            return 0.0
+        return self.top_n_correct / self.examples
 
     def precision(self, c: int | None = None) -> float:
         if c is not None:
@@ -115,6 +139,9 @@ class Evaluation:
         lines.append(f" Precision: {self.precision():.4f}")
         lines.append(f" Recall:    {self.recall():.4f}")
         lines.append(f" F1 Score:  {self.f1():.4f}")
+        if self.top_n > 1:
+            lines.append(f" Top-{self.top_n} Accuracy: "
+                         f"{self.top_n_accuracy():.4f}")
         lines.append("===========================================================")
         if self.confusion is not None and (self._n or 0) <= 20:
             lines.append("Confusion matrix:")

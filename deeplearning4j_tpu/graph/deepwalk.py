@@ -64,12 +64,18 @@ class DeepWalk:
 
     def fit(self, graph_or_walker, walk_length: int = 40,
             walks_per_vertex: int = 1, epochs: int = 1,
-            weighted: bool = False) -> "DeepWalk":
+            weighted: bool = False,
+            no_edge_handling: str | None = None) -> "DeepWalk":
         """Generate walks and train (DeepWalk.fit(IGraph, walkLength)).
-        Accepts a Graph (builds the walker) or a walk iterator."""
+        Accepts a Graph (builds the walker) or a walk iterator. The walker
+        default raises on dead-end vertices (reference parity); pass
+        no_edge_handling=NoEdgeHandling.SELF_LOOP_ON_DISCONNECTED for graphs
+        with sinks."""
         if isinstance(graph_or_walker, Graph):
             cls = WeightedRandomWalkIterator if weighted else RandomWalkIterator
-            walker = cls(graph_or_walker, walk_length, seed=self.seed)
+            kw = ({} if no_edge_handling is None
+                  else {"no_edge_handling": no_edge_handling})
+            walker = cls(graph_or_walker, walk_length, seed=self.seed, **kw)
             self.num_vertices = graph_or_walker.num_vertices()
         else:
             walker = graph_or_walker

@@ -31,7 +31,7 @@ class RandomWalkIterator:
     (iterator/RandomWalkIterator.java)."""
 
     def __init__(self, graph: Graph, walk_length: int, seed: int = 0,
-                 no_edge_handling: str = NoEdgeHandling.SELF_LOOP_ON_DISCONNECTED):
+                 no_edge_handling: str = NoEdgeHandling.EXCEPTION_ON_DISCONNECTED):
         self.graph = graph
         self.walk_length = int(walk_length)
         self.no_edge_handling = no_edge_handling

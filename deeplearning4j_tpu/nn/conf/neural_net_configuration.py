@@ -262,6 +262,7 @@ class ListBuilder:
         if any(l is None for l in self._layers):
             raise ValueError("Layer list has gaps — set every index")
         layers = [self._conf.resolve_layer(l) for l in self._layers]
+        _validate_names(layers)
         pre = {int(k): v for k, v in self._preprocessors.items()}
         if self._input_type is not None:
             _infer_shapes(layers, pre, self._input_type)
@@ -277,6 +278,19 @@ class ListBuilder:
             input_type=self._input_type,
         )
         return mlc
+
+
+def _validate_names(layers) -> None:
+    """Fail fast at build() on typo'd activation/loss names instead of at
+    init() — the builder is the user-facing contract (reference builders
+    validate eagerly via enums)."""
+    from deeplearning4j_tpu.nn.conf.layers import validate_layer_names
+
+    for i, layer in enumerate(layers):
+        try:
+            validate_layer_names(layer)
+        except ValueError as e:
+            raise ValueError(f"layer {i} ({type(layer).__name__}): {e}") from None
 
 
 def _expected_kind(layer: Layer) -> str:

@@ -318,10 +318,10 @@ class ComputationGraph:
                              train=training)
         return float(loss)
 
-    def evaluate(self, it):
+    def evaluate(self, it, top_n: int = 1):
         from deeplearning4j_tpu.eval.evaluation import Evaluation
 
-        ev = Evaluation()
+        ev = Evaluation(top_n=top_n)
         if isinstance(it, (DataSet, MultiDataSet)):
             it = ListDataSetIterator([it])
         it.reset()

@@ -406,10 +406,11 @@ class MultiLayerNetwork:
         return float(loss)
 
     def evaluate(self, it, top_n: int = 1):
-        """Classification evaluation (reference evaluate:2311)."""
+        """Classification evaluation (reference evaluate:2311); top_n > 1
+        additionally tracks top-N accuracy (Evaluation.topNAccuracy)."""
         from deeplearning4j_tpu.eval.evaluation import Evaluation
 
-        ev = Evaluation()
+        ev = Evaluation(top_n=top_n)
         if isinstance(it, DataSet):
             it = ListDataSetIterator([it])
         it.reset()

@@ -62,9 +62,11 @@ def _x2p(x, perplexity, iters=50):
 
 
 @jax.jit
-def _tsne_step(y, iy, gains, p, momentum, min_gain, learning_rate):
+def _tsne_step(y, iy, gains, p, p_report, momentum, min_gain, learning_rate):
     """One t-SNE gradient step with the reference's gains/momentum scheme
-    (Tsne.java:124-151)."""
+    (Tsne.java:124-151). `p` drives the gradient (may be early-exaggerated);
+    `p_report` is the true P so reported KL is comparable across the lying
+    phase boundary."""
     n = y.shape[0]
     sum_y = jnp.sum(y * y, axis=1)
     num = 1.0 / (1.0 + sum_y[:, None] + sum_y[None, :] - 2.0 * y @ y.T)
@@ -79,7 +81,7 @@ def _tsne_step(y, iy, gains, p, momentum, min_gain, learning_rate):
     iy = momentum * iy - learning_rate * (gains * dy)
     y = y + iy
     y = y - jnp.mean(y, axis=0, keepdims=True)
-    kl = jnp.sum(jnp.where(p > 0, p * jnp.log(p / q), 0.0))
+    kl = jnp.sum(jnp.where(p_report > 0, p_report * jnp.log(p_report / q), 0.0))
     return y, iy, gains, kl
 
 
@@ -126,7 +128,7 @@ class Tsne:
             lying = i < self.stop_lying_iteration
             p_eff = p * self.exaggeration if lying else p
             y, iy, gains, kl = _tsne_step(
-                y, iy, gains, p_eff, momentum, self.min_gain,
+                y, iy, gains, p_eff, p, momentum, self.min_gain,
                 self.learning_rate)
             if (i + 1) % 50 == 0:
                 self.kl_history.append(float(kl))

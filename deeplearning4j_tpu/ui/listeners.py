@@ -12,8 +12,11 @@ hop; going direct-to-storage is the embedded mode.
 from __future__ import annotations
 
 import json
+import logging
 import urllib.request
 from typing import Optional
+
+log = logging.getLogger(__name__)
 
 import numpy as np
 
@@ -32,6 +35,10 @@ def _post(url: str, payload: dict) -> None:
 
 class _BaseUiListener(IterationListener):
     kind = ""
+    # consecutive POST failures before the listener stops trying — monitoring
+    # must never take down training (the reference HistogramIterationListener
+    # catches and logs its HTTP errors for the same reason)
+    MAX_POST_FAILURES = 5
 
     def __init__(self, url: Optional[str] = None,
                  storage: Optional[SessionStorage] = None,
@@ -42,13 +49,22 @@ class _BaseUiListener(IterationListener):
         self.storage = storage
         self.session_id = session_id
         self.frequency = max(1, frequency)
+        self._post_failures = 0
 
     def _emit(self, payload: dict) -> None:
         if self.storage is not None:
             self.storage.put(self.session_id, self.kind, payload)
-        if self.url is not None:
-            _post(f"{self.url}/{self.kind}/update?sid={self.session_id}",
-                  payload)
+        if self.url is not None and self._post_failures < self.MAX_POST_FAILURES:
+            try:
+                _post(f"{self.url}/{self.kind}/update?sid={self.session_id}",
+                      payload)
+                self._post_failures = 0
+            except Exception as e:  # noqa: BLE001 — any transport failure
+                self._post_failures += 1
+                log.warning("UI POST to %s failed (%s)%s", self.url, e,
+                            "; disabling further posts"
+                            if self._post_failures >= self.MAX_POST_FAILURES
+                            else "")
 
     def iteration_done(self, model, iteration):
         if iteration % self.frequency:

@@ -103,7 +103,11 @@ class StaticPageUtil:
     @staticmethod
     def render_html(components: Sequence[Component],
                     title: str = "deeplearning4j_tpu report") -> str:
-        payload = json.dumps([c.to_dict() for c in components])
+        # escape for <script> context: "<" inside JSON strings becomes <
+        # so neither "</script>" nor "<!--" (script-data-escaped state) in a
+        # ComponentText can break out of the block or inject HTML
+        payload = json.dumps([c.to_dict() for c in components]).replace(
+            "<", "\\u003c")
         return f"""<!doctype html>
 <html><head><meta charset="utf-8"><title>{html.escape(title)}</title>
 <script>{_RENDER_JS}</script></head>

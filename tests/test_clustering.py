@@ -136,6 +136,28 @@ class TestSpTree:
         assert sum_q == pytest.approx(exact_sum_q, rel=1e-9)
         assert np.allclose(neg_f, exact_neg)
 
+    def test_non_edge_forces_duplicate_rows(self, rng):
+        """Duplicate rows collapse into one leaf; every copy must still count
+        toward every other point's sum_Q, and each duplicate must see the
+        same sums (self excluded) — covers both the absorbed-then-subdivided
+        insertion order and direct duplicate leaves."""
+        base = rng.normal(size=(6, 2))
+        # [dup, dup, far, ...]: index 1 absorbed into 0's leaf, later points
+        # force subdivision of that leaf
+        pts = np.vstack([base[0], base[0], base[1:], base[0]])
+        n = len(pts)
+        for i in range(n):
+            neg_f = np.zeros(2)
+            sum_q = SpTree(pts).compute_non_edge_forces(i, theta=0.0,
+                                                        neg_f=neg_f)
+            diff = pts[i][None, :] - pts
+            d2 = np.sum(diff * diff, axis=1)
+            q = 1.0 / (1.0 + d2)
+            q[i] = 0.0
+            exact_neg = (q[:, None] ** 2 * diff).sum(axis=0)
+            assert sum_q == pytest.approx(q.sum(), rel=1e-9), f"point {i}"
+            assert np.allclose(neg_f, exact_neg)
+
     def test_theta_pruning_approximates(self, rng):
         pts = rng.normal(size=(128, 2))
         tree = SpTree(pts)

@@ -1,0 +1,134 @@
+"""Known-value tests for the eval package (reference test strategy §4:
+eval/EvaluationTest-style assertions against hand-computed matrices;
+Evaluation.java:111 eval, :294 stats, merge; RegressionEvaluation.java)."""
+
+import numpy as np
+
+from deeplearning4j_tpu.eval.confusion import ConfusionMatrix
+from deeplearning4j_tpu.eval.evaluation import Evaluation
+from deeplearning4j_tpu.eval.regression import RegressionEvaluation
+
+
+def one_hot(idx, n):
+    out = np.zeros((len(idx), n), dtype=np.float32)
+    out[np.arange(len(idx)), idx] = 1.0
+    return out
+
+
+class TestEvaluation:
+    def test_known_values(self):
+        # 3-class problem with a hand-checkable confusion matrix
+        actual = np.array([0, 0, 0, 1, 1, 2, 2, 2, 2, 2])
+        pred = np.array([0, 0, 1, 1, 1, 2, 2, 2, 0, 1])
+        ev = Evaluation()
+        ev.eval(one_hot(actual, 3), one_hot(pred, 3))
+        assert ev.examples == 10
+        assert ev.accuracy() == 7 / 10
+        # class 0: tp=2, predicted total=3, actual total=3
+        assert ev.precision(0) == 2 / 3
+        assert ev.recall(0) == 2 / 3
+        # class 2: tp=3, predicted=3, actual=5
+        assert ev.precision(2) == 1.0
+        assert ev.recall(2) == 3 / 5
+        p, r = ev.precision(1), ev.recall(1)
+        assert ev.f1(1) == 2 * p * r / (p + r)
+        assert ev.confusion.get_count(2, 0) == 1
+        assert "Accuracy" in ev.stats()
+
+    def test_never_predicted_class_warning_and_macro_exclusion(self):
+        actual = np.array([0, 1, 2, 2])
+        pred = np.array([0, 0, 0, 0])
+        ev = Evaluation()
+        ev.eval(one_hot(actual, 3), one_hot(pred, 3))
+        # macro precision only over predicted classes (class 0)
+        assert ev.precision() == 1 / 4
+        assert "never predicted" in ev.stats()
+
+    def test_time_series_mask(self):
+        # [batch=1, time=4, C=2]; mask drops the 2 wrong timesteps
+        labels = one_hot(np.array([0, 1, 0, 1]), 2)[None]
+        preds = one_hot(np.array([0, 1, 1, 0]), 2)[None]
+        mask = np.array([[1, 1, 0, 0]])
+        ev = Evaluation()
+        ev.eval(labels, preds, mask=mask)
+        assert ev.examples == 2
+        assert ev.accuracy() == 1.0
+
+    def test_merge(self):
+        a, b = Evaluation(), Evaluation()
+        a.eval(one_hot(np.array([0, 1]), 2), one_hot(np.array([0, 0]), 2))
+        b.eval(one_hot(np.array([1, 1]), 2), one_hot(np.array([1, 0]), 2))
+        a.merge(b)
+        assert a.examples == 4
+        assert a.accuracy() == 2 / 4
+        assert a.confusion.get_count(1, 0) == 2
+
+    def test_top_n_accuracy(self):
+        # probs: true class is rank-2 for examples 1 and 2, rank-1 for 0,
+        # rank-3 (out of top-2) for 3
+        probs = np.array([
+            [0.7, 0.2, 0.1],   # true 0 → top-1 hit
+            [0.5, 0.4, 0.1],   # true 1 → top-2 hit
+            [0.4, 0.5, 0.1],   # true 0 → top-2 hit
+            [0.5, 0.3, 0.2],   # true 2 → miss even at top-2
+        ])
+        truth = one_hot(np.array([0, 1, 0, 2]), 3)
+        ev = Evaluation(top_n=2)
+        ev.eval(truth, probs)
+        assert ev.accuracy() == 1 / 4
+        assert ev.top_n_accuracy() == 3 / 4
+        assert "Top-2" in ev.stats()
+
+    def test_top_n_merge(self):
+        a = Evaluation(top_n=2)
+        b = Evaluation(top_n=2)
+        probs = np.array([[0.5, 0.4, 0.1]])
+        a.eval(one_hot(np.array([1]), 3), probs)
+        b.eval(one_hot(np.array([2]), 3), probs)
+        a.merge(b)
+        assert a.top_n_correct == 1
+        assert a.top_n_accuracy() == 1 / 2
+
+
+class TestRegressionEvaluation:
+    def test_known_values(self):
+        labels = np.array([[1.0, 2.0], [3.0, 4.0], [5.0, 6.0]])
+        preds = np.array([[1.5, 2.0], [2.5, 5.0], [5.0, 5.0]])
+        re = RegressionEvaluation()
+        re.eval(labels, preds)
+        assert np.isclose(re.mean_squared_error(0), (0.25 + 0.25 + 0) / 3)
+        assert np.isclose(re.mean_absolute_error(1), (0 + 1 + 1) / 3)
+        assert np.isclose(re.root_mean_squared_error(0),
+                          np.sqrt((0.25 + 0.25 + 0) / 3))
+        # R^2 column 0: ss_res=0.5, ss_tot=8 (mean 3)
+        assert np.isclose(re.r_squared(0), 1 - 0.5 / 8)
+        assert "MSE" in re.stats()
+
+    def test_perfect_fit_r2(self):
+        labels = np.random.default_rng(0).normal(size=(10, 3))
+        re = RegressionEvaluation()
+        re.eval(labels, labels.copy())
+        for c in range(3):
+            assert re.mean_squared_error(c) == 0.0
+            assert re.r_squared(c) >= 1.0 - 1e-9
+
+    def test_time_series_with_mask(self):
+        labels = np.ones((2, 3, 1))
+        preds = np.zeros((2, 3, 1))
+        mask = np.array([[1, 1, 0], [1, 0, 0]])
+        re = RegressionEvaluation()
+        re.eval(labels, preds, mask=mask)
+        assert re._count == 3
+        assert np.isclose(re.mean_squared_error(0), 1.0)
+
+
+class TestConfusionMatrix:
+    def test_add_and_totals(self):
+        cm = ConfusionMatrix(range(3))
+        cm.add(0, 1)
+        cm.add(0, 1)
+        cm.add(2, 2, count=3)
+        assert cm.get_count(0, 1) == 2
+        assert cm.get_actual_total(0) == 2
+        assert cm.get_predicted_total(2) == 3
+        assert "0,2,0" in cm.to_csv()

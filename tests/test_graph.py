@@ -76,12 +76,14 @@ class TestWalkers:
     def test_dead_end_self_loop_and_exception(self):
         g = Graph(2)
         g.add_edge(0, 1, directed=True)
-        w = RandomWalkIterator(g, 5, seed=0)._walk_from(1)
+        w = RandomWalkIterator(
+            g, 5, seed=0,
+            no_edge_handling=NoEdgeHandling.SELF_LOOP_ON_DISCONNECTED,
+        )._walk_from(1)
         assert w.tolist() == [1] * 6
+        # the default matches the reference: EXCEPTION_ON_DISCONNECTED
         with pytest.raises(RuntimeError):
-            RandomWalkIterator(
-                g, 5, no_edge_handling=NoEdgeHandling.EXCEPTION_ON_DISCONNECTED,
-            )._walk_from(1)
+            RandomWalkIterator(g, 5)._walk_from(1)
 
     def test_dead_end_cutoff(self):
         g = Graph(3)

@@ -50,13 +50,14 @@ def check_gradients(net, dataset, *, epsilon: float = 1e-6,
 
     flat0, treedef, shapes = _flatten_params(net.params)
 
+    @jax.jit
     def loss_flat(flat):
         params = _unflatten(flat, treedef, shapes)
         loss, _ = net._loss(params, net.state, rng, batch)
         return loss
 
     analytic = np.asarray(
-        jax.grad(lambda f: loss_flat(f))(jnp.asarray(flat0, jnp.float64)),
+        jax.jit(jax.grad(loss_flat))(jnp.asarray(flat0, jnp.float64)),
         np.float64)
 
     n = flat0.size

@@ -123,12 +123,17 @@ class BatchNormImpl(LayerImpl):
     def apply(self, conf, params, state, x, *, train=False, rng=None, mask=None):
         axes = tuple(range(x.ndim - 1))  # all but channel/feature
         if train:
-            mean = jnp.mean(x.astype(jnp.float32), axis=axes)
-            var = jnp.var(x.astype(jnp.float32), axis=axes)
+            # at least f32 for the stats, but never truncate wider inputs
+            # (f64 gradient checks rely on exact mean cancellation)
+            stat_dtype = jnp.promote_types(x.dtype, jnp.float32)
+            mean = jnp.mean(x.astype(stat_dtype), axis=axes)
+            var = jnp.var(x.astype(stat_dtype), axis=axes)
             decay = conf.decay
             new_state = {
-                "mean": decay * state["mean"] + (1 - decay) * mean,
-                "var": decay * state["var"] + (1 - decay) * var,
+                "mean": (decay * state["mean"] + (1 - decay) * mean).astype(
+                    state["mean"].dtype),
+                "var": (decay * state["var"] + (1 - decay) * var).astype(
+                    state["var"].dtype),
                 "count": state["count"] + 1,
             }
         else:

@@ -34,7 +34,12 @@ from deeplearning4j_tpu.nn.conf.graph_conf import (
     SubsetVertexConf,
     UnstackVertexConf,
 )
-from deeplearning4j_tpu.nn.conf.layers import BaseOutputLayer, validate_layer_names
+from deeplearning4j_tpu.nn.conf.enums import BackpropType, OptimizationAlgorithm
+from deeplearning4j_tpu.nn.conf.layers import (
+    BaseOutputLayer,
+    BaseRecurrentLayer,
+    validate_layer_names,
+)
 from deeplearning4j_tpu.nn.layers import get_impl, l1_l2_penalty
 from deeplearning4j_tpu.nn.training import make_train_step
 from deeplearning4j_tpu.nn.updater import build_optimizer
@@ -66,6 +71,8 @@ class ComputationGraph:
         self._output_jit = None
         self._rng = None
         self._mesh = None
+        self._rnn_carries = None  # streaming inference state (rnn_time_step)
+        self._rnn_jit = None
 
     @property
     def compute_dtype(self):
@@ -161,7 +168,7 @@ class ComputationGraph:
         raise ValueError(f"Unhandled vertex type {type(vconf).__name__} for '{name}'")
 
     def _forward(self, params, state, input_dict, *, train, rng, masks=None,
-                 collect=False):
+                 collect=False, carries=None):
         masks = masks or {}
         acts = {}
         cdtype = self.compute_dtype
@@ -171,6 +178,7 @@ class ComputationGraph:
                 v = v.astype(cdtype)
             acts[k] = v
         new_state = {}
+        new_carries = {}
         names = [n for n in self.topo if n not in self.conf.network_inputs]
         rngs = (jax.random.split(rng, max(len(names), 1)) if rng is not None
                 else [None] * len(names))
@@ -187,9 +195,19 @@ class ComputationGraph:
                         lambda a: a.astype(cdtype)
                         if jnp.issubdtype(a.dtype, jnp.floating) else a, p)
                 in_mask = masks.get(self.conf.vertex_inputs[name][0])
-                y, s = self.impls[name].apply(
+                want_carry = (carries is not None
+                              and isinstance(vconf.layer, BaseRecurrentLayer)
+                              and hasattr(self.impls[name], "initial_carry"))
+                kw = ({"initial_carry": carries.get(name), "return_carry": True}
+                      if want_carry else {})
+                out = self.impls[name].apply(
                     vconf.layer, p, state.get(name, {}), x, train=train, rng=k,
-                    mask=in_mask)
+                    mask=in_mask, **kw)
+                if want_carry:
+                    y, s, carry = out
+                    new_carries[name] = carry
+                else:
+                    y, s = out
                 acts[name] = y
                 new_state[name] = s
             else:
@@ -198,8 +216,8 @@ class ComputationGraph:
         for n in self.layer_vertices:
             new_state.setdefault(n, state.get(n, {}))
         if collect:
-            return acts, new_state
-        return [acts[o] for o in self.conf.network_outputs], new_state
+            return acts, new_state, new_carries
+        return [acts[o] for o in self.conf.network_outputs], new_state, new_carries
 
     def _loss(self, params, state, rng, batch, train=True):
         """Sum of output-layer losses + L1/L2 (reference
@@ -215,9 +233,9 @@ class ComputationGraph:
             k_body, k_outs = keys[0], keys[1:]
         else:
             k_body, k_outs = None, [None] * n_out
-        acts, new_state = self._forward(
+        acts, new_state, new_carries = self._forward(
             params, state, input_dict, train=train, rng=k_body, masks=masks,
-            collect=True)
+            collect=True, carries=batch.get("carries"))
         loss = 0.0
         labels_list = batch["labels"]
         lmasks = batch.get("labels_masks") or [None] * len(labels_list)
@@ -235,7 +253,9 @@ class ComputationGraph:
                 mask=lmask)
         for name, v in self.layer_vertices.items():
             loss = loss + l1_l2_penalty(v.layer, params[name])
-        return loss, (new_state, {})
+        extras = ({"carries": new_carries} if batch.get("carries") is not None
+                  else {})
+        return loss, (new_state, extras)
 
     # ------------------------------------------------------------------- fit
     @staticmethod
@@ -260,6 +280,8 @@ class ComputationGraph:
         return b
 
     def fit(self, data, labels=None, epochs: int = 1):
+        """Train (reference ComputationGraph.fit:545-672, incl. the
+        pretrain:165-equivalent, tbptt branch, and Solver dispatch)."""
         if self.params is None:
             self.init()
         if labels is not None:
@@ -270,15 +292,28 @@ class ComputationGraph:
         if isinstance(it, DataSetIterator) and it.async_supported() and not isinstance(
                 it, AsyncDataSetIterator):
             it = AsyncDataSetIterator(it)
+        if self.conf.pretrain:
+            self.pretrain(it)
+            it.reset()
+        if not self.conf.backprop:
+            return self
+        g = self.conf.conf
+        if str(g.optimization_algo) != str(
+                OptimizationAlgorithm.STOCHASTIC_GRADIENT_DESCENT):
+            return self._fit_with_solver(it, epochs)
         if self._train_step is None:
             confs = {n: v.layer for n, v in self.layer_vertices.items()}
             self._train_step = make_train_step(self._loss, self.tx, confs,
                                                mesh=self._mesh)
-        g = self.conf.conf
+        tbptt = self.conf.backprop_type in (BackpropType.TRUNCATED_BPTT,
+                                            "truncated_bptt")
         for _ in range(epochs):
             it.reset()
             while it.has_next():
                 mds = self._to_mds(it.next())
+                if tbptt and self._needs_tbptt(mds):
+                    self._fit_tbptt(mds)
+                    continue
                 batch = self._batch_dict(mds)
                 for _i in range(max(1, g.iterations)):
                     self.params, self.opt_state, self.state, loss, _ = self._train_step(
@@ -290,6 +325,151 @@ class ComputationGraph:
                         lst.iteration_done(self, self.iteration_count)
         return self
 
+    def _fit_with_solver(self, it, epochs: int):
+        """CG/LBFGS/line-GD path (reference Solver dispatch — the graph
+        delegates per-minibatch optimization exactly like MLN does)."""
+        from deeplearning4j_tpu.optimize.solvers import Solver
+
+        if self.conf.backprop_type in (BackpropType.TRUNCATED_BPTT,
+                                       "truncated_bptt"):
+            raise ValueError(
+                "TRUNCATED_BPTT requires STOCHASTIC_GRADIENT_DESCENT; "
+                "second-order solvers would differentiate the full sequence")
+        solver = Solver(self)
+        for _ in range(epochs):
+            it.reset()
+            while it.has_next():
+                mds = self._to_mds(it.next())
+                solver.optimize(self._batch_dict(mds), rng=self._next_rng())
+                for lst in self.listeners:
+                    lst.iteration_done(self, self.iteration_count)
+        return self
+
+    def _needs_tbptt(self, mds) -> bool:
+        L = self.conf.tbptt_fwd_length
+        return any(np.asarray(f).ndim == 3 and f.shape[1] > L
+                   for f in mds.features)
+
+    def _initial_carries(self, batch_size):
+        """Zero carries for every recurrent layer vertex."""
+        carries = {}
+        for name, v in self.layer_vertices.items():
+            impl = self.impls[name]
+            if isinstance(v.layer, BaseRecurrentLayer) and hasattr(
+                    impl, "initial_carry"):
+                carries[name] = impl.initial_carry(v.layer, batch_size,
+                                                   self.compute_dtype)
+        return carries
+
+    @staticmethod
+    def _slice_time(arrs, t0, L):
+        """Window [t0, t0+L) of every 3-D array; 2-D pass through unchanged
+        (static inputs broadcast to all segments, as the reference's
+        rnn-to-ff mixed graphs do)."""
+        return tuple(None if a is None
+                     else (a[:, t0:t0 + L] if np.asarray(a).ndim >= 3 else a)
+                     for a in arrs)
+
+    def _fit_tbptt(self, mds: MultiDataSet):
+        """Truncated BPTT over the DAG (reference ComputationGraph fit tbptt
+        branch): slide a tbptt_fwd_length window over time; recurrent-vertex
+        carries thread between segments through the jitted step, gradients
+        stop at segment boundaries."""
+        T = max(f.shape[1] for f in mds.features if np.asarray(f).ndim == 3)
+        L = self.conf.tbptt_fwd_length
+        B = mds.features[0].shape[0]
+        for lab in mds.labels:
+            if np.asarray(lab).ndim != 3:
+                raise ValueError(
+                    "TRUNCATED_BPTT needs time-distributed labels "
+                    f"[batch, time, n_out]; got shape {np.asarray(lab).shape}. "
+                    "A per-sequence label would be counted once per segment "
+                    "against mid-sequence activations — train with standard "
+                    "BPTT (or a LastTimeStep head on full sequences) instead")
+        carries = self._initial_carries(B)
+
+        def mask_slice(masks, t0):
+            if masks is None:
+                return None
+            return tuple(None if m is None
+                         else (m[:, t0:t0 + L] if np.asarray(m).ndim >= 2
+                               and m.shape[1] == T else m)
+                         for m in masks)
+
+        for t0 in range(0, T, L):
+            sub = MultiDataSet(
+                self._slice_time(mds.features, t0, L),
+                self._slice_time(mds.labels, t0, L),
+                mask_slice(mds.features_masks, t0),
+                mask_slice(mds.labels_masks, t0),
+            )
+            batch = self._batch_dict(sub)
+            batch["carries"] = carries
+            self.params, self.opt_state, self.state, loss, extras = self._train_step(
+                self.params, self.opt_state, self.state, self._next_rng(), batch)
+            carries = extras.get("carries", carries)
+            self.score_value = float(loss)
+            self.iteration_count += 1
+            for lst in self.listeners:
+                lst.iteration_done(self, self.iteration_count)
+
+    # -------------------------------------------------------------- pretrain
+    def pretrain(self, it, epochs: int = 1):
+        """Greedy layer-wise pretraining over the DAG (reference
+        ComputationGraph.pretrain): for each pretrain-capable layer vertex in
+        topological order, train its params on the activations feeding it."""
+        if self.params is None:
+            self.init()
+        if isinstance(it, (DataSet, MultiDataSet)):
+            it = ListDataSetIterator([it])
+        for name in self.topo:
+            v = self.conf.vertices.get(name)
+            if not isinstance(v, LayerVertexConf) or not v.layer.is_pretrain_layer():
+                continue
+            impl = self.impls[name]
+            lc = v.layer
+            tx = build_optimizer(self.conf.conf, {name: lc})
+            # the optimizer's per-layer lr/updater overrides key on layer
+            # names, so feed it {name: params} — not the bare inner dict
+            opt = tx.init({name: self.params[name]})
+            src = self.conf.vertex_inputs[name][0]
+            is_input = src in self.conf.network_inputs
+
+            @jax.jit
+            def featurize(params, state, input_dict, _src=src, _v=v):
+                acts, _, _ = self._forward(params, state, input_dict,
+                                           train=False, rng=None, collect=True)
+                x = acts[_src]
+                if _v.preprocessor is not None:
+                    x = _v.preprocessor.pre_process(x)
+                return x
+
+            @jax.jit
+            def pstep(p, opt_state, rng, x, _impl=impl, _lc=lc, _tx=tx,
+                      _name=name):
+                loss, grads = jax.value_and_grad(
+                    lambda q: _impl.pretrain_loss(_lc, q[_name], x, rng))(
+                        {_name: p})
+                updates, opt_state = _tx.update(grads, opt_state, {_name: p})
+                return (optax.apply_updates({_name: p}, updates)[_name],
+                        opt_state, loss)
+
+            for _ in range(epochs):
+                it.reset()
+                while it.has_next():
+                    mds = self._to_mds(it.next())
+                    input_dict = dict(zip(self.conf.network_inputs,
+                                          [jnp.asarray(f) for f in mds.features]))
+                    if is_input and v.preprocessor is None:
+                        x = jnp.asarray(input_dict[src], self.compute_dtype)
+                    else:
+                        x = featurize(self.params, self.state, input_dict)
+                    p_new, opt, loss = pstep(self.params[name], opt,
+                                             self._next_rng(), x)
+                    self.params = dict(self.params, **{name: p_new})
+                    self.score_value = float(loss)
+        return self
+
     # ------------------------------------------------------------- inference
     def output(self, *inputs, train: bool = False):
         """Outputs for given inputs (reference output). Returns a list (one
@@ -297,7 +477,8 @@ class ComputationGraph:
         input_dict = dict(zip(self.conf.network_inputs, inputs))
         if self._output_jit is None:
             def _out(params, state, input_dict):
-                ys, _ = self._forward(params, state, input_dict, train=False, rng=None)
+                ys, _, _ = self._forward(params, state, input_dict, train=False,
+                                         rng=None)
                 return ys
             self._output_jit = jax.jit(_out)
         ys = self._output_jit(self.params, self.state,
@@ -333,6 +514,54 @@ class ComputationGraph:
             ev.eval(mds.labels[0], np.asarray(outs[0]),
                     mask=None if mds.labels_masks is None else mds.labels_masks[0])
         return ev
+
+    # ------------------------------------------------- streaming RNN inference
+    def rnn_clear_previous_state(self):
+        self._rnn_carries = None
+
+    def rnn_time_step(self, *inputs):
+        """Stateful single/multi-step inference over the DAG (reference
+        ComputationGraph.rnnTimeStep). Each input: [batch, n_in] (one step)
+        or [batch, time, n_in] — ranks must agree across inputs; recurrent-
+        vertex carries persist between calls so long sequences stream in
+        chunks. Raises for layers that cannot stream causally (bidirectional
+        LSTM, self-attention — the reference throws
+        UnsupportedOperationException for these)."""
+        for name, v in self.layer_vertices.items():
+            if isinstance(v.layer, BaseRecurrentLayer) and not hasattr(
+                    self.impls[name], "initial_carry"):
+                raise ValueError(
+                    f"rnn_time_step: layer '{name}' "
+                    f"({type(v.layer).__name__}) cannot stream causally — it "
+                    "needs the full sequence (reference throws "
+                    "UnsupportedOperationException)")
+        cdtype = self.compute_dtype
+        ranks = {jnp.asarray(x).ndim for x in inputs}
+        if len(ranks) > 1:
+            raise ValueError(
+                f"rnn_time_step: mixed input ranks {sorted(ranks)} — pass all "
+                "inputs as [batch, n_in] or all as [batch, time, n_in]")
+        single = ranks == {2}
+        arrs = []
+        for x in inputs:
+            x = jnp.asarray(x)
+            if jnp.issubdtype(x.dtype, jnp.floating):
+                x = x.astype(cdtype)
+            arrs.append(x[:, None, :] if single else x)
+        carries = self._rnn_carries
+        if carries is None:
+            carries = self._initial_carries(arrs[0].shape[0])
+        input_dict = dict(zip(self.conf.network_inputs, arrs))
+        if self._rnn_jit is None:
+            def _step(params, state, input_dict, carries):
+                return self._forward(params, state, input_dict, train=False,
+                                     rng=None, carries=carries)
+            self._rnn_jit = jax.jit(_step)
+        ys, _, new_carries = self._rnn_jit(self.params, self.state, input_dict,
+                                           carries)
+        self._rnn_carries = {**carries, **new_carries}
+        outs = [y[:, -1, :] if single and y.ndim == 3 else y for y in ys]
+        return outs[0] if len(outs) == 1 else outs
 
     def num_params(self) -> int:
         return sum(int(np.prod(p.shape)) for p in jax.tree.leaves(self.params))

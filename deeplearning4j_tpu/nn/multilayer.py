@@ -67,6 +67,7 @@ class MultiLayerNetwork:
         self._output_jit = None
         self._rng = None
         self._rnn_carries = None  # streaming inference state
+        self._rnn_jit = None
         self._mesh = None
         self.score_value = float("nan")
 
@@ -310,11 +311,18 @@ class MultiLayerNetwork:
         fwdLen == backLen configuration)."""
         T = ds.features.shape[1]
         L = self.conf.tbptt_fwd_length
+        if np.asarray(ds.labels).ndim != 3:
+            raise ValueError(
+                "TRUNCATED_BPTT needs time-distributed labels "
+                f"[batch, time, n_out]; got shape {np.asarray(ds.labels).shape}. "
+                "A per-sequence label would be counted once per segment "
+                "against mid-sequence activations — train with standard BPTT "
+                "instead")
         carries = self._initial_carries(ds.features.shape[0])
         for t0 in range(0, T, L):
             sub = DataSet(
                 ds.features[:, t0:t0 + L],
-                ds.labels[:, t0:t0 + L] if ds.labels.ndim == 3 else ds.labels,
+                ds.labels[:, t0:t0 + L],
                 None if ds.features_mask is None else ds.features_mask[:, t0:t0 + L],
                 None if ds.labels_mask is None else ds.labels_mask[:, t0:t0 + L],
             )
@@ -342,16 +350,19 @@ class MultiLayerNetwork:
             if not lc.is_pretrain_layer():
                 continue
             tx = build_optimizer(self.conf.conf, {name: lc})
-            opt = tx.init(self.params[name])
-
-            def ptrain_loss(p, rng, x):
-                return impl.pretrain_loss(lc, p, x, rng)
+            # the optimizer's per-layer lr/updater overrides key on layer
+            # names, so feed it {name: params} — not the bare inner dict
+            opt = tx.init({name: self.params[name]})
 
             @jax.jit
-            def pstep(p, opt_state, rng, x):
-                loss, grads = jax.value_and_grad(ptrain_loss)(p, rng, x)
-                updates, opt_state = tx.update(grads, opt_state, p)
-                return optax.apply_updates(p, updates), opt_state, loss
+            def pstep(p, opt_state, rng, x, _impl=impl, _lc=lc, _tx=tx,
+                      _name=name):
+                loss, grads = jax.value_and_grad(
+                    lambda q: _impl.pretrain_loss(_lc, q[_name], x, rng))(
+                        {_name: p})
+                updates, opt_state = _tx.update(grads, opt_state, {_name: p})
+                return (optax.apply_updates({_name: p}, updates)[_name],
+                        opt_state, loss)
 
             featurize = None
             if i > 0:
@@ -426,14 +437,29 @@ class MultiLayerNetwork:
 
     def rnn_time_step(self, x):
         """Stateful single/multi-step inference (reference rnnTimeStep:2147).
-        x: [batch, n_in] (one step) or [batch, time, n_in]."""
+        x: [batch, n_in] (one step) or [batch, time, n_in]. Raises for layers
+        that cannot stream causally (bidirectional LSTM, self-attention —
+        the reference throws UnsupportedOperationException)."""
+        for name, lc, impl in zip(self.layer_names, self.layer_confs, self.impls):
+            if isinstance(lc, BaseRecurrentLayer) and not hasattr(
+                    impl, "initial_carry"):
+                raise ValueError(
+                    f"rnn_time_step: layer '{name}' ({type(lc).__name__}) "
+                    "cannot stream causally — it needs the full sequence "
+                    "(reference throws UnsupportedOperationException)")
         x = jnp.asarray(x, self.compute_dtype)
         single = x.ndim == 2
         if single:
             x = x[:, None, :]
-        carries = self._rnn_carries or {}
-        y, _, new_carries = self._forward(self.params, self.state, x, train=False,
-                                          rng=None, carries=carries)
+        carries = self._rnn_carries
+        if carries is None:
+            carries = self._initial_carries(x.shape[0])
+        if self._rnn_jit is None:
+            def _step(params, state, x, carries):
+                return self._forward(params, state, x, train=False, rng=None,
+                                     carries=carries)
+            self._rnn_jit = jax.jit(_step)
+        y, _, new_carries = self._rnn_jit(self.params, self.state, x, carries)
         self._rnn_carries = {**carries, **new_carries}
         return y[:, -1, :] if single and y.ndim == 3 else y
 

@@ -1,0 +1,258 @@
+"""ComputationGraph runtime parity tests (reference
+nn/graph/ComputationGraph.java: fit with tbptt branch:545-672, rnnTimeStep,
+pretrain; TestComputationGraphNetwork / ComputationGraphTestRNN patterns)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.datasets.api import DataSet, MultiDataSet
+from deeplearning4j_tpu.datasets.iterators import ListDataSetIterator
+from deeplearning4j_tpu.nn.conf import (
+    AutoEncoder,
+    DenseLayer,
+    GravesLSTM,
+    NeuralNetConfiguration,
+    OutputLayer,
+    RnnOutputLayer,
+    Updater,
+)
+from deeplearning4j_tpu.nn.conf.enums import BackpropType, OptimizationAlgorithm
+from deeplearning4j_tpu.nn.graph import ComputationGraph
+from deeplearning4j_tpu.parallel import DataParallelTrainer, make_mesh
+
+
+def _lstm_graph(tbptt=False, L=4):
+    b = (NeuralNetConfiguration.builder()
+         .seed(11).learning_rate(0.05).updater(Updater.ADAM)
+         .graph_builder()
+         .add_inputs("in")
+         .add_layer("lstm", GravesLSTM(n_in=3, n_out=8, activation="tanh"), "in")
+         .add_layer("out", RnnOutputLayer(n_in=8, n_out=3, activation="softmax",
+                                          loss_function="mcxent"), "lstm")
+         .set_outputs("out"))
+    if tbptt:
+        b = (b.backprop_type(BackpropType.TRUNCATED_BPTT)
+             .t_bptt_forward_length(L).t_bptt_backward_length(L))
+    return ComputationGraph(b.build()).init()
+
+
+def _seq_data(rng, b=4, t=12, n_in=3, n_out=3):
+    x = rng.standard_normal((b, t, n_in)).astype(np.float32)
+    # learnable: label tracks sign pattern of a fixed input channel
+    lab = (x[..., 0] > 0).astype(int) + (x[..., 1] > 0).astype(int)
+    y = np.eye(n_out, dtype=np.float32)[lab]
+    return DataSet(x, y)
+
+
+class TestGraphTBPTT:
+    def test_tbptt_trains_and_segments(self, rng):
+        net = _lstm_graph(tbptt=True, L=4)
+        ds = _seq_data(rng, t=12)
+        before = net.score(ds)
+        net.fit(ds, epochs=30)
+        after = net.score(ds)
+        assert after < before
+        # 12 timesteps / window 4 = 3 segments per batch pass
+        assert net.iteration_count == 30 * 3
+
+    def test_tbptt_carries_flow_between_segments(self, rng):
+        """With carries threaded, segment 2 must see segment 1's final
+        hidden state: verify by checking a TBPTT step sequence differs from
+        training each window as an independent sequence (carry reset)."""
+        rng2 = np.random.default_rng(7)
+        ds = _seq_data(rng2, b=2, t=8)
+        net_a = _lstm_graph(tbptt=True, L=4)
+        net_b = _lstm_graph(tbptt=True, L=4)
+        net_b.params = jax.tree.map(jnp.copy, net_a.params)
+        net_b.opt_state = net_b.tx.init(net_b.params)
+
+        net_a.fit(ds, epochs=1)
+        # net_b: train on the two windows as separate datasets (fresh carries)
+        net_b.fit(DataSet(ds.features[:, :4], ds.labels[:, :4]), epochs=1)
+        net_b.fit(DataSet(ds.features[:, 4:], ds.labels[:, 4:]), epochs=1)
+        pa, pb = net_a.params_flat(), net_b.params_flat()
+        assert not np.allclose(pa, pb, atol=1e-7), \
+            "TBPTT carries had no effect — state is not flowing"
+
+
+class TestGraphRnnTimeStep:
+    def test_streaming_matches_full_sequence(self, rng):
+        net = _lstm_graph()
+        x = rng.standard_normal((2, 8, 3)).astype(np.float32)
+        full = np.asarray(net.output(x))  # [B, T, n_out]
+
+        net.rnn_clear_previous_state()
+        chunks = [np.asarray(net.rnn_time_step(x[:, :3])),
+                  np.asarray(net.rnn_time_step(x[:, 3:6])),
+                  np.asarray(net.rnn_time_step(x[:, 6:]))]
+        streamed = np.concatenate(chunks, axis=1)
+        np.testing.assert_allclose(full, streamed, atol=1e-5)
+
+    def test_single_step_2d(self, rng):
+        net = _lstm_graph()
+        net.rnn_clear_previous_state()
+        y1 = net.rnn_time_step(rng.standard_normal((2, 3)).astype(np.float32))
+        assert y1.shape == (2, 3)
+        # second step continues the carry (different from a fresh call)
+        x2 = rng.standard_normal((2, 3)).astype(np.float32)
+        y2 = np.asarray(net.rnn_time_step(x2))
+        net.rnn_clear_previous_state()
+        y2_fresh = np.asarray(net.rnn_time_step(x2))
+        assert not np.allclose(y2, y2_fresh, atol=1e-7)
+
+
+class TestGraphPretrain:
+    def test_greedy_pretrain_reduces_reconstruction_loss(self, rng):
+        g = (NeuralNetConfiguration.builder()
+             .seed(3).learning_rate(0.05).updater(Updater.ADAM)
+             .graph_builder()
+             .add_inputs("in")
+             .add_layer("ae", AutoEncoder(n_in=8, n_out=4, activation="sigmoid"),
+                        "in")
+             .add_layer("out", OutputLayer(n_in=4, n_out=2, activation="softmax",
+                                           loss_function="mcxent"), "ae")
+             .set_outputs("out")
+             .pretrain(True)
+             .build())
+        net = ComputationGraph(g).init()
+        x = rng.standard_normal((32, 8)).astype(np.float32)
+        y = np.eye(2, dtype=np.float32)[rng.integers(0, 2, 32)]
+        impl, lc = net.impls["ae"], net.layer_vertices["ae"].layer
+        loss0 = float(impl.pretrain_loss(lc, net.params["ae"],
+                                         jnp.asarray(x), jax.random.PRNGKey(0)))
+        net.pretrain(DataSet(x, y), epochs=40)
+        loss1 = float(impl.pretrain_loss(lc, net.params["ae"],
+                                         jnp.asarray(x), jax.random.PRNGKey(0)))
+        assert loss1 < loss0
+        # full fit path runs pretrain then backprop without error
+        net2 = ComputationGraph(g).init()
+        net2.fit(DataSet(x, y), epochs=2)
+        assert np.isfinite(net2.score_value)
+
+
+class TestGraphSolver:
+    def test_lbfgs_path(self, rng):
+        g = (NeuralNetConfiguration.builder()
+             .seed(5)
+             .optimization_algo(OptimizationAlgorithm.LBFGS)
+             .iterations(10)
+             .graph_builder()
+             .add_inputs("in")
+             .add_layer("d", DenseLayer(n_in=4, n_out=8, activation="tanh"), "in")
+             .add_layer("out", OutputLayer(n_in=8, n_out=2, activation="softmax",
+                                           loss_function="mcxent"), "d")
+             .set_outputs("out")
+             .build())
+        net = ComputationGraph(g).init()
+        x = rng.standard_normal((32, 4)).astype(np.float32)
+        y = np.eye(2, dtype=np.float32)[(x.sum(1) > 0).astype(int)]
+        ds = DataSet(x, y)
+        before = net.score(ds)
+        net.fit(ds, epochs=3)
+        after = net.score(ds)
+        assert after < before
+        assert net.iteration_count > 0
+
+
+@pytest.mark.skipif(len(jax.devices()) < 8, reason="needs 8 virtual devices")
+class TestGraphDistributed:
+    def test_cg_allreduce_dp_matches_single_device(self, rng):
+        """ComputationGraph under DataParallelTrainer == single-device
+        training (VERDICT weak #5 — CG mesh path was untested)."""
+        def build():
+            g = (NeuralNetConfiguration.builder()
+                 .seed(9).learning_rate(0.1).updater(Updater.SGD)
+                 .graph_builder()
+                 .add_inputs("in")
+                 .add_layer("d1", DenseLayer(n_in=4, n_out=8, activation="tanh"),
+                            "in")
+                 .add_layer("out", OutputLayer(n_in=8, n_out=2,
+                                               activation="softmax",
+                                               loss_function="mcxent"), "d1")
+                 .set_outputs("out")
+                 .build())
+            return ComputationGraph(g).init()
+
+        x = rng.standard_normal((64, 4)).astype(np.float32)
+        y = np.eye(2, dtype=np.float32)[(x.sum(1) > 0).astype(int)]
+        ds = DataSet(x, y)
+
+        net_a, net_b = build(), build()
+        net_b.params = jax.tree.map(jnp.copy, net_a.params)
+        net_b.opt_state = net_b.tx.init(net_b.params)
+
+        net_a.fit(ListDataSetIterator([ds]), epochs=3)
+        mesh = make_mesh({"data": 8})
+        DataParallelTrainer(net_b, mesh).fit(ListDataSetIterator([ds]), epochs=3)
+        np.testing.assert_allclose(net_a.params_flat(), net_b.params_flat(),
+                                   atol=2e-5)
+
+
+class TestGraphGuards:
+    """Regression tests for silent-wrong-result paths (round-2 review)."""
+
+    def test_rnn_time_step_rejects_bidirectional(self, rng):
+        from deeplearning4j_tpu.nn.conf import GravesBidirectionalLSTM
+
+        g = (NeuralNetConfiguration.builder().seed(1)
+             .graph_builder()
+             .add_inputs("in")
+             .add_layer("bi", GravesBidirectionalLSTM(n_in=3, n_out=4,
+                                                      activation="tanh"), "in")
+             .add_layer("out", RnnOutputLayer(n_in=4, n_out=2,
+                                              activation="softmax"), "bi")
+             .set_outputs("out")
+             .build())
+        net = ComputationGraph(g).init()
+        with pytest.raises(ValueError, match="cannot stream"):
+            net.rnn_time_step(rng.standard_normal((2, 3)).astype(np.float32))
+
+    def test_rnn_time_step_rejects_mixed_ranks(self, rng):
+        g = (NeuralNetConfiguration.builder().seed(1)
+             .graph_builder()
+             .add_inputs("a", "b")
+             .add_layer("l1", GravesLSTM(n_in=3, n_out=4, activation="tanh"),
+                        "a")
+             .add_layer("l2", GravesLSTM(n_in=3, n_out=4, activation="tanh"),
+                        "b")
+             .add_vertex("m", __import__(
+                 "deeplearning4j_tpu.nn.conf.graph_conf",
+                 fromlist=["MergeVertexConf"]).MergeVertexConf(), "l1", "l2")
+             .add_layer("out", RnnOutputLayer(n_in=8, n_out=2,
+                                              activation="softmax"), "m")
+             .set_outputs("out")
+             .build())
+        net = ComputationGraph(g).init()
+        with pytest.raises(ValueError, match="mixed input ranks"):
+            net.rnn_time_step(
+                rng.standard_normal((2, 3)).astype(np.float32),
+                rng.standard_normal((2, 5, 3)).astype(np.float32))
+
+    def test_tbptt_rejects_per_sequence_labels(self, rng):
+        net = _lstm_graph(tbptt=True, L=4)
+        x = rng.standard_normal((2, 12, 3)).astype(np.float32)
+        y2d = np.eye(3, dtype=np.float32)[rng.integers(0, 3, 2)]
+        with pytest.raises(ValueError, match="time-distributed labels"):
+            net.fit(DataSet(x, y2d), epochs=1)
+
+    def test_pretrain_honors_per_layer_lr(self, rng):
+        """Per-layer learning_rate=0 must freeze the pretrain layer (the
+        multi_transform labels key on layer names)."""
+        g = (NeuralNetConfiguration.builder()
+             .seed(3).learning_rate(0.05).updater(Updater.SGD)
+             .graph_builder()
+             .add_inputs("in")
+             .add_layer("ae", AutoEncoder(n_in=8, n_out=4, activation="sigmoid",
+                                          learning_rate=0.0), "in")
+             .add_layer("out", OutputLayer(n_in=4, n_out=2,
+                                           activation="softmax"), "ae")
+             .set_outputs("out")
+             .build())
+        net = ComputationGraph(g).init()
+        x = rng.standard_normal((16, 8)).astype(np.float32)
+        y = np.eye(2, dtype=np.float32)[rng.integers(0, 2, 16)]
+        before = np.array(net.params["ae"]["W"])
+        net.pretrain(DataSet(x, y), epochs=3)
+        np.testing.assert_allclose(before, np.array(net.params["ae"]["W"]))

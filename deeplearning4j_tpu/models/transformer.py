@@ -82,9 +82,9 @@ def transformer_flops_per_token(vocab_size, d_model, n_layers, d_ff, seq_len):
     """Analytic forward+backward FLOPs per token for MFU accounting
     (backward ≈ 2x forward; attention quadratic term included)."""
     per_layer = (
-        4 * d_model * d_model * 3  # qkv + out proj (2*d*d mults ×2 matmul ops)
-        + 2 * d_model * d_ff * 2  # two FF matmuls
-        + 2 * 2 * seq_len * d_model  # qk^T and attn@v per token
+        4 * 2 * d_model * d_model  # qkv + out proj: 4 [d,d] matmuls, 2dd each
+        + 2 * 2 * d_model * d_ff  # two FF matmuls
+        + 2 * 2 * seq_len * d_model  # qk^T and attn@v per token (full causal)
     )
-    fwd = n_layers * per_layer + 2 * d_model * vocab_size
+    fwd = n_layers * per_layer + 2 * d_model * vocab_size  # + LM head
     return 3 * fwd  # fwd + bwd(2x)

@@ -150,6 +150,14 @@ class Glove(SequenceVectors):
                 (W, Wc, b, bc, hW, hWc, hb, hbc, loss) = glove_step(
                     W, Wc, b, bc, hW, hWc, hb, hbc,
                     ii[sel], jj[sel], logx[sel], fx[sel], self.learning_rate)
-                self.loss_history.append(float(loss) / B)
+                # device scalar; one host sync after the run (below)
+                self.loss_history.append(loss)
+        # normalize only this run's fresh device entries — floats from a
+        # previous fit() are already normalized
+        from deeplearning4j_tpu.nlp.sequencevectors import _fetch_loss_scalars
+
+        self.loss_history = [
+            l if isinstance(l, float) else l / B for l in self.loss_history]
+        self.loss_history = _fetch_loss_scalars(self.loss_history)
         self.lookup_table.set_vectors(np.asarray(W + Wc))
         return self

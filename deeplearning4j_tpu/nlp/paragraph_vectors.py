@@ -94,6 +94,7 @@ class ParagraphVectors(SequenceVectors):
             done = self._train_corpus(
                 seqs, total, label_for_sequence=lambda si: label_rows[si],
                 words_done=done)
+        self._finalize_losses()
         return self
 
     # ----------------------------------------------------------- queries

@@ -41,6 +41,25 @@ from deeplearning4j_tpu.nlp.vocab import (
 )
 
 
+_LOSS_FETCH_CHUNK = 512
+
+
+def _fetch_loss_scalars(history):
+    """Resolve a list of float|device-scalar losses to floats with few
+    host round-trips: stack device scalars in fixed-size chunks (the
+    chunked concatenate trace is cached across chunks) and fetch each
+    chunk as one transfer. Already-float entries pass through, so
+    repeated fits don't re-fetch."""
+    import jax.numpy as jnp
+
+    dev = [l for l in history if not isinstance(l, float)]
+    vals = []
+    for i in range(0, len(dev), _LOSS_FETCH_CHUNK):
+        vals.extend(np.asarray(jnp.stack(dev[i:i + _LOSS_FETCH_CHUNK])).tolist())
+    it = iter(vals)
+    return [l if isinstance(l, float) else float(next(it)) for l in history]
+
+
 class SequenceVectors:
     """Batched-TPU embedding trainer over token sequences.
 
@@ -180,7 +199,9 @@ class SequenceVectors:
                                     (len(centers), self.negative), self._rng)
             t.syn0, t.syn1neg, loss = sgns_step(
                 t.syn0, t.syn1neg, centers, contexts, negs, lr)
-        self.loss_history.append(float(loss))
+        # keep the device scalar — a float() here would force a host
+        # round-trip per batch and serialize the async dispatch stream
+        self.loss_history.append(loss)
 
     def _flush_cbow(self, ctx, mask, targets, lr):
         t = self.lookup_table
@@ -188,7 +209,7 @@ class SequenceVectors:
                                 (len(targets), self.negative), self._rng)
         t.syn0, t.syn1neg, loss = cbow_ns_step(
             t.syn0, t.syn1neg, ctx, mask, targets, negs, lr)
-        self.loss_history.append(float(loss))
+        self.loss_history.append(loss)
 
     def _train_corpus(self, sequences, total_words: float,
                       label_for_sequence=None, words_done: float = 0.0):
@@ -268,7 +289,17 @@ class SequenceVectors:
             done = self._train_corpus(
                 corpus if seq_list is None else seq_list, total,
                 words_done=done)
+        self._finalize_losses()
         return self
+
+    def _finalize_losses(self):
+        """One deferred host sync for the whole run (see _flush_sg): stack
+        on device and fetch in chunked transfers — per-scalar float() would
+        pay one full host round-trip each, while a single giant stack
+        traces a concatenate whose operand count scales superlinearly."""
+        if not self.loss_history:
+            return
+        self.loss_history = _fetch_loss_scalars(self.loss_history)
 
     # ------------------------------------------------------- vector queries
     # (reference embeddings/wordvectors/WordVectorsImpl.java API)

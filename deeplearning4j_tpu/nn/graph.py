@@ -301,10 +301,7 @@ class ComputationGraph:
         if str(g.optimization_algo) != str(
                 OptimizationAlgorithm.STOCHASTIC_GRADIENT_DESCENT):
             return self._fit_with_solver(it, epochs)
-        if self._train_step is None:
-            confs = {n: v.layer for n, v in self.layer_vertices.items()}
-            self._train_step = make_train_step(self._loss, self.tx, confs,
-                                               mesh=self._mesh)
+        self._get_train_step()
         tbptt = self.conf.backprop_type in (BackpropType.TRUNCATED_BPTT,
                                             "truncated_bptt")
         for _ in range(epochs):
@@ -324,6 +321,14 @@ class ComputationGraph:
                     for lst in self.listeners:
                         lst.iteration_done(self, self.iteration_count)
         return self
+
+    def _get_train_step(self):
+        """Jitted donated train step (same contract as MLN._get_train_step)."""
+        if self._train_step is None:
+            confs = {n: v.layer for n, v in self.layer_vertices.items()}
+            self._train_step = make_train_step(self._loss, self.tx, confs,
+                                               mesh=self._mesh)
+        return self._train_step
 
     def _fit_with_solver(self, it, epochs: int):
         """CG/LBFGS/line-GD path (reference Solver dispatch — the graph

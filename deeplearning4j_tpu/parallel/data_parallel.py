@@ -28,7 +28,7 @@ import jax.numpy as jnp
 import optax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-from deeplearning4j_tpu.datasets.api import DataSet
+from deeplearning4j_tpu.datasets.api import DataSet, MultiDataSet
 from deeplearning4j_tpu.datasets.iterators import DataSetIterator, ListDataSetIterator
 
 
@@ -147,14 +147,27 @@ class ParameterAveragingTrainer:
                         f"the last {b - per * n} examples of each such batch "
                         f"are dropped", stacklevel=2)
                     self._warned_truncation = True
-                batch = {
-                    "features": jnp.asarray(ds.features[:per * n]),
-                    "labels": jnp.asarray(ds.labels[:per * n]),
-                }
-                if ds.features_mask is not None:
-                    batch["features_mask"] = jnp.asarray(ds.features_mask[:per * n])
-                if ds.labels_mask is not None:
-                    batch["labels_mask"] = jnp.asarray(ds.labels_mask[:per * n])
+                m = per * n
+
+                def trunc(arrs):
+                    return None if arrs is None else [
+                        None if a is None else a[:m] for a in arrs]
+
+                if isinstance(ds, MultiDataSet):
+                    tds = MultiDataSet(trunc(ds.features), trunc(ds.labels),
+                                       trunc(ds.features_masks),
+                                       trunc(ds.labels_masks))
+                    batch = self.net._batch_dict(tds)
+                else:
+                    tds = DataSet(
+                        ds.features[:m], ds.labels[:m],
+                        None if ds.features_mask is None else ds.features_mask[:m],
+                        None if ds.labels_mask is None else ds.labels_mask[:m])
+                    if hasattr(self.net, "_to_mds"):
+                        # ComputationGraph: multi-input batch format (tuples)
+                        batch = self.net._batch_dict(self.net._to_mds(tds))
+                    else:
+                        batch = self.net._batch_dict(tds)
                 batch = jax.tree.map(
                     lambda x: jax.device_put(
                         x, NamedSharding(self.mesh, P("data"))), batch)

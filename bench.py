@@ -29,11 +29,13 @@ import time
 
 import numpy as np
 
-# Nominal anchors (regression guards; re-based once real-TPU numbers land).
+# Anchors: lenet/vgg16/word2vec were measured on the real v5e chip
+# (round 2, 2026-07) and act as regression guards; resnet_dp's natural
+# baseline is parity (1.0) and transformer's is the >=30% MFU north star.
 TARGETS = {
-    "lenet": 20000.0,        # images/sec/chip
-    "vgg16": 2000.0,         # images/sec/chip
-    "word2vec": 100000.0,    # words/sec
+    "lenet": 84000.0,        # images/sec/chip (r2 measured: 84.6k)
+    "vgg16": 18000.0,        # images/sec/chip (r2 measured: 18.7k)
+    "word2vec": 220000.0,    # words/sec (r2 measured: 225k, device pipeline)
     "resnet_dp": 1.0,        # allreduce/param-avg speedup (>=1 expected)
     "transformer": 0.30,     # MFU fraction (north star >=30%)
 }
@@ -82,53 +84,54 @@ def _sync(carry) -> float:
     return float(jnp.ravel(leaf.astype(jnp.float32))[0])
 
 
-def _time_steps(step, args_fn, warmup: int, steps: int) -> float:
-    """Seconds/step via a two-point measurement: run `steps` and `3*steps`
-    chained iterations, each ended by a scalar host readback, and take the
-    slope — this cancels the fixed dispatch/readback round-trip latency
-    (~60-100ms through the driver's device tunnel) that would otherwise
-    dominate short runs."""
+def _time_net_steps(net, batch, steps: int) -> float:
+    """Seconds per training step, measured device-side.
 
-    def timed(n) -> float:
-        carry = None
-        t0 = time.perf_counter()
-        for _ in range(n):
-            carry = step(*args_fn(carry))
-        _sync(carry)
-        return time.perf_counter() - t0
-
-    timed(warmup)  # compile + warm caches (result discarded)
-    t1 = timed(steps)
-    t3 = timed(3 * steps)
-    return max((t3 - t1) / (2 * steps), 1e-9)
-
-
-def _net_stepper(net, batch):
-    """Adapt a network's jitted train step to the _time_steps carry protocol."""
+    The driver's device tunnel adds ~60-100ms of round-trip latency per
+    host sync AND several ms per individual dispatch, so per-step Python
+    dispatch pollutes the measurement. Instead `n` steps run inside ONE
+    jitted lax.scan (a single dispatch), ended by a scalar readback; the
+    slope between n=steps and n=3*steps cancels the remaining fixed cost.
+    """
     import jax
-
     import jax.numpy as jnp
+    from functools import partial
 
     step = net._get_train_step()
 
-    def args_fn(carry):
-        if carry is None:
-            # fresh on-device copies: the step donates its buffers, so each
-            # timed run must start from un-donated state
-            carry = (jax.tree.map(jnp.copy, net.params),
-                     jax.tree.map(jnp.copy, net.opt_state),
-                     jax.tree.map(jnp.copy, net.state),
-                     jax.random.PRNGKey(0))
-        params, opt_state, state, key = carry
-        key, k = jax.random.split(key)
-        return params, opt_state, state, k, key
+    def run_n(params, opt_state, state, key, b, *, n):
+        # batch comes in as an argument — captured as a closure constant it
+        # would be inlined into the serialized HLO (hundreds of MB)
+        def body(carry, _):
+            params, opt_state, state, key = carry
+            key, k = jax.random.split(key)
+            params, opt_state, state, loss, _ = step(params, opt_state,
+                                                     state, k, b)
+            return (params, opt_state, state, key), loss
 
-    def stepper(params, opt_state, state, k, key):
-        params, opt_state, state, loss, _ = step(params, opt_state, state, k,
-                                                 batch)
-        return params, opt_state, state, key
+        carry, losses = jax.lax.scan(body, (params, opt_state, state, key),
+                                     None, length=n)
+        return losses[-1]
 
-    return stepper, args_fn
+    fns = {n: jax.jit(partial(run_n, n=n)) for n in (steps, 3 * steps)}
+    batch_dev = jax.device_put(batch)
+
+    def timed(n) -> float:
+        # fresh on-device copies: the inner step donates its buffers
+        args = (jax.tree.map(jnp.copy, net.params),
+                jax.tree.map(jnp.copy, net.opt_state),
+                jax.tree.map(jnp.copy, net.state),
+                jax.random.PRNGKey(0))
+        t0 = time.perf_counter()
+        _sync(fns[n](*args, batch_dev))
+        return time.perf_counter() - t0
+
+    timed(steps)       # compile
+    timed(3 * steps)   # compile
+    # tunnel jitter is hundreds of ms; min-of-3 is the robust estimator
+    t1 = min(timed(steps) for _ in range(3))
+    t3 = min(timed(3 * steps) for _ in range(3))
+    return max((t3 - t1) / (2 * steps), 1e-9)
 
 
 # --------------------------------------------------------------------- modes
@@ -141,15 +144,14 @@ def bench_lenet() -> None:
 
     backend = jax.default_backend()
     on_tpu = backend == "tpu"
-    batch = 512
+    batch = 512 if on_tpu else 128
     net = lenet5(dtype="bfloat16" if on_tpu else "float32")
     net.init()
     rng = np.random.default_rng(0)
     x = rng.random((batch, 28, 28, 1), dtype=np.float32)
     y = np.eye(10, dtype=np.float32)[rng.integers(0, 10, batch)]
     b = {"features": jnp.asarray(x), "labels": jnp.asarray(y)}
-    stepper, args_fn = _net_stepper(net, b)
-    sec = _time_steps(stepper, args_fn, warmup=5, steps=30)
+    sec = _time_net_steps(net, b, steps=60 if on_tpu else 4)
     _emit("lenet", batch / sec, "images/sec/chip",
           metric=f"lenet_mnist_images_per_sec_{backend}")
 
@@ -163,15 +165,14 @@ def bench_vgg16() -> None:
     backend = jax.default_backend()
     on_tpu = backend == "tpu"
     batch = 256 if on_tpu else 16
-    steps = 20 if on_tpu else 3
+    steps = 40 if on_tpu else 2
     net = vgg16(dtype="bfloat16" if on_tpu else "float32")
     net.init()
     rng = np.random.default_rng(0)
     x = rng.random((batch, 32, 32, 3), dtype=np.float32)
     y = np.eye(10, dtype=np.float32)[rng.integers(0, 10, batch)]
     b = {"features": (jnp.asarray(x),), "labels": (jnp.asarray(y),)}
-    stepper, args_fn = _net_stepper(net, b)
-    sec = _time_steps(stepper, args_fn, warmup=3, steps=steps)
+    sec = _time_net_steps(net, b, steps=steps)
     _emit("vgg16", batch / sec, "images/sec/chip",
           metric=f"vgg16_cifar_images_per_sec_{backend}")
 
@@ -182,7 +183,7 @@ def bench_word2vec() -> None:
     from deeplearning4j_tpu.nlp.word2vec import Word2Vec
 
     rng = np.random.default_rng(0)
-    vocab, n_words, sent_len = 2000, 200_000, 25
+    vocab, n_words, sent_len = 10000, 1_000_000, 25
     zipf = 1.0 / np.arange(1, vocab + 1)
     p = zipf / zipf.sum()
     words = [f"w{i}" for i in range(vocab)]
@@ -190,21 +191,14 @@ def bench_word2vec() -> None:
     sents = [[words[j] for j in ids[i:i + sent_len]]
              for i in range(0, n_words, sent_len)]
 
-    batch = 8192
-
-    def build():
-        return (Word2Vec.builder().layer_size(128).window_size(5)
-                .min_word_frequency(1).negative_sample(5).batch_size(batch)
-                .epochs(1).seed(1).build())
-
-    w2v = build()
+    w2v = (Word2Vec.builder().layer_size(128).window_size(5)
+           .min_word_frequency(1).negative_sample(5)
+           .use_device_pipeline(True).epochs(1).seed(1).build())
+    w2v.pipeline_chunk, w2v.pipeline_group = 1024, 8
     w2v.build_vocab(sents)  # one-time host-side work, not training throughput
-    # compile warmup at the true table shapes: a zero-lr flush updates
-    # nothing but populates the jit cache for the timed run
-    w2v._flush_sg(np.zeros(batch, np.int32), np.zeros(batch, np.int32), 0.0)
-    w2v.loss_history.clear()
+    w2v.fit(sents)          # warmup fit: compiles the epoch scan
     t0 = time.perf_counter()
-    w2v.fit(sents)
+    w2v.fit(sents)          # timed fit: repack + full on-device epoch
     np.asarray(w2v.word_vector("w0"))  # force pending device work to finish
     dt = time.perf_counter() - t0
     _emit("word2vec", n_words / dt, "words/sec",
@@ -267,10 +261,10 @@ def bench_transformer() -> None:
 
     backend = jax.default_backend()
     on_tpu = backend == "tpu"
-    vocab, d_model, heads, layers, d_ff = 10000, 256, 8, 6, 1024
+    vocab, d_model, heads, layers, d_ff = 10000, 256, 4, 6, 1024
     seq = 512 if on_tpu else 128
-    batch = 16 if on_tpu else 2
-    steps = 20 if on_tpu else 3
+    batch = 32 if on_tpu else 2
+    steps = 40 if on_tpu else 2
     net = transformer_lm(vocab_size=vocab, d_model=d_model, n_heads=heads,
                          n_layers=layers, d_ff=d_ff, max_length=seq,
                          dtype="bfloat16" if on_tpu else "float32")
@@ -278,20 +272,25 @@ def bench_transformer() -> None:
     rng = np.random.default_rng(0)
     toks = np.asarray(rng.integers(0, vocab, (batch, seq)), np.int32)
     shifted = np.roll(toks, -1, axis=1)
-    labels = np.eye(vocab, dtype=np.float32)[shifted]
-    b = {"features": (jnp.asarray(toks),), "labels": (jnp.asarray(labels),)}
-    stepper, args_fn = _net_stepper(net, b)
-    sec = _time_steps(stepper, args_fn, warmup=3, steps=steps)
+    # sparse int labels: the mcxent gather path (O(N) vs O(N*V) HBM traffic)
+    b = {"features": (jnp.asarray(toks),), "labels": (jnp.asarray(shifted),)}
+    sec = _time_net_steps(net, b, steps=steps)
 
     tokens_per_sec = batch * seq / sec
     flops_tok = transformer_flops_per_token(vocab, d_model, layers, d_ff, seq)
     peak = _peak_flops(jax.devices()[0])
-    mfu = (flops_tok * tokens_per_sec / peak) if peak else 0.0
-    _emit("transformer", mfu, "MFU fraction",
-          metric=f"transformer_lm_mfu_{backend}",
-          tokens_per_sec=round(tokens_per_sec, 1),
-          model_flops_per_token=flops_tok,
-          peak_flops=peak)
+    if peak:
+        _emit("transformer", flops_tok * tokens_per_sec / peak,
+              "MFU fraction", metric=f"transformer_lm_mfu_{backend}",
+              tokens_per_sec=round(tokens_per_sec, 1),
+              model_flops_per_token=flops_tok, peak_flops=peak)
+    else:
+        # no peak-FLOPs table entry (CPU smoke runs): report raw throughput
+        print(json.dumps({
+            "metric": f"transformer_lm_tokens_per_sec_{backend}",
+            "value": round(tokens_per_sec, 1), "unit": "tokens/sec",
+            "vs_baseline": 1.0,
+            "model_flops_per_token": flops_tok}), flush=True)
 
 
 MODES = {

@@ -1,0 +1,213 @@
+"""On-device skip-gram training pipeline (TPU-native word2vec hot path).
+
+The reference trains word2vec with host-side per-pair loops
+(`embeddings/learning/impl/elements/SkipGram.java:160-229`, HogWild threads
+racing on shared arrays) and ships pair buffers to workers in the Spark
+variant (`spark/dl4j-spark-nlp/.../Word2VecPerformer.java:46-246`). Both
+designs are host/IO bound. Here the WHOLE epoch runs on device:
+
+- the token stream (with sentence ids) is uploaded ONCE per epoch;
+- dynamic-window pair generation, unigram^0.75 negative sampling, the
+  SGNS forward/backward, and the scatter updates are all inside one
+  jitted `lax.scan` over fixed-size chunks — zero host round-trips;
+- learning-rate decay follows scan progress (word2vec linear alpha);
+- optionally the chunk stream is sharded over a mesh 'data' axis
+  (DP-5): each device computes gradient tables for its chunks, a psum
+  merges them, and one shared update is applied — the synchronous
+  equivalent of Word2VecPerformer's accumulated updates, with the same
+  result on any device count (gradient sums are order-free).
+
+Semantics match the batched host path (`lookup.sgns_step`): per-update
+summed gradients with the MAX_ROW_STEP trust region; negatives drawn from
+the same unigram^0.75 distribution (on device via Walker alias tables).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from deeplearning4j_tpu.nlp.lookup import MAX_ROW_STEP
+
+
+def build_alias_table(probs: np.ndarray) -> Tuple[np.ndarray, np.ndarray]:
+    """Walker alias tables (J, q) for O(1) categorical sampling on device.
+
+    jnp.searchsorted over the unigram CDF costs tens of ms per update on
+    TPU (binary-search gathers don't vectorize well); the alias method is
+    two gathers and a select. Host construction is O(V)."""
+    p = np.asarray(probs, np.float64)
+    p = p / p.sum()
+    V = len(p)
+    q = p * V
+    J = np.zeros(V, np.int32)
+    small = [i for i in range(V) if q[i] < 1.0]
+    large = [i for i in range(V) if q[i] >= 1.0]
+    while small and large:
+        s_ = small.pop()
+        l_ = large.pop()
+        J[s_] = l_
+        q[l_] = q[l_] - (1.0 - q[s_])
+        (small if q[l_] < 1.0 else large).append(l_)
+    for i in small + large:
+        q[i] = 1.0
+    return J, q.astype(np.float32)
+
+
+def _alias_sample(key, J, q, shape):
+    k1, k2 = jax.random.split(key)
+    i = jax.random.randint(k1, shape, 0, J.shape[0])
+    coin = jax.random.uniform(k2, shape)
+    return jnp.where(coin < q[i], i, J[i]).astype(jnp.int32)
+
+
+def pack_corpus(idx_seqs: List[np.ndarray], multiple: int
+                ) -> Tuple[np.ndarray, np.ndarray]:
+    """Flatten index sequences into (tokens [N], sent_ids [N]) padded to a
+    multiple of `multiple`; padding carries sent_id -1 (never pairs)."""
+    seqs = [np.asarray(s, np.int32) for s in idx_seqs if len(s) > 0]
+    if not seqs:
+        raise ValueError("empty corpus")
+    tokens = np.concatenate(seqs)
+    sent_ids = np.concatenate(
+        [np.full(len(s), i, np.int32) for i, s in enumerate(seqs)])
+    pad = (-len(tokens)) % multiple
+    if pad:
+        tokens = np.concatenate([tokens, np.zeros(pad, np.int32)])
+        sent_ids = np.concatenate([sent_ids, np.full(pad, -1, np.int32)])
+    return tokens, sent_ids
+
+
+def _chunk_pair_grads(syn0, syn1neg, tokens, sent_ids, alias_J, alias_q,
+                      start, key, *, chunk, window, K):
+    """Pair gradients for `chunk` consecutive center positions.
+
+    Returns per-pair gradient pieces (no dense tables — those are built
+    once per update so a vmap over chunks stays memory-light) plus the
+    masked loss sum and valid-pair count.
+    """
+    N = tokens.shape[0]
+    pos = start + jnp.arange(chunk)
+    centers = tokens[pos]
+    csent = sent_ids[pos]
+    kb, kn = jax.random.split(key)
+    # word2vec dynamic window: per center, b ~ uniform{1..window}
+    b = jax.random.randint(kb, (chunk,), 1, window + 1)
+    offs = jnp.asarray(np.concatenate(
+        [np.arange(-window, 0), np.arange(1, window + 1)]), jnp.int32)
+    cpos = pos[:, None] + offs[None, :]
+    cposc = jnp.clip(cpos, 0, N - 1)
+    valid = ((cpos >= 0) & (cpos < N)
+             & (sent_ids[cposc] == csent[:, None])
+             & (jnp.abs(offs)[None, :] <= b[:, None])
+             & (csent[:, None] >= 0))
+    ctx = tokens[cposc]                                    # [S, 2w]
+    negs = _alias_sample(kn, alias_J, alias_q,
+                         (chunk, 2 * window, K))            # [S, 2w, K]
+
+    c = syn0[centers]                                      # [S, D]
+    posv = syn1neg[ctx]                                    # [S, 2w, D]
+    negv = syn1neg[negs]                                   # [S, 2w, K, D]
+    pos_score = jax.nn.sigmoid(jnp.einsum("sd,swd->sw", c, posv))
+    neg_score = jax.nn.sigmoid(jnp.einsum("sd,swkd->swk", c, negv))
+    vm = valid.astype(c.dtype)
+    g_pos = (pos_score - 1.0) * vm                         # [S, 2w]
+    g_neg = neg_score * vm[..., None]                      # [S, 2w, K]
+
+    grad_c = (jnp.einsum("sw,swd->sd", g_pos, posv)
+              + jnp.einsum("swk,swkd->sd", g_neg, negv))   # [S, D]
+    grad_pos = g_pos[..., None] * c[:, None, :]            # [S, 2w, D]
+    grad_neg = g_neg[..., None] * c[:, None, None, :]      # [S, 2w, K, D]
+
+    eps = 1e-10
+    loss = -(jnp.sum(jnp.log(pos_score + eps) * vm)
+             + jnp.sum(jnp.log(1.0 - neg_score + eps) * vm[..., None]))
+    return centers, grad_c, ctx, grad_pos, negs, grad_neg, loss, vm.sum()
+
+
+def _trust_region_apply(table, grad, lr):
+    """table - lr*grad with the per-row step-norm cap (see
+    lookup._scatter_update — identical trust-region semantics)."""
+    step = lr * grad
+    n = jnp.linalg.norm(step, axis=1, keepdims=True)
+    return table - step * jnp.minimum(1.0, MAX_ROW_STEP / jnp.maximum(n, 1e-12))
+
+
+def make_sgns_epoch(*, window: int, negative: int, chunk: int = 512,
+                    group: int = 4, mesh=None):
+    """Build the jitted epoch function.
+
+    epoch(syn0, syn1neg, tokens, sent_ids, alias_J, alias_q, key, lr0, lr1)
+      -> (syn0, syn1neg, per_update_loss [U], per_update_pairs [U])
+    (alias_J, alias_q from build_alias_table over the unigram^0.75 dist)
+
+    One update = `group` chunks of `chunk` centers with summed gradients
+    (a global batch). With `mesh`, the group dimension is sharded over the
+    mesh's 'data' axis and gradients are psum-merged — numerically the
+    same update as single-device, so device count never changes results.
+    """
+    K = negative
+    pair_grads = partial(_chunk_pair_grads, chunk=chunk, window=window, K=K)
+
+    def local_grads(syn0, syn1neg, tokens, sent_ids, aJ, aq, starts, keys):
+        (centers, grad_c, ctx, grad_pos, negs, grad_neg, loss, pairs
+         ) = jax.vmap(lambda s, k: pair_grads(
+             syn0, syn1neg, tokens, sent_ids, aJ, aq, s, k))(starts, keys)
+        D = syn0.shape[1]
+        g0 = jnp.zeros_like(syn0).at[centers.reshape(-1)].add(
+            grad_c.reshape(-1, D))
+        g1 = (jnp.zeros_like(syn1neg)
+              .at[ctx.reshape(-1)].add(grad_pos.reshape(-1, D))
+              .at[negs.reshape(-1)].add(grad_neg.reshape(-1, D)))
+        return g0, g1, jnp.sum(loss), jnp.sum(pairs)
+
+    if mesh is not None:
+        from jax.sharding import PartitionSpec as P
+        from jax import shard_map
+
+        n_dev = mesh.shape["data"]
+        if group % n_dev:
+            raise ValueError(f"group={group} not divisible by mesh data "
+                             f"axis size {n_dev}")
+
+        def sharded_grads(syn0, syn1neg, tokens, sent_ids, aJ, aq, starts,
+                          keys):
+            g0, g1, loss, pairs = local_grads(
+                syn0, syn1neg, tokens, sent_ids, aJ, aq, starts, keys)
+            return (jax.lax.psum(g0, "data"), jax.lax.psum(g1, "data"),
+                    jax.lax.psum(loss, "data"), jax.lax.psum(pairs, "data"))
+
+        grads_fn = shard_map(
+            sharded_grads, mesh=mesh,
+            in_specs=(P(), P(), P(), P(), P(), P(), P("data"), P("data")),
+            out_specs=(P(), P(), P(), P()))
+    else:
+        grads_fn = local_grads
+
+    @partial(jax.jit, donate_argnums=(0, 1))
+    def epoch(syn0, syn1neg, tokens, sent_ids, aJ, aq, key, lr0, lr1):
+        N = tokens.shape[0]
+        per_update = chunk * group
+        n_up = max(N // per_update, 1)
+
+        def body(carry, u):
+            s0, s1 = carry
+            starts = u * per_update + jnp.arange(group) * chunk
+            keys = jax.vmap(jax.random.fold_in, (None, 0))(
+                key, u * group + jnp.arange(group))
+            g0, g1, loss, pairs = grads_fn(s0, s1, tokens, sent_ids, aJ, aq,
+                                           starts, keys)
+            lr = lr0 + (lr1 - lr0) * (u.astype(s0.dtype) / n_up)
+            s0 = _trust_region_apply(s0, g0, lr)
+            s1 = _trust_region_apply(s1, g1, lr)
+            return (s0, s1), (loss, pairs)
+
+        (syn0, syn1neg), (losses, pairs) = jax.lax.scan(
+            body, (syn0, syn1neg), jnp.arange(n_up))
+        return syn0, syn1neg, losses, pairs
+
+    return epoch

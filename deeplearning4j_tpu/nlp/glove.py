@@ -152,12 +152,15 @@ class Glove(SequenceVectors):
                     ii[sel], jj[sel], logx[sel], fx[sel], self.learning_rate)
                 # device scalar; one host sync after the run (below)
                 self.loss_history.append(loss)
-        # normalize only this run's fresh device entries — floats from a
-        # previous fit() are already normalized
+        # fetch fresh device entries, then normalize only those — floats
+        # from a previous fit() are already normalized, and dividing on
+        # host avoids one tiny device dispatch per recorded batch
         from deeplearning4j_tpu.nlp.sequencevectors import _fetch_loss_scalars
 
+        fresh = {i for i, l in enumerate(self.loss_history)
+                 if not isinstance(l, float)}
         self.loss_history = [
-            l if isinstance(l, float) else l / B for l in self.loss_history]
-        self.loss_history = _fetch_loss_scalars(self.loss_history)
+            l / B if i in fresh else l
+            for i, l in enumerate(_fetch_loss_scalars(self.loss_history))]
         self.lookup_table.set_vectors(np.asarray(W + Wc))
         return self

@@ -77,6 +77,10 @@ class ParagraphVectors(SequenceVectors):
 
     # ----------------------------------------------------------- training
     def fit(self, docs=None, labels=None):
+        if self.use_device_pipeline:
+            raise ValueError(
+                "device pipeline does not support extra label rows "
+                "(ParagraphVectors) — use the host path")
         seqs, doc_labels = self._load_corpus(docs, labels)
         self._doc_labels = doc_labels
         # register labels before vocab init so syn0 gets the extra rows

@@ -22,6 +22,8 @@ from __future__ import annotations
 
 from typing import Iterable, List, Optional, Sequence
 
+import jax
+import jax.numpy as jnp
 import numpy as np
 
 from deeplearning4j_tpu.nlp.lookup import (
@@ -50,8 +52,6 @@ def _fetch_loss_scalars(history):
     chunked concatenate trace is cached across chunks) and fetch each
     chunk as one transfer. Already-float entries pass through, so
     repeated fits don't re-fetch."""
-    import jax.numpy as jnp
-
     dev = [l for l in history if not isinstance(l, float)]
     vals = []
     for i in range(0, len(dev), _LOSS_FETCH_CHUNK):
@@ -77,7 +77,9 @@ class SequenceVectors:
                  use_hs: bool = False, sampling: float = 0.0,
                  batch_size: int = 2048, seed: int = 123,
                  elements_learning_algorithm: str = "skipgram",
-                 vocab_limit: Optional[int] = None):
+                 vocab_limit: Optional[int] = None,
+                 use_device_pipeline: bool = False, device_mesh=None,
+                 pipeline_chunk: int = 512, pipeline_group: int = 4):
         self.layer_size = layer_size
         self.window_size = window_size
         self.min_word_frequency = min_word_frequency
@@ -91,6 +93,11 @@ class SequenceVectors:
         self.seed = seed
         self.algorithm = elements_learning_algorithm
         self.vocab_limit = vocab_limit
+        self.use_device_pipeline = use_device_pipeline
+        self.device_mesh = device_mesh
+        self.pipeline_chunk = pipeline_chunk
+        self.pipeline_group = pipeline_group
+        self._epoch_fn = None
 
         self.vocab: Optional[VocabCache] = None
         self.lookup_table: Optional[InMemoryLookupTable] = None
@@ -283,6 +290,8 @@ class SequenceVectors:
                 seq_list = [list(s) for s in sequences]
             self.build_vocab(seq_list)
         corpus = seq_list if seq_list is not None else sequences
+        if self.use_device_pipeline:
+            return self._fit_device_pipeline(corpus)
         total = self.vocab.total_word_occurrences * self.epochs
         done = 0.0
         for _ in range(self.epochs):
@@ -290,6 +299,66 @@ class SequenceVectors:
                 corpus if seq_list is None else seq_list, total,
                 words_done=done)
         self._finalize_losses()
+        return self
+
+    def _fit_device_pipeline(self, corpus):
+        """Whole-epoch on-device training (nlp/device_pipeline.py): the
+        corpus is uploaded once per epoch and pair generation, negative
+        sampling, and updates all run inside one jitted scan. Supports
+        skip-gram + negative sampling (the word2vec hot path) only;
+        other algorithm combinations raise — requesting the pipeline is
+        explicit, so a silent host-loop fallback would hide a perf cliff."""
+        from deeplearning4j_tpu.nlp.device_pipeline import (
+            build_alias_table,
+            make_sgns_epoch,
+            pack_corpus,
+        )
+
+        if self.algorithm != "skipgram" or self.use_hs or self.negative <= 0:
+            raise ValueError(
+                "device pipeline supports skip-gram with negative sampling "
+                "(use_hs=False, negative>0); use the host path otherwise")
+        if self._extra_rows():
+            raise ValueError("device pipeline does not support extra label "
+                             "rows (ParagraphVectors) — use the host path")
+        cfg = (self.window_size, self.negative, self.pipeline_chunk,
+               self.pipeline_group, id(self.device_mesh))
+        if self._epoch_fn is None or getattr(self, "_epoch_cfg", None) != cfg:
+            self._epoch_fn = make_sgns_epoch(
+                window=self.window_size, negative=self.negative,
+                chunk=self.pipeline_chunk, group=self.pipeline_group,
+                mesh=self.device_mesh)
+            self._epoch_cfg = cfg
+        t = self.lookup_table
+        probs = np.diff(self._cum_table, prepend=0.0)
+        aJ, aq = build_alias_table(probs)
+        aJ, aq = jnp.asarray(aJ), jnp.asarray(aq)
+        total = self.vocab.total_word_occurrences * self.epochs
+        per_update = self.pipeline_chunk * self.pipeline_group
+        done = 0.0
+        packed = None
+        losses = []
+        for _ in range(self.epochs):
+            if packed is None or self.sampling > 0:
+                # subsampling redraws per epoch (host rng, like the
+                # reference); without it the packed corpus is uploaded once
+                # and reused across epochs
+                idx_seqs = [self._sequence_indices(toks) for toks in corpus]
+                tokens_np, sent_ids_np = pack_corpus(idx_seqs, per_update)
+                packed = (jnp.asarray(tokens_np), jnp.asarray(sent_ids_np))
+            tokens, sent_ids = packed
+            lr0 = self._alpha(done, total)
+            lr1 = self._alpha(done + len(tokens), total)
+            key = jax.random.PRNGKey(self.seed + int(done) % (2**31))
+            t.syn0, t.syn1neg, ls, pairs = self._epoch_fn(
+                t.syn0, t.syn1neg, tokens, sent_ids, aJ, aq, key, lr0, lr1)
+            losses.append((ls, pairs))
+            done += len(tokens)
+        # one host fetch for the whole run
+        for ls, pairs in losses:
+            ls = np.asarray(ls)
+            pairs = np.maximum(np.asarray(pairs), 1.0)
+            self.loss_history.extend((ls / pairs).tolist())
         return self
 
     def _finalize_losses(self):

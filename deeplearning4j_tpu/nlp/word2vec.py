@@ -90,6 +90,20 @@ class Word2Vec(SequenceVectors):
             self._kw["seed"] = int(s)
             return self
 
+        def use_device_pipeline(self, flag=True):
+            """Whole-epoch on-device training (see nlp/device_pipeline.py)."""
+            self._kw["use_device_pipeline"] = flag
+            return self
+
+        def device_mesh(self, mesh, chunk: int = 512, group: int = 4):
+            """Shard the chunk stream over mesh's 'data' axis (DP-5).
+            Implies use_device_pipeline."""
+            self._kw["use_device_pipeline"] = True
+            self._kw["device_mesh"] = mesh
+            self._kw["pipeline_chunk"] = chunk
+            self._kw["pipeline_group"] = group
+            return self
+
         def elements_learning_algorithm(self, name: str):
             self._kw["elements_learning_algorithm"] = (
                 "cbow" if "cbow" in name.lower() else "skipgram")

@@ -337,6 +337,7 @@ class SelfAttentionLayer(BaseRecurrentLayer):
     n_heads: int = 8
     causal: bool = True
     attention_dropout: float = 0.0
+    use_flash: bool = True  # fused Pallas kernel when the case supports it
 
     def set_n_in(self, input_type: InputType) -> None:
         if self.n_in == 0:

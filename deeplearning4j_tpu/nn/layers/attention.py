@@ -12,6 +12,10 @@ from __future__ import annotations
 import jax
 import jax.numpy as jnp
 
+from deeplearning4j_tpu.ops.flash_attention import (
+    flash_attention,
+    supports as flash_supports,
+)
 from deeplearning4j_tpu.nn.conf.layers import (
     LayerNormalization,
     PositionalEncodingLayer,
@@ -112,10 +116,16 @@ class SelfAttentionImpl(LayerImpl):
         def heads(t):
             return t.reshape(B, T, H, D).transpose(0, 2, 1, 3)
 
-        out = dot_product_attention(
-            heads(q), heads(k), heads(v), causal=conf.causal, mask=mask,
-            dropout=conf.attention_dropout, rng=rng, train=train,
-        )
+        qh, kh, vh = heads(q), heads(k), heads(v)
+        drop = conf.attention_dropout if train else 0.0
+        if getattr(conf, "use_flash", True) and flash_supports(
+                qh.shape, causal=conf.causal, dropout=drop, mask=mask):
+            out = flash_attention(qh, kh, vh, causal=conf.causal)
+        else:
+            out = dot_product_attention(
+                qh, kh, vh, causal=conf.causal, mask=mask,
+                dropout=conf.attention_dropout, rng=rng, train=train,
+            )
         out = out.transpose(0, 2, 1, 3).reshape(B, T, n)
         y = out @ params["Wo"] + params["bo"]
         return get_activation(conf.activation or "identity")(y), state

@@ -1,0 +1,278 @@
+"""Fused blockwise (flash) attention for TPU via Pallas.
+
+Replaces the naive [B, H, T, T] score materialization in
+`nn/layers/attention.dot_product_attention` for the causal/unmasked LM hot
+path (the VERDICT-flagged MFU risk): scores never leave VMEM; the softmax
+is computed online per key block (running max + running sum), and the
+backward pass recomputes probabilities from the saved logsumexp instead of
+storing them — O(T) HBM traffic instead of O(T^2).
+
+Kernel layout (per (batch*head, q-block) program):
+  fwd:  loop key blocks -> online softmax into an f32 accumulator; saves
+        out and logsumexp.
+  bwd:  two kernels — dq (loop over key blocks per q block) and dk/dv
+        (loop over q blocks per key block) — using the standard
+        ds = p * (dp - delta) identity with delta = rowsum(do * o).
+
+Constraints: T divisible by the block size (128), no attention dropout,
+no padding mask (the dense path handles those); head_dim is padded to the
+128-lane tile internally by Mosaic when smaller.
+
+Falls back to interpret mode off-TPU so the unit tests exercise the same
+kernel code on CPU.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import pallas as pl
+
+BLOCK = 128
+LANES = 128  # lane-broadcast width for per-row scalars (TPU tile rule)
+NEG_INF = -1e30
+
+
+def _use_interpret() -> bool:
+    return jax.default_backend() != "tpu"
+
+
+# ------------------------------------------------------------------ forward
+
+def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, sm_scale, causal,
+                block_q, block_k, seq_len):
+    qi = pl.program_id(1)
+    q = q_ref[0].astype(jnp.float32) * sm_scale            # [bq, D]
+    nk = seq_len // block_k
+    hi = jnp.where(causal, (qi * block_q) // block_k + 1, nk) if causal else nk
+
+    def body(j, carry):
+        m, l, acc = carry
+        kb = k_ref[0, pl.ds(j * block_k, block_k), :].astype(jnp.float32)
+        vb = v_ref[0, pl.ds(j * block_k, block_k), :].astype(jnp.float32)
+        s = jax.lax.dot_general(q, kb, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32)
+        if causal:
+            qpos = qi * block_q + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 0)
+            kpos = j * block_k + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 1)
+            s = jnp.where(qpos >= kpos, s, NEG_INF)
+        m_new = jnp.maximum(m, jnp.max(s, axis=-1))
+        p = jnp.exp(s - m_new[:, None])
+        alpha = jnp.exp(m - m_new)
+        l = l * alpha + jnp.sum(p, axis=-1)
+        acc = acc * alpha[:, None] + jax.lax.dot_general(
+            p, vb, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        return m_new, l, acc
+
+    D = q_ref.shape[-1]
+    m0 = jnp.full((block_q,), NEG_INF, jnp.float32)
+    l0 = jnp.zeros((block_q,), jnp.float32)
+    acc0 = jnp.zeros((block_q, D), jnp.float32)
+    m, l, acc = jax.lax.fori_loop(0, hi, body, (m0, l0, acc0))
+    l = jnp.maximum(l, 1e-30)
+    o_ref[0] = (acc / l[:, None]).astype(o_ref.dtype)
+    # TPU tiling requires >=2D (8,128)-aligned blocks: broadcast the
+    # per-row scalar across a 128-lane dim (same trick as jax's kernel)
+    lse_ref[0] = jax.lax.broadcast_in_dim(
+        m + jnp.log(l), (block_q, LANES), (0,))
+
+
+def _flash_fwd(q, k, v, sm_scale, causal):
+    BH, T, D = q.shape
+    block_q = block_k = min(BLOCK, T)
+    grid = (BH, T // block_q)
+    kern = functools.partial(_fwd_kernel, sm_scale=sm_scale, causal=causal,
+                             block_q=block_q, block_k=block_k, seq_len=T)
+    o, lse = pl.pallas_call(
+        kern,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((1, block_q, D), lambda bh, qi: (bh, qi, 0)),
+            pl.BlockSpec((1, T, D), lambda bh, qi: (bh, 0, 0)),
+            pl.BlockSpec((1, T, D), lambda bh, qi: (bh, 0, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, block_q, D), lambda bh, qi: (bh, qi, 0)),
+            pl.BlockSpec((1, block_q, LANES), lambda bh, qi: (bh, qi, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((BH, T, D), q.dtype),
+            jax.ShapeDtypeStruct((BH, T, LANES), jnp.float32),
+        ],
+        interpret=_use_interpret(),
+    )(q, k, v)
+    return o, lse[:, :, 0]
+
+
+# ----------------------------------------------------------------- backward
+
+def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref, *,
+               sm_scale, causal, block_q, block_k, seq_len):
+    qi = pl.program_id(1)
+    q = q_ref[0].astype(jnp.float32)                        # [bq, D]
+    do = do_ref[0].astype(jnp.float32)
+    lse = jnp.max(lse_ref[0], axis=-1)      # lanes are identical copies
+    delta = jnp.max(delta_ref[0], axis=-1)
+    nk = seq_len // block_k
+    hi = jnp.where(causal, (qi * block_q) // block_k + 1, nk) if causal else nk
+
+    def body(j, dq):
+        kb = k_ref[0, pl.ds(j * block_k, block_k), :].astype(jnp.float32)
+        vb = v_ref[0, pl.ds(j * block_k, block_k), :].astype(jnp.float32)
+        s = sm_scale * jax.lax.dot_general(
+            q, kb, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        if causal:
+            qpos = qi * block_q + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 0)
+            kpos = j * block_k + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 1)
+            s = jnp.where(qpos >= kpos, s, NEG_INF)
+        p = jnp.exp(s - lse[:, None])                      # [bq, bk]
+        dp = jax.lax.dot_general(do, vb, (((1,), (1,)), ((), ())),
+                                 preferred_element_type=jnp.float32)
+        ds = p * (dp - delta[:, None]) * sm_scale
+        return dq + jax.lax.dot_general(ds, kb, (((1,), (0,)), ((), ())),
+                                        preferred_element_type=jnp.float32)
+
+    dq0 = jnp.zeros_like(q)
+    dq = jax.lax.fori_loop(0, hi, body, dq0)
+    dq_ref[0] = dq.astype(dq_ref.dtype)
+
+
+def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dk_ref,
+                dv_ref, *, sm_scale, causal, block_q, block_k, seq_len):
+    ki = pl.program_id(1)
+    kb = k_ref[0].astype(jnp.float32)                       # [bk, D]
+    vb = v_ref[0].astype(jnp.float32)
+    nq = seq_len // block_q
+    lo = (ki * block_k) // block_q if causal else 0
+
+    def body(j, carry):
+        dk, dv = carry
+        qb = q_ref[0, pl.ds(j * block_q, block_q), :].astype(jnp.float32)
+        dob = do_ref[0, pl.ds(j * block_q, block_q), :].astype(jnp.float32)
+        lse = jnp.max(lse_ref[0, pl.ds(j * block_q, block_q), :], axis=-1)
+        delta = jnp.max(delta_ref[0, pl.ds(j * block_q, block_q), :], axis=-1)
+        s = sm_scale * jax.lax.dot_general(
+            qb, kb, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        if causal:
+            qpos = j * block_q + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 0)
+            kpos = ki * block_k + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 1)
+            s = jnp.where(qpos >= kpos, s, NEG_INF)
+        p = jnp.exp(s - lse[:, None])                      # [bq, bk]
+        dv = dv + jax.lax.dot_general(p, dob, (((0,), (0,)), ((), ())),
+                                      preferred_element_type=jnp.float32)
+        dp = jax.lax.dot_general(dob, vb, (((1,), (1,)), ((), ())),
+                                 preferred_element_type=jnp.float32)
+        ds = p * (dp - delta[:, None]) * sm_scale
+        dk = dk + jax.lax.dot_general(ds, qb, (((0,), (0,)), ((), ())),
+                                      preferred_element_type=jnp.float32)
+        return dk, dv
+
+    dk0 = jnp.zeros_like(kb)
+    dv0 = jnp.zeros_like(vb)
+    dk, dv = jax.lax.fori_loop(lo, nq, body, (dk0, dv0))
+    dk_ref[0] = dk.astype(dk_ref.dtype)
+    dv_ref[0] = dv.astype(dv_ref.dtype)
+
+
+def _flash_bwd(sm_scale, causal, res, do):
+    q, k, v, o, lse = res
+    BH, T, D = q.shape
+    block_q = block_k = min(BLOCK, T)
+    delta = jnp.sum(do.astype(jnp.float32) * o.astype(jnp.float32), axis=-1)
+    # lane-broadcast the per-row scalars for tile-legal kernel blocks
+    lse = jnp.broadcast_to(lse[:, :, None], (BH, T, LANES))
+    delta = jnp.broadcast_to(delta[:, :, None], (BH, T, LANES))
+
+    dq = pl.pallas_call(
+        functools.partial(_dq_kernel, sm_scale=sm_scale, causal=causal,
+                          block_q=block_q, block_k=block_k, seq_len=T),
+        grid=(BH, T // block_q),
+        in_specs=[
+            pl.BlockSpec((1, block_q, D), lambda bh, qi: (bh, qi, 0)),
+            pl.BlockSpec((1, T, D), lambda bh, qi: (bh, 0, 0)),
+            pl.BlockSpec((1, T, D), lambda bh, qi: (bh, 0, 0)),
+            pl.BlockSpec((1, block_q, D), lambda bh, qi: (bh, qi, 0)),
+            pl.BlockSpec((1, block_q, LANES), lambda bh, qi: (bh, qi, 0)),
+            pl.BlockSpec((1, block_q, LANES), lambda bh, qi: (bh, qi, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, block_q, D), lambda bh, qi: (bh, qi, 0)),
+        out_shape=jax.ShapeDtypeStruct((BH, T, D), q.dtype),
+        interpret=_use_interpret(),
+    )(q, k, v, do, lse, delta)
+
+    dk, dv = pl.pallas_call(
+        functools.partial(_dkv_kernel, sm_scale=sm_scale, causal=causal,
+                          block_q=block_q, block_k=block_k, seq_len=T),
+        grid=(BH, T // block_k),
+        in_specs=[
+            pl.BlockSpec((1, T, D), lambda bh, ki: (bh, 0, 0)),
+            pl.BlockSpec((1, block_k, D), lambda bh, ki: (bh, ki, 0)),
+            pl.BlockSpec((1, block_k, D), lambda bh, ki: (bh, ki, 0)),
+            pl.BlockSpec((1, T, D), lambda bh, ki: (bh, 0, 0)),
+            pl.BlockSpec((1, T, LANES), lambda bh, ki: (bh, 0, 0)),
+            pl.BlockSpec((1, T, LANES), lambda bh, ki: (bh, 0, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, block_k, D), lambda bh, ki: (bh, ki, 0)),
+            pl.BlockSpec((1, block_k, D), lambda bh, ki: (bh, ki, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((BH, T, D), k.dtype),
+            jax.ShapeDtypeStruct((BH, T, D), v.dtype),
+        ],
+        interpret=_use_interpret(),
+    )(q, k, v, do, lse, delta)
+    return dq, dk, dv
+
+
+# ---------------------------------------------------------------- public op
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4))
+def _flash_bhtd(q, k, v, sm_scale, causal):
+    o, _ = _flash_fwd(q, k, v, sm_scale, causal)
+    return o
+
+
+def _flash_bhtd_fwd(q, k, v, sm_scale, causal):
+    o, lse = _flash_fwd(q, k, v, sm_scale, causal)
+    return o, (q, k, v, o, lse)
+
+
+_flash_bhtd.defvjp(_flash_bhtd_fwd, _flash_bwd)
+
+
+# Below this sequence length XLA's fused dense attention is faster on TPU
+# (measured on v5e: dense wins at T=512, flash wins at T>=2048); the [T,T]
+# materialization only starts to dominate HBM traffic for long sequences.
+MIN_FLASH_SEQ = 1024
+
+
+def supports(q_shape, *, causal, dropout, mask) -> bool:
+    """Whether the fused kernel handles this case (else: dense path)."""
+    T = q_shape[2]
+    return (mask is None and not dropout and T >= MIN_FLASH_SEQ
+            and T % BLOCK == 0)
+
+
+def flash_attention(q, k, v, *, causal=True, sm_scale=None):
+    """q, k, v: [B, H, T, D] -> [B, H, T, D]; differentiable (custom VJP)."""
+    B, H, T, D = q.shape
+    if sm_scale is None:
+        sm_scale = 1.0 / float(np.sqrt(D))
+    qf = q.reshape(B * H, T, D)
+    kf = k.reshape(B * H, T, D)
+    vf = v.reshape(B * H, T, D)
+    o = _flash_bhtd(qf, kf, vf, sm_scale, bool(causal))
+    return o.reshape(B, H, T, D)

@@ -88,7 +88,14 @@ def compute_loss(name, labels, output, mask=None, *, logits=None):
             logp = jax.nn.log_softmax(logits, axis=-1)
         else:
             logp = jnp.log(jnp.clip(output, _EPS, 1.0))
-        per = -jnp.sum(labels * logp, axis=-1)
+        if (labels.ndim == logp.ndim - 1
+                and jnp.issubdtype(labels.dtype, jnp.integer)):
+            # sparse integer class labels [...,]: a gather instead of the
+            # one-hot elementwise product — O(N) HBM traffic, not O(N*V)
+            per = -jnp.take_along_axis(logp, labels[..., None],
+                                       axis=-1)[..., 0]
+        else:
+            per = -jnp.sum(labels * logp, axis=-1)
         return _masked_mean(per, mask)
     if name == LossFunction.XENT:
         if logits is not None:

@@ -289,7 +289,7 @@ def bench_transformer() -> None:
         print(json.dumps({
             "metric": f"transformer_lm_tokens_per_sec_{backend}",
             "value": round(tokens_per_sec, 1), "unit": "tokens/sec",
-            "vs_baseline": 1.0,
+            "vs_baseline": None,  # no MFU anchor without a peak-FLOPs entry
             "model_flops_per_token": flops_tok}), flush=True)
 
 

@@ -47,7 +47,7 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, sm_scale, causal,
     qi = pl.program_id(1)
     q = q_ref[0].astype(jnp.float32) * sm_scale            # [bq, D]
     nk = seq_len // block_k
-    hi = jnp.where(causal, (qi * block_q) // block_k + 1, nk) if causal else nk
+    hi = (qi * block_q) // block_k + 1 if causal else nk
 
     def body(j, carry):
         m, l, acc = carry
@@ -120,7 +120,7 @@ def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref, *,
     lse = jnp.max(lse_ref[0], axis=-1)      # lanes are identical copies
     delta = jnp.max(delta_ref[0], axis=-1)
     nk = seq_len // block_k
-    hi = jnp.where(causal, (qi * block_q) // block_k + 1, nk) if causal else nk
+    hi = (qi * block_q) // block_k + 1 if causal else nk
 
     def body(j, dq):
         kb = k_ref[0, pl.ds(j * block_k, block_k), :].astype(jnp.float32)

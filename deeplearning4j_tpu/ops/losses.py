@@ -91,7 +91,10 @@ def compute_loss(name, labels, output, mask=None, *, logits=None):
         if (labels.ndim == logp.ndim - 1
                 and jnp.issubdtype(labels.dtype, jnp.integer)):
             # sparse integer class labels [...,]: a gather instead of the
-            # one-hot elementwise product — O(N) HBM traffic, not O(N*V)
+            # one-hot elementwise product — O(N) HBM traffic, not O(N*V).
+            # NOTE: XLA clamps out-of-range indices, so labels must be in
+            # [0, C); there is no -1 ignore-index convention — mask ignored
+            # positions with labels_mask instead.
             per = -jnp.take_along_axis(logp, labels[..., None],
                                        axis=-1)[..., 0]
         else:

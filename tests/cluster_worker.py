@@ -1,0 +1,103 @@
+"""Standalone elastic-worker process used by tests/test_cluster.py.
+
+Run: python tests/cluster_worker.py <address> <worker_id> <shard 0|1>
+         <checkpoint_path|-> <crash_after_n_syncs|none> [local_mesh_devices]
+
+With local_mesh_devices > 0 the worker also shards its OWN batches over a
+virtual CPU mesh (in-process allreduce DP) — the 2-process x 4-device
+hierarchical topology of SURVEY.md §4.5: XLA collectives inside each
+process, coordinator averaging across processes.
+
+Also imported by the test for the shared net/data definitions, so the
+multi-process run and the single-process reference use identical configs.
+"""
+
+import sys
+
+import numpy as np
+
+from deeplearning4j_tpu.datasets.api import DataSet
+from deeplearning4j_tpu.nn.conf import (
+    DenseLayer,
+    NeuralNetConfiguration,
+    OutputLayer,
+)
+from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+
+N, F, C, STEPS = 32, 6, 3, 6
+
+
+def build_net() -> MultiLayerNetwork:
+    conf = (
+        NeuralNetConfiguration.builder()
+        .seed(7)
+        .learning_rate(0.1)
+        .updater("sgd")
+        .list()
+        .layer(DenseLayer(n_in=F, n_out=8, activation="tanh"))
+        .layer(OutputLayer(n_in=8, n_out=C, activation="softmax",
+                           loss_function="mcxent"))
+        .build()
+    )
+    return MultiLayerNetwork(conf)
+
+
+def full_data():
+    rng = np.random.default_rng(0)
+    x = rng.random((N, F), dtype=np.float32)
+    y = np.eye(C, dtype=np.float32)[rng.integers(0, C, N)]
+    return x, y
+
+
+def shard_batches(shard: str):
+    x, y = full_data()
+    half = N // 2
+    lo, hi = (0, half) if shard == "0" else (half, N)
+    return [DataSet(x[lo:hi], y[lo:hi])] * STEPS
+
+
+def main() -> int:
+    address, wid, shard, ckpt, crash_at = sys.argv[1:6]
+    local_mesh = int(sys.argv[6]) if len(sys.argv) > 6 else 0
+    ckpt = None if ckpt == "-" else ckpt
+    if local_mesh:
+        from deeplearning4j_tpu.util.virtual_devices import ensure_cpu_devices
+
+        ensure_cpu_devices(local_mesh)
+
+    from deeplearning4j_tpu.parallel.cluster import (
+        ClusterClient,
+        run_elastic_worker,
+    )
+
+    if crash_at != "none":
+        # simulated process failure after N averaging rounds
+        n = int(crash_at)
+        orig = ClusterClient.average
+        calls = [0]
+
+        def avg(self, step, flat):
+            calls[0] += 1
+            if calls[0] > n:
+                import os
+
+                os._exit(1)
+            return orig(self, step, flat)
+
+        ClusterClient.average = avg
+
+    net = build_net()
+    net.init()
+    if local_mesh:
+        from deeplearning4j_tpu.parallel.mesh import make_mesh
+
+        net.set_mesh(make_mesh({"data": local_mesh}))
+    net = run_elastic_worker(address, wid, net, shard_batches(shard),
+                             sync_every=1, checkpoint_path=ckpt)
+    out = (ckpt or f"/tmp/{wid}") + ".params.npy"
+    np.save(out, np.asarray(net.params_flat()))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,180 @@
+"""Multi-process control plane (parallel/cluster.py): registration,
+heartbeat dead-worker removal, config registry, averaging rounds, and the
+elastic training loop — including true multi-PROCESS training parity with
+single-process full-batch SGD and a kill-one-worker-and-resume recovery
+test (SURVEY.md §4.5; reference MasterActor heartbeat semantics)."""
+
+import os
+import subprocess
+import sys
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.parallel.cluster import (
+    ClusterClient,
+    ClusterCoordinator,
+)
+
+
+@pytest.fixture()
+def coord():
+    c = ClusterCoordinator(heartbeat_timeout=2.0).start()
+    yield c
+    c.shutdown()
+
+
+def test_register_ranks_and_config(coord):
+    a = ClusterClient(coord.address, "wA")
+    b = ClusterClient(coord.address, "wB")
+    assert {a.rank, b.rank} == {0, 1}
+    assert a.workers() == ["wA", "wB"]
+    a.set_config("training", {"lr": 0.1, "layers": [4, 3]})
+    assert b.get_config("training") == {"lr": 0.1, "layers": [4, 3]}
+    with pytest.raises(RuntimeError):
+        b.get_config("missing")
+    a.close()
+    b.close()
+
+
+def test_dead_worker_removed_after_heartbeat_timeout(coord):
+    a = ClusterClient(coord.address, "wA", heartbeat_interval=0.2)
+    b = ClusterClient(coord.address, "wB", heartbeat_interval=0.2)
+    assert sorted(coord.alive_workers()) == ["wA", "wB"]
+    b._hb_stop.set()  # b stops heartbeating (simulated crash)
+    time.sleep(2.5)
+    assert sorted(coord.alive_workers()) == ["wA"]
+    a.close()
+
+
+def test_average_round_means_contributions(coord):
+    a = ClusterClient(coord.address, "wA")
+    b = ClusterClient(coord.address, "wB")
+    out = {}
+
+    def go(client, vec):
+        out[client.worker_id] = client.average(1, np.asarray(vec, np.float32))
+
+    ta = threading.Thread(target=go, args=(a, [1.0, 3.0]))
+    tb = threading.Thread(target=go, args=(b, [3.0, 5.0]))
+    ta.start(); tb.start(); ta.join(); tb.join()
+    np.testing.assert_allclose(out["wA"], [2.0, 4.0])
+    np.testing.assert_allclose(out["wB"], [2.0, 4.0])
+    a.close()
+    b.close()
+
+
+def test_average_completes_elastically_when_worker_dies(coord):
+    a = ClusterClient(coord.address, "wA", heartbeat_interval=0.2)
+    b = ClusterClient(coord.address, "wB", heartbeat_interval=0.2)
+    b._hb_stop.set()  # b will be declared dead mid-round
+    result = {}
+
+    def go():
+        result["avg"] = a.average(5, np.asarray([2.0, 2.0], np.float32))
+
+    t = threading.Thread(target=go)
+    t.start()
+    t.join(timeout=15)
+    assert not t.is_alive(), "round never completed after worker death"
+    np.testing.assert_allclose(result["avg"], [2.0, 2.0])
+    a.close()
+
+
+# --------------------------------------------------------------- processes
+
+def _spawn(address, wid, shard, ckpt="-", crash_at="none", local_mesh=0):
+    env = dict(os.environ)
+    env["PYTHONPATH"] = "/root/repo"
+    env["JAX_PLATFORMS"] = "cpu"
+    env.pop("XLA_FLAGS", None)
+    return subprocess.Popen(
+        [sys.executable, "tests/cluster_worker.py", address, wid, shard,
+         ckpt, crash_at, str(local_mesh)], env=env, cwd="/root/repo",
+        stdout=subprocess.PIPE, stderr=subprocess.PIPE)
+
+
+def test_two_process_training_matches_single_process(tmp_path):
+    """2 workers x half batch with per-step averaging == 1 process x full
+    batch, for plain SGD (gradient linearity). True multi-process CPU run
+    (SURVEY.md §4.5)."""
+    from tests.cluster_worker import STEPS, build_net, full_data
+    from deeplearning4j_tpu.datasets.api import DataSet
+
+    coord = ClusterCoordinator(heartbeat_timeout=30.0).start()
+    try:
+        pa = _spawn(coord.address, "w0", "0", ckpt=str(tmp_path / "w0.zip"))
+        pb = _spawn(coord.address, "w1", "1", ckpt=str(tmp_path / "w1.zip"))
+        for p in (pa, pb):
+            out, err = p.communicate(timeout=300)
+            assert p.returncode == 0, err.decode()[-2000:]
+    finally:
+        coord.shutdown()
+
+    flat0 = np.load(str(tmp_path / "w0.zip.params.npy"))
+    flat1 = np.load(str(tmp_path / "w1.zip.params.npy"))
+    np.testing.assert_allclose(flat0, flat1, atol=1e-6)  # synced replicas
+
+    # single-process reference: full batch, same config and seed
+    x, y = full_data()
+    ref = build_net().init()
+    for _ in range(STEPS):
+        ref.fit(DataSet(x, y))
+    np.testing.assert_allclose(flat0, np.asarray(ref.params_flat()),
+                               atol=5e-4)
+
+
+def test_kill_one_worker_then_resume_from_checkpoint(tmp_path):
+    """One worker crashes after 2 syncs; the survivor finishes its rounds
+    elastically; the crashed worker restarts from its checkpoint and
+    completes the remaining steps."""
+    coord = ClusterCoordinator(heartbeat_timeout=3.0).start()
+    ckpt = str(tmp_path / "w1.zip")
+    try:
+        pa = _spawn(coord.address, "w0", "0", ckpt=str(tmp_path / "w0.zip"))
+        pb = _spawn(coord.address, "w1", "1", ckpt=ckpt, crash_at="2")
+        out, err = pb.communicate(timeout=300)
+        assert pb.returncode == 1  # crashed as scripted
+        assert os.path.exists(ckpt), "no checkpoint before crash"
+        # survivor completes all rounds despite the death
+        out, err = pa.communicate(timeout=300)
+        assert pa.returncode == 0, err.decode()[-2000:]
+
+        # restart the crashed worker: resumes at the checkpointed step
+        pb2 = _spawn(coord.address, "w1", "1", ckpt=ckpt)
+        out, err = pb2.communicate(timeout=300)
+        assert pb2.returncode == 0, err.decode()[-2000:]
+        flat = np.load(ckpt + ".params.npy")
+        assert np.isfinite(flat).all()
+    finally:
+        coord.shutdown()
+
+
+def test_two_process_times_four_device_hierarchy(tmp_path):
+    """SURVEY.md §4.5 topology: 2 processes x 4 virtual devices each —
+    in-process XLA allreduce + cross-process coordinator averaging gives
+    the same result as plain 2-process training (gradient linearity)."""
+    coord = ClusterCoordinator(heartbeat_timeout=30.0).start()
+    try:
+        pa = _spawn(coord.address, "w0", "0", ckpt=str(tmp_path / "w0.zip"),
+                    local_mesh=4)
+        pb = _spawn(coord.address, "w1", "1", ckpt=str(tmp_path / "w1.zip"),
+                    local_mesh=4)
+        for p in (pa, pb):
+            out, err = p.communicate(timeout=300)
+            assert p.returncode == 0, err.decode()[-2000:]
+    finally:
+        coord.shutdown()
+
+    from tests.cluster_worker import STEPS, build_net, full_data
+    from deeplearning4j_tpu.datasets.api import DataSet
+
+    flat0 = np.load(str(tmp_path / "w0.zip.params.npy"))
+    x, y = full_data()
+    ref = build_net().init()
+    for _ in range(STEPS):
+        ref.fit(DataSet(x, y))
+    np.testing.assert_allclose(flat0, np.asarray(ref.params_flat()),
+                               atol=5e-4)

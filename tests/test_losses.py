@@ -1,0 +1,53 @@
+"""Loss functions (ops/losses.py) — the sparse integer-label mcxent path
+vs one-hot, with masks and through jax.grad (the transformer-LM hot path)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from deeplearning4j_tpu.ops.losses import compute_loss
+
+
+def _softmax_case(shape=(4, 6, 10), seed=0):
+    rng = np.random.default_rng(seed)
+    logits = jnp.asarray(rng.standard_normal(shape), jnp.float32)
+    out = jax.nn.softmax(logits, axis=-1)
+    idx = jnp.asarray(rng.integers(0, shape[-1], shape[:-1]), jnp.int32)
+    onehot = jnp.asarray(np.eye(shape[-1], dtype=np.float32)[np.asarray(idx)])
+    return logits, out, idx, onehot
+
+
+def test_sparse_labels_match_onehot():
+    logits, out, idx, onehot = _softmax_case()
+    a = compute_loss("mcxent", onehot, out, logits=logits)
+    b = compute_loss("mcxent", idx, out, logits=logits)
+    np.testing.assert_allclose(float(a), float(b), rtol=1e-6)
+
+
+def test_sparse_labels_match_onehot_without_logits():
+    _, out, idx, onehot = _softmax_case()
+    a = compute_loss("negativeloglikelihood", onehot, out)
+    b = compute_loss("negativeloglikelihood", idx, out)
+    np.testing.assert_allclose(float(a), float(b), rtol=1e-6)
+
+
+def test_sparse_labels_respect_mask():
+    logits, out, idx, onehot = _softmax_case()
+    mask = jnp.asarray(np.random.default_rng(1).integers(0, 2, idx.shape),
+                       jnp.float32)
+    a = compute_loss("mcxent", onehot, out, mask, logits=logits)
+    b = compute_loss("mcxent", idx, out, mask, logits=logits)
+    np.testing.assert_allclose(float(a), float(b), rtol=1e-6)
+
+
+def test_sparse_labels_gradient_matches_onehot():
+    logits, _, idx, onehot = _softmax_case(shape=(3, 8))
+
+    def loss_fn(lg, labels):
+        return compute_loss("mcxent", labels, jax.nn.softmax(lg, -1),
+                            logits=lg)
+
+    g_sparse = jax.grad(loss_fn)(logits, idx)
+    g_onehot = jax.grad(loss_fn)(logits, onehot)
+    np.testing.assert_allclose(np.asarray(g_sparse), np.asarray(g_onehot),
+                               atol=1e-6)

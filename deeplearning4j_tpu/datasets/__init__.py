@@ -13,3 +13,12 @@ from deeplearning4j_tpu.datasets.async_iterator import AsyncDataSetIterator  # n
 from deeplearning4j_tpu.datasets.mnist import MnistDataSetIterator  # noqa: F401
 from deeplearning4j_tpu.datasets.cifar import CifarDataSetIterator  # noqa: F401
 from deeplearning4j_tpu.datasets.iris import IrisDataSetIterator  # noqa: F401
+from deeplearning4j_tpu.datasets.lfw import LFWDataSetIterator  # noqa: F401
+from deeplearning4j_tpu.datasets.curves import (  # noqa: F401
+    CurvesDataFetcher,
+    CurvesDataSetIterator,
+)
+from deeplearning4j_tpu.datasets.image_records import (  # noqa: F401
+    ImageRecordReader,
+    ImageRecordReaderDataSetIterator,
+)

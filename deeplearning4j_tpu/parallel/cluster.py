@@ -82,10 +82,17 @@ class ClusterCoordinator:
     """
 
     def __init__(self, host: str = "127.0.0.1", port: int = 0,
-                 heartbeat_timeout: float = 10.0):
+                 heartbeat_timeout: float = 10.0,
+                 round_timeout: Optional[float] = None):
         self.heartbeat_timeout = heartbeat_timeout
+        # max wall time an averaging round waits for alive-but-silent
+        # workers before finishing without them (progress guarantee; a
+        # worker whose local step takes longer than this is misconfigured)
+        self.round_timeout = (round_timeout if round_timeout is not None
+                              else 6.0 * heartbeat_timeout)
         self._lock = threading.RLock()
         self._workers: Dict[str, dict] = {}
+        self._ranks: Dict[str, int] = {}  # stable across re-registration
         self._configs: Dict[str, dict] = {}
         self._next_rank = 0
         self._avg_rounds: Dict[int, _Round] = {}
@@ -136,15 +143,16 @@ class ClusterCoordinator:
         op = msg.get("op")
         if op == "register":
             with self._lock:
-                info = self._workers.get(msg["worker"])
-                if info is None:
-                    info = {"rank": self._next_rank,
-                            "last_seen": time.monotonic()}
+                wid = msg["worker"]
+                if wid not in self._ranks:
+                    self._ranks[wid] = self._next_rank
                     self._next_rank += 1
-                    self._workers[msg["worker"]] = info
-                info["last_seen"] = time.monotonic()
-                return {"ok": True, "rank": info["rank"],
-                        "n_workers": len(self._workers)}
+                self._workers[wid] = {"rank": self._ranks[wid],
+                                      "last_seen": time.monotonic()}
+                return {"ok": True, "rank": self._ranks[wid],
+                        "n_workers": len(self._workers),
+                        "heartbeat_timeout": self.heartbeat_timeout,
+                        "round_timeout": self.round_timeout}
         if op == "heartbeat":
             with self._lock:
                 if msg["worker"] in self._workers:
@@ -185,9 +193,11 @@ class ClusterCoordinator:
                 rnd.contributions[worker] = arr
                 if set(rnd.contributions) >= set(self.alive_workers()):
                     self._finish_round(rnd)
-        # elastic completion: if a contributor dies mid-round the timeout
-        # re-checks liveness and finishes with whoever remains
-        deadline = time.monotonic() + self.heartbeat_timeout * 2
+        # elastic completion: the liveness re-check finishes the round as
+        # soon as every still-alive worker has contributed (dead workers
+        # drop out via heartbeat expiry); round_timeout is the last-resort
+        # progress guarantee against alive-but-stuck contributors
+        deadline = time.monotonic() + self.round_timeout
         while not rnd.done.wait(timeout=0.05):
             with self._lock:
                 if not rnd.done.is_set() and (
@@ -218,7 +228,7 @@ class ClusterCoordinator:
             rnd.contributions[worker] = np.zeros(0)
             if set(rnd.contributions) >= set(self.alive_workers()):
                 rnd.done.set()
-        deadline = time.monotonic() + self.heartbeat_timeout * 2
+        deadline = time.monotonic() + self.round_timeout
         while not rnd.done.wait(timeout=0.05):
             with self._lock:
                 if (set(rnd.contributions) >= set(self.alive_workers())
@@ -241,7 +251,11 @@ class ClusterClient:
         self._lock = threading.Lock()
         self._sock = socket.create_connection(self.address, timeout=120)
         self._file = self._sock.makefile("r")
-        self.rank = self._call({"op": "register"})["rank"]
+        reply = self._call({"op": "register"})
+        self.rank = reply["rank"]
+        # a blocked average() waits up to the server's round_timeout; give
+        # the socket comfortable headroom beyond it
+        self._sock.settimeout(2.0 * reply.get("round_timeout", 60.0) + 60.0)
         self._hb_stop = threading.Event()
         self._hb = threading.Thread(
             target=self._heartbeat_loop, args=(heartbeat_interval,),
@@ -265,7 +279,13 @@ class ClusterClient:
             f = sock.makefile("r")
             while not self._hb_stop.wait(interval):
                 _send_json(sock, {"op": "heartbeat", "worker": self.worker_id})
-                _recv_json(f)
+                reply = _recv_json(f)
+                if not reply.get("ok"):
+                    # demoted after a transient stall: re-register (the
+                    # coordinator keeps ranks stable across re-registration)
+                    _send_json(sock, {"op": "register",
+                                      "worker": self.worker_id})
+                    _recv_json(f)
         except (OSError, ConnectionError):
             pass
 
@@ -318,8 +338,17 @@ def run_elastic_worker(address: str, worker_id: str, net, batches, *,
 
     start_step = 0
     if checkpoint_path and os.path.exists(checkpoint_path):
-        net = ModelSerializer.restore(checkpoint_path)
-        start_step = net.iteration_count
+        # copy the checkpoint's arrays into the CALLER's net so runtime
+        # configuration (mesh, listeners, custom optimizer) survives the
+        # restart — replacing the object would silently drop them
+        restored = ModelSerializer.restore(checkpoint_path)
+        if net.params is None:
+            net.init()
+        net.params = restored.params
+        net.opt_state = restored.opt_state
+        net.state = restored.state
+        net.iteration_count = restored.iteration_count
+        start_step = restored.iteration_count
     client = ClusterClient(address, worker_id)
     try:
         if net.params is None:

@@ -76,13 +76,19 @@ class ModelSerializer:
             }))
 
     @staticmethod
-    def restore(path):
-        """Restore either network kind (dispatches on stored metadata)."""
+    def restore(path, expected_kind=None):
+        """Restore either network kind (dispatches on stored metadata);
+        expected_kind rejects the other kind with a named error."""
         from deeplearning4j_tpu.nn.graph import ComputationGraph
         from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
 
         with zipfile.ZipFile(path, "r") as zf:
             meta = json.loads(zf.read("meta.json"))
+            if expected_kind is not None and meta["kind"] != expected_kind:
+                raise ValueError(
+                    f"{path} holds a {meta['kind']}, not a {expected_kind} "
+                    f"(reference restore{expected_kind} rejects the wrong "
+                    f"model kind)")
             conf = serde.from_json(zf.read("configuration.json").decode())
             if meta["kind"] == "ComputationGraph":
                 net = ComputationGraph(conf)
@@ -99,11 +105,10 @@ class ModelSerializer:
                 net.epoch_count = meta.get("epoch", 0)
         return net
 
-    # reference-parity aliases
     @staticmethod
     def restore_multi_layer_network(path):
-        return ModelSerializer.restore(path)
+        return ModelSerializer.restore(path, "MultiLayerNetwork")
 
     @staticmethod
     def restore_computation_graph(path):
-        return ModelSerializer.restore(path)
+        return ModelSerializer.restore(path, "ComputationGraph")

@@ -85,14 +85,17 @@ def test_average_completes_elastically_when_worker_dies(coord):
 
 # --------------------------------------------------------------- processes
 
+_REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
 def _spawn(address, wid, shard, ckpt="-", crash_at="none", local_mesh=0):
     env = dict(os.environ)
-    env["PYTHONPATH"] = "/root/repo"
+    env["PYTHONPATH"] = _REPO_ROOT
     env["JAX_PLATFORMS"] = "cpu"
     env.pop("XLA_FLAGS", None)
     return subprocess.Popen(
         [sys.executable, "tests/cluster_worker.py", address, wid, shard,
-         ckpt, crash_at, str(local_mesh)], env=env, cwd="/root/repo",
+         ckpt, crash_at, str(local_mesh)], env=env, cwd=_REPO_ROOT,
         stdout=subprocess.PIPE, stderr=subprocess.PIPE)
 
 

@@ -1,0 +1,170 @@
+"""Data pipeline: fetchers/iterators (MNIST/CIFAR/Iris/LFW/Curves), the
+image loader, and the image record reader (Canova bridge equivalent).
+Reference: datasets/fetchers + datasets/iterator/impl + util/ImageLoader."""
+
+import os
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.datasets import (
+    CifarDataSetIterator,
+    CurvesDataFetcher,
+    CurvesDataSetIterator,
+    ImageRecordReader,
+    ImageRecordReaderDataSetIterator,
+    IrisDataSetIterator,
+    LFWDataSetIterator,
+    MnistDataSetIterator,
+)
+from deeplearning4j_tpu.util.image_loader import ImageLoader, crop_to_square
+
+
+def test_mnist_iterator_shapes_and_epoch():
+    it = MnistDataSetIterator(batch_size=32, num_examples=96)
+    seen = 0
+    it.reset()
+    while it.has_next():
+        ds = it.next()
+        assert ds.features.shape[1] == 784
+        assert ds.labels.shape[1] == 10
+        assert 0.0 <= ds.features.min() and ds.features.max() <= 1.0
+        seen += ds.num_examples()
+    assert seen == 96
+    # one-hot labels
+    np.testing.assert_allclose(ds.labels.sum(-1), 1.0)
+
+
+def test_mnist_reshaped_images():
+    it = MnistDataSetIterator(batch_size=8, num_examples=8,
+                              reshape_images=True)
+    ds = it.next()
+    assert ds.features.shape == (8, 28, 28, 1)
+
+
+def test_cifar_iterator():
+    it = CifarDataSetIterator(batch_size=16, num_examples=32)
+    ds = it.next()
+    assert ds.features.shape == (16, 32, 32, 3)
+    assert ds.labels.shape == (16, 10)
+
+
+def test_iris_iterator_full_pass():
+    it = IrisDataSetIterator(batch_size=150)
+    ds = it.next()
+    assert ds.features.shape == (150, 4)
+    assert ds.labels.shape == (150, 3)
+    assert not it.has_next()
+
+
+def test_curves_fetcher_is_autoencoder_style():
+    f = CurvesDataFetcher(num_examples=12)
+    ds = f.fetch(5)
+    assert ds.features.shape == (5, 784)
+    np.testing.assert_allclose(ds.features, ds.labels)
+    it = CurvesDataSetIterator(batch_size=4, num_examples=12)
+    n = 0
+    it.reset()
+    while it.has_next():
+        n += it.next().num_examples()
+    assert n == 12
+
+
+def test_image_loader_round_trip(tmp_path):
+    rng = np.random.default_rng(0)
+    img = rng.random((20, 30, 3)).astype(np.float32)
+    for name in ("a.png", "a.ppm"):
+        path = str(tmp_path / name)
+        ImageLoader.save(img, path)
+        back = ImageLoader(channels=3).as_array(path)
+        assert back.shape == (20, 30, 3)
+        np.testing.assert_allclose(back, img, atol=1 / 255 + 1e-6)
+
+
+def test_image_loader_resize_and_grayscale(tmp_path):
+    img = np.zeros((16, 16, 3), np.float32)
+    img[:8] = 1.0
+    path = str(tmp_path / "half.png")
+    ImageLoader.save(img, path)
+    arr = ImageLoader(8, 8, channels=1).as_array(path)
+    assert arr.shape == (8, 8, 1)
+    assert arr[:3].mean() > 0.9 and arr[-3:].mean() < 0.1
+
+
+def test_crop_to_square():
+    arr = np.arange(6 * 4 * 1, dtype=np.float32).reshape(6, 4, 1)
+    sq = crop_to_square(arr)
+    assert sq.shape == (4, 4, 1)
+
+
+def test_image_record_reader_labels_from_directories(tmp_path):
+    rng = np.random.default_rng(1)
+    for label in ("cat", "dog"):
+        os.makedirs(tmp_path / label)
+        for i in range(3):
+            ImageLoader.save(rng.random((10, 10, 3)).astype(np.float32),
+                             str(tmp_path / label / f"{i}.png"))
+    rr = ImageRecordReader(str(tmp_path), 10, 10, 3)
+    assert rr.labels == ["cat", "dog"]
+    assert rr.num_examples() == 6
+    recs = list(rr)
+    assert recs[0][0].shape == (10, 10, 3)
+    assert {lbl for _, lbl in recs} == {0, 1}
+
+    it = ImageRecordReaderDataSetIterator(rr, batch_size=4, shuffle=True,
+                                          seed=7)
+    ds = it.next()
+    assert ds.features.shape == (4, 10, 10, 3)
+    assert ds.labels.shape == (4, 2)
+    assert it.total_outcomes() == 2
+
+
+def test_image_record_reader_empty_dir_raises(tmp_path):
+    os.makedirs(tmp_path / "empty_label")
+    with pytest.raises(IOError):
+        ImageRecordReader(str(tmp_path), 8, 8)
+
+
+def test_lfw_iterator_synthetic_corpus(tmp_path):
+    it = LFWDataSetIterator(batch_size=10, data_dir=str(tmp_path),
+                            image_size=16, n_people=4, images_per_person=5)
+    assert it.total_examples() == 20
+    assert len(it.get_labels()) == 4
+    ds = it.next()
+    assert ds.features.shape == (10, 16, 16, 3)
+    # second construction reuses the cached corpus (no regeneration)
+    it2 = LFWDataSetIterator(batch_size=5, data_dir=str(tmp_path),
+                             image_size=16)
+    assert it2.total_examples() == 20
+
+
+def test_lfw_trains_a_small_conv_net(tmp_path):
+    """End-to-end: LFW images -> conv net fit (the reference LFW example)."""
+    from deeplearning4j_tpu.nn.conf import (
+        ConvolutionLayer,
+        InputType,
+        NeuralNetConfiguration,
+        OutputLayer,
+        SubsamplingLayer,
+    )
+    from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+
+    it = LFWDataSetIterator(batch_size=8, data_dir=str(tmp_path),
+                            image_size=16, n_people=3, images_per_person=4)
+    conf = (
+        NeuralNetConfiguration.builder()
+        .seed(0)
+        .learning_rate(0.01)
+        .updater("adam")
+        .list()
+        .layer(ConvolutionLayer(n_out=4, kernel_size=(3, 3),
+                                convolution_mode="same", activation="relu"))
+        .layer(SubsamplingLayer(kernel_size=(2, 2), stride=(2, 2)))
+        .layer(OutputLayer(n_out=3, activation="softmax",
+                           loss_function="mcxent"))
+        .set_input_type(InputType.convolutional(16, 16, 3))
+        .build()
+    )
+    net = MultiLayerNetwork(conf).init()
+    net.fit(it, epochs=2)
+    assert np.isfinite(net.score_value)

@@ -1,0 +1,157 @@
+"""Early stopping end-to-end (earlystopping/core.py) — reference
+org.deeplearning4j.earlystopping: trainer loop, terminations, savers,
+score calculators, and the ComputationGraph variant."""
+
+import os
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.datasets.api import DataSet
+from deeplearning4j_tpu.datasets.iterators import ListDataSetIterator
+from deeplearning4j_tpu.earlystopping.core import (
+    BestScoreEpochTerminationCondition,
+    DataSetLossCalculator,
+    EarlyStoppingConfiguration,
+    EarlyStoppingGraphTrainer,
+    EarlyStoppingTrainer,
+    InMemoryModelSaver,
+    InvalidScoreIterationTerminationCondition,
+    LocalFileModelSaver,
+    MaxEpochsTerminationCondition,
+    MaxScoreIterationTerminationCondition,
+    ScoreImprovementEpochTerminationCondition,
+)
+from deeplearning4j_tpu.nn.conf import (
+    DenseLayer,
+    NeuralNetConfiguration,
+    OutputLayer,
+)
+from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+
+
+def _data(seed=0, n=64):
+    rng = np.random.default_rng(seed)
+    x = rng.random((n, 4), dtype=np.float32)
+    y = np.eye(2, dtype=np.float32)[(x.sum(-1) > 2.0).astype(int)]
+    return DataSet(x, y)
+
+
+def _net(seed=3):
+    conf = (
+        NeuralNetConfiguration.builder()
+        .seed(seed)
+        .learning_rate(0.1)
+        .updater("adam")
+        .list()
+        .layer(DenseLayer(n_in=4, n_out=12, activation="tanh"))
+        .layer(OutputLayer(n_in=12, n_out=2, activation="softmax",
+                           loss_function="mcxent"))
+        .build()
+    )
+    return MultiLayerNetwork(conf).init()
+
+
+def test_max_epochs_termination_and_best_model():
+    train = ListDataSetIterator([_data(0)])
+    val = ListDataSetIterator([_data(1)])
+    cfg = EarlyStoppingConfiguration(
+        score_calculator=DataSetLossCalculator(val),
+        epoch_terminations=[MaxEpochsTerminationCondition(5)],
+    )
+    result = EarlyStoppingTrainer(cfg, _net(), train).fit()
+    assert result.termination_reason == "EpochTermination"
+    assert result.termination_details == "MaxEpochsTerminationCondition"
+    assert result.total_epochs == 5
+    assert result.best_model is not None
+    assert 0 <= result.best_model_epoch < 5
+    # best model really is the argmin of the recorded validation scores
+    assert result.best_model_score == min(result.score_vs_epoch.values())
+    # restored best model must be usable
+    out = result.best_model.output(_data(1).features)
+    assert np.isfinite(np.asarray(out)).all()
+
+
+def test_score_improvement_termination_stops_early():
+    train = ListDataSetIterator([_data(0)])
+    cfg = EarlyStoppingConfiguration(
+        score_calculator=DataSetLossCalculator(ListDataSetIterator([_data(1)])),
+        epoch_terminations=[
+            ScoreImprovementEpochTerminationCondition(2, min_improvement=10.0),
+            MaxEpochsTerminationCondition(50),
+        ],
+    )
+    result = EarlyStoppingTrainer(cfg, _net(), train).fit()
+    # an improvement of 10.0/epoch is impossible -> patience fires quickly
+    assert result.termination_details == (
+        "ScoreImprovementEpochTerminationCondition")
+    assert result.total_epochs <= 4
+
+
+def test_best_score_termination():
+    train = ListDataSetIterator([_data(0)])
+    cfg = EarlyStoppingConfiguration(
+        score_calculator=DataSetLossCalculator(ListDataSetIterator([_data(0)])),
+        epoch_terminations=[BestScoreEpochTerminationCondition(1e9),
+                            MaxEpochsTerminationCondition(50)],
+    )
+    result = EarlyStoppingTrainer(cfg, _net(), train).fit()
+    assert result.termination_details == "BestScoreEpochTerminationCondition"
+    assert result.total_epochs == 1
+
+
+def test_iteration_termination_on_score_blowup():
+    train = ListDataSetIterator([_data(0)])
+    cfg = EarlyStoppingConfiguration(
+        score_calculator=DataSetLossCalculator(ListDataSetIterator([_data(0)])),
+        iteration_terminations=[MaxScoreIterationTerminationCondition(1e-9),
+                                InvalidScoreIterationTerminationCondition()],
+        epoch_terminations=[MaxEpochsTerminationCondition(50)],
+    )
+    result = EarlyStoppingTrainer(cfg, _net(), train).fit()
+    assert result.termination_reason == "IterationTermination"
+    assert result.total_epochs == 0
+
+
+def test_local_file_saver_round_trip(tmp_path):
+    train = ListDataSetIterator([_data(0)])
+    saver = LocalFileModelSaver(str(tmp_path))
+    cfg = EarlyStoppingConfiguration(
+        score_calculator=DataSetLossCalculator(ListDataSetIterator([_data(1)])),
+        model_saver=saver,
+        save_last_model=True,
+        epoch_terminations=[MaxEpochsTerminationCondition(3)],
+    )
+    result = EarlyStoppingTrainer(cfg, _net(), train).fit()
+    assert any(f.endswith(".zip") for f in os.listdir(tmp_path))
+    best = saver.get_best_model()
+    np.testing.assert_allclose(
+        np.asarray(best.params_flat()),
+        np.asarray(result.best_model.params_flat()), atol=1e-6)
+
+
+def test_graph_trainer_runs():
+    from deeplearning4j_tpu.nn.graph import ComputationGraph
+
+    g = (
+        NeuralNetConfiguration.builder()
+        .seed(1)
+        .learning_rate(0.05)
+        .updater("adam")
+        .graph_builder()
+        .add_inputs("in")
+    )
+    g.add_layer("h", DenseLayer(n_in=4, n_out=8, activation="relu"), "in")
+    g.add_layer("out", OutputLayer(n_in=8, n_out=2, activation="softmax",
+                                   loss_function="mcxent"), "h")
+    g.set_outputs("out")
+    net = ComputationGraph(g.build())
+    net.init()
+    cfg = EarlyStoppingConfiguration(
+        score_calculator=DataSetLossCalculator(ListDataSetIterator([_data(1)])),
+        epoch_terminations=[MaxEpochsTerminationCondition(3)],
+    )
+    result = EarlyStoppingGraphTrainer(cfg, net,
+                                       ListDataSetIterator([_data(0)])).fit()
+    assert result.total_epochs == 3
+    assert result.best_model is not None

@@ -1,0 +1,86 @@
+"""Model zoo build-and-train smoke tests (models/): LeNet-5, VGG-16,
+ResNet-20, Transformer-LM — the BASELINE.json benchmark configs must
+build, run one train step, and produce finite decreasing-capable losses."""
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.models.lenet import lenet5
+from deeplearning4j_tpu.models.resnet import resnet20
+from deeplearning4j_tpu.models.transformer import (
+    transformer_flops_per_token,
+    transformer_lm,
+)
+from deeplearning4j_tpu.models.vgg import vgg16
+
+
+def _img_batch(n, h, w, c, classes, seed=0):
+    rng = np.random.default_rng(seed)
+    x = rng.random((n, h, w, c), dtype=np.float32)
+    y = np.eye(classes, dtype=np.float32)[rng.integers(0, classes, n)]
+    return x, y
+
+
+def test_lenet_builds_and_fits():
+    net = lenet5()
+    net.init()
+    x, y = _img_batch(8, 28, 28, 1, 10)
+    net.fit(x, y)
+    first = net.score_value
+    net.fit(x, y)
+    net.fit(x, y)
+    assert np.isfinite(net.score_value)
+    assert net.score_value < first  # learns on a repeated batch
+    out = np.asarray(net.output(x))
+    assert out.shape == (8, 10)
+    np.testing.assert_allclose(out.sum(-1), 1.0, atol=1e-4)
+
+
+def test_vgg16_builds_and_steps():
+    net = vgg16()
+    net.init()
+    assert net.num_params() > 1_000_000  # a real VGG-16, not a stub
+    x, y = _img_batch(2, 32, 32, 3, 10)
+    net.fit(x, y)
+    assert np.isfinite(net.score_value)
+    out = np.asarray(net.output(x))
+    assert out.shape == (2, 10)
+
+
+def test_resnet20_builds_and_steps():
+    net = resnet20()
+    net.init()
+    x, y = _img_batch(4, 32, 32, 3, 10)
+    net.fit(x, y)
+    assert np.isfinite(net.score_value)
+    out = np.asarray(net.output(x))
+    assert out.shape == (4, 10)
+    # 20 weighted layers: conv0 + 9 blocks x 2 convs + fc
+    conv_names = [n for n in net.params if "conv" in n]
+    assert len(conv_names) >= 19
+
+
+def test_transformer_lm_builds_and_fits_sparse_and_onehot():
+    net = transformer_lm(vocab_size=50, d_model=32, n_heads=2, n_layers=2,
+                         d_ff=64, max_length=12)
+    net.init()
+    rng = np.random.default_rng(0)
+    toks = np.asarray(rng.integers(0, 50, (4, 12)), np.int32)
+    shifted = np.roll(toks, -1, 1)
+    # sparse integer labels (the bench path)
+    net.fit(toks, shifted)
+    sparse_score = net.score_value
+    # one-hot labels (the reference-parity path) give the same loss scale
+    net2 = transformer_lm(vocab_size=50, d_model=32, n_heads=2, n_layers=2,
+                          d_ff=64, max_length=12)
+    net2.init()
+    net2.fit(toks, np.eye(50, dtype=np.float32)[shifted])
+    assert np.isfinite(sparse_score) and np.isfinite(net2.score_value)
+    np.testing.assert_allclose(sparse_score, net2.score_value, rtol=1e-3)
+
+
+def test_transformer_flops_accounting():
+    fl = transformer_flops_per_token(10000, 256, 6, 1024, 512)
+    # 3x(fwd) with fwd = layers*(8d^2 + 4d*dff + 4Td) + 2dV
+    fwd = 6 * (8 * 256**2 + 4 * 256 * 1024 + 4 * 512 * 256) + 2 * 256 * 10000
+    assert fl == 3 * fwd

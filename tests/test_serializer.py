@@ -1,0 +1,125 @@
+"""ModelSerializer round-trips (util/model_serializer.py) — reference
+org.deeplearning4j.util.ModelSerializer: both network kinds, updater
+state, iteration counter, and retrain-after-restore."""
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.datasets.api import DataSet
+from deeplearning4j_tpu.nn.conf import (
+    DenseLayer,
+    GravesLSTM,
+    NeuralNetConfiguration,
+    OutputLayer,
+    RnnOutputLayer,
+)
+from deeplearning4j_tpu.nn.graph import ComputationGraph
+from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+from deeplearning4j_tpu.util.model_serializer import ModelSerializer
+
+
+def _mln():
+    conf = (
+        NeuralNetConfiguration.builder()
+        .seed(11)
+        .learning_rate(0.05)
+        .updater("adam")
+        .list()
+        .layer(DenseLayer(n_in=5, n_out=9, activation="relu"))
+        .layer(OutputLayer(n_in=9, n_out=3, activation="softmax",
+                           loss_function="mcxent"))
+        .build()
+    )
+    return MultiLayerNetwork(conf).init()
+
+
+def _cg():
+    g = (
+        NeuralNetConfiguration.builder()
+        .seed(5)
+        .learning_rate(0.05)
+        .updater("rmsprop")
+        .graph_builder()
+        .add_inputs("in")
+    )
+    g.add_layer("lstm", GravesLSTM(n_in=4, n_out=6, activation="tanh"), "in")
+    g.add_layer("out", RnnOutputLayer(n_in=6, n_out=2, activation="softmax",
+                                      loss_function="mcxent"), "lstm")
+    g.set_outputs("out")
+    return ComputationGraph(g.build())
+
+
+def _data(seed=0):
+    rng = np.random.default_rng(seed)
+    x = rng.random((16, 5), dtype=np.float32)
+    y = np.eye(3, dtype=np.float32)[rng.integers(0, 3, 16)]
+    return DataSet(x, y)
+
+
+def test_mln_round_trip_params_updater_and_step(tmp_path):
+    net = _mln()
+    net.fit(_data())
+    net.fit(_data(1))
+    path = str(tmp_path / "mln.zip")
+    ModelSerializer.write_model(net, path)
+    restored = ModelSerializer.restore(path)
+    assert isinstance(restored, MultiLayerNetwork)
+    assert restored.iteration_count == net.iteration_count
+    np.testing.assert_allclose(np.asarray(restored.params_flat()),
+                               np.asarray(net.params_flat()), atol=0)
+    x = _data(2).features
+    np.testing.assert_allclose(np.asarray(restored.output(x)),
+                               np.asarray(net.output(x)), atol=1e-6)
+
+
+def test_mln_restore_continues_training_identically(tmp_path):
+    """Updater state round-trips: training after restore == training the
+    original (the optimizer moments must survive serialization)."""
+    net = _mln()
+    net.fit(_data())
+    path = str(tmp_path / "mln.zip")
+    ModelSerializer.write_model(net, path)
+    restored = ModelSerializer.restore(path)
+    net.fit(_data(1))
+    restored.fit(_data(1))
+    np.testing.assert_allclose(np.asarray(restored.params_flat()),
+                               np.asarray(net.params_flat()), atol=1e-6)
+
+
+def test_mln_restore_without_updater(tmp_path):
+    net = _mln()
+    net.fit(_data())
+    path = str(tmp_path / "mln.zip")
+    ModelSerializer.write_model(net, path, save_updater=False)
+    restored = ModelSerializer.restore(path)
+    x = _data(2).features
+    np.testing.assert_allclose(np.asarray(restored.output(x)),
+                               np.asarray(net.output(x)), atol=1e-6)
+
+
+def test_cg_round_trip_with_rnn_state(tmp_path):
+    net = _cg()
+    net.init()
+    rng = np.random.default_rng(0)
+    x = rng.random((4, 7, 4), dtype=np.float32)
+    y = np.eye(2, dtype=np.float32)[rng.integers(0, 2, (4, 7))]
+    net.fit(x, y)
+    path = str(tmp_path / "cg.zip")
+    ModelSerializer.write_model(net, path)
+    restored = ModelSerializer.restore_computation_graph(path)
+    assert isinstance(restored, ComputationGraph)
+    np.testing.assert_allclose(np.asarray(restored.output(x)),
+                               np.asarray(net.output(x)), atol=1e-6)
+    # streaming inference works on the restored graph
+    step = restored.rnn_time_step(x[:, 0])
+    assert np.asarray(step).shape == (4, 2)
+
+
+def test_kind_specific_restores_reject_wrong_kind(tmp_path):
+    net = _mln()
+    path = str(tmp_path / "m.zip")
+    ModelSerializer.write_model(net, path)
+    with pytest.raises(ValueError):
+        ModelSerializer.restore_computation_graph(path)
+    assert isinstance(ModelSerializer.restore_multi_layer_network(path),
+                      MultiLayerNetwork)

@@ -26,7 +26,6 @@ def _rasterize_curve(rng: np.random.Generator, size: int) -> np.ndarray:
     t = np.linspace(0.0, 1.0, 6 * size)[:, None]
     b = ((1 - t) ** 3 * p[0] + 3 * (1 - t) ** 2 * t * p[1]
          + 3 * (1 - t) * t ** 2 * p[2] + t ** 3 * p[3])
-    img = np.zeros((size, size), np.float32)
     yy, xx = np.mgrid[0:size, 0:size].astype(np.float32)
     # soft gaussian stroke around sampled curve points (vectorized)
     d2 = ((yy[None] - b[:, 1, None, None]) ** 2
@@ -37,8 +36,6 @@ def _rasterize_curve(rng: np.random.Generator, size: int) -> np.ndarray:
 
 class CurvesDataFetcher:
     """Synthesizes the full curves split into memory once."""
-
-    NUM_EXAMPLES = 10000
 
     def __init__(self, num_examples: int = 2000, seed: int = 123):
         rng = np.random.default_rng(seed)

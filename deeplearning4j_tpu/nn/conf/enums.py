@@ -34,6 +34,7 @@ class Updater(StrEnum):
 class OptimizationAlgorithm(StrEnum):
     LINE_GRADIENT_DESCENT = "line_gradient_descent"
     CONJUGATE_GRADIENT = "conjugate_gradient"
+    HESSIAN_FREE = "hessian_free"
     LBFGS = "lbfgs"
     STOCHASTIC_GRADIENT_DESCENT = "stochastic_gradient_descent"
 

@@ -181,7 +181,16 @@ class Builder:
 
     # Explicit aliases matching reference naming
     def optimization_algo(self, v):
-        self._c.optimization_algo = _coerce_enum(v)
+        from deeplearning4j_tpu.nn.conf.enums import OptimizationAlgorithm
+
+        v = _coerce_enum(v)
+        try:
+            v = OptimizationAlgorithm(v)
+        except ValueError:
+            raise ValueError(
+                f"Unknown optimization algorithm {v!r}; one of "
+                f"{[a.value for a in OptimizationAlgorithm]}") from None
+        self._c.optimization_algo = str(v)
         return self
 
     def regularization(self, flag: bool):

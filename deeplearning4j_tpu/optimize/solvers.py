@@ -225,6 +225,8 @@ class BaseOptimizer:
                 break
             f_new_f = float(f_new)
             _, g_new = self.vg(x_new, *args)
+            # scores at x_old/x_new for subclasses (e.g. HF reduction ratio)
+            self._f_pair = (float(f), f_new_f)
             aux = self.update_aux(aux, x, x_new, g, g_new, d)
             x, old_f, f, g = x_new, float(f), f_new, g_new
             self.score_value = f_new_f
@@ -369,7 +371,90 @@ class StochasticGradientDescent(BaseOptimizer):
         return SolveResult(x, f, self.max_iterations, True)
 
 
+class HessianFree(BaseOptimizer):
+    """Hessian-free (truncated-Newton) optimization — reference
+    OptimizationAlgorithm.HESSIAN_FREE / StochasticHessianFree.java.
+
+    The reference builds Gauss-Newton products by hand through its layer
+    stack; here the curvature-vector product is one `jax.jvp` through the
+    gradient (the R-operator), so ANY loss works unchanged. Each outer
+    iteration runs damped conjugate gradient on
+        (H + lam*I) d = g
+    and line-searches along -d; lam adapts Levenberg-Marquardt style from
+    the reduction ratio (Martens 2010, the algorithm the reference's
+    StochasticHessianFree implements).
+    """
+
+    def __init__(self, loss_f, max_iterations=10, cg_iterations=32,
+                 initial_lambda=1.0, **kw):
+        super().__init__(loss_f, max_iterations, **kw)
+        self.cg_iterations = cg_iterations
+        self.lam = float(initial_lambda)
+
+        def hvp(x, v, *args):
+            return jax.jvp(lambda z: jax.grad(loss_f)(z, *args), (x,), (v,))[1]
+
+        @partial(jax.jit, static_argnames=("iters",))
+        def cg_solve(x, g, lam, *args, iters):
+            def A(v):
+                return hvp(x, v, *args) + lam * v
+
+            d0 = jnp.zeros_like(g)
+            r0 = g  # residual of A d = g at d = 0
+            p0 = r0
+
+            def body(carry, _):
+                d, r, p, rs = carry
+                Ap = A(p)
+                denom = jnp.vdot(p, Ap)
+                alpha = jnp.where(denom > 1e-20, rs / denom, 0.0)
+                d = d + alpha * p
+                r = r - alpha * Ap
+                rs_new = jnp.vdot(r, r)
+                beta = jnp.where(rs > 1e-20, rs_new / rs, 0.0)
+                p = r + beta * p
+                return (d, r, p, rs_new), None
+
+            (d, _, _, _), _ = jax.lax.scan(
+                body, (d0, r0, p0, jnp.vdot(r0, r0)), None, length=iters)
+            return d
+
+        self._cg_solve = cg_solve
+        self._hvp = jax.jit(hvp)
+
+    def direction(self, x, g, aux):
+        self._last_args = getattr(self, "_opt_args", ())
+        d = self._cg_solve(x, g, self.lam, *self._last_args,
+                           iters=self.cg_iterations)
+        # fall back to the gradient when CG fails to produce a descent dir
+        ok = jnp.isfinite(d).all() & (jnp.vdot(g, d) > 0)
+        d = jnp.where(ok, d, g)
+        return d, aux
+
+    def update_aux(self, aux, x_old, x_new, g_old, g_new, d_used):
+        # Levenberg-Marquardt lambda adaptation from the reduction ratio
+        args = self._last_args
+        delta = x_new - x_old
+        Hd = self._hvp(x_old, delta, *args)
+        model_change = float(jnp.vdot(g_old, delta)
+                             + 0.5 * jnp.vdot(delta, Hd))
+        f_old, f_new = self._f_pair  # scores the optimize loop already has
+        actual = f_new - f_old
+        if model_change < 0:
+            rho = actual / model_change
+            if rho > 0.75:
+                self.lam *= 2.0 / 3.0
+            elif rho < 0.25:
+                self.lam *= 1.5
+        return aux
+
+    def optimize(self, x0, *args):
+        self._opt_args = args
+        return super().optimize(x0, *args)
+
+
 _OPTIMIZERS = {
+    OptimizationAlgorithm.HESSIAN_FREE: HessianFree,
     OptimizationAlgorithm.STOCHASTIC_GRADIENT_DESCENT: StochasticGradientDescent,
     OptimizationAlgorithm.LINE_GRADIENT_DESCENT: LineGradientDescent,
     OptimizationAlgorithm.CONJUGATE_GRADIENT: ConjugateGradient,

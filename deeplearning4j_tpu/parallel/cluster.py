@@ -280,9 +280,11 @@ class ClusterClient:
             while not self._hb_stop.wait(interval):
                 _send_json(sock, {"op": "heartbeat", "worker": self.worker_id})
                 reply = _recv_json(f)
-                if not reply.get("ok"):
+                if not reply.get("ok") and not self._hb_stop.is_set():
                     # demoted after a transient stall: re-register (the
-                    # coordinator keeps ranks stable across re-registration)
+                    # coordinator keeps ranks stable across re-registration).
+                    # The _hb_stop guard avoids re-registering a worker whose
+                    # close() already deregistered it (in-flight heartbeat).
                     _send_json(sock, {"op": "register",
                                       "worker": self.worker_id})
                     _recv_json(f)
@@ -344,6 +346,15 @@ def run_elastic_worker(address: str, worker_id: str, net, batches, *,
         restored = ModelSerializer.restore(checkpoint_path)
         if net.params is None:
             net.init()
+        import jax
+
+        if (jax.tree.structure(restored.params) != jax.tree.structure(net.params)
+                or [l.shape for l in jax.tree.leaves(restored.params)]
+                != [l.shape for l in jax.tree.leaves(net.params)]):
+            raise ValueError(
+                f"checkpoint {checkpoint_path} holds a different architecture "
+                "than the worker's net — delete the stale checkpoint or pass "
+                "the matching configuration")
         net.params = restored.params
         net.opt_state = restored.opt_state
         net.state = restored.state

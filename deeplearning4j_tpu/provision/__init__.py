@@ -1,0 +1,7 @@
+"""Cluster provisioning (reference deeplearning4j-aws → TPU-VM)."""
+
+from deeplearning4j_tpu.provision.tpu_vm import (  # noqa: F401
+    TpuPodLauncher,
+    TpuVmCreator,
+    bootstrap_script,
+)

@@ -1,0 +1,134 @@
+"""TPU-VM provisioning — the reference's AWS module mapped to Cloud TPU.
+
+Reference: `deeplearning4j-aws/.../Ec2BoxCreator.java` (create/blockUntil
+running/terminate EC2 boxes) and `provision/install-deps.sh`-style
+bootstrap. The TPU equivalent provisions TPU-VM pod slices: this module
+generates the exact `gcloud compute tpus tpu-vm ...` invocations, the
+per-host bootstrap script, and the multi-host launch plan wired to
+`parallel.cluster.initialize_multihost` (jax.distributed). It builds
+COMMANDS and SCRIPTS rather than calling cloud APIs directly — the
+environment has no egress and no cloud credentials, and emitting the plan
+keeps it auditable and dry-runnable (`--dry-run` prints what would run).
+"""
+
+from __future__ import annotations
+
+import shlex
+from dataclasses import dataclass, field
+from typing import List, Optional
+
+
+@dataclass
+class TpuVmCreator:
+    """Ec2BoxCreator equivalent: lifecycle commands for one TPU VM/slice.
+
+    accelerator_type: e.g. 'v5litepod-8' (one host) or 'v5litepod-256'
+    (multi-host pod slice). runtime_version: the TPU software image.
+    """
+
+    name: str
+    zone: str = "us-central1-a"
+    accelerator_type: str = "v5litepod-8"
+    runtime_version: str = "v2-alpha-tpuv5-lite"
+    project: Optional[str] = None
+    preemptible: bool = False
+    labels: dict = field(default_factory=dict)
+
+    def _base(self) -> List[str]:
+        cmd = ["gcloud", "compute", "tpus", "tpu-vm"]
+        return cmd
+
+    def _scope(self) -> List[str]:
+        out = ["--zone", self.zone]
+        if self.project:
+            out += ["--project", self.project]
+        return out
+
+    # ------------------------------------------------------------ lifecycle
+    def create_command(self) -> List[str]:
+        cmd = self._base() + ["create", self.name] + self._scope() + [
+            "--accelerator-type", self.accelerator_type,
+            "--version", self.runtime_version,
+        ]
+        if self.preemptible:
+            cmd.append("--preemptible")
+        if self.labels:
+            cmd += ["--labels",
+                    ",".join(f"{k}={v}" for k, v in sorted(self.labels.items()))]
+        return cmd
+
+    def delete_command(self) -> List[str]:
+        return self._base() + ["delete", self.name, "--quiet"] + self._scope()
+
+    def describe_command(self) -> List[str]:
+        return self._base() + ["describe", self.name] + self._scope()
+
+    def ssh_command(self, remote_command: str,
+                    worker: str = "all") -> List[str]:
+        return self._base() + ["ssh", self.name] + self._scope() + [
+            "--worker", worker, "--command", remote_command]
+
+    def scp_command(self, local_path: str, remote_path: str,
+                    worker: str = "all") -> List[str]:
+        return self._base() + ["scp", local_path,
+                               f"{self.name}:{remote_path}"] + self._scope() + [
+            "--worker", worker]
+
+    def num_hosts(self) -> int:
+        """Hosts in the slice (chips/4 for v4/v5 TPU-VM topologies)."""
+        chips = int(self.accelerator_type.rsplit("-", 1)[1])
+        return max(1, chips // (8 if "lite" in self.accelerator_type else 4))
+
+
+def bootstrap_script(package_source: str = "deeplearning4j_tpu",
+                     extra_env: Optional[dict] = None) -> str:
+    """Per-host bootstrap (the reference's provisioning shell): install the
+    framework and leave a marker. jax[tpu] ships preinstalled on TPU-VM
+    runtime images, so only the framework itself is installed."""
+    env_lines = "\n".join(
+        f"echo 'export {k}={shlex.quote(str(v))}' >> ~/.profile"
+        for k, v in (extra_env or {}).items())
+    return f"""#!/usr/bin/env bash
+set -euo pipefail
+python3 -m pip install --upgrade pip
+python3 -m pip install {shlex.quote(package_source)}
+{env_lines}
+python3 -c "import deeplearning4j_tpu, jax; print('ok', jax.device_count())"
+touch ~/.deeplearning4j_tpu_provisioned
+"""
+
+
+class TpuPodLauncher:
+    """Multi-host launch plan: bootstrap every host, then start the same
+    training entrypoint on each with jax.distributed coordinates (the
+    reference's master/worker actor bootstrap, minus Akka).
+
+    Process 0's host doubles as the jax.distributed coordinator; the
+    training entrypoint calls `parallel.cluster.initialize_multihost`
+    with the env vars this launcher sets.
+    """
+
+    COORD_PORT = 8476
+
+    def __init__(self, creator: TpuVmCreator):
+        self.creator = creator
+
+    def launch_commands(self, train_command: str) -> List[List[str]]:
+        """One ssh invocation per host; `gcloud --worker=all` broadcasts,
+        so the env-parameterized form needs only one command."""
+        n = self.creator.num_hosts()
+        remote = (
+            f"export DL4J_TPU_COORDINATOR="
+            f"$(hostname -i):{self.COORD_PORT} DL4J_TPU_NUM_PROCESSES={n}; "
+            f"{train_command}")
+        return [self.creator.ssh_command(remote, worker="all")]
+
+    def plan(self, train_command: str,
+             package_source: str = "deeplearning4j_tpu") -> List[str]:
+        """Full ordered dry-run plan as printable shell lines."""
+        steps = [self.creator.create_command()]
+        steps.append(self.creator.ssh_command(
+            bootstrap_script(package_source).replace("\n", "; ").strip(),
+            worker="all"))
+        steps += self.launch_commands(train_command)
+        return [" ".join(shlex.quote(part) for part in cmd) for cmd in steps]

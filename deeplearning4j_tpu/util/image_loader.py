@@ -44,6 +44,10 @@ def _read_pnm(path: str) -> np.ndarray:
             fields.append(data[i:j])
             i = j
     magic, w, h, maxval = fields[0], int(fields[1]), int(fields[2]), int(fields[3])
+    if maxval != 255:
+        raise ValueError(
+            f"unsupported PNM maxval {maxval} in {path} (only 8-bit, "
+            f"maxval 255, is supported)")
     i += 1  # single whitespace after maxval
     if magic == b"P6":
         arr = np.frombuffer(data, np.uint8, count=w * h * 3, offset=i)
@@ -71,6 +75,9 @@ class ImageLoader:
                  width: Optional[int] = None, channels: int = 3):
         if channels not in (1, 3):
             raise ValueError("channels must be 1 or 3")
+        if (height is None) != (width is None):
+            raise ValueError("height and width must be set together "
+                             "(or both omitted for no resize)")
         self.height = height
         self.width = width
         self.channels = channels

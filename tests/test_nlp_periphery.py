@@ -1,0 +1,97 @@
+"""NLP periphery (nlp/sentiment.py) and TPU-VM provisioning (provision/)
+— reference SWN3.java, UIMA PoStagger, deeplearning4j-aws Ec2BoxCreator."""
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.nlp.sentiment import (
+    PosAwareTokenizerFactory,
+    SentiWordNet,
+    pos_tag,
+)
+from deeplearning4j_tpu.provision import (
+    TpuPodLauncher,
+    TpuVmCreator,
+    bootstrap_script,
+)
+
+
+def test_seed_lexicon_classification():
+    swn = SentiWordNet()
+    assert swn.classify("excellent") == "strong_positive"
+    assert swn.classify("terrible") == "strong_negative"
+    assert swn.classify("unknownword") == "neutral"
+    assert swn.classify_score(0.3) == "positive"
+    assert swn.classify_score(-0.3) == "negative"
+    assert swn.classify_score(0.1) == "weak_positive"
+
+
+def test_swn_tsv_parse_rank_weighting(tmp_path):
+    # two senses of 'cool': rank 1 strongly positive, rank 2 neutral ->
+    # 1/rank weighting pulls the aggregate toward the first sense
+    p = tmp_path / "swn.txt"
+    p.write_text("# SentiWordNet\n"
+                 "a\t1\t0.75\t0.0\tcool#1\n"
+                 "a\t2\t0.0\t0.0\tcool#2\n"
+                 "v\t3\t0.0\t0.5\tstink#1\n")
+    swn = SentiWordNet(str(p))
+    expected = (0.75 / 1 + 0.0 / 2) / (1 + 0.5)
+    assert abs(swn.extract("cool", "a") - expected) < 1e-9
+    assert swn.extract("stink", "v") == -0.5
+
+
+def test_pos_tagger_rules():
+    tagged = dict(pos_tag(["the", "dog", "ran", "quickly", "is", "happiness"]))
+    assert tagged["the"] == "d"
+    assert tagged["quickly"] == "r"
+    assert tagged["is"] == "v"
+    assert tagged["happiness"] == "n"
+    assert tagged["dog"] == "n"  # default
+
+
+def test_sentence_scoring_pipeline():
+    swn = SentiWordNet()
+    good = swn.score_tokens(pos_tag("a wonderful great movie".split()))
+    bad = swn.score_tokens(pos_tag("a terrible awful movie".split()))
+    assert good > 0 > bad
+
+
+def test_pos_aware_tokenizer_factory_feeds_word2vec_keys():
+    tf = PosAwareTokenizerFactory()
+    toks = tf.create("The dog runs happily").get_tokens()
+    assert all("#" in t for t in toks)
+    assert "happily#r" in toks
+
+
+# ------------------------------------------------------------- provisioning
+
+def test_tpu_vm_lifecycle_commands():
+    c = TpuVmCreator("trainer", zone="us-east5-b",
+                     accelerator_type="v5litepod-16", project="proj",
+                     preemptible=True, labels={"team": "ml"})
+    create = c.create_command()
+    assert create[:5] == ["gcloud", "compute", "tpus", "tpu-vm", "create"]
+    assert "--accelerator-type" in create and "v5litepod-16" in create
+    assert "--preemptible" in create and "team=ml" in " ".join(create)
+    assert "delete" in c.delete_command()
+    ssh = c.ssh_command("echo hi", worker="0")
+    assert "--worker" in ssh and "echo hi" in ssh
+    assert c.num_hosts() == 2  # 16 chips / 8 per v5e host
+
+
+def test_bootstrap_script_contents():
+    script = bootstrap_script(extra_env={"JAX_PLATFORMS": "tpu"})
+    assert "pip install" in script
+    assert "deeplearning4j_tpu" in script
+    assert "JAX_PLATFORMS" in script
+    assert script.startswith("#!")
+
+
+def test_pod_launch_plan():
+    c = TpuVmCreator("pod", accelerator_type="v5litepod-256")
+    launcher = TpuPodLauncher(c)
+    plan = launcher.plan("python3 -m deeplearning4j_tpu.cli train --conf c.json")
+    assert len(plan) == 3  # create, bootstrap, launch
+    assert "create" in plan[0]
+    assert "DL4J_TPU_NUM_PROCESSES=32" in plan[2]  # 256/8 hosts
+    assert "deeplearning4j_tpu.cli" in plan[2]

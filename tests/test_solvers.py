@@ -98,3 +98,65 @@ def test_network_fit_with_solver(algo, rng):
     after = net.score(__import__("deeplearning4j_tpu.datasets.api",
                                  fromlist=["DataSet"]).DataSet(x, y))
     assert after < before
+
+
+def test_hessian_free_quadratic_one_shot():
+    """On a quadratic, damped-CG Newton reaches the optimum in ~1 outer
+    iteration (reference StochasticHessianFree semantics)."""
+    import jax.numpy as jnp
+    from deeplearning4j_tpu.optimize.solvers import HessianFree
+
+    A = jnp.asarray([[3.0, 0.5], [0.5, 1.0]])
+    b = jnp.asarray([1.0, -2.0])
+
+    def loss(x):
+        return 0.5 * x @ A @ x - b @ x
+
+    opt = HessianFree(loss, max_iterations=8, cg_iterations=16,
+                      initial_lambda=1e-3)
+    res = opt.optimize(jnp.zeros(2))
+    x_star = jnp.linalg.solve(A, b)
+    np.testing.assert_allclose(np.asarray(res.x), np.asarray(x_star),
+                               atol=1e-3)
+
+
+def test_hessian_free_rosenbrock_descends():
+    import jax.numpy as jnp
+    from deeplearning4j_tpu.optimize.solvers import HessianFree
+
+    def rosen(x):
+        return (1 - x[0]) ** 2 + 100.0 * (x[1] - x[0] ** 2) ** 2
+
+    opt = HessianFree(rosen, max_iterations=60, cg_iterations=20)
+    res = opt.optimize(jnp.asarray([-1.2, 1.0]))
+    assert res.score < 1e-2
+
+
+def test_network_fit_with_hessian_free():
+    from deeplearning4j_tpu.datasets.api import DataSet
+    from deeplearning4j_tpu.nn.conf import (
+        DenseLayer,
+        NeuralNetConfiguration,
+        OutputLayer,
+    )
+    from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+
+    rng = np.random.default_rng(0)
+    x = rng.random((32, 4), dtype=np.float32)
+    y = np.eye(2, dtype=np.float32)[(x.sum(-1) > 2).astype(int)]
+    conf = (
+        NeuralNetConfiguration.builder()
+        .seed(0)
+        .optimization_algo("hessian_free")
+        .iterations(12)
+        .list()
+        .layer(DenseLayer(n_in=4, n_out=8, activation="tanh"))
+        .layer(OutputLayer(n_in=8, n_out=2, activation="softmax",
+                           loss_function="mcxent"))
+        .build()
+    )
+    net = MultiLayerNetwork(conf).init()
+    s0 = float(net.score(DataSet(x, y)))
+    net.fit(x, y)
+    assert np.isfinite(net.score_value)
+    assert net.score_value < s0

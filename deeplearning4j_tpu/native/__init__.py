@@ -1,0 +1,148 @@
+"""ctypes bindings for the native IO core (io_core.cpp).
+
+Build model: the C++ source ships inside the package and is compiled ONCE
+per source-hash into `~/.cache/deeplearning4j_tpu/` with the system g++
+(`-O3 -shared -fPIC`) at first use — no pybind11/pip dependency, no build
+step at install time, and a missing toolchain simply means the Python
+fallbacks run (every caller treats `None` from these helpers as "use the
+Python path"). This mirrors the reference's split: Java front-end, native
+(libnd4j/canova) hot path — except our compute native layer is XLA and
+only host-side record parsing/corpus encoding lives here.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import hashlib
+import os
+import subprocess
+import threading
+from typing import List, Optional, Tuple
+
+import numpy as np
+
+_SRC = os.path.join(os.path.dirname(__file__), "io_core.cpp")
+_CACHE_DIR = os.path.expanduser("~/.cache/deeplearning4j_tpu")
+
+_lock = threading.Lock()
+_lib = None
+_lib_failed = False
+
+
+def _build() -> Optional[str]:
+    with open(_SRC, "rb") as f:
+        digest = hashlib.sha256(f.read()).hexdigest()[:16]
+    out = os.path.join(_CACHE_DIR, f"io_core-{digest}.so")
+    if os.path.exists(out):
+        return out
+    os.makedirs(_CACHE_DIR, exist_ok=True)
+    tmp = out + f".tmp{os.getpid()}"
+    try:
+        subprocess.run(
+            ["g++", "-O3", "-shared", "-fPIC", "-std=c++17", _SRC, "-o", tmp],
+            check=True, capture_output=True, timeout=120)
+        os.replace(tmp, out)
+        return out
+    except (OSError, subprocess.SubprocessError):
+        if os.path.exists(tmp):
+            os.unlink(tmp)
+        return None
+
+
+def get_lib():
+    """The loaded CDLL, or None when no toolchain is available."""
+    global _lib, _lib_failed
+    if _lib is not None or _lib_failed:
+        return _lib
+    with _lock:
+        if _lib is not None or _lib_failed:
+            return _lib
+        path = _build()
+        if path is None:
+            _lib_failed = True
+            return None
+        lib = ctypes.CDLL(path)
+        c_long_p = ctypes.POINTER(ctypes.c_long)
+        f32_p = np.ctypeslib.ndpointer(np.float32, flags="C_CONTIGUOUS")
+        i32_p = np.ctypeslib.ndpointer(np.int32, flags="C_CONTIGUOUS")
+        lib.dl4j_csv_dims.argtypes = [
+            ctypes.c_char_p, ctypes.c_long, ctypes.c_char, c_long_p, c_long_p]
+        lib.dl4j_csv_dims.restype = ctypes.c_long
+        lib.dl4j_parse_csv.argtypes = [
+            ctypes.c_char_p, ctypes.c_long, ctypes.c_char, f32_p,
+            ctypes.c_long, ctypes.c_long]
+        lib.dl4j_parse_csv.restype = ctypes.c_long
+        lib.dl4j_svmlight_rows.argtypes = [ctypes.c_char_p]
+        lib.dl4j_svmlight_rows.restype = ctypes.c_long
+        lib.dl4j_parse_svmlight.argtypes = [
+            ctypes.c_char_p, ctypes.c_long, f32_p, f32_p, ctypes.c_long]
+        lib.dl4j_parse_svmlight.restype = ctypes.c_long
+        lib.dl4j_encode_tokens.argtypes = [
+            ctypes.c_char_p, ctypes.c_long, ctypes.c_char_p, ctypes.c_long,
+            ctypes.c_long, i32_p, ctypes.c_long]
+        lib.dl4j_encode_tokens.restype = ctypes.c_long
+        _lib = lib
+        return _lib
+
+
+# ------------------------------------------------------------- public API
+
+def load_csv(path: str, skip_lines: int = 0,
+             delimiter: str = ",") -> Optional[np.ndarray]:
+    """Numeric CSV → float32 [rows, cols], or None (unavailable/non-numeric)."""
+    lib = get_lib()
+    if lib is None or len(delimiter) != 1:
+        return None
+    rows = ctypes.c_long()
+    cols = ctypes.c_long()
+    if lib.dl4j_csv_dims(path.encode(), skip_lines, delimiter.encode(),
+                         ctypes.byref(rows), ctypes.byref(cols)) != 0:
+        return None
+    if rows.value <= 0 or cols.value <= 0:
+        return None
+    out = np.empty((rows.value, cols.value), np.float32)
+    got = lib.dl4j_parse_csv(path.encode(), skip_lines, delimiter.encode(),
+                             out, rows.value, cols.value)
+    if got < 0:
+        return None  # non-numeric cell: caller falls back to Python parsing
+    return out[:got]
+
+
+def load_svmlight(path: str, num_features: int
+                  ) -> Optional[Tuple[np.ndarray, np.ndarray]]:
+    """SVMLight file → (labels [N], dense features [N, F]), or None."""
+    lib = get_lib()
+    if lib is None:
+        return None
+    n = lib.dl4j_svmlight_rows(path.encode())
+    if n < 0:
+        return None
+    labels = np.empty(n, np.float32)
+    feats = np.zeros((n, num_features), np.float32)
+    got = lib.dl4j_parse_svmlight(path.encode(), num_features, labels,
+                                  feats, n)
+    if got < 0:
+        return None
+    return labels[:got], feats[:got]
+
+
+def encode_tokens(text: str, vocab: List[str]) -> Optional[np.ndarray]:
+    """Whitespace-tokenize `text` and map tokens to vocab indices (-1 for
+    OOV) in one native pass — the corpus-indexing step of the word2vec
+    device pipeline. Returns int32 [n_tokens] or None."""
+    lib = get_lib()
+    if lib is None:
+        return None
+    data = text.encode()
+    blob = "\n".join(vocab).encode()
+    # upper bound on token count: every other byte a separator
+    out = np.empty(len(data) // 2 + 1, np.int32)
+    got = lib.dl4j_encode_tokens(data, len(data), blob, len(blob),
+                                 len(vocab), out, len(out))
+    if got < 0:
+        return None
+    return out[:got]
+
+
+def available() -> bool:
+    return get_lib() is not None

@@ -103,10 +103,21 @@ class SentiWordNet:
             return "weak_negative"
         return "neutral"
 
+    def extract_any(self, word: str, pos: str = "a") -> Optional[float]:
+        """Score for word#pos, falling back to the word's other POS senses
+        (the tagger is heuristic; a miss shouldn't zero the sentiment)."""
+        w = word.lower()
+        if f"{w}#{pos}" in self.scores:
+            return self.scores[f"{w}#{pos}"]
+        for alt in ("a", "n", "v", "r"):
+            if f"{w}#{alt}" in self.scores:
+                return self.scores[f"{w}#{alt}"]
+        return None
+
     def score_tokens(self, tagged: Iterable[Tuple[str, str]]) -> float:
         """Mean sentiment over (word, pos) pairs with a lexicon hit."""
-        hits = [self.extract(w, p) for w, p in tagged
-                if f"{w.lower()}#{p}" in self.scores]
+        hits = [v for v in (self.extract_any(w, p) for w, p in tagged)
+                if v is not None]
         return sum(hits) / len(hits) if hits else 0.0
 
 
@@ -121,6 +132,10 @@ _POS_LEXICON = {
     "could": "v", "not": "r", "very": "r", "really": "r", "quite": "r",
     "and": "c", "or": "c", "but": "c", "of": "p", "in": "p", "on": "p",
     "at": "p", "to": "p", "with": "p", "for": "p",
+    # common suffix-less adjectives (the seed lexicon keys these as #a)
+    "good": "a", "bad": "a", "great": "a", "nice": "a", "best": "a",
+    "worst": "a", "poor": "a", "sad": "a", "happy": "a", "cool": "a",
+    "new": "a", "old": "a", "big": "a", "small": "a", "fine": "a",
 }
 
 _SUFFIX_RULES: List[Tuple[str, str]] = [

@@ -288,7 +288,10 @@ class SequenceVectors:
         if self.vocab is None:
             if seq_list is None:
                 seq_list = [list(s) for s in sequences]
-            self.build_vocab(seq_list)
+            vocab_src = ([line.split() for line in seq_list]
+                         if seq_list and isinstance(seq_list[0], str)
+                         else seq_list)
+            self.build_vocab(vocab_src)
         corpus = seq_list if seq_list is not None else sequences
         if self.use_device_pipeline:
             return self._fit_device_pipeline(corpus)
@@ -343,7 +346,7 @@ class SequenceVectors:
                 # subsampling redraws per epoch (host rng, like the
                 # reference); without it the packed corpus is uploaded once
                 # and reused across epochs
-                idx_seqs = [self._sequence_indices(toks) for toks in corpus]
+                idx_seqs = self._corpus_indices(corpus)
                 tokens_np, sent_ids_np = pack_corpus(idx_seqs, per_update)
                 packed = (jnp.asarray(tokens_np), jnp.asarray(sent_ids_np))
             tokens, sent_ids = packed
@@ -360,6 +363,26 @@ class SequenceVectors:
             pairs = np.maximum(np.asarray(pairs), 1.0)
             self.loss_history.extend((ls / pairs).tolist())
         return self
+
+    def _corpus_indices(self, corpus):
+        """Corpus → per-sequence index arrays. Raw-string sentences go
+        through the native one-pass tokenize+hash encoder
+        (native.encode_tokens: whitespace split + vocab lookup in C++);
+        token lists (or subsampling>0, which needs the host rng) use the
+        Python path."""
+        if corpus and isinstance(corpus[0], str):
+            if self.sampling == 0:
+                from deeplearning4j_tpu import native
+
+                if native.available():
+                    words = self.vocab.words()  # index-ordered
+                    out = []
+                    for line in corpus:
+                        ids = native.encode_tokens(line, words)
+                        out.append(ids[ids >= 0])
+                    return out
+            corpus = [line.split() for line in corpus]
+        return [self._sequence_indices(toks) for toks in corpus]
 
     def _finalize_losses(self):
         """One deferred host sync for the whole run (see _flush_sg): stack

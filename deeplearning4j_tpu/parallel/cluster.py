@@ -389,6 +389,10 @@ def initialize_multihost(coordinator_address: Optional[str] = None,
                          local_device_ids=None) -> None:
     """Initialize jax's multi-host runtime for REAL TPU pod slices.
 
+    On Cloud TPU all arguments may be omitted: jax auto-detects the
+    coordinator, process count, and process id from the TPU metadata
+    server (this is how TpuPodLauncher's broadcast launch works).
+
     After this, `jax.devices()` spans all hosts and a Mesh over them makes
     jitted steps communicate over ICI/DCN via XLA collectives — the
     TPU-native replacement for the reference's Spark/Akka data plane. The

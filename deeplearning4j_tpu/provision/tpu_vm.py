@@ -13,6 +13,7 @@ keeps it auditable and dry-runnable (`--dry-run` prints what would run).
 
 from __future__ import annotations
 
+import base64
 import shlex
 from dataclasses import dataclass, field
 from typing import List, Optional
@@ -75,9 +76,19 @@ class TpuVmCreator:
             "--worker", worker]
 
     def num_hosts(self) -> int:
-        """Hosts in the slice (chips/4 for v4/v5 TPU-VM topologies)."""
-        chips = int(self.accelerator_type.rsplit("-", 1)[1])
-        return max(1, chips // (8 if "lite" in self.accelerator_type else 4))
+        """Hosts in the slice. The accelerator-type suffix counts CORES for
+        v2/v3 (8 cores per host) and CHIPS for v4/v5p (4 per host) and
+        v5e/v6e ('lite', 8 per host)."""
+        gen = self.accelerator_type.split("-")[0].lower()
+        n = int(self.accelerator_type.rsplit("-", 1)[1])
+        if "lite" in self.accelerator_type.lower() or gen in ("v5litepod",
+                                                              "v6e"):
+            per_host = 8   # chips per host
+        elif gen in ("v2", "v3"):
+            per_host = 8   # cores per host
+        else:
+            per_host = 4   # v4/v5p chips per host
+        return max(1, n // per_host)
 
 
 def bootstrap_script(package_source: str = "deeplearning4j_tpu",
@@ -108,27 +119,30 @@ class TpuPodLauncher:
     with the env vars this launcher sets.
     """
 
-    COORD_PORT = 8476
-
     def __init__(self, creator: TpuVmCreator):
         self.creator = creator
 
     def launch_commands(self, train_command: str) -> List[List[str]]:
-        """One ssh invocation per host; `gcloud --worker=all` broadcasts,
-        so the env-parameterized form needs only one command."""
+        """One broadcast ssh (`--worker=all`) running the training
+        entrypoint on every host. On Cloud TPU pod slices
+        `jax.distributed.initialize()` (and thus
+        `parallel.cluster.initialize_multihost()` with no arguments)
+        auto-detects coordinator address, process count, and process id
+        from the TPU metadata server — no per-host environment wiring is
+        needed or attempted here."""
         n = self.creator.num_hosts()
-        remote = (
-            f"export DL4J_TPU_COORDINATOR="
-            f"$(hostname -i):{self.COORD_PORT} DL4J_TPU_NUM_PROCESSES={n}; "
-            f"{train_command}")
+        remote = f"DL4J_TPU_EXPECTED_HOSTS={n} {train_command}"
         return [self.creator.ssh_command(remote, worker="all")]
 
     def plan(self, train_command: str,
              package_source: str = "deeplearning4j_tpu") -> List[str]:
         """Full ordered dry-run plan as printable shell lines."""
+        script = bootstrap_script(package_source)
+        # ship the multiline script intact: base64 through the ssh command
+        # (newline-folding would hide everything behind the shebang comment)
+        encoded = base64.b64encode(script.encode()).decode()
         steps = [self.creator.create_command()]
         steps.append(self.creator.ssh_command(
-            bootstrap_script(package_source).replace("\n", "; ").strip(),
-            worker="all"))
+            f"echo {encoded} | base64 -d | bash", worker="all"))
         steps += self.launch_commands(train_command)
         return [" ".join(shlex.quote(part) for part in cmd) for cmd in steps]

@@ -1,0 +1,134 @@
+"""Native IO core (deeplearning4j_tpu/native): build, parse correctness vs
+the Python paths, fallbacks, and the record-iterator fast path."""
+
+import csv
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu import native
+from deeplearning4j_tpu.datasets.records import (
+    CSVRecordReader,
+    RecordReaderDataSetIterator,
+    SVMLightRecordReader,
+)
+
+pytestmark = pytest.mark.skipif(not native.available(),
+                                reason="no C++ toolchain")
+
+
+@pytest.fixture()
+def csv_file(tmp_path):
+    rng = np.random.default_rng(0)
+    data = rng.random((64, 5)).astype(np.float32)
+    data[:, -1] = rng.integers(0, 3, 64)
+    p = tmp_path / "data.csv"
+    with open(p, "w") as f:
+        for row in data:
+            f.write(",".join(f"{v:.6f}" for v in row) + "\n")
+    return str(p), data
+
+
+def test_load_csv_matches_python(csv_file):
+    path, data = csv_file
+    arr = native.load_csv(path)
+    ref = np.asarray([[float(v) for v in row]
+                      for row in csv.reader(open(path))], np.float32)
+    np.testing.assert_allclose(arr, ref, rtol=1e-6)
+
+
+def test_load_csv_nonnumeric_returns_none(tmp_path):
+    p = tmp_path / "bad.csv"
+    p.write_text("1.0,hello,3\n")
+    assert native.load_csv(str(p)) is None
+
+
+def test_load_csv_skip_lines(tmp_path):
+    p = tmp_path / "h.csv"
+    p.write_text("colA,colB\n1,2\n3,4\n")
+    arr = native.load_csv(str(p), skip_lines=1)
+    np.testing.assert_allclose(arr, [[1, 2], [3, 4]])
+
+
+def test_load_svmlight(tmp_path):
+    p = tmp_path / "s.txt"
+    p.write_text("1 1:0.5 3:2.0\n# comment\n\n0 2:1.5\n")
+    labels, feats = native.load_svmlight(str(p), 4)
+    np.testing.assert_allclose(labels, [1, 0])
+    np.testing.assert_allclose(feats, [[0.5, 0, 2.0, 0], [0, 1.5, 0, 0]])
+
+
+def test_encode_tokens_matches_vocab_indices():
+    vocab = [f"w{i}" for i in range(5000)]
+    text = "w10 w4999 nope w0\n w17"
+    ids = native.encode_tokens(text, vocab)
+    assert ids.tolist() == [10, 4999, -1, 0, 17]
+
+
+def test_record_iterator_native_path_matches_python(csv_file):
+    path, data = csv_file
+    it = RecordReaderDataSetIterator(CSVRecordReader(path), batch_size=16,
+                                     num_classes=3)
+    assert it._matrix is not None  # fast path engaged
+    batches = []
+    it.reset()
+    while it.has_next():
+        batches.append(it.next())
+    x = np.concatenate([b.features for b in batches])
+    y = np.concatenate([b.labels for b in batches])
+    np.testing.assert_allclose(x, data[:, :-1], atol=1e-6)
+    np.testing.assert_allclose(y.argmax(-1), data[:, -1])
+
+
+def test_record_iterator_python_fallback_same_result(tmp_path, csv_file):
+    path, data = csv_file
+
+    class NoNative(CSVRecordReader):
+        def to_matrix(self):
+            return None
+
+    fast = RecordReaderDataSetIterator(CSVRecordReader(path), 16,
+                                       num_classes=3)
+    slow = RecordReaderDataSetIterator(NoNative(path), 16, num_classes=3)
+    assert slow._matrix is None
+    for _ in range(2):
+        a, b = fast.next(), slow.next()
+        np.testing.assert_allclose(a.features, b.features, atol=1e-6)
+        np.testing.assert_allclose(a.labels, b.labels)
+
+
+def test_svmlight_iterator_native_path(tmp_path):
+    p = tmp_path / "s.txt"
+    p.write_text("".join(f"{i % 2} 1:{i}.0 4:{i * 2}.5\n" for i in range(10)))
+    it = RecordReaderDataSetIterator(SVMLightRecordReader(str(p), 4),
+                                     batch_size=4, num_classes=2)
+    assert it._matrix is not None
+    ds = it.next()
+    assert ds.features.shape == (4, 4)
+    np.testing.assert_allclose(ds.features[1, 0], 1.0)
+
+
+def test_parse_csv_empty_cell_falls_back(tmp_path):
+    """An empty trailing cell must NOT steal the next line's value."""
+    p = tmp_path / "empty.csv"
+    p.write_text("1,2,\n4,5,6\n")
+    assert native.load_csv(str(p)) is None  # Python path handles/raises
+
+
+def test_parse_csv_ragged_lines_skipped_consistently(tmp_path):
+    p = tmp_path / "ragged.csv"
+    p.write_text("1,2,3\n9,9,9,9\n4,5,6\n")
+    arr = native.load_csv(str(p))
+    np.testing.assert_allclose(arr, [[1, 2, 3], [4, 5, 6]])
+
+
+def test_raw_string_corpus_uses_native_encoder():
+    from deeplearning4j_tpu.nlp.word2vec import Word2Vec
+
+    lines = ["a b a b a b"] * 30
+    w = (Word2Vec.builder().layer_size(8).window_size(2).min_word_frequency(1)
+         .negative_sample(2).epochs(1).seed(1).use_device_pipeline(True)
+         .build())
+    w.fit(lines)
+    assert w.vocab_size == 2
+    assert np.isfinite(w.loss_history).all()

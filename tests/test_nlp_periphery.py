@@ -88,10 +88,31 @@ def test_bootstrap_script_contents():
 
 
 def test_pod_launch_plan():
+    import base64
+
     c = TpuVmCreator("pod", accelerator_type="v5litepod-256")
     launcher = TpuPodLauncher(c)
     plan = launcher.plan("python3 -m deeplearning4j_tpu.cli train --conf c.json")
     assert len(plan) == 3  # create, bootstrap, launch
     assert "create" in plan[0]
-    assert "DL4J_TPU_NUM_PROCESSES=32" in plan[2]  # 256/8 hosts
+    # the bootstrap ships base64 (newline-folding would comment everything
+    # out behind the shebang) and decodes to the full script
+    assert "base64 -d | bash" in plan[1]
+    encoded = plan[1].split("echo ")[1].split(" |")[0]
+    decoded = base64.b64decode(encoded).decode()
+    assert "pip install" in decoded and decoded.startswith("#!")
+    assert "DL4J_TPU_EXPECTED_HOSTS=32" in plan[2]  # 256/8 hosts
     assert "deeplearning4j_tpu.cli" in plan[2]
+
+
+def test_num_hosts_per_generation():
+    assert TpuVmCreator("a", accelerator_type="v3-8").num_hosts() == 1
+    assert TpuVmCreator("a", accelerator_type="v3-32").num_hosts() == 4
+    assert TpuVmCreator("a", accelerator_type="v4-16").num_hosts() == 4
+    assert TpuVmCreator("a", accelerator_type="v5litepod-16").num_hosts() == 2
+
+
+def test_score_tokens_covers_suffixless_adjectives():
+    swn = SentiWordNet()
+    assert swn.score_tokens(pos_tag("a good movie".split())) > 0
+    assert swn.score_tokens(pos_tag("a bad movie".split())) < 0

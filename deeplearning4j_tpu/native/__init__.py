@@ -81,6 +81,10 @@ def get_lib():
             ctypes.c_char_p, ctypes.c_long, ctypes.c_char_p, ctypes.c_long,
             ctypes.c_long, i32_p, ctypes.c_long]
         lib.dl4j_encode_tokens.restype = ctypes.c_long
+        lib.dl4j_encode_corpus.argtypes = [
+            ctypes.c_char_p, ctypes.c_long, ctypes.c_char_p, ctypes.c_long,
+            ctypes.c_long, i32_p, i32_p, ctypes.c_long]
+        lib.dl4j_encode_corpus.restype = ctypes.c_long
         _lib = lib
         return _lib
 
@@ -142,6 +146,27 @@ def encode_tokens(text: str, vocab: List[str]) -> Optional[np.ndarray]:
     if got < 0:
         return None
     return out[:got]
+
+
+def encode_corpus(lines: List[str], vocab: List[str]
+                  ) -> Optional[Tuple[np.ndarray, np.ndarray]]:
+    """Encode a WHOLE corpus (one sentence per list entry) in one native
+    pass: builds the vocab hash table once and returns (token_ids,
+    sentence_ids), OOV as -1 — per-line encode_tokens calls would rebuild
+    the table per sentence."""
+    lib = get_lib()
+    if lib is None:
+        return None
+    data = "\n".join(lines).encode()
+    blob = "\n".join(vocab).encode()
+    cap = len(data) // 2 + 1
+    ids = np.empty(cap, np.int32)
+    sent = np.empty(cap, np.int32)
+    got = lib.dl4j_encode_corpus(data, len(data), blob, len(blob),
+                                 len(vocab), ids, sent, cap)
+    if got < 0:
+        return None
+    return ids[:got], sent[:got]
 
 
 def available() -> bool:

@@ -34,6 +34,10 @@ bool read_file(const char* path, std::string& out) {
     if (!f) return false;
     std::fseek(f, 0, SEEK_END);
     long n = std::ftell(f);
+    if (n < 0) {  // non-seekable (FIFO/device): not supported here
+        std::fclose(f);
+        return false;
+    }
     std::fseek(f, 0, SEEK_SET);
     out.resize(static_cast<size_t>(n));
     size_t got = n ? std::fread(&out[0], 1, static_cast<size_t>(n), f) : 0;
@@ -100,10 +104,10 @@ inline bool is_ws(char c) {
 
 }  // namespace
 
-extern "C" {
+namespace {
 
 // Scan dims of a numeric CSV. Returns 0 on success, -1 on IO error.
-long dl4j_csv_dims(const char* path, long skip_lines, char delim,
+long csv_dims_impl(const char* path, long skip_lines, char delim,
                    long* n_rows, long* n_cols) {
     std::string buf;
     if (!read_file(path, buf)) return -1;
@@ -129,7 +133,7 @@ long dl4j_csv_dims(const char* path, long skip_lines, char delim,
 }
 
 // Parse into out[rows*cols]. Returns rows parsed, or -1 on malformed cell.
-long dl4j_parse_csv(const char* path, long skip_lines, char delim,
+long parse_csv_impl(const char* path, long skip_lines, char delim,
                     float* out, long max_rows, long n_cols) {
     std::string buf;
     if (!read_file(path, buf)) return -1;
@@ -181,7 +185,7 @@ long dl4j_parse_csv(const char* path, long skip_lines, char delim,
     return rows;
 }
 
-long dl4j_svmlight_rows(const char* path) {
+long svmlight_rows_impl(const char* path) {
     std::string buf;
     if (!read_file(path, buf)) return -1;
     long rows = 0;
@@ -201,7 +205,7 @@ long dl4j_svmlight_rows(const char* path) {
 
 // labels[max_rows], feats[max_rows*num_features] (feats must be zeroed by
 // the caller). Returns rows parsed or -1.
-long dl4j_parse_svmlight(const char* path, long num_features, float* labels,
+long parse_svmlight_impl(const char* path, long num_features, float* labels,
                          float* feats, long max_rows) {
     std::string buf;
     if (!read_file(path, buf)) return -1;
@@ -217,7 +221,7 @@ long dl4j_parse_svmlight(const char* path, long num_features, float* labels,
         if (q < eol && *q != '#') {
             char* cell_end = nullptr;
             float label = std::strtof(q, &cell_end);
-            if (cell_end == q) return -1;
+            if (cell_end == q || cell_end > eol) return -1;
             labels[rows] = label;
             q = cell_end;
             while (q < eol) {
@@ -228,7 +232,9 @@ long dl4j_parse_svmlight(const char* path, long num_features, float* labels,
                 if (ie == q || ie >= eol || *ie != ':') return -1;
                 q = ie + 1;
                 float v = std::strtof(q, &cell_end);
-                if (cell_end == q) return -1;
+                // empty value: strtof would cross the newline and consume
+                // the next line's label — same guard as the CSV parser
+                if (cell_end == q || cell_end > eol) return -1;
                 q = cell_end;
                 if (idx >= 1 && idx <= num_features)
                     feats[rows * num_features + (idx - 1)] = v;
@@ -243,7 +249,7 @@ long dl4j_parse_svmlight(const char* path, long num_features, float* labels,
 // Tokenize text[0..text_len) on whitespace; for each token write its vocab
 // index (or -1 for OOV) into out. vocab_blob: '\n'-joined words. Returns
 // the number of tokens written (<= max_tokens).
-long dl4j_encode_tokens(const char* text, long text_len,
+long encode_tokens_impl(const char* text, long text_len,
                         const char* vocab_blob, long blob_len, long n_words,
                         int32_t* out, long max_tokens) {
     TokenHash table;
@@ -260,6 +266,83 @@ long dl4j_encode_tokens(const char* text, long text_len,
             table.lookup(start, static_cast<size_t>(p - start)));
     }
     return count;
+}
+
+// one-pass corpus encoding: token ids + sentence ids (newline-separated
+// sentences), built on a SINGLE vocab hash table for the whole corpus.
+long encode_corpus_impl(const char* text, long text_len,
+                        const char* vocab_blob, long blob_len, long n_words,
+                        int32_t* out_ids, int32_t* out_sent,
+                        long max_tokens) {
+    TokenHash table;
+    table.build(vocab_blob, blob_len, n_words);
+    long count = 0;
+    int32_t sent = 0;
+    const char* p = text;
+    const char* end = text + text_len;
+    while (p < end && count < max_tokens) {
+        while (p < end && is_ws(*p)) {
+            if (*p == '\n') ++sent;
+            ++p;
+        }
+        if (p >= end) break;
+        const char* start = p;
+        while (p < end && !is_ws(*p)) ++p;
+        out_ids[count] = static_cast<int32_t>(
+            table.lookup(start, static_cast<size_t>(p - start)));
+        out_sent[count] = sent;
+        ++count;
+    }
+    return count;
+}
+
+}  // namespace
+
+// Every extern "C" entry is an exception barrier: the module contract is
+// "hard errors return -1 and Python falls back", and a C++ exception
+// escaping extern "C" would std::terminate the host interpreter.
+extern "C" {
+
+long dl4j_csv_dims(const char* path, long skip_lines, char delim,
+                   long* n_rows, long* n_cols) {
+    try { return csv_dims_impl(path, skip_lines, delim, n_rows, n_cols); }
+    catch (...) { return -1; }
+}
+
+long dl4j_parse_csv(const char* path, long skip_lines, char delim,
+                    float* out, long max_rows, long n_cols) {
+    try { return parse_csv_impl(path, skip_lines, delim, out, max_rows,
+                                n_cols); }
+    catch (...) { return -1; }
+}
+
+long dl4j_svmlight_rows(const char* path) {
+    try { return svmlight_rows_impl(path); }
+    catch (...) { return -1; }
+}
+
+long dl4j_parse_svmlight(const char* path, long num_features, float* labels,
+                         float* feats, long max_rows) {
+    try { return parse_svmlight_impl(path, num_features, labels, feats,
+                                     max_rows); }
+    catch (...) { return -1; }
+}
+
+long dl4j_encode_tokens(const char* text, long text_len,
+                        const char* vocab_blob, long blob_len, long n_words,
+                        int32_t* out, long max_tokens) {
+    try { return encode_tokens_impl(text, text_len, vocab_blob, blob_len,
+                                    n_words, out, max_tokens); }
+    catch (...) { return -1; }
+}
+
+long dl4j_encode_corpus(const char* text, long text_len,
+                        const char* vocab_blob, long blob_len, long n_words,
+                        int32_t* out_ids, int32_t* out_sent,
+                        long max_tokens) {
+    try { return encode_corpus_impl(text, text_len, vocab_blob, blob_len,
+                                    n_words, out_ids, out_sent, max_tokens); }
+    catch (...) { return -1; }
 }
 
 }  // extern "C"

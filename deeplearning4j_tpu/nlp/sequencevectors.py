@@ -295,12 +295,14 @@ class SequenceVectors:
         corpus = seq_list if seq_list is not None else sequences
         if self.use_device_pipeline:
             return self._fit_device_pipeline(corpus)
+        if isinstance(corpus, list) and corpus and isinstance(corpus[0], str):
+            # the host loop consumes token lists; raw sentences would be
+            # iterated character-by-character (training nothing)
+            corpus = [line.split() for line in corpus]
         total = self.vocab.total_word_occurrences * self.epochs
         done = 0.0
         for _ in range(self.epochs):
-            done = self._train_corpus(
-                corpus if seq_list is None else seq_list, total,
-                words_done=done)
+            done = self._train_corpus(corpus, total, words_done=done)
         self._finalize_losses()
         return self
 
@@ -366,21 +368,20 @@ class SequenceVectors:
 
     def _corpus_indices(self, corpus):
         """Corpus → per-sequence index arrays. Raw-string sentences go
-        through the native one-pass tokenize+hash encoder
-        (native.encode_tokens: whitespace split + vocab lookup in C++);
-        token lists (or subsampling>0, which needs the host rng) use the
-        Python path."""
+        through the native ONE-PASS corpus encoder (native.encode_corpus:
+        whitespace split + vocab hash lookups for the whole corpus in a
+        single call — the hash table is built once); token lists (or
+        subsampling>0, which needs the host rng) use the Python path."""
         if corpus and isinstance(corpus[0], str):
             if self.sampling == 0:
                 from deeplearning4j_tpu import native
 
-                if native.available():
-                    words = self.vocab.words()  # index-ordered
-                    out = []
-                    for line in corpus:
-                        ids = native.encode_tokens(line, words)
-                        out.append(ids[ids >= 0])
-                    return out
+                enc = native.encode_corpus(corpus, self.vocab.words())
+                if enc is not None:
+                    ids, sent = enc
+                    keep = ids >= 0  # drop OOV/min-frequency-filtered
+                    ids, sent = ids[keep], sent[keep]
+                    return [ids[sent == i] for i in range(len(corpus))]
             corpus = [line.split() for line in corpus]
         return [self._sequence_indices(toks) for toks in corpus]
 

@@ -11,3 +11,4 @@ import deeplearning4j_tpu.nn.layers.feedforward  # noqa: F401
 import deeplearning4j_tpu.nn.layers.convolution  # noqa: F401
 import deeplearning4j_tpu.nn.layers.recurrent  # noqa: F401
 import deeplearning4j_tpu.nn.layers.attention  # noqa: F401
+import deeplearning4j_tpu.nn.layers.moe  # noqa: F401

@@ -1,0 +1,88 @@
+"""Mixture-of-Experts layer with top-k gating + expert parallelism (EP).
+
+New TPU-first capability (no reference analogue — the reference predates
+MoE): E expert FFNs with a learned router. The dense path computes every
+expert for every token and masks by the top-k gate — compiler-friendly
+(static shapes, no gather/scatter of token groups) and exact; the
+expert-parallel path (parallel/expert_parallel.py) shards the expert
+dimension over a mesh axis and psum-combines partial outputs, bitwise
+matching the dense path on any device count.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+
+from deeplearning4j_tpu.nn.conf.inputs import InputType
+from deeplearning4j_tpu.nn.conf.layers import FeedForwardLayer
+from deeplearning4j_tpu.nn.conf.serde import register_config
+from deeplearning4j_tpu.nn.layers.base import LayerImpl, register_impl
+from deeplearning4j_tpu.nn.weights import init_weights
+from deeplearning4j_tpu.ops.activations import get_activation
+
+
+@register_config
+@dataclasses.dataclass
+class MixtureOfExpertsLayer(FeedForwardLayer):
+    """Top-k gated expert FFNs: y = sum_k gate_k * FFN_{e_k}(x)."""
+
+    n_experts: int = 8
+    top_k: int = 2
+    d_hidden: int = 0  # defaults to 4*n_in
+
+    def get_output_type(self, input_type: InputType) -> InputType:
+        if input_type.kind == "recurrent":
+            return InputType.recurrent(self.n_out, input_type.timeseries_length)
+        return InputType.feed_forward(self.n_out)
+
+
+def moe_gates(x2d, Wg, top_k):
+    """Top-k renormalized softmax gates [N, E] (zeros outside the top-k)."""
+    logits = x2d @ Wg                                     # [N, E]
+    E = logits.shape[-1]
+    top_vals, top_idx = jax.lax.top_k(logits, top_k)      # [N, k]
+    probs = jax.nn.softmax(top_vals, axis=-1)             # renormalized
+    gates = jnp.zeros((x2d.shape[0], E), logits.dtype).at[
+        jnp.arange(x2d.shape[0])[:, None], top_idx].set(probs)
+    return gates
+
+
+def moe_expert_outputs(params, x2d, activation):
+    """All experts applied to all tokens: [N, E, n_out]."""
+    act = get_activation(activation)
+    h = jnp.einsum("nd,edh->neh", x2d, params["We1"]) + params["be1"]
+    h = act(h)
+    return jnp.einsum("neh,eho->neo", h, params["We2"]) + params["be2"]
+
+
+@register_impl(MixtureOfExpertsLayer)
+class MixtureOfExpertsImpl(LayerImpl):
+    def init(self, conf, rng, dtype):
+        E = conf.n_experts
+        D, O = conf.n_in, conf.n_out or conf.n_in
+        H = conf.d_hidden or 4 * D
+        kg, k1, k2 = jax.random.split(rng, 3)
+        We1 = jnp.stack([
+            init_weights(k, (D, H), conf.weight_init, conf.dist, dtype)
+            for k in jax.random.split(k1, E)])
+        We2 = jnp.stack([
+            init_weights(k, (H, O), conf.weight_init, conf.dist, dtype)
+            for k in jax.random.split(k2, E)])
+        return {
+            "Wg": init_weights(kg, (D, E), conf.weight_init, conf.dist, dtype),
+            "We1": We1, "be1": jnp.zeros((E, H), dtype),
+            "We2": We2, "be2": jnp.zeros((E, O), dtype),
+        }, {}
+
+    def apply(self, conf, params, state, x, *, train=False, rng=None,
+              mask=None):
+        shape = x.shape
+        x2d = x.reshape(-1, shape[-1])
+        gates = moe_gates(x2d, params["Wg"], conf.top_k)   # [N, E]
+        outs = moe_expert_outputs(params, x2d, conf.activation or "gelu")
+        y = jnp.einsum("ne,neo->no", gates, outs)
+        y = y.reshape(*shape[:-1], y.shape[-1])
+        return y, state

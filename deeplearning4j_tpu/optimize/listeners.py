@@ -45,12 +45,26 @@ class CollectScoresIterationListener(IterationListener):
 
 
 class PerformanceListener(IterationListener):
-    """Per-iteration wall-clock + throughput (new for the TPU build —
-    SURVEY.md §5 notes the reference has no profiling)."""
+    """Per-iteration wall-clock + throughput + optional MFU (new for the
+    TPU build — SURVEY.md §5 notes the reference has no profiling).
 
-    def __init__(self, frequency: int = 10, printer=None):
+    examples_per_iteration: adds examples/sec to the report.
+    flops_per_example (model fwd+bwd FLOPs, e.g. from
+    models.transformer.transformer_flops_per_token x tokens/example) plus
+    peak_flops (chip peak, e.g. bench.PEAK_BF16_FLOPS) adds MFU — the
+    fraction of peak the fit() loop sustains. Stats are also kept on
+    `.last_stats` for programmatic checks.
+    """
+
+    def __init__(self, frequency: int = 10, printer=None,
+                 examples_per_iteration: int = 0,
+                 flops_per_example: float = 0.0, peak_flops: float = 0.0):
         self.frequency = max(1, frequency)
         self.printer = printer or (lambda s: logger.info(s))
+        self.examples_per_iteration = examples_per_iteration
+        self.flops_per_example = flops_per_example
+        self.peak_flops = peak_flops
+        self.last_stats = {}
         self._last_time = None
         self._last_iter = 0
 
@@ -60,8 +74,20 @@ class PerformanceListener(IterationListener):
             dt = now - self._last_time
             its = iteration - self._last_iter
             if dt > 0 and its > 0:
-                self.printer(
-                    f"iter {iteration}: {its / dt:.2f} it/s, score {model.score_value:.5f}")
+                ips = its / dt
+                msg = f"iter {iteration}: {ips:.2f} it/s"
+                stats = {"iterations_per_sec": ips,
+                         "score": float(model.score_value)}
+                if self.examples_per_iteration:
+                    eps = ips * self.examples_per_iteration
+                    stats["examples_per_sec"] = eps
+                    msg += f", {eps:.1f} ex/s"
+                    if self.flops_per_example and self.peak_flops:
+                        mfu = eps * self.flops_per_example / self.peak_flops
+                        stats["mfu"] = mfu
+                        msg += f", MFU {mfu:.1%}"
+                self.printer(msg + f", score {model.score_value:.5f}")
+                self.last_stats = stats
             self._last_time, self._last_iter = now, iteration
         elif self._last_time is None:
             self._last_time, self._last_iter = now, iteration

@@ -1,0 +1,129 @@
+"""Pipeline parallelism (PP): a GPipe-style microbatch schedule over a
+mesh 'pipe' axis.
+
+No reference analogue — the reference scales out only via data-parallel
+Spark/Akka masters; PP is part of this framework's TPU-first distributed
+design (SURVEY.md §5 long-context/distributed goals, scaling-book recipe):
+a homogeneous stack of S blocks (e.g. transformer layers) is partitioned
+one-stage-per-device; microbatches flow through the stages with
+`jax.lax.ppermute` moving activations over ICI, and the whole schedule —
+fill, steady state, drain — is one `lax.scan` inside `shard_map`, fully
+differentiable (ppermute has a transpose rule, so jax.grad gives the
+reverse schedule automatically).
+
+Layout contract:
+- stage parameters are stacked on a leading axis of size S and sharded
+  over 'pipe' (each device holds ONE stage's params);
+- the input batch is split into M microbatches (M >= S keeps bubbles at
+  the GPipe fraction (S-1)/(M+S-1));
+- `stage_fn(params, x) -> y` is the per-stage computation with identical
+  activation shapes in and out (homogeneous stack).
+
+`pipeline_apply` returns outputs identical (up to float assoc) to
+sequentially applying the S stages to each microbatch.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+from jax import shard_map
+from jax.sharding import PartitionSpec as P
+
+
+def pipeline_apply(stage_fn, stacked_params, x, *, mesh, n_microbatches,
+                   axis: str = "pipe"):
+    """Run x through S pipelined stages.
+
+    stage_fn(params_one_stage, x_mb) -> y_mb (same shape as x_mb)
+    stacked_params: pytree with leading stage axis S (sharded over `axis`)
+    x: [batch, ...]; batch must divide into n_microbatches
+    Returns y [batch, ...].
+    """
+    S = mesh.shape[axis]
+    M = n_microbatches
+    B = x.shape[0]
+    if B % M:
+        raise ValueError(f"batch {B} not divisible into {M} microbatches")
+    mb = B // M
+    xs = x.reshape(M, mb, *x.shape[1:])
+
+    def stage_program(params, xs_local):
+        # params: this device's stage (leading axis stripped to size 1)
+        p = jax.tree.map(lambda a: a[0], params)
+        idx = jax.lax.axis_index(axis)
+        T = M + S - 1  # total ticks: fill + steady + drain
+        fwd = [(i, (i + 1) % S) for i in range(S)]  # stage i -> i+1
+
+        zero = jnp.zeros_like(xs_local[0])
+
+        def tick(carry, t):
+            inflight, outputs = carry
+            # stage 0 injects microbatch t while t < M; other stages use
+            # what arrived from the previous stage on the last rotation
+            inject = jnp.where(t < M, t, 0)
+            x_in = jnp.where(idx == 0,
+                             jnp.where(t < M, xs_local[inject], zero),
+                             inflight)
+            y = stage_fn(p, x_in)
+            # last stage stores its result: it finishes microbatch t-(S-1)
+            out_slot = jnp.clip(t - (S - 1), 0, M - 1)
+            store = (idx == S - 1) & (t >= S - 1)
+            # masked write (a lax.cond would need matching varying-axis
+            # types under shard_map; where keeps it simple)
+            outputs = jnp.where(store, outputs.at[out_slot].set(y), outputs)
+            # rotate activations one stage forward
+            inflight = jax.lax.ppermute(y, axis, fwd)
+            return (inflight, outputs), None
+
+        outputs0 = jnp.zeros_like(xs_local)
+        # the body's carries are device-varying (they depend on axis_index
+        # and ppermute); mark the initial values accordingly for scan's
+        # type agreement under shard_map
+        zero_v = jax.lax.pcast(zero, (axis,), to="varying")
+        outputs0_v = jax.lax.pcast(outputs0, (axis,), to="varying")
+        (_, outputs), _ = jax.lax.scan(
+            tick, (zero_v, outputs0_v), jnp.arange(T))
+        return outputs
+
+    # xs is replicated across the pipe axis; each device sees the full
+    # microbatch stream (only stage 0 injects, only stage S-1 emits; the
+    # psum below collapses the zero buffers of the other stages)
+    def program(params, xs_repl):
+        out = stage_program(params, xs_repl)
+        # only the last stage wrote real outputs; make them replicated
+        is_last = jax.lax.axis_index(axis) == S - 1
+        out = jnp.where(is_last, out, jnp.zeros_like(out))
+        return jax.lax.psum(out, axis)
+
+    out = shard_map(
+        program, mesh=mesh,
+        in_specs=(P(axis), P()),
+        out_specs=P(),
+    )(stacked_params, xs)
+    return out.reshape(B, *x.shape[1:])
+
+
+def stack_stage_params(params_list):
+    """Stack per-stage param pytrees along a new leading axis (the 'pipe'
+    sharding axis). All stages must be homogeneous."""
+    return jax.tree.map(lambda *xs: jnp.stack(xs), *params_list)
+
+
+def shard_stacked_params(stacked, mesh, axis: str = "pipe"):
+    """Place the stacked stage params with one stage per 'pipe' device."""
+    from jax.sharding import NamedSharding
+
+    sh = NamedSharding(mesh, P(axis))
+    return jax.tree.map(lambda a: jax.device_put(a, sh), stacked)
+
+
+def pipeline_loss(stage_fn, loss_fn, stacked_params, x, y, *, mesh,
+                  n_microbatches, axis: str = "pipe"):
+    """loss over a pipelined forward — differentiable end to end (the
+    reverse microbatch schedule falls out of ppermute's transpose)."""
+    out = pipeline_apply(stage_fn, stacked_params, x, mesh=mesh,
+                         n_microbatches=n_microbatches, axis=axis)
+    return loss_fn(out, y)

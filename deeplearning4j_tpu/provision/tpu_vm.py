@@ -76,19 +76,12 @@ class TpuVmCreator:
             "--worker", worker]
 
     def num_hosts(self) -> int:
-        """Hosts in the slice. The accelerator-type suffix counts CORES for
-        v2/v3 (8 cores per host) and CHIPS for v4/v5p (4 per host) and
-        v5e/v6e ('lite', 8 per host)."""
-        gen = self.accelerator_type.split("-")[0].lower()
+        """Hosts in the slice. The accelerator-type suffix counts
+        TensorCORES for v2/v3/v4/v5p (2 cores/chip x 4 chips = 8 per host)
+        and CHIPS for the 'lite' types v5e/v6e (8 single-core chips per
+        host) — either way the divisor is 8."""
         n = int(self.accelerator_type.rsplit("-", 1)[1])
-        if "lite" in self.accelerator_type.lower() or gen in ("v5litepod",
-                                                              "v6e"):
-            per_host = 8   # chips per host
-        elif gen in ("v2", "v3"):
-            per_host = 8   # cores per host
-        else:
-            per_host = 4   # v4/v5p chips per host
-        return max(1, n // per_host)
+        return max(1, n // 8)
 
 
 def bootstrap_script(package_source: str = "deeplearning4j_tpu",

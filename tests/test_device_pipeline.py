@@ -100,3 +100,34 @@ def test_group_not_divisible_by_mesh_raises():
     mesh = make_mesh({"data": 4})
     with pytest.raises(ValueError):
         make_sgns_epoch(window=2, negative=3, chunk=64, group=3, mesh=mesh)
+
+
+def test_quality_on_zipf_corpus_with_trust_region():
+    """The MAX_ROW_STEP trust region must not destroy learning on a
+    realistic zipf-distributed corpus (VERDICT r1 weak #7): semantically
+    paired words end up closer than unrelated words of similar rank."""
+    rng = np.random.default_rng(0)
+    vocab, n_words = 300, 60_000
+    zipf = 1.0 / np.arange(1, vocab + 1)
+    p = zipf / zipf.sum()
+    # words come in pairs (2i, 2i+1); each sentence repeats ONE pair, so
+    # partner co-occurrence dominates and cross-pair co-occurrence is zero
+    # within sentences, while pair frequency stays zipf-skewed (the regime
+    # where summed batched updates hit the trust region hardest)
+    draws = rng.choice(vocab // 2, size=n_words // 8, p=(
+        p[::2] / p[::2].sum()))
+    sents = [[f"w{2 * j}", f"w{2 * j + 1}"] * 4 for j in draws]
+    w = (Word2Vec.builder().layer_size(48).window_size(3)
+         .min_word_frequency(1).negative_sample(5).epochs(4).seed(1)
+         .use_device_pipeline(True).build())
+    w.pipeline_chunk, w.pipeline_group = 256, 4
+    w.fit(sents)
+    # paired similarity beats cross-pair similarity for frequent words
+    paired, cross = [], []
+    for j in range(0, 20, 2):
+        if w.has_word(f"w{j}") and w.has_word(f"w{j + 1}"):
+            paired.append(w.similarity(f"w{j}", f"w{j + 1}"))
+        if w.has_word(f"w{j}") and w.has_word(f"w{j + 4}"):
+            cross.append(w.similarity(f"w{j}", f"w{j + 4}"))
+    assert np.mean(paired) > np.mean(cross) + 0.05, (
+        np.mean(paired), np.mean(cross))

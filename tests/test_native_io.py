@@ -132,3 +132,29 @@ def test_raw_string_corpus_uses_native_encoder():
     w.fit(lines)
     assert w.vocab_size == 2
     assert np.isfinite(w.loss_history).all()
+
+
+def test_svmlight_empty_value_falls_back(tmp_path):
+    """An empty 'idx:' value must NOT consume the next line's label."""
+    p = tmp_path / "bad.txt"
+    p.write_text("1 2: \n5 1:7\n")
+    assert native.load_svmlight(str(p), 4) is None
+
+
+def test_encode_corpus_single_pass():
+    ids, sent = native.encode_corpus(["a b oov", "b a"], ["a", "b"])
+    assert ids.tolist() == [0, 1, -1, 1, 0]
+    assert sent.tolist() == [0, 0, 0, 1, 1]
+
+
+def test_host_path_raw_string_corpus_trains():
+    """Raw-string corpora must train on the HOST path too (words, not
+    characters)."""
+    from deeplearning4j_tpu.nlp.word2vec import Word2Vec
+
+    lines = ["alpha beta alpha beta"] * 20
+    w = (Word2Vec.builder().layer_size(8).window_size(2).min_word_frequency(1)
+         .negative_sample(2).epochs(1).seed(1).build())  # host path
+    w.fit(lines)
+    assert w.vocab_size == 2
+    assert len(w.loss_history) > 0  # pairs actually trained

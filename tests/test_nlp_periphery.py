@@ -108,7 +108,8 @@ def test_pod_launch_plan():
 def test_num_hosts_per_generation():
     assert TpuVmCreator("a", accelerator_type="v3-8").num_hosts() == 1
     assert TpuVmCreator("a", accelerator_type="v3-32").num_hosts() == 4
-    assert TpuVmCreator("a", accelerator_type="v4-16").num_hosts() == 4
+    assert TpuVmCreator("a", accelerator_type="v4-16").num_hosts() == 2
+    assert TpuVmCreator("a", accelerator_type="v4-32").num_hosts() == 4
     assert TpuVmCreator("a", accelerator_type="v5litepod-16").num_hosts() == 2
 
 

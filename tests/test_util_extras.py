@@ -153,3 +153,23 @@ class TestTreeParser:
         tv = TreeVectorizer(lambda w: table.get(w), dim=4)
         np.testing.assert_allclose(tv.vectorize(t), 0.5 * np.ones(4))
         assert len(tv.vectorize_all(t)) == 5  # S, A, a, B, b
+
+
+def test_performance_listener_reports_throughput_and_mfu():
+    from deeplearning4j_tpu.optimize.listeners import PerformanceListener
+
+    msgs = []
+    lst = PerformanceListener(frequency=2, printer=msgs.append,
+                              examples_per_iteration=64,
+                              flops_per_example=1e9, peak_flops=1e12)
+
+    class FakeModel:
+        score_value = 0.5
+
+    lst.iteration_done(FakeModel(), 2)   # primes the clock
+    lst.iteration_done(FakeModel(), 4)
+    assert msgs and "MFU" in msgs[-1] and "ex/s" in msgs[-1]
+    stats = lst.last_stats
+    assert stats["examples_per_sec"] > 0
+    # mfu = eps * flops / peak
+    assert abs(stats["mfu"] - stats["examples_per_sec"] * 1e9 / 1e12) < 1e-9

@@ -33,7 +33,9 @@ import numpy as np
 # (round 2, 2026-07) and act as regression guards; resnet_dp's natural
 # baseline is parity (1.0) and transformer's is the >=30% MFU north star.
 TARGETS = {
-    "lenet": 84000.0,        # images/sec/chip (r2 measured: 84.6k)
+    "lenet": 1700000.0,      # images/sec/chip (r2 measured: 1.78M, scanned
+                             # steady-state; per-step Python dispatch caps a
+                             # naive loop far lower)
     "vgg16": 18000.0,        # images/sec/chip (r2 measured: 18.7k)
     "word2vec": 220000.0,    # words/sec (r2 measured: 225k, device pipeline)
     "resnet_dp": 1.0,        # allreduce/param-avg speedup (>=1 expected)
@@ -151,7 +153,9 @@ def bench_lenet() -> None:
     x = rng.random((batch, 28, 28, 1), dtype=np.float32)
     y = np.eye(10, dtype=np.float32)[rng.integers(0, 10, batch)]
     b = {"features": jnp.asarray(x), "labels": jnp.asarray(y)}
-    sec = _time_net_steps(net, b, steps=60 if on_tpu else 4)
+    # LeNet steps are ~40us on the chip: thousands of scanned steps
+    # are needed for the slope to dominate tunnel jitter
+    sec = _time_net_steps(net, b, steps=2000 if on_tpu else 4)
     _emit("lenet", batch / sec, "images/sec/chip",
           metric=f"lenet_mnist_images_per_sec_{backend}")
 

@@ -157,7 +157,10 @@ def encode_corpus(lines: List[str], vocab: List[str]
     lib = get_lib()
     if lib is None:
         return None
-    data = "\n".join(lines).encode()
+    # normalize: embedded/trailing newlines in a line would desync the
+    # native sentence counter from the list indices
+    data = "\n".join(
+        l.replace("\n", " ").strip() for l in lines).encode()
     blob = "\n".join(vocab).encode()
     cap = len(data) // 2 + 1
     ids = np.empty(cap, np.int32)

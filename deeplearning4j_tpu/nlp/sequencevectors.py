@@ -285,14 +285,18 @@ class SequenceVectors:
         """Build vocab (if needed) and train (reference fit():125).
         `sequences`: reiterable of token lists (e.g. SentenceTransformer)."""
         seq_list = sequences if isinstance(sequences, list) else None
+        if seq_list is None:
+            # materialize BEFORE any per-element conversion: list(str) would
+            # silently explode raw sentences into characters
+            seq_list = list(sequences)
+        if seq_list and not isinstance(seq_list[0], (str, list)):
+            seq_list = [list(s) for s in seq_list]
         if self.vocab is None:
-            if seq_list is None:
-                seq_list = [list(s) for s in sequences]
             vocab_src = ([line.split() for line in seq_list]
                          if seq_list and isinstance(seq_list[0], str)
                          else seq_list)
             self.build_vocab(vocab_src)
-        corpus = seq_list if seq_list is not None else sequences
+        corpus = seq_list
         if self.use_device_pipeline:
             return self._fit_device_pipeline(corpus)
         if isinstance(corpus, list) and corpus and isinstance(corpus[0], str):
@@ -381,7 +385,10 @@ class SequenceVectors:
                     ids, sent = enc
                     keep = ids >= 0  # drop OOV/min-frequency-filtered
                     ids, sent = ids[keep], sent[keep]
-                    return [ids[sent == i] for i in range(len(corpus))]
+                    # sent is sorted: one searchsorted splits all sentences
+                    # (a per-sentence boolean scan would be quadratic)
+                    cuts = np.searchsorted(sent, np.arange(1, len(corpus)))
+                    return np.split(ids, cuts)
             corpus = [line.split() for line in corpus]
         return [self._sequence_indices(toks) for toks in corpus]
 

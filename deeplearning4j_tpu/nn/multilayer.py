@@ -205,6 +205,24 @@ class MultiLayerNetwork:
         return loss, (new_state, extras)
 
     # ------------------------------------------------------------------- fit
+
+    # score_value is lazily materialized: the jitted step returns a DEVICE
+    # scalar, and converting it eagerly would force a host sync every
+    # iteration (~100ms per batch through a remote-device tunnel). The
+    # setter accepts device scalars; the getter pays the sync on first
+    # read (listeners that read every iteration opt into that cost).
+    @property
+    def score_value(self):
+        v = getattr(self, "_score_raw", float("nan"))
+        if not isinstance(v, float):
+            v = float(v)
+            self._score_raw = v
+        return v
+
+    @score_value.setter
+    def score_value(self, v):
+        self._score_raw = v
+
     def _get_train_step(self):
         if self._train_step is None:
             confs = dict(zip(self.layer_names, self.layer_confs))
@@ -260,7 +278,7 @@ class MultiLayerNetwork:
                     self.params, self.opt_state, self.state, loss, _ = step(
                         self.params, self.opt_state, self.state,
                         self._next_rng(), batch)
-                    self.score_value = float(loss)
+                    self.score_value = loss
                     self.iteration_count += 1
                     for lst in self.listeners:
                         lst.iteration_done(self, self.iteration_count)
@@ -331,7 +349,7 @@ class MultiLayerNetwork:
             self.params, self.opt_state, self.state, loss, extras = step(
                 self.params, self.opt_state, self.state, self._next_rng(), batch)
             carries = extras.get("carries", carries)
-            self.score_value = float(loss)
+            self.score_value = loss
             self.iteration_count += 1
             for lst in self.listeners:
                 lst.iteration_done(self, self.iteration_count)
@@ -378,7 +396,7 @@ class MultiLayerNetwork:
                         x = featurize(self.params, self.state, x)
                     p_new, opt, loss = pstep(self.params[name], opt, self._next_rng(), x)
                     self.params = dict(self.params, **{name: p_new})
-                    self.score_value = float(loss)
+                    self.score_value = loss
         return self
 
     # ------------------------------------------------------------- inference

@@ -176,7 +176,7 @@ class ParameterAveragingTrainer:
                  losses) = self._local_step(
                     self._stacked_params, self._stacked_opt, self._stacked_state,
                     batch, rng)
-                self.net.score_value = float(jnp.mean(losses))
+                self.net.score_value = jnp.mean(losses)  # lazy host sync
                 self.net.iteration_count += 1
                 self._local_steps += 1
                 if self._local_steps % self.k == 0:

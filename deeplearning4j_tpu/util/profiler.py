@@ -1,0 +1,69 @@
+"""Profiling helpers (SURVEY.md §5: the reference has no tracing at all;
+the TPU build gets jax.profiler traces + the per-step PerformanceListener).
+
+`trace(logdir)` wraps a training region in a jax.profiler trace whose
+output loads in TensorBoard/XProf (op-level TPU timelines, HBM usage);
+`ProfilerIterationListener` starts the trace at a given iteration and
+stops it N iterations later, so users profile a steady-state window of
+`fit()` without modifying their loop.
+"""
+
+from __future__ import annotations
+
+import contextlib
+from typing import Optional
+
+from deeplearning4j_tpu.optimize.listeners import IterationListener
+
+
+@contextlib.contextmanager
+def trace(logdir: str):
+    """Context manager: jax.profiler trace over the enclosed region."""
+    import jax
+
+    jax.profiler.start_trace(logdir)
+    try:
+        yield logdir
+    finally:
+        jax.profiler.stop_trace()
+
+
+class ProfilerIterationListener(IterationListener):
+    """Trace a steady-state window of fit(): [start_iteration,
+    start_iteration + n_iterations)."""
+
+    def __init__(self, logdir: str, start_iteration: int = 10,
+                 n_iterations: int = 5):
+        self.logdir = logdir
+        self.start_iteration = start_iteration
+        self.n_iterations = n_iterations
+        self._active = False
+        self.done = False
+
+    def iteration_done(self, model, iteration):
+        import jax
+
+        if (not self._active and not self.done
+                and iteration >= self.start_iteration):
+            jax.profiler.start_trace(self.logdir)
+            self._active = True
+            self._stop_at = iteration + self.n_iterations
+        elif self._active and iteration >= self._stop_at:
+            self.close()
+
+    def close(self):
+        """Flush an open trace. Call after fit() if training might end
+        inside the window — an unstopped trace is lost AND leaves the
+        process-global profiler started (later traces would fail)."""
+        if self._active:
+            import jax
+
+            jax.profiler.stop_trace()
+            self._active = False
+            self.done = True
+
+    def __del__(self):  # best-effort flush
+        try:
+            self.close()
+        except Exception:
+            pass

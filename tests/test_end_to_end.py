@@ -1,0 +1,100 @@
+"""The SURVEY.md §7 step-4 'minimum end-to-end slice': LeNet-5 on MNIST
+through the builder API — fit, >=97% accuracy, checkpoint/resume, score
+listener — plus cloud dataset IO (datasets/cloud.py) and the profiler
+listener window (util/profiler.py)."""
+
+import os
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.datasets.api import DataSet
+from deeplearning4j_tpu.datasets.cloud import (
+    GcsDataSetIterator,
+    GcsDownloader,
+    GcsUploader,
+    load_dataset,
+    save_dataset,
+)
+from deeplearning4j_tpu.datasets.mnist import MnistDataSetIterator
+from deeplearning4j_tpu.models.lenet import lenet5
+from deeplearning4j_tpu.optimize.listeners import CollectScoresIterationListener
+from deeplearning4j_tpu.util.model_serializer import ModelSerializer
+
+
+def test_lenet_mnist_end_to_end_slice(tmp_path):
+    train_it = MnistDataSetIterator(batch_size=128, num_examples=2048,
+                                    train=True, reshape_images=True,
+                                    shuffle=True, seed=7)
+    test_it = MnistDataSetIterator(batch_size=256, num_examples=512,
+                                   train=False, reshape_images=True)
+    net = lenet5(learning_rate=2e-3)
+    net.init()
+    collector = CollectScoresIterationListener(frequency=1)
+    net.set_listeners(collector)
+    net.fit(train_it, epochs=4)
+    assert collector.scores[-1][1] < collector.scores[0][1]
+    ev = net.evaluate(test_it)
+    acc = ev.accuracy()
+    assert acc >= 0.97, f"end-to-end slice accuracy {acc} < 0.97"
+
+    # checkpoint / resume
+    path = str(tmp_path / "lenet.zip")
+    ModelSerializer.write_model(net, path)
+    restored = ModelSerializer.restore_multi_layer_network(path)
+    test_it.reset()
+    ev2 = restored.evaluate(test_it)
+    assert abs(ev2.accuracy() - acc) < 1e-9
+
+
+def test_cloud_dataset_round_trip(tmp_path):
+    rng = np.random.default_rng(0)
+    bucket = tmp_path / "bucket"
+    os.makedirs(bucket)
+    up = GcsUploader()
+    for i in range(3):
+        ds = DataSet(rng.random((8, 4)).astype(np.float32),
+                     np.eye(2, dtype=np.float32)[rng.integers(0, 2, 8)])
+        local = str(tmp_path / f"part{i}.npz")
+        save_dataset(ds, local)
+        up.upload(local, str(bucket / f"part{i}.npz"))
+
+    it = GcsDataSetIterator(str(bucket))
+    n, batches = 0, 0
+    it.reset()
+    while it.has_next():
+        b = it.next()
+        assert b.features.shape == (8, 4)
+        n += b.num_examples()
+        batches += 1
+    assert (n, batches) == (24, 3)
+    # local passthrough download + masks round trip
+    ds = DataSet(rng.random((4, 3)).astype(np.float32),
+                 rng.random((4, 2)).astype(np.float32),
+                 features_mask=np.ones((4,), np.float32))
+    p = str(tmp_path / "masked.npz")
+    save_dataset(ds, p)
+    back = load_dataset(GcsDownloader().download(p))
+    np.testing.assert_allclose(back.features, ds.features)
+    assert back.features_mask is not None
+
+
+def test_cloud_iterator_empty_prefix_raises(tmp_path):
+    with pytest.raises(IOError):
+        GcsDataSetIterator(str(tmp_path))
+
+
+def test_profiler_listener_window(tmp_path):
+    from deeplearning4j_tpu.util.profiler import ProfilerIterationListener
+
+    lst = ProfilerIterationListener(str(tmp_path), start_iteration=2,
+                                   n_iterations=2)
+
+    class M:
+        score_value = 0.0
+
+    for i in range(1, 7):
+        lst.iteration_done(M(), i)
+    assert lst.done
+    # a trace directory was produced
+    assert any(os.scandir(str(tmp_path)))

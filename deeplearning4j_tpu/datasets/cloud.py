@@ -57,8 +57,16 @@ class GcsDownloader:
                 "no gcloud/gsutil on PATH — install the Cloud SDK or pass "
                 "a local path")
         os.makedirs(os.path.dirname(dest), exist_ok=True)
-        subprocess.run(cli + ["cp", uri, dest], check=True,
-                       capture_output=True)
+        # download to a temp name and rename: a transfer killed mid-way
+        # must not leave a truncated file that reads as a cache hit forever
+        tmp = dest + f".tmp{os.getpid()}"
+        try:
+            subprocess.run(cli + ["cp", uri, tmp], check=True,
+                           capture_output=True)
+            os.replace(tmp, dest)
+        finally:
+            if os.path.exists(tmp):
+                os.unlink(tmp)
         return dest
 
     def list(self, prefix: str) -> List[str]:

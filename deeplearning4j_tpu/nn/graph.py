@@ -68,6 +68,7 @@ class ComputationGraph:
         self.iteration_count = 0
         self.score_value = float("nan")
         self._train_step = None
+        self._scan_fit = None
         self._output_jit = None
         self._rng = None
         self._mesh = None
@@ -108,11 +109,13 @@ class ComputationGraph:
     def set_mesh(self, mesh):
         self._mesh = mesh
         self._train_step = None
+        self._scan_fit = None
 
     def set_optimizer(self, tx):
         self.tx = tx
         self.opt_state = tx.init(self.params)
         self._train_step = None
+        self._scan_fit = None
 
     def _next_rng(self):
         self._rng, k = jax.random.split(self._rng)
@@ -347,6 +350,21 @@ class ComputationGraph:
             self._train_step = make_train_step(self._loss, self.tx, confs,
                                                mesh=self._mesh)
         return self._train_step
+
+    def fit_scanned(self, data, labels=None, epochs: int = 1):
+        """Whole-epoch fused training for DAG networks — see
+        MultiLayerNetwork.fit_scanned (same engine, nn/training.fused_fit;
+        same guards and per-epoch listener contract)."""
+        from deeplearning4j_tpu.nn.training import fused_fit
+
+        if self.params is None:
+            self.init()
+        if labels is not None:
+            data = DataSet(data, labels)
+        if isinstance(data, (DataSet, MultiDataSet)):
+            data = ListDataSetIterator([data])
+        batches = [self._batch_dict(self._to_mds(ds)) for ds in data]
+        return fused_fit(self, batches, epochs)
 
     def _fit_with_solver(self, it, epochs: int):
         """CG/LBFGS/line-GD path (reference Solver dispatch — the graph

@@ -64,6 +64,7 @@ class MultiLayerNetwork:
         self.iteration_count = 0
         self.epoch_count = 0
         self._train_step = None
+        self._scan_fit = None
         self._output_jit = None
         self._rng = None
         self._rnn_carries = None  # streaming inference state
@@ -104,6 +105,7 @@ class MultiLayerNetwork:
         self.tx = tx
         self.opt_state = tx.init(self.params)
         self._train_step = None
+        self._scan_fit = None
 
     def set_listeners(self, *listeners):
         self.listeners = list(listeners)
@@ -113,6 +115,7 @@ class MultiLayerNetwork:
         'data' axis (replaces the Spark parameter-averaging master)."""
         self._mesh = mesh
         self._train_step = None
+        self._scan_fit = None
 
     # --------------------------------------------------------------- forward
     def _next_rng(self):
@@ -238,6 +241,27 @@ class MultiLayerNetwork:
         if ds.labels_mask is not None:
             b["labels_mask"] = jnp.asarray(ds.labels_mask)
         return b
+
+    def fit_scanned(self, data, labels=None, epochs: int = 1):
+        """Whole-epoch fused training: every minibatch is staged on device
+        and each epoch runs as ONE jitted lax.scan dispatch (the fit-path
+        MFU mode — BASELINE's "end-to-end MFU via fit()"). Identical
+        training math to fit() for plain SGD-family runs on uniform
+        batches (rng streams differ, which only matters under dropout);
+        unsupported config modes (solvers, TBPTT, pretraining,
+        iterations>1) raise instead of silently diverging. Listeners fire
+        once per epoch with the epoch-mean score. The staged batches must
+        fit in device memory; fit() remains the streaming path.
+        """
+        from deeplearning4j_tpu.nn.training import fused_fit
+
+        if self.params is None:
+            self.init()
+        if labels is not None:
+            data = DataSet(data, labels)
+        if isinstance(data, DataSet):
+            data = ListDataSetIterator([data])
+        return fused_fit(self, [self._batch_dict(ds) for ds in data], epochs)
 
     def fit(self, data, labels=None, epochs: int = 1):
         """Train (reference fit(DataSetIterator):1011). Accepts a

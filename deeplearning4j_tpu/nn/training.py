@@ -61,6 +61,105 @@ def make_train_step(loss_fn, tx, layer_confs_by_name, mesh=None,
     return jax.jit(step, donate_argnums=donate_argnums)
 
 
+def make_scanned_fit(step):
+    """Wrap a train step into a whole-epoch jitted scan.
+
+    All minibatches live on device stacked on a leading axis; one dispatch
+    runs the entire epoch (the fit()-path MFU mode: no per-batch host
+    round-trips — on a remote-device link the per-dispatch latency
+    otherwise dominates small steps). Returns
+    run(params, opt_state, state, rng, batches, n_epochs) ->
+    (params, opt_state, state, losses [n_epochs, n_batches]).
+    """
+
+    def run(params, opt_state, state, rng, batches, *, n_epochs):
+        def epoch(carry, _):
+            params, opt_state, state, rng = carry
+
+            def one(carry, batch):
+                params, opt_state, state, rng = carry
+                rng, k = jax.random.split(rng)
+                params, opt_state, state, loss, _ = step(
+                    params, opt_state, state, k, batch)
+                return (params, opt_state, state, rng), loss
+
+            carry, losses = jax.lax.scan(
+                one, (params, opt_state, state, rng), batches)
+            return carry, losses
+
+        (params, opt_state, state, _), losses = jax.lax.scan(
+            epoch, (params, opt_state, state, rng), None, length=n_epochs)
+        return params, opt_state, state, losses
+
+    return jax.jit(partial(run), static_argnames=("n_epochs",))
+
+
+def stack_batches(batch_dicts):
+    """Stack per-batch dicts (uniform shapes) on a new leading axis."""
+    return jax.tree.map(lambda *xs: jnp.stack(xs), *batch_dicts)
+
+
+def fused_fit(net, batches, epochs):
+    """Shared fit_scanned engine for both network containers.
+
+    Guards against config modes the fused scan cannot honor (fit()'s
+    dispatch would route them elsewhere), checks batch uniformity on full
+    tree structure + every leaf shape, runs the scan, and updates
+    iteration/epoch counters and listeners per epoch with that epoch's
+    mean score.
+    """
+    from deeplearning4j_tpu.nn.conf.enums import (
+        BackpropType,
+        OptimizationAlgorithm,
+    )
+
+    conf = net.conf
+    g = conf.conf
+    if conf.pretrain:
+        raise ValueError("fit_scanned does not support layerwise "
+                         "pretraining — call pretrain()/fit() first")
+    if not conf.backprop:
+        raise ValueError("fit_scanned needs backprop=True")
+    if str(g.optimization_algo) != str(
+            OptimizationAlgorithm.STOCHASTIC_GRADIENT_DESCENT):
+        raise ValueError(
+            f"fit_scanned supports SGD-family training only; "
+            f"{g.optimization_algo!r} routes through the Solver path — "
+            "use fit()")
+    if str(conf.backprop_type) in (str(BackpropType.TRUNCATED_BPTT),
+                                   "truncated_bptt"):
+        raise ValueError("fit_scanned does not implement TBPTT — use fit()")
+    if getattr(g, "iterations", 1) > 1:
+        raise ValueError("fit_scanned runs one optimizer pass per batch; "
+                         "iterations>1 needs fit()")
+    if not batches:
+        return net
+    structs = {jax.tree.structure(b) for b in batches}
+    shapes = {tuple(l.shape for l in jax.tree.leaves(b)) for b in batches}
+    if len(structs) > 1 or len(shapes) > 1:
+        raise ValueError(
+            "fit_scanned needs uniform batch shapes — drop or pad the "
+            "ragged tail batch, or use fit()")
+    stacked = stack_batches(batches)
+    if net._scan_fit is None:
+        net._scan_fit = make_scanned_fit(net._get_train_step())
+    net.params, net.opt_state, net.state, losses = net._scan_fit(
+        net.params, net.opt_state, net.state, net._next_rng(), stacked,
+        n_epochs=epochs)
+    per_epoch = losses.mean(axis=1)
+    nb = len(batches)
+    for e in range(epochs):
+        net.iteration_count += nb
+        if hasattr(net, "epoch_count"):
+            net.epoch_count += 1
+        net.score_value = per_epoch[e]
+        for lst in net.listeners:
+            lst.iteration_done(net, net.iteration_count)
+    net.score_value = losses[-1, -1]
+    net._epoch_losses = per_epoch
+    return net
+
+
 def make_eval_step(output_fn):
     """output_fn(params, state, features, mask) -> activations."""
     return jax.jit(partial(output_fn))

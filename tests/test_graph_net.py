@@ -256,3 +256,18 @@ class TestGraphGuards:
         before = np.array(net.params["ae"]["W"])
         net.pretrain(DataSet(x, y), epochs=3)
         np.testing.assert_allclose(before, np.array(net.params["ae"]["W"]))
+
+
+def test_cg_fit_scanned():
+    from deeplearning4j_tpu.models.transformer import transformer_lm
+
+    net = transformer_lm(vocab_size=32, d_model=16, n_heads=2, n_layers=1,
+                         d_ff=32, max_length=8)
+    net.init()
+    rng = np.random.default_rng(0)
+    toks = np.asarray(rng.integers(0, 32, (4, 8)), np.int32)
+    net.fit_scanned(toks, np.roll(toks, -1, 1), epochs=4)
+    first = float(net._epoch_losses[0])
+    last = float(net._epoch_losses[-1])
+    assert np.isfinite(last) and last < first
+    assert net.iteration_count == 4

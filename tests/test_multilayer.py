@@ -158,3 +158,55 @@ def test_all_updaters_run(updater):
     ds = make_xor_data(32)
     net.fit(ds, epochs=2)
     assert np.isfinite(net.score_value)
+
+
+def test_fit_scanned_matches_fit():
+    """fit_scanned (whole-epoch fused scan) trains identically to fit()
+    for SGD on uniform batches (rng only differs under dropout)."""
+    from deeplearning4j_tpu.datasets.api import DataSet
+
+    def build():
+        conf = (
+            NeuralNetConfiguration.builder()
+            .seed(3)
+            .learning_rate(0.1)
+            .updater("sgd")
+            .list()
+            .layer(DenseLayer(n_in=4, n_out=8, activation="tanh"))
+            .layer(OutputLayer(n_in=8, n_out=2, activation="softmax",
+                               loss_function="mcxent"))
+            .build()
+        )
+        return MultiLayerNetwork(conf).init()
+
+    rng = np.random.default_rng(0)
+    batches = [DataSet(rng.random((16, 4), dtype=np.float32),
+                       np.eye(2, dtype=np.float32)[rng.integers(0, 2, 16)])
+               for _ in range(5)]
+    a, b = build(), build()
+    for _ in range(3):
+        a.fit(ListDataSetIterator(batches))
+    b.fit_scanned(ListDataSetIterator(batches), epochs=3)
+    np.testing.assert_allclose(np.asarray(a.params_flat()),
+                               np.asarray(b.params_flat()), atol=1e-5)
+    assert abs(a.score_value - b.score_value) < 1e-5
+    assert b.iteration_count == 15
+
+
+def test_fit_scanned_rejects_ragged_batches():
+    from deeplearning4j_tpu.datasets.api import DataSet
+
+    conf = (
+        NeuralNetConfiguration.builder()
+        .list()
+        .layer(DenseLayer(n_in=4, n_out=4, activation="tanh"))
+        .layer(OutputLayer(n_in=4, n_out=2, activation="softmax",
+                           loss_function="mcxent"))
+        .build()
+    )
+    net = MultiLayerNetwork(conf).init()
+    rng = np.random.default_rng(0)
+    mk = lambda n: DataSet(rng.random((n, 4), dtype=np.float32),
+                           np.eye(2, dtype=np.float32)[rng.integers(0, 2, n)])
+    with pytest.raises(ValueError):
+        net.fit_scanned(ListDataSetIterator([mk(16), mk(7)]))

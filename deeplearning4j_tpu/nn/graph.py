@@ -110,6 +110,7 @@ class ComputationGraph:
         self._mesh = mesh
         self._train_step = None
         self._scan_fit = None
+        self._output_jit = None
 
     def set_optimizer(self, tx):
         self.tx = tx
@@ -521,9 +522,32 @@ class ComputationGraph:
                 ys, _, _ = self._forward(params, state, input_dict, train=False,
                                          rng=None)
                 return ys
-            self._output_jit = jax.jit(_out)
-        ys = self._output_jit(self.params, self.state,
-                              {k: jnp.asarray(v) for k, v in input_dict.items()})
+            if self._mesh is not None:
+                # distributed evaluation: batch sharded over 'data'
+                # (reference EvaluateFlatMapFunction + Evaluation.merge)
+                from jax.sharding import NamedSharding, PartitionSpec as P
+
+                repl = NamedSharding(self._mesh, P())
+                data = NamedSharding(self._mesh, P("data"))
+                self._output_jit = jax.jit(
+                    _out, in_shardings=(repl, repl, data),
+                    out_shardings=data)
+            else:
+                self._output_jit = jax.jit(_out)
+        input_dict = {k: jnp.asarray(v) for k, v in input_dict.items()}
+        pad = 0
+        if self._mesh is not None:
+            # pad batch to a multiple of the data axis, slice back below
+            n = self._mesh.shape["data"]
+            B = next(iter(input_dict.values())).shape[0]
+            pad = (-B) % n
+            if pad:
+                input_dict = {
+                    k: jnp.concatenate([v, jnp.repeat(v[:1], pad, axis=0)])
+                    for k, v in input_dict.items()}
+        ys = self._output_jit(self.params, self.state, input_dict)
+        if pad:
+            ys = [y[:-pad] for y in ys]
         return ys[0] if len(ys) == 1 else ys
 
     def predict(self, *inputs):

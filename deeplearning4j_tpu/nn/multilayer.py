@@ -106,6 +106,7 @@ class MultiLayerNetwork:
         self.opt_state = tx.init(self.params)
         self._train_step = None
         self._scan_fit = None
+        self._output_jit = None
 
     def set_listeners(self, *listeners):
         self.listeners = list(listeners)
@@ -116,6 +117,7 @@ class MultiLayerNetwork:
         self._mesh = mesh
         self._train_step = None
         self._scan_fit = None
+        self._output_jit = None
 
     # --------------------------------------------------------------- forward
     def _next_rng(self):
@@ -432,18 +434,43 @@ class MultiLayerNetwork:
         return acts
 
     def output(self, x, train: bool = False, mask=None):
-        """Network output (reference output:1500-1582)."""
+        """Network output (reference output:1500-1582). With a mesh set,
+        inference shards the batch over the 'data' axis — the distributed-
+        evaluation path (reference EvaluateFlatMapFunction + merge)."""
         if self._output_jit is None:
             def _out(params, state, x, mask):
                 y, _, _ = self._forward(params, state, x, train=False, rng=None,
                                         mask=mask)
                 return y
-            self._output_jit = jax.jit(_out)
+            if self._mesh is not None:
+                from jax.sharding import NamedSharding, PartitionSpec as P
+
+                repl = NamedSharding(self._mesh, P())
+                data = NamedSharding(self._mesh, P("data"))
+                self._output_jit = jax.jit(
+                    _out, in_shardings=(repl, repl, data, None),
+                    out_shardings=data)
+            else:
+                self._output_jit = jax.jit(_out)
         if train:
             y, _, _ = self._forward(self.params, self.state, jnp.asarray(x),
                                     train=True, rng=self._next_rng(), mask=mask)
             return y
-        return self._output_jit(self.params, self.state, jnp.asarray(x), mask)
+        x = jnp.asarray(x)
+        if self._mesh is not None:
+            # sharded inference needs batch % mesh == 0: pad with repeated
+            # rows and slice back (EvaluateFlatMapFunction handles uneven
+            # shards the same way semantically)
+            n = self._mesh.shape["data"]
+            B = x.shape[0]
+            pad = (-B) % n
+            if pad:
+                x = jnp.concatenate([x, jnp.repeat(x[:1], pad, axis=0)])
+                if mask is not None:
+                    mask = jnp.concatenate(
+                        [mask, jnp.repeat(mask[:1], pad, axis=0)])
+                return self._output_jit(self.params, self.state, x, mask)[:B]
+        return self._output_jit(self.params, self.state, x, mask)
 
     def predict(self, x):
         """Class indices (reference predict)."""

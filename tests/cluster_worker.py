@@ -27,18 +27,25 @@ from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
 N, F, C, STEPS = 32, 6, 3, 6
 
 
-def build_net() -> MultiLayerNetwork:
-    conf = (
-        NeuralNetConfiguration.builder()
-        .seed(7)
-        .learning_rate(0.1)
-        .updater("sgd")
-        .list()
-        .layer(DenseLayer(n_in=F, n_out=8, activation="tanh"))
-        .layer(OutputLayer(n_in=8, n_out=C, activation="softmax",
-                           loss_function="mcxent"))
-        .build()
-    )
+def build_net(kind: str = "mln"):
+    b = (NeuralNetConfiguration.builder()
+         .seed(7)
+         .learning_rate(0.1)
+         .updater("sgd"))
+    if kind == "cg":
+        from deeplearning4j_tpu.nn.graph import ComputationGraph
+
+        g = b.graph_builder().add_inputs("in")
+        g.add_layer("h", DenseLayer(n_in=F, n_out=8, activation="tanh"), "in")
+        g.add_layer("out", OutputLayer(n_in=8, n_out=C, activation="softmax",
+                                       loss_function="mcxent"), "h")
+        g.set_outputs("out")
+        return ComputationGraph(g.build())
+    conf = (b.list()
+            .layer(DenseLayer(n_in=F, n_out=8, activation="tanh"))
+            .layer(OutputLayer(n_in=8, n_out=C, activation="softmax",
+                               loss_function="mcxent"))
+            .build())
     return MultiLayerNetwork(conf)
 
 
@@ -59,6 +66,7 @@ def shard_batches(shard: str):
 def main() -> int:
     address, wid, shard, ckpt, crash_at = sys.argv[1:6]
     local_mesh = int(sys.argv[6]) if len(sys.argv) > 6 else 0
+    kind = sys.argv[7] if len(sys.argv) > 7 else "mln"
     ckpt = None if ckpt == "-" else ckpt
     if local_mesh:
         from deeplearning4j_tpu.util.virtual_devices import ensure_cpu_devices
@@ -86,7 +94,7 @@ def main() -> int:
 
         ClusterClient.average = avg
 
-    net = build_net()
+    net = build_net(kind)
     net.init()
     if local_mesh:
         from deeplearning4j_tpu.parallel.mesh import make_mesh

@@ -88,14 +88,15 @@ def test_average_completes_elastically_when_worker_dies(coord):
 _REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 
-def _spawn(address, wid, shard, ckpt="-", crash_at="none", local_mesh=0):
+def _spawn(address, wid, shard, ckpt="-", crash_at="none", local_mesh=0,
+           kind="mln"):
     env = dict(os.environ)
     env["PYTHONPATH"] = _REPO_ROOT
     env["JAX_PLATFORMS"] = "cpu"
     env.pop("XLA_FLAGS", None)
     return subprocess.Popen(
         [sys.executable, "tests/cluster_worker.py", address, wid, shard,
-         ckpt, crash_at, str(local_mesh)], env=env, cwd=_REPO_ROOT,
+         ckpt, crash_at, str(local_mesh), kind], env=env, cwd=_REPO_ROOT,
         stdout=subprocess.PIPE, stderr=subprocess.PIPE)
 
 
@@ -181,3 +182,23 @@ def test_two_process_times_four_device_hierarchy(tmp_path):
         ref.fit(DataSet(x, y))
     np.testing.assert_allclose(flat0, np.asarray(ref.params_flat()),
                                atol=5e-4)
+
+
+def test_two_process_computation_graph_training(tmp_path):
+    """The elastic worker loop serves DAG networks too (DP-3 across
+    processes): replicas converge and stay synchronized."""
+    coord = ClusterCoordinator(heartbeat_timeout=30.0).start()
+    try:
+        pa = _spawn(coord.address, "w0", "0", ckpt=str(tmp_path / "w0.zip"),
+                    kind="cg")
+        pb = _spawn(coord.address, "w1", "1", ckpt=str(tmp_path / "w1.zip"),
+                    kind="cg")
+        for p in (pa, pb):
+            out, err = p.communicate(timeout=300)
+            assert p.returncode == 0, err.decode()[-2000:]
+    finally:
+        coord.shutdown()
+    flat0 = np.load(str(tmp_path / "w0.zip.params.npy"))
+    flat1 = np.load(str(tmp_path / "w1.zip.params.npy"))
+    np.testing.assert_allclose(flat0, flat1, atol=1e-6)
+    assert np.isfinite(flat0).all()

@@ -161,3 +161,28 @@ def test_computation_graph_under_data_parallel_trainer():
     # gradients; the parity bound is loose but still catches wiring bugs
     np.testing.assert_allclose(np.asarray(mesh_net.params_flat()),
                                np.asarray(single.params_flat()), atol=5e-3)
+
+
+def test_distributed_evaluation_matches_single_device():
+    """Mesh-sharded inference/eval == single-device (reference
+    EvaluateFlatMapFunction + Evaluation.merge semantics)."""
+    from deeplearning4j_tpu.models.resnet import resnet20
+    from deeplearning4j_tpu.datasets.api import DataSet
+
+    rng = np.random.default_rng(1)
+    x = rng.random((16, 32, 32, 3), dtype=np.float32)
+    y = np.eye(10, dtype=np.float32)[rng.integers(0, 10, 16)]
+
+    net = resnet20(seed=9)
+    net.init()
+    ref_out = np.asarray(net.output(x))
+    ref_acc = net.evaluate(DataSet(x, y)).accuracy()
+
+    net.set_mesh(make_mesh({"data": 8}))
+    mesh_out = np.asarray(net.output(x))
+    np.testing.assert_allclose(mesh_out, ref_out, atol=2e-5)
+    assert net.evaluate(DataSet(x, y)).accuracy() == ref_acc
+    # indivisible batches pad-and-slice instead of crashing
+    odd = np.asarray(net.output(x[:10]))
+    np.testing.assert_allclose(odd, ref_out[:10], atol=2e-5)
+    assert net.evaluate(DataSet(x[:10], y[:10])).accuracy() >= 0.0

@@ -525,10 +525,9 @@ class ComputationGraph:
             if self._mesh is not None:
                 # distributed evaluation: batch sharded over 'data'
                 # (reference EvaluateFlatMapFunction + Evaluation.merge)
-                from jax.sharding import NamedSharding, PartitionSpec as P
+                from deeplearning4j_tpu.nn.training import mesh_shardings
 
-                repl = NamedSharding(self._mesh, P())
-                data = NamedSharding(self._mesh, P("data"))
+                repl, data = mesh_shardings(self._mesh)
                 self._output_jit = jax.jit(
                     _out, in_shardings=(repl, repl, data),
                     out_shardings=data)
@@ -538,13 +537,10 @@ class ComputationGraph:
         pad = 0
         if self._mesh is not None:
             # pad batch to a multiple of the data axis, slice back below
-            n = self._mesh.shape["data"]
-            B = next(iter(input_dict.values())).shape[0]
-            pad = (-B) % n
-            if pad:
-                input_dict = {
-                    k: jnp.concatenate([v, jnp.repeat(v[:1], pad, axis=0)])
-                    for k, v in input_dict.items()}
+            from deeplearning4j_tpu.nn.training import pad_batch_to_multiple
+
+            input_dict, pad = pad_batch_to_multiple(
+                input_dict, self._mesh.shape["data"])
         ys = self._output_jit(self.params, self.state, input_dict)
         if pad:
             ys = [y[:-pad] for y in ys]

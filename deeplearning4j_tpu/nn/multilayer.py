@@ -443,10 +443,9 @@ class MultiLayerNetwork:
                                         mask=mask)
                 return y
             if self._mesh is not None:
-                from jax.sharding import NamedSharding, PartitionSpec as P
+                from deeplearning4j_tpu.nn.training import mesh_shardings
 
-                repl = NamedSharding(self._mesh, P())
-                data = NamedSharding(self._mesh, P("data"))
+                repl, data = mesh_shardings(self._mesh)
                 self._output_jit = jax.jit(
                     _out, in_shardings=(repl, repl, data, None),
                     out_shardings=data)
@@ -458,17 +457,17 @@ class MultiLayerNetwork:
             return y
         x = jnp.asarray(x)
         if self._mesh is not None:
-            # sharded inference needs batch % mesh == 0: pad with repeated
-            # rows and slice back (EvaluateFlatMapFunction handles uneven
-            # shards the same way semantically)
-            n = self._mesh.shape["data"]
+            # sharded inference needs batch % mesh == 0: pad-and-slice
+            # (EvaluateFlatMapFunction handles uneven shards semantically)
+            from deeplearning4j_tpu.nn.training import pad_batch_to_multiple
+
             B = x.shape[0]
-            pad = (-B) % n
+            bundle = (x,) if mask is None else (x, mask)
+            bundle, pad = pad_batch_to_multiple(bundle,
+                                                self._mesh.shape["data"])
             if pad:
-                x = jnp.concatenate([x, jnp.repeat(x[:1], pad, axis=0)])
-                if mask is not None:
-                    mask = jnp.concatenate(
-                        [mask, jnp.repeat(mask[:1], pad, axis=0)])
+                x = bundle[0]
+                mask = bundle[1] if mask is not None else None
                 return self._output_jit(self.params, self.state, x, mask)[:B]
         return self._output_jit(self.params, self.state, x, mask)
 

@@ -160,6 +160,29 @@ def fused_fit(net, batches, epochs):
     return net
 
 
+def mesh_shardings(mesh):
+    """(replicated, data-sharded) NamedShardings for a mesh's 'data' axis."""
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    return NamedSharding(mesh, P()), NamedSharding(mesh, P("data"))
+
+
+def pad_batch_to_multiple(tree, n):
+    """Pad every leaf's batch dim to a multiple of n by repeating row 0;
+    returns (padded_tree, pad). Sharded inference requires batch % n == 0;
+    callers slice the pad rows back off the output."""
+    leaves = jax.tree.leaves(tree)
+    if not leaves:
+        return tree, 0
+    B = leaves[0].shape[0]
+    pad = (-B) % n
+    if pad == 0:
+        return tree, 0
+    return jax.tree.map(
+        lambda v: jnp.concatenate([v, jnp.repeat(v[:1], pad, axis=0)]),
+        tree), pad
+
+
 def make_eval_step(output_fn):
     """output_fn(params, state, features, mask) -> activations."""
     return jax.jit(partial(output_fn))

@@ -238,3 +238,21 @@ def test_computation_graph_vertices(rng):
     assert check_gradients_graph(net, MultiDataSet([ds.features], [ds.labels]),
                                  epsilon=EPS, max_rel_error=MAX_REL,
                                  print_results=True)
+
+
+def test_moe_layer_gradients(rng):
+    """Mixture-of-Experts: top-k gated expert FFNs (the gate top_k mask is
+    piecewise-constant, so finite differences remain valid away from
+    routing boundaries — tanh-bounded inputs keep logits well-separated)."""
+    from deeplearning4j_tpu.nn.layers.moe import MixtureOfExpertsLayer
+
+    conf = (_builder().list()
+            .layer(MixtureOfExpertsLayer(n_in=4, n_out=5, n_experts=3,
+                                         top_k=2, d_hidden=6,
+                                         activation="tanh"))
+            .layer(OutputLayer(n_in=5, n_out=3, activation="softmax",
+                               loss_function="mcxent"))
+            .build())
+    net = MultiLayerNetwork(conf).init()
+    assert check_gradients(net, _iris_like(rng), epsilon=EPS,
+                           max_rel_error=MAX_REL, print_results=True)

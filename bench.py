@@ -134,11 +134,9 @@ def _measure_matmul_tflops():
 
     def timed(K):
         f = fns[K]
-        r = f(a)
-        float(jnp.ravel(r.astype(jnp.float32))[0])  # compile+sync (cached)
+        _sync(f(a))  # compile+sync (cached)
         t0 = time.perf_counter()
-        r = f(a)
-        float(jnp.ravel(r.astype(jnp.float32))[0])
+        _sync(f(a))
         return time.perf_counter() - t0
 
     t1 = min(timed(10) for _ in range(2))
@@ -322,12 +320,55 @@ def bench_transformer() -> None:
             "model_flops_per_token": flops_tok}), flush=True)
 
 
+def bench_longcontext() -> None:
+    """Long-sequence training step (seq 4096): exercises the fused Pallas
+    flash-attention kernel (dense attention's [T,T] scores at this length
+    are 32MB/head/layer each way) and remat — the long-context first-class
+    requirement measured on hardware."""
+    import jax
+
+    from deeplearning4j_tpu.datasets.api import DataSet
+    from deeplearning4j_tpu.models.transformer import (
+        transformer_flops_per_token,
+        transformer_lm,
+    )
+
+    backend = jax.default_backend()
+    on_tpu = backend == "tpu"
+    vocab, d_model, heads, layers, d_ff = 10000, 256, 4, 6, 1024
+    seq = 4096 if on_tpu else 256
+    batch = 4 if on_tpu else 1
+    steps = 20 if on_tpu else 2
+    net = transformer_lm(vocab_size=vocab, d_model=d_model, n_heads=heads,
+                         n_layers=layers, d_ff=d_ff, max_length=seq,
+                         dtype="bfloat16" if on_tpu else "float32")
+    net.init()
+    rng = np.random.default_rng(0)
+    toks = np.asarray(rng.integers(0, vocab, (batch, seq)), np.int32)
+    ds = DataSet(toks, np.roll(toks, -1, axis=1))
+    sec = _time_net_steps(net, ds, steps=steps)
+    tokens_per_sec = batch * seq / sec
+    flops_tok = transformer_flops_per_token(vocab, d_model, layers, d_ff, seq)
+    peak = _peak_flops(jax.devices()[0])
+    line = {
+        "metric": f"transformer_lm_seq{seq}_tokens_per_sec_{backend}",
+        "value": round(tokens_per_sec, 1),
+        "unit": "tokens/sec",
+        "vs_baseline": None,  # informational: no anchor yet
+        "model_flops_per_token": flops_tok,
+    }
+    if peak:
+        line["mfu"] = round(flops_tok * tokens_per_sec / peak, 4)
+    print(json.dumps(line), flush=True)
+
+
 MODES = {
     "lenet": bench_lenet,
     "vgg16": bench_vgg16,
     "word2vec": bench_word2vec,
     "resnet_dp": bench_resnet_dp,
     "transformer": bench_transformer,
+    "longcontext": bench_longcontext,
 }
 
 

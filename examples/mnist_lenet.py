@@ -1,0 +1,28 @@
+"""LeNet-5 on MNIST through the sequential builder API."""
+import numpy as np
+
+from deeplearning4j_tpu.datasets.mnist import MnistDataSetIterator
+from deeplearning4j_tpu.models.lenet import lenet5
+from deeplearning4j_tpu.optimize.listeners import ScoreIterationListener
+from deeplearning4j_tpu.util.model_serializer import ModelSerializer
+
+train = MnistDataSetIterator(batch_size=128, num_examples=2048,
+                             reshape_images=True, shuffle=True)
+test = MnistDataSetIterator(batch_size=256, num_examples=512, train=False,
+                            reshape_images=True)
+
+net = lenet5(learning_rate=2e-3)
+net.init()
+net.set_listeners(ScoreIterationListener(print_iterations=16, printer=print))
+
+# fused-epoch training: each epoch is one device dispatch
+net.fit_scanned(train, epochs=4)
+print("epoch losses:", [round(float(x), 4) for x in net._epoch_losses])
+
+ev = net.evaluate(test)
+print(ev.stats())
+
+ModelSerializer.write_model(net, "/tmp/lenet.zip")
+restored = ModelSerializer.restore_multi_layer_network("/tmp/lenet.zip")
+test.reset()
+print("restored accuracy:", restored.evaluate(test).accuracy())

@@ -1,0 +1,28 @@
+"""Word2Vec with the on-device skip-gram pipeline."""
+import numpy as np
+
+from deeplearning4j_tpu.nlp.word2vec import Word2Vec
+
+rng = np.random.default_rng(0)
+sents = []
+for _ in range(800):
+    i = rng.integers(0, 30)
+    sents.append([f"city{i}", f"country{i}"] * 3)
+
+w2v = (Word2Vec.builder()
+       .layer_size(64)
+       .window_size(2)
+       .min_word_frequency(1)
+       .negative_sample(5)
+       .epochs(3)
+       .use_device_pipeline(True)   # corpus on device, one scan per epoch
+       .build())
+w2v.fit(sents)
+
+print("sim(city3, country3) =", w2v.similarity("city3", "country3"))
+print("sim(city3, country17) =", w2v.similarity("city3", "country17"))
+print("nearest to city5:", w2v.words_nearest("city5", 3))
+
+from deeplearning4j_tpu.nlp.serializer import WordVectorSerializer
+WordVectorSerializer.write_word_vectors(w2v, "/tmp/vectors.txt")
+print("saved /tmp/vectors.txt")

@@ -177,6 +177,7 @@ def test_distributed_evaluation_matches_single_device():
     net.init()
     ref_out = np.asarray(net.output(x))
     ref_acc = net.evaluate(DataSet(x, y)).accuracy()
+    ref_acc10 = net.evaluate(DataSet(x[:10], y[:10])).accuracy()
 
     net.set_mesh(make_mesh({"data": 8}))
     mesh_out = np.asarray(net.output(x))
@@ -185,7 +186,4 @@ def test_distributed_evaluation_matches_single_device():
     # indivisible batches pad-and-slice instead of crashing
     odd = np.asarray(net.output(x[:10]))
     np.testing.assert_allclose(odd, ref_out[:10], atol=2e-5)
-    ref_net = resnet20(seed=9)
-    ref_net.init()
-    assert (net.evaluate(DataSet(x[:10], y[:10])).accuracy()
-            == ref_net.evaluate(DataSet(x[:10], y[:10])).accuracy())
+    assert net.evaluate(DataSet(x[:10], y[:10])).accuracy() == ref_acc10

@@ -31,11 +31,12 @@ from deeplearning4j_tpu.nn.conf.layers import PositionalEncodingLayer
 from deeplearning4j_tpu.nn.graph import ComputationGraph
 
 
-def transformer_lm(vocab_size: int = 10000, d_model: int = 256,
-                   n_heads: int = 4, n_layers: int = 6, d_ff: int = 1024,
-                   max_length: int = 512, dropout: float = 0.0,
-                   seed: int = 12345, learning_rate: float = 3e-4,
-                   dtype: str = "float32", remat: bool = False) -> ComputationGraph:
+def _build_lm(vocab_size, d_model, n_heads, n_layers, max_length, dropout,
+              seed, learning_rate, dtype, remat, ff_builder
+              ) -> ComputationGraph:
+    """Shared pre-norm LM skeleton; `ff_builder(g, name, input_name)` adds
+    the per-block feed-forward sublayer(s) and returns the output name —
+    the dense and MoE variants differ only there."""
     g = (
         NeuralNetConfiguration.builder()
         .seed(seed)
@@ -65,13 +66,9 @@ def transformer_lm(vocab_size: int = 10000, d_model: int = 256,
                      prev, f"{b}_attn")
         g.add_layer(f"{b}_ln2", LayerNormalization(n_in=d_model, n_out=d_model),
                     f"{b}_res1")
-        g.add_layer(f"{b}_ff1", DenseLayer(n_in=d_model, n_out=d_ff,
-                                           activation="gelu", dropout=dropout),
-                    f"{b}_ln2")
-        g.add_layer(f"{b}_ff2", DenseLayer(n_in=d_ff, n_out=d_model,
-                                           activation="identity"), f"{b}_ff1")
+        ff_out = ff_builder(g, b, f"{b}_ln2")
         g.add_vertex(f"{b}_res2", ElementWiseVertexConf(op="add"),
-                     f"{b}_res1", f"{b}_ff2")
+                     f"{b}_res1", ff_out)
         prev = f"{b}_res2"
     g.add_layer("ln_f", LayerNormalization(n_in=d_model, n_out=d_model), prev)
     g.add_layer("out", RnnOutputLayer(n_in=d_model, n_out=vocab_size,
@@ -80,6 +77,47 @@ def transformer_lm(vocab_size: int = 10000, d_model: int = 256,
     g.set_outputs("out")
     g.set_input_types(tokens=InputType.recurrent(1))
     return ComputationGraph(g.build())
+
+
+def transformer_lm(vocab_size: int = 10000, d_model: int = 256,
+                   n_heads: int = 4, n_layers: int = 6, d_ff: int = 1024,
+                   max_length: int = 512, dropout: float = 0.0,
+                   seed: int = 12345, learning_rate: float = 3e-4,
+                   dtype: str = "float32", remat: bool = False) -> ComputationGraph:
+    def ff(g, b, src):
+        g.add_layer(f"{b}_ff1", DenseLayer(n_in=d_model, n_out=d_ff,
+                                           activation="gelu", dropout=dropout),
+                    src)
+        g.add_layer(f"{b}_ff2", DenseLayer(n_in=d_ff, n_out=d_model,
+                                           activation="identity"), f"{b}_ff1")
+        return f"{b}_ff2"
+
+    return _build_lm(vocab_size, d_model, n_heads, n_layers, max_length,
+                     dropout, seed, learning_rate, dtype, remat, ff)
+
+
+def transformer_moe_lm(vocab_size: int = 10000, d_model: int = 256,
+                       n_heads: int = 4, n_layers: int = 6,
+                       n_experts: int = 8, top_k: int = 2,
+                       d_expert_hidden: int = 512, max_length: int = 512,
+                       dropout: float = 0.0, seed: int = 12345,
+                       learning_rate: float = 3e-4, dtype: str = "float32",
+                       remat: bool = False) -> ComputationGraph:
+    """Mixture-of-Experts LM: each block's dense FF replaced by a top-k
+    gated expert FFN (nn/layers/moe.py; dropout applies to the expert
+    input like the dense variant's first FF layer). Experts shard over a
+    mesh 'expert' axis for EP execution (parallel/expert_parallel.py)."""
+    from deeplearning4j_tpu.nn.layers.moe import MixtureOfExpertsLayer
+
+    def ff(g, b, src):
+        g.add_layer(f"{b}_moe", MixtureOfExpertsLayer(
+            n_in=d_model, n_out=d_model, n_experts=n_experts, top_k=top_k,
+            d_hidden=d_expert_hidden, activation="gelu", dropout=dropout),
+            src)
+        return f"{b}_moe"
+
+    return _build_lm(vocab_size, d_model, n_heads, n_layers, max_length,
+                     dropout, seed, learning_rate, dtype, remat, ff)
 
 
 def transformer_flops_per_token(vocab_size, d_model, n_layers, d_ff, seq_len):
@@ -92,3 +130,5 @@ def transformer_flops_per_token(vocab_size, d_model, n_layers, d_ff, seq_len):
     )
     fwd = n_layers * per_layer + 2 * d_model * vocab_size  # + LM head
     return 3 * fwd  # fwd + bwd(2x)
+
+

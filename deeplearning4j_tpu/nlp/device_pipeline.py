@@ -90,22 +90,8 @@ def _chunk_pair_grads(syn0, syn1neg, tokens, sent_ids, alias_J, alias_q,
     once per update so a vmap over chunks stays memory-light) plus the
     masked loss sum and valid-pair count.
     """
-    N = tokens.shape[0]
-    pos = start + jnp.arange(chunk)
-    centers = tokens[pos]
-    csent = sent_ids[pos]
-    kb, kn = jax.random.split(key)
-    # word2vec dynamic window: per center, b ~ uniform{1..window}
-    b = jax.random.randint(kb, (chunk,), 1, window + 1)
-    offs = jnp.asarray(np.concatenate(
-        [np.arange(-window, 0), np.arange(1, window + 1)]), jnp.int32)
-    cpos = pos[:, None] + offs[None, :]
-    cposc = jnp.clip(cpos, 0, N - 1)
-    valid = ((cpos >= 0) & (cpos < N)
-             & (sent_ids[cposc] == csent[:, None])
-             & (jnp.abs(offs)[None, :] <= b[:, None])
-             & (csent[:, None] >= 0))
-    ctx = tokens[cposc]                                    # [S, 2w]
+    centers, ctx, valid, kn = _window_context(
+        tokens, sent_ids, start, key, chunk=chunk, window=window)
     negs = _alias_sample(kn, alias_J, alias_q,
                          (chunk, 2 * window, K))            # [S, 2w, K]
 
@@ -137,6 +123,84 @@ def _trust_region_apply(table, grad, lr):
     return table - step * jnp.minimum(1.0, MAX_ROW_STEP / jnp.maximum(n, 1e-12))
 
 
+def _window_context(tokens, sent_ids, start, key, *, chunk, window):
+    """Dynamic-window context extraction shared by SGNS and CBOW chunks:
+    returns (centers, ctx [S,2w], valid [S,2w], kn) where kn is the
+    remaining rng key for negative sampling."""
+    N = tokens.shape[0]
+    pos = start + jnp.arange(chunk)
+    centers = tokens[pos]
+    csent = sent_ids[pos]
+    kb, kn = jax.random.split(key)
+    # word2vec dynamic window: per center, b ~ uniform{1..window}
+    b = jax.random.randint(kb, (chunk,), 1, window + 1)
+    offs = jnp.asarray(np.concatenate(
+        [np.arange(-window, 0), np.arange(1, window + 1)]), jnp.int32)
+    cpos = pos[:, None] + offs[None, :]
+    cposc = jnp.clip(cpos, 0, N - 1)
+    valid = ((cpos >= 0) & (cpos < N)
+             & (sent_ids[cposc] == csent[:, None])
+             & (jnp.abs(offs)[None, :] <= b[:, None])
+             & (csent[:, None] >= 0))
+    return centers, tokens[cposc], valid, kn
+
+
+def _chunk_cbow_grads(syn0, syn1neg, tokens, sent_ids, alias_J, alias_q,
+                      start, key, *, chunk, window, K):
+    """CBOW pair gradients for `chunk` consecutive center positions:
+    h = mean(context vectors) predicts the center against K negatives
+    (reference CBOW.java semantics, batched)."""
+    centers, ctx, valid, kn = _window_context(
+        tokens, sent_ids, start, key, chunk=chunk, window=window)
+    vm = valid.astype(syn0.dtype)
+    cnt = jnp.maximum(vm.sum(-1, keepdims=True), 1.0)     # [S, 1]
+    ctxv = syn0[ctx] * vm[..., None]                       # [S, 2w, D]
+    h = ctxv.sum(1) / cnt                                  # [S, D]
+    has_ctx = (vm.sum(-1) > 0).astype(syn0.dtype)          # centers w/ window
+
+    negs = _alias_sample(kn, alias_J, alias_q, (chunk, K))  # [S, K]
+    tgt = syn1neg[centers]                                  # [S, D]
+    negv = syn1neg[negs]                                    # [S, K, D]
+    pos_score = jax.nn.sigmoid(jnp.einsum("sd,sd->s", h, tgt))
+    neg_score = jax.nn.sigmoid(jnp.einsum("sd,skd->sk", h, negv))
+    g_pos = (pos_score - 1.0) * has_ctx                     # [S]
+    g_neg = neg_score * has_ctx[:, None]                    # [S, K]
+
+    grad_h = (g_pos[:, None] * tgt
+              + jnp.einsum("sk,skd->sd", g_neg, negv))      # [S, D]
+    # d h / d ctx_row = vm / cnt
+    grad_ctx = grad_h[:, None, :] * (vm / cnt)[..., None]   # [S, 2w, D]
+    grad_tgt = g_pos[:, None] * h                           # [S, D]
+    grad_neg = g_neg[..., None] * h[:, None, :]             # [S, K, D]
+
+    eps = 1e-10
+    loss = -(jnp.sum(jnp.log(pos_score + eps) * has_ctx)
+             + jnp.sum(jnp.log(1.0 - neg_score + eps) * has_ctx[:, None]))
+    return ctx, grad_ctx, centers, grad_tgt, negs, grad_neg, loss, has_ctx.sum()
+
+
+def make_cbow_epoch(*, window: int, negative: int, chunk: int = 512,
+                    group: int = 4, mesh=None):
+    """CBOW analogue of make_sgns_epoch — same scan/update/mesh contract;
+    syn0 receives context-row gradients, syn1neg center+negative rows."""
+    K = negative
+    pair_grads = partial(_chunk_cbow_grads, chunk=chunk, window=window, K=K)
+
+    def local_grads(syn0, syn1neg, tokens, sent_ids, aJ, aq, starts, keys):
+        (ctx, grad_ctx, centers, grad_tgt, negs, grad_neg, loss, pairs
+         ) = jax.vmap(lambda s, k: pair_grads(
+             syn0, syn1neg, tokens, sent_ids, aJ, aq, s, k))(starts, keys)
+        D = syn0.shape[1]
+        g0 = jnp.zeros_like(syn0).at[ctx.reshape(-1)].add(
+            grad_ctx.reshape(-1, D))
+        g1 = (jnp.zeros_like(syn1neg)
+              .at[centers.reshape(-1)].add(grad_tgt.reshape(-1, D))
+              .at[negs.reshape(-1)].add(grad_neg.reshape(-1, D)))
+        return g0, g1, jnp.sum(loss), jnp.sum(pairs)
+
+    return _build_epoch(local_grads, chunk=chunk, group=group, mesh=mesh)
+
+
 def make_sgns_epoch(*, window: int, negative: int, chunk: int = 512,
                     group: int = 4, mesh=None):
     """Build the jitted epoch function.
@@ -165,6 +229,11 @@ def make_sgns_epoch(*, window: int, negative: int, chunk: int = 512,
               .at[negs.reshape(-1)].add(grad_neg.reshape(-1, D)))
         return g0, g1, jnp.sum(loss), jnp.sum(pairs)
 
+    return _build_epoch(local_grads, chunk=chunk, group=group, mesh=mesh)
+
+
+def _build_epoch(local_grads, *, chunk, group, mesh):
+    """Scan/update/mesh scaffolding shared by the SGNS and CBOW epochs."""
     if mesh is not None:
         from jax.sharding import PartitionSpec as P
         from jax import shard_map

@@ -314,26 +314,32 @@ class SequenceVectors:
         """Whole-epoch on-device training (nlp/device_pipeline.py): the
         corpus is uploaded once per epoch and pair generation, negative
         sampling, and updates all run inside one jitted scan. Supports
-        skip-gram + negative sampling (the word2vec hot path) only;
-        other algorithm combinations raise — requesting the pipeline is
+        skip-gram and CBOW with negative sampling; other combinations
+        (hierarchical softmax) raise — requesting the pipeline is
         explicit, so a silent host-loop fallback would hide a perf cliff."""
         from deeplearning4j_tpu.nlp.device_pipeline import (
             build_alias_table,
+            make_cbow_epoch,
             make_sgns_epoch,
             pack_corpus,
         )
 
-        if self.algorithm != "skipgram" or self.use_hs or self.negative <= 0:
+        if (self.algorithm not in ("skipgram", "cbow") or self.use_hs
+                or self.negative <= 0):
             raise ValueError(
-                "device pipeline supports skip-gram with negative sampling "
-                "(use_hs=False, negative>0); use the host path otherwise")
+                "device pipeline supports skip-gram/CBOW with negative "
+                "sampling (use_hs=False, negative>0); use the host path "
+                "otherwise")
         if self._extra_rows():
             raise ValueError("device pipeline does not support extra label "
                              "rows (ParagraphVectors) — use the host path")
-        cfg = (self.window_size, self.negative, self.pipeline_chunk,
-               self.pipeline_group, id(self.device_mesh))
+        cfg = (self.algorithm, self.window_size, self.negative,
+               self.pipeline_chunk, self.pipeline_group,
+               id(self.device_mesh))
         if self._epoch_fn is None or getattr(self, "_epoch_cfg", None) != cfg:
-            self._epoch_fn = make_sgns_epoch(
+            make_epoch = (make_cbow_epoch if self.algorithm == "cbow"
+                          else make_sgns_epoch)
+            self._epoch_fn = make_epoch(
                 window=self.window_size, negative=self.negative,
                 chunk=self.pipeline_chunk, group=self.pipeline_group,
                 mesh=self.device_mesh)

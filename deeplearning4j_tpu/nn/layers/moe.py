@@ -19,7 +19,11 @@ import jax.numpy as jnp
 from deeplearning4j_tpu.nn.conf.inputs import InputType
 from deeplearning4j_tpu.nn.conf.layers import FeedForwardLayer
 from deeplearning4j_tpu.nn.conf.serde import register_config
-from deeplearning4j_tpu.nn.layers.base import LayerImpl, register_impl
+from deeplearning4j_tpu.nn.layers.base import (
+    LayerImpl,
+    apply_dropout,
+    register_impl,
+)
 from deeplearning4j_tpu.nn.weights import init_weights
 from deeplearning4j_tpu.ops.activations import get_activation
 
@@ -79,6 +83,8 @@ class MixtureOfExpertsImpl(LayerImpl):
 
     def apply(self, conf, params, state, x, *, train=False, rng=None,
               mask=None):
+        if conf.dropout:
+            x = apply_dropout(x, conf.dropout, rng, train=train)
         shape = x.shape
         x2d = x.reshape(-1, shape[-1])
         gates = moe_gates(x2d, params["Wg"], conf.top_k)   # [N, E]

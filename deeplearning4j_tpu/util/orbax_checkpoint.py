@@ -70,6 +70,10 @@ class ShardedCheckpointer:
     def save(self, net, step: Optional[int] = None) -> str:
         step = net.iteration_count if step is None else step
         d = self._step_dir(step)
+        if getattr(self, "_pending", None) is not None:
+            # an earlier async save is still uncommitted: finalize it first
+            # or its meta.json would never be written (invisible + unpruned)
+            self.wait()
         # meta/config go to a staging name and rename AFTER the orbax
         # commit: restore() only selects steps whose meta.json exists, so
         # a crash mid-save can never surface a partial step as "latest"

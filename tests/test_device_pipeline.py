@@ -131,3 +131,29 @@ def test_quality_on_zipf_corpus_with_trust_region():
             cross.append(w.similarity(f"w{j}", f"w{j + 4}"))
     assert np.mean(paired) > np.mean(cross) + 0.05, (
         np.mean(paired), np.mean(cross))
+
+
+def test_cbow_device_pipeline_learns_and_mesh_parity():
+    """CBOW on the device pipeline: learns co-occurrence structure and is
+    device-count invariant (same psum'd-gradient contract as SGNS)."""
+    sents = _structured_corpus(n=400, seed=4)
+
+    def build(mesh_arg):
+        w = (Word2Vec.builder().layer_size(24).window_size(2)
+             .min_word_frequency(1).negative_sample(4).epochs(3).seed(5)
+             .elements_learning_algorithm("cbow")
+             .use_device_pipeline(True).build())
+        w.pipeline_chunk, w.pipeline_group = 128, 4
+        w.device_mesh = mesh_arg
+        return w
+
+    w = build(None)
+    w.fit(sents)
+    assert w.loss_history and all(np.isfinite(l) for l in w.loss_history)
+    assert w.similarity("a3", "b3") > w.similarity("a3", "b11")
+
+    w_mesh = build(make_mesh({"data": 4}))
+    w_mesh.fit(sents)
+    np.testing.assert_allclose(np.asarray(w.lookup_table.syn0),
+                               np.asarray(w_mesh.lookup_table.syn0),
+                               atol=1e-5)

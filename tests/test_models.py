@@ -84,3 +84,19 @@ def test_transformer_flops_accounting():
     # 3x(fwd) with fwd = layers*(8d^2 + 4d*dff + 4Td) + 2dV
     fwd = 6 * (8 * 256**2 + 4 * 256 * 1024 + 4 * 512 * 256) + 2 * 256 * 10000
     assert fl == 3 * fwd
+
+
+def test_transformer_moe_lm_builds_and_fits():
+    from deeplearning4j_tpu.models.transformer import transformer_moe_lm
+
+    net = transformer_moe_lm(vocab_size=50, d_model=16, n_heads=2,
+                             n_layers=2, n_experts=4, top_k=2,
+                             d_expert_hidden=32, max_length=12)
+    net.init()
+    rng = np.random.default_rng(0)
+    toks = np.asarray(rng.integers(0, 50, (4, 12)), np.int32)
+    net.fit_scanned(toks, np.roll(toks, -1, 1), epochs=4)
+    assert np.isfinite(net.score_value)
+    assert float(net._epoch_losses[-1]) < float(net._epoch_losses[0])
+    # expert params present per block
+    assert net.params["blk0_moe"]["We1"].shape == (4, 16, 32)

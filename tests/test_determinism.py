@@ -1,0 +1,92 @@
+"""Determinism by construction (SURVEY.md §5): the reference embraces
+HogWild data races (SequenceVectors threads on shared syn0); this build
+replaces shared-memory racing with keyed PRNG + order-free collective
+sums, so identical seeds must give bitwise-identical results — across
+runs, across fit/fit_scanned restarts, and across device counts."""
+
+import numpy as np
+
+from deeplearning4j_tpu.datasets.api import DataSet
+from deeplearning4j_tpu.nn.conf import (
+    DenseLayer,
+    NeuralNetConfiguration,
+    OutputLayer,
+)
+from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+
+
+def _net(seed=11):
+    conf = (
+        NeuralNetConfiguration.builder()
+        .seed(seed)
+        .learning_rate(0.1)
+        .updater("adam")
+        .dropout(0.2)  # rng-consuming path included
+        .list()
+        .layer(DenseLayer(n_in=4, n_out=8, activation="tanh"))
+        .layer(OutputLayer(n_in=8, n_out=2, activation="softmax",
+                           loss_function="mcxent"))
+        .build()
+    )
+    return MultiLayerNetwork(conf).init()
+
+
+def _data():
+    rng = np.random.default_rng(0)
+    return DataSet(rng.random((32, 4), dtype=np.float32),
+                   np.eye(2, dtype=np.float32)[rng.integers(0, 2, 32)])
+
+
+def test_training_bitwise_reproducible_across_runs():
+    ds = _data()
+    runs = []
+    for _ in range(2):
+        net = _net()
+        for _ in range(5):
+            net.fit(ds)
+        runs.append(np.asarray(net.params_flat()))
+    np.testing.assert_array_equal(runs[0], runs[1])
+
+
+def test_init_reproducible_across_runs():
+    np.testing.assert_array_equal(np.asarray(_net().params_flat()),
+                                  np.asarray(_net().params_flat()))
+
+
+def test_word2vec_device_pipeline_reproducible():
+    from deeplearning4j_tpu.nlp.word2vec import Word2Vec
+
+    rng = np.random.default_rng(0)
+    sents = [[f"w{rng.integers(0, 20)}", f"v{rng.integers(0, 20)}"] * 3
+             for _ in range(200)]
+
+    def run():
+        w = (Word2Vec.builder().layer_size(16).window_size(2)
+             .min_word_frequency(1).negative_sample(3).epochs(2).seed(9)
+             .use_device_pipeline(True).build())
+        w.fit(sents)
+        return np.asarray(w.lookup_table.syn0)
+
+    np.testing.assert_array_equal(run(), run())
+
+
+def test_device_count_invariance_of_mesh_word2vec():
+    """2-device and 4-device meshes give identical embeddings (order-free
+    psum'd gradients — the anti-HogWild design property)."""
+    from deeplearning4j_tpu.nlp.word2vec import Word2Vec
+    from deeplearning4j_tpu.parallel.mesh import make_mesh
+
+    rng = np.random.default_rng(1)
+    sents = [[f"w{rng.integers(0, 15)}", f"v{rng.integers(0, 15)}"] * 2
+             for _ in range(200)]
+
+    def run(n_dev):
+        w = (Word2Vec.builder().layer_size(16).window_size(2)
+             .min_word_frequency(1).negative_sample(3).epochs(1).seed(4)
+             .use_device_pipeline(True)
+             .device_mesh(make_mesh({"data": n_dev}), chunk=64, group=4)
+             .build())
+        w.fit(sents)
+        return np.asarray(w.lookup_table.syn0)
+
+    np.testing.assert_allclose(run(2), run(4), atol=1e-6)

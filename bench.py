@@ -37,7 +37,7 @@ TARGETS = {
                              # steady-state; per-step Python dispatch caps a
                              # naive loop far lower)
     "vgg16": 55000.0,        # images/sec/chip (r2 measured: 59.3k, fit_scanned)
-    "word2vec": 190000.0,    # words/sec (r2 measured: 199-225k, device pipeline)
+    "word2vec": 300000.0,    # words/sec (r2 measured: 317k, shared negatives)
     "resnet_dp": 1.0,        # allreduce/param-avg speedup (>=1 expected)
     "transformer": 0.30,     # MFU fraction (north star >=30%)
 }
@@ -107,9 +107,14 @@ def _time_net_steps(net, ds, steps: int) -> float:
     timed(steps)       # compile
     timed(3 * steps)   # compile
     # tunnel jitter is hundreds of ms; min-of-3 is the robust estimator
-    t1 = min(timed(steps) for _ in range(3))
-    t3 = min(timed(3 * steps) for _ in range(3))
-    return max((t3 - t1) / (2 * steps), 1e-9)
+    for attempt in range(3):
+        t1 = min(timed(steps) for _ in range(3))
+        t3 = min(timed(3 * steps) for _ in range(3))
+        if t3 - t1 > 0.05 * t3:  # slope must dominate jitter
+            return (t3 - t1) / (2 * steps)
+    # degenerate slope even after retries (heavy contention): report the
+    # latency-inclusive upper bound rather than a fabricated number
+    return t3 / (3 * steps)
 
 
 def _measure_matmul_tflops():

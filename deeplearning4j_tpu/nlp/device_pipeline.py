@@ -17,9 +17,14 @@ designs are host/IO bound. Here the WHOLE epoch runs on device:
   equivalent of Word2VecPerformer's accumulated updates, with the same
   result on any device count (gradient sums are order-free).
 
-Semantics match the batched host path (`lookup.sgns_step`): per-update
+Semantics follow the batched host path (`lookup.sgns_step`): per-update
 summed gradients with the MAX_ROW_STEP trust region; negatives drawn from
 the same unigram^0.75 distribution (on device via Walker alias tables).
+By default SGNS shares each center's K negatives across its context slots
+with pair-count weighting (`share_negatives=True`) — expectation-
+equivalent to per-pair draws with ~10x fewer scatter rows; pass
+`share_negatives=False` (SequenceVectors: `pipeline_share_negatives`)
+for strict per-pair sampling.
 """
 
 from __future__ import annotations
@@ -83,35 +88,52 @@ def pack_corpus(idx_seqs: List[np.ndarray], multiple: int
 
 
 def _chunk_pair_grads(syn0, syn1neg, tokens, sent_ids, alias_J, alias_q,
-                      start, key, *, chunk, window, K):
+                      start, key, *, chunk, window, K, share_negatives=True):
     """Pair gradients for `chunk` consecutive center positions.
 
     Returns per-pair gradient pieces (no dense tables — those are built
     once per update so a vmap over chunks stays memory-light) plus the
     masked loss sum and valid-pair count.
+
+    share_negatives: the K negatives are drawn once PER CENTER and each
+    contributes with weight n_valid_pairs(center) — expectation-equivalent
+    to per-pair draws (negatives score only against the center vector in
+    SGNS) with 2w x fewer sampled rows, so the scatter update shrinks ~10x
+    (the negative-sharing batching SURVEY.md §7 calls for). Set False for
+    strict per-pair sampling.
     """
     centers, ctx, valid, kn = _window_context(
         tokens, sent_ids, start, key, chunk=chunk, window=window)
-    negs = _alias_sample(kn, alias_J, alias_q,
-                         (chunk, 2 * window, K))            # [S, 2w, K]
-
     c = syn0[centers]                                      # [S, D]
     posv = syn1neg[ctx]                                    # [S, 2w, D]
-    negv = syn1neg[negs]                                   # [S, 2w, K, D]
     pos_score = jax.nn.sigmoid(jnp.einsum("sd,swd->sw", c, posv))
-    neg_score = jax.nn.sigmoid(jnp.einsum("sd,swkd->swk", c, negv))
     vm = valid.astype(c.dtype)
     g_pos = (pos_score - 1.0) * vm                         # [S, 2w]
-    g_neg = neg_score * vm[..., None]                      # [S, 2w, K]
-
-    grad_c = (jnp.einsum("sw,swd->sd", g_pos, posv)
-              + jnp.einsum("swk,swkd->sd", g_neg, negv))   # [S, D]
     grad_pos = g_pos[..., None] * c[:, None, :]            # [S, 2w, D]
-    grad_neg = g_neg[..., None] * c[:, None, None, :]      # [S, 2w, K, D]
-
     eps = 1e-10
-    loss = -(jnp.sum(jnp.log(pos_score + eps) * vm)
-             + jnp.sum(jnp.log(1.0 - neg_score + eps) * vm[..., None]))
+    loss = -jnp.sum(jnp.log(pos_score + eps) * vm)
+
+    grad_c_pos = jnp.einsum("sw,swd->sd", g_pos, posv)     # shared term
+    if share_negatives:
+        negs = _alias_sample(kn, alias_J, alias_q, (chunk, K))  # [S, K]
+        negv = syn1neg[negs]                               # [S, K, D]
+        neg_score = jax.nn.sigmoid(jnp.einsum("sd,skd->sk", c, negv))
+        pair_cnt = vm.sum(-1)                              # [S]
+        g_neg = neg_score * pair_cnt[:, None]              # [S, K]
+        grad_c = grad_c_pos + jnp.einsum("sk,skd->sd", g_neg, negv)
+        grad_neg = g_neg[..., None] * c[:, None, :]        # [S, K, D]
+        loss = loss - jnp.sum(
+            jnp.log(1.0 - neg_score + eps) * pair_cnt[:, None])
+    else:
+        negs = _alias_sample(kn, alias_J, alias_q,
+                             (chunk, 2 * window, K))        # [S, 2w, K]
+        negv = syn1neg[negs]                               # [S, 2w, K, D]
+        neg_score = jax.nn.sigmoid(jnp.einsum("sd,swkd->swk", c, negv))
+        g_neg = neg_score * vm[..., None]                  # [S, 2w, K]
+        grad_c = grad_c_pos + jnp.einsum("swk,swkd->sd", g_neg, negv)
+        grad_neg = g_neg[..., None] * c[:, None, None, :]  # [S, 2w, K, D]
+        loss = loss - jnp.sum(jnp.log(1.0 - neg_score + eps) * vm[..., None])
+
     return centers, grad_c, ctx, grad_pos, negs, grad_neg, loss, vm.sum()
 
 
@@ -202,7 +224,7 @@ def make_cbow_epoch(*, window: int, negative: int, chunk: int = 512,
 
 
 def make_sgns_epoch(*, window: int, negative: int, chunk: int = 512,
-                    group: int = 4, mesh=None):
+                    group: int = 4, mesh=None, share_negatives: bool = True):
     """Build the jitted epoch function.
 
     epoch(syn0, syn1neg, tokens, sent_ids, alias_J, alias_q, key, lr0, lr1)
@@ -215,7 +237,8 @@ def make_sgns_epoch(*, window: int, negative: int, chunk: int = 512,
     same update as single-device, so device count never changes results.
     """
     K = negative
-    pair_grads = partial(_chunk_pair_grads, chunk=chunk, window=window, K=K)
+    pair_grads = partial(_chunk_pair_grads, chunk=chunk, window=window, K=K,
+                         share_negatives=share_negatives)
 
     def local_grads(syn0, syn1neg, tokens, sent_ids, aJ, aq, starts, keys):
         (centers, grad_c, ctx, grad_pos, negs, grad_neg, loss, pairs

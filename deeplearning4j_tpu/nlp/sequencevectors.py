@@ -79,7 +79,8 @@ class SequenceVectors:
                  elements_learning_algorithm: str = "skipgram",
                  vocab_limit: Optional[int] = None,
                  use_device_pipeline: bool = False, device_mesh=None,
-                 pipeline_chunk: int = 512, pipeline_group: int = 4):
+                 pipeline_chunk: int = 512, pipeline_group: int = 4,
+                 pipeline_share_negatives: bool = True):
         self.layer_size = layer_size
         self.window_size = window_size
         self.min_word_frequency = min_word_frequency
@@ -97,6 +98,7 @@ class SequenceVectors:
         self.device_mesh = device_mesh
         self.pipeline_chunk = pipeline_chunk
         self.pipeline_group = pipeline_group
+        self.pipeline_share_negatives = pipeline_share_negatives
         self._epoch_fn = None
 
         self.vocab: Optional[VocabCache] = None
@@ -335,14 +337,19 @@ class SequenceVectors:
                              "rows (ParagraphVectors) — use the host path")
         cfg = (self.algorithm, self.window_size, self.negative,
                self.pipeline_chunk, self.pipeline_group,
-               id(self.device_mesh))
+               self.pipeline_share_negatives, id(self.device_mesh))
         if self._epoch_fn is None or getattr(self, "_epoch_cfg", None) != cfg:
-            make_epoch = (make_cbow_epoch if self.algorithm == "cbow"
-                          else make_sgns_epoch)
-            self._epoch_fn = make_epoch(
-                window=self.window_size, negative=self.negative,
-                chunk=self.pipeline_chunk, group=self.pipeline_group,
-                mesh=self.device_mesh)
+            if self.algorithm == "cbow":
+                self._epoch_fn = make_cbow_epoch(
+                    window=self.window_size, negative=self.negative,
+                    chunk=self.pipeline_chunk, group=self.pipeline_group,
+                    mesh=self.device_mesh)
+            else:
+                self._epoch_fn = make_sgns_epoch(
+                    window=self.window_size, negative=self.negative,
+                    chunk=self.pipeline_chunk, group=self.pipeline_group,
+                    mesh=self.device_mesh,
+                    share_negatives=self.pipeline_share_negatives)
             self._epoch_cfg = cfg
         t = self.lookup_table
         probs = np.diff(self._cum_table, prepend=0.0)

@@ -95,6 +95,12 @@ class Word2Vec(SequenceVectors):
             self._kw["use_device_pipeline"] = flag
             return self
 
+        def share_negatives(self, flag=True):
+            """Per-center negative sharing in the device pipeline (default
+            on; False = strict per-pair sampling)."""
+            self._kw["pipeline_share_negatives"] = flag
+            return self
+
         def device_mesh(self, mesh, chunk: int = 512, group: int = 4):
             """Shard the chunk stream over mesh's 'data' axis (DP-5).
             Implies use_device_pipeline."""

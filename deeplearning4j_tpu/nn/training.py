@@ -148,13 +148,22 @@ def fused_fit(net, batches, epochs):
         n_epochs=epochs)
     per_epoch = losses.mean(axis=1)
     nb = len(batches)
-    for e in range(epochs):
-        net.iteration_count += nb
+    if net.listeners:
+        # counters advance WITH the callbacks so listeners that read model
+        # state (per-epoch checkpointers keyed on iteration_count) see the
+        # running values; per_epoch[e] device indexing happens only when
+        # someone is listening — a bare fit_scanned stays one dispatch
+        for e in range(epochs):
+            net.iteration_count += nb
+            if hasattr(net, "epoch_count"):
+                net.epoch_count += 1
+            net.score_value = per_epoch[e]
+            for lst in net.listeners:
+                lst.iteration_done(net, net.iteration_count)
+    else:
+        net.iteration_count += epochs * nb
         if hasattr(net, "epoch_count"):
-            net.epoch_count += 1
-        net.score_value = per_epoch[e]
-        for lst in net.listeners:
-            lst.iteration_done(net, net.iteration_count)
+            net.epoch_count += epochs
     net.score_value = losses[-1, -1]
     net._epoch_losses = per_epoch
     return net

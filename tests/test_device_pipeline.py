@@ -157,3 +157,14 @@ def test_cbow_device_pipeline_learns_and_mesh_parity():
     np.testing.assert_allclose(np.asarray(w.lookup_table.syn0),
                                np.asarray(w_mesh.lookup_table.syn0),
                                atol=1e-5)
+
+
+def test_strict_per_pair_negative_sampling_opt_out():
+    """share_negatives=False restores per-pair draws; both modes learn."""
+    sents = _structured_corpus(n=300, seed=6)
+    w = (Word2Vec.builder().layer_size(16).window_size(2)
+         .min_word_frequency(1).negative_sample(3).epochs(2).seed(3)
+         .use_device_pipeline(True).share_negatives(False).build())
+    w.fit(sents)
+    assert w.pipeline_share_negatives is False
+    assert w.similarity("a3", "b3") > w.similarity("a3", "b11")

@@ -11,6 +11,7 @@ from __future__ import annotations
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 from deeplearning4j_tpu.nn.conf.enums import HiddenUnit, VisibleUnit
 from deeplearning4j_tpu.nn.conf.layers import (
@@ -78,10 +79,48 @@ class OutputImpl(LayerImpl):
         return z
 
     def loss(self, conf, params, x, labels, *, train=False, rng=None, mask=None):
-        y, z = _dense_forward(conf, params, x, train, rng)
         act = (conf.activation or "").lower()
+        if self._use_fused_head(conf, params, x, labels, act):
+            from deeplearning4j_tpu.ops.fused_softmax_xent import (
+                softmax_xent_head,
+            )
+            from deeplearning4j_tpu.ops.losses import _masked_mean
+
+            if conf.dropout:
+                x = apply_dropout(x, conf.dropout, rng, train=train)
+            per = softmax_xent_head(x, params["W"], params["b"], labels)
+            return _masked_mean(per, mask)
+        y, z = _dense_forward(conf, params, x, train, rng)
         logits = z if act in ("softmax", "sigmoid") else None
         return compute_loss(conf.loss_function, labels, y, mask, logits=logits)
+
+    @staticmethod
+    def _use_fused_head(conf, params, x, labels, act):
+        """Large-vocab sparse-label softmax/mcxent on TPU: dispatch to the
+        fused Pallas head (ops/fused_softmax_xent.py) instead of
+        materializing [N, V] logits."""
+        from deeplearning4j_tpu.ops import fused_softmax_xent as fsx
+        from deeplearning4j_tpu.ops.losses import LossFunction
+
+        if fsx.FORCE_FUSED is False:
+            return False
+        loss_name = conf.loss_function
+        if callable(loss_name):
+            return False
+        if act != "softmax" or str(loss_name).lower() not in (
+                LossFunction.MCXENT, LossFunction.NEGATIVELOGLIKELIHOOD):
+            return False
+        if not (labels.ndim == x.ndim - 1
+                and jnp.issubdtype(labels.dtype, jnp.integer)):
+            return False
+        if getattr(conf, "drop_connect", False):
+            return False
+        n = int(np.prod(x.shape[:-1]))
+        d = x.shape[-1]
+        v = params["W"].shape[-1]
+        if not fsx.supports(n, d, v):
+            return False
+        return bool(fsx.FORCE_FUSED) or jax.default_backend() == "tpu"
 
 
 @register_impl(ActivationLayer)

@@ -60,7 +60,8 @@ def test_supports_gate():
     assert supports((2, 2, MIN_FLASH_SEQ, 64), causal=True, dropout=0.0,
                     mask=None)
     # short sequences use XLA's fused dense path (faster below the cutoff)
-    assert not supports((2, 2, 512, 64), causal=True, dropout=0.0, mask=None)
+    assert not supports((2, 2, MIN_FLASH_SEQ // 2, 64), causal=True,
+                        dropout=0.0, mask=None)
     # dropout and padding masks are dense-only cases
     assert not supports((2, 2, MIN_FLASH_SEQ, 64), causal=True, dropout=0.1,
                         mask=None)

@@ -1,0 +1,243 @@
+"""Small host-side utilities filling out the SURVEY §2 inventory:
+StringGrid/StringCluster dedupe, DiskBasedQueue, SloppyMath, the
+unstructured-data train/test formatter, ImageVectorizer, PlotFilters, and
+the moving-window converters."""
+
+import math
+import os
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.util.string_grid import (
+    StringCluster,
+    StringGrid,
+    fingerprint,
+)
+
+
+def test_fingerprint_clusters_reorderings():
+    c = StringCluster(["Two words", "TWO words", "words two", "other"])
+    assert fingerprint("Two words") == fingerprint("words TWO!")
+    clusters = c.get_clusters()
+    assert len(c) == 2
+    assert sum(clusters[0].values()) == 3  # biggest cluster first
+
+
+def test_string_grid_cleanup_and_dedupe(tmp_path):
+    lines = ["a,1,x", "b,2,y", "a,3,z", "A,4,w", ",5,v"]
+    g = StringGrid.from_lines(lines, ",")
+    assert len(g) == 5 and g.num_columns == 3
+    g.remove_rows_with_empty_column(0)
+    assert len(g) == 4
+    dup = g.get_rows_with_duplicate_values_in_column(0)
+    assert len(dup) == 2  # the two literal "a" rows
+    g.dedupe_by_cluster(0)  # "a", "a", "A" share a fingerprint
+    assert len(g) == 2
+    p = tmp_path / "grid.csv"
+    g.write_lines_to(str(p))
+    g2 = StringGrid.from_file(str(p), ",")
+    assert g2.rows == g.rows
+
+
+def test_string_grid_similarity_filter():
+    g = StringGrid(",", rows=[["color", "colour"], ["color", "zebra"]])
+    assert len(g.get_all_with_similarity(0.8, 0, 1)) == 1
+
+
+def test_disk_based_queue(tmp_path):
+    from deeplearning4j_tpu.util.disk_queue import DiskBasedQueue
+
+    q = DiskBasedQueue(str(tmp_path / "q"))
+    assert q.is_empty() and q.poll() is None
+    q.add({"a": 1})
+    q.add_all([[1, 2], "three"])
+    assert len(q) == 3
+    assert q.peek() == {"a": 1}
+    assert q.poll() == {"a": 1}
+    assert q.poll() == [1, 2]
+    assert q.poll() == "three"
+    assert q.poll() is None
+    q.close()
+
+
+def test_disk_queue_refuses_foreign_directory(tmp_path):
+    from deeplearning4j_tpu.util.disk_queue import DiskBasedQueue
+
+    d = tmp_path / "data"
+    d.mkdir()
+    (d / "precious.txt").write_text("keep me")
+    with pytest.raises(ValueError):
+        DiskBasedQueue(str(d))
+    assert (d / "precious.txt").exists()
+    # but it does reclaim its own stale directory
+    q1 = DiskBasedQueue(str(tmp_path / "q"))
+    q1.add(1)
+    q2 = DiskBasedQueue(str(tmp_path / "q"))
+    assert q2.is_empty()
+
+
+def test_sloppy_math():
+    from deeplearning4j_tpu.util import sloppy_math as sm
+
+    assert np.isclose(sm.log_add(math.log(2), math.log(3)), math.log(5))
+    assert np.isclose(sm.log_add([math.log(1), math.log(2), math.log(3)]),
+                      math.log(6))
+    # truncation: a summand 40 nats down is treated as zero
+    assert sm.log_add(0.0, -40.0) == 0.0
+    assert np.isclose(sm.log_subtract(math.log(5), math.log(2)), math.log(3))
+    p = np.exp(sm.log_normalize([0.0, 0.0]))
+    np.testing.assert_allclose(p, [0.5, 0.5])
+    assert sm.n_choose_k(5, 2) == 10
+    assert sm.int_pow(3, 5) == 243
+    assert sm.is_dangerous(float("nan")) and sm.is_dangerous(0.0)
+    assert not sm.is_dangerous(1.0)
+
+
+def test_unstructured_formatter_directory_labels(tmp_path):
+    from deeplearning4j_tpu.datasets.rearrange import (
+        LabelingType,
+        LocalUnstructuredDataFormatter,
+    )
+
+    src = tmp_path / "raw"
+    for label in ("cat", "dog"):
+        (src / label).mkdir(parents=True)
+        for i in range(10):
+            (src / label / f"img{i}.txt").write_text(f"{label}{i}")
+    fmt = LocalUnstructuredDataFormatter(
+        str(tmp_path / "out"), str(src), LabelingType.DIRECTORY,
+        percent_train=0.8, seed=0)
+    fmt.rearrange()
+    assert fmt.num_examples_total == 20
+    assert fmt.num_examples_to_train_on == 16
+    n_train = sum(len(files) for _, _, files in os.walk(fmt.get_train()))
+    n_test = sum(len(files) for _, _, files in os.walk(fmt.get_test()))
+    assert (n_train, n_test) == (16, 4)
+    # labels preserved as subdirectories
+    assert set(os.listdir(fmt.get_train())) <= {"cat", "dog"}
+    # refuses to overwrite an existing split
+    with pytest.raises(FileExistsError):
+        LocalUnstructuredDataFormatter(
+            str(tmp_path / "out"), str(src), LabelingType.DIRECTORY, 0.8)
+
+
+def test_formatter_disambiguates_duplicate_basenames(tmp_path):
+    from deeplearning4j_tpu.datasets.rearrange import (
+        LabelingType,
+        LocalUnstructuredDataFormatter,
+    )
+
+    src = tmp_path / "raw"
+    for sub in ("part_a", "part_b"):
+        (src / sub / "cat").mkdir(parents=True)
+        (src / sub / "cat" / "img0.txt").write_text(sub)
+    fmt = LocalUnstructuredDataFormatter(
+        str(tmp_path / "out"), str(src), LabelingType.DIRECTORY,
+        percent_train=1.0, seed=0)
+    fmt.rearrange()
+    n = sum(len(files) for _, _, files in os.walk(fmt.get_train()))
+    assert n == 2  # both survive despite the shared basename
+
+
+def test_name_label_parsing():
+    from deeplearning4j_tpu.datasets.rearrange import (
+        LocalUnstructuredDataFormatter as F,
+    )
+
+    assert F.get_name_label("/data/img1-cat.png") == "cat"
+    with pytest.raises(ValueError):
+        F.get_name_label("/data/nolabel.png")
+    with pytest.raises(ValueError):
+        F.get_name_label("/data/noext")
+
+
+def test_image_vectorizer(tmp_path):
+    from deeplearning4j_tpu.datasets.vectorizer import ImageVectorizer
+    from deeplearning4j_tpu.util.image_loader import ImageLoader
+
+    img = (np.arange(64, dtype=np.float32).reshape(8, 8) / 63.0)
+    p = str(tmp_path / "img.pgm")
+    ImageLoader.save(img[..., None], p)
+    ds = ImageVectorizer(p, num_labels=3, label=1).normalize().vectorize()
+    assert ds.features.shape[0] == 1
+    assert ds.features.max() <= 1.0
+    np.testing.assert_array_equal(ds.labels, [[0, 1, 0]])
+    ds_bin = ImageVectorizer(p, num_labels=3, label=0).binarize(30).vectorize()
+    assert set(np.unique(ds_bin.features)) <= {0.0, 1.0}
+
+
+def test_plot_filters_grid():
+    from deeplearning4j_tpu.plot.filters import PlotFilters
+
+    filters = np.random.default_rng(0).random((6, 16))  # 6 4x4 filters
+    pf = PlotFilters(filters, tile_shape=(2, 3), tile_spacing=(1, 1),
+                     image_shape=(4, 4))
+    grid = pf.plot()
+    assert grid.shape == ((4 + 1) * 2 - 1, (4 + 1) * 3 - 1)
+    assert grid.max() <= 255.0 and grid.min() >= 0.0
+    assert pf.get_plot() is grid
+
+
+def test_plot_filters_listener():
+    from deeplearning4j_tpu.nn.conf import (
+        DenseLayer,
+        NeuralNetConfiguration,
+        OutputLayer,
+    )
+    from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+    from deeplearning4j_tpu.plot.filters import PlotFiltersIterationListener
+
+    conf = (NeuralNetConfiguration.builder().seed(1).list()
+            .layer(DenseLayer(n_in=16, n_out=4, activation="relu"))
+            .layer(OutputLayer(n_in=4, n_out=2, activation="softmax",
+                               loss_function="mcxent"))
+            .build())
+    net = MultiLayerNetwork(conf)
+    net.init()
+    name = next(iter(net.params))
+    lst = PlotFiltersIterationListener(name, tile_shape=(2, 2),
+                                       image_shape=(4, 4), frequency=1)
+    lst.iteration_done(net, 0)
+    assert lst.last_plot is not None and lst.invoked == 1
+
+
+def test_context_label_retriever():
+    from deeplearning4j_tpu.nlp.movingwindow import string_with_labels
+
+    clean, spans = string_with_labels(
+        "the <LOC> new york </LOC> subway is <ADJ> loud </ADJ> today")
+    assert clean == "the new york subway is loud today"
+    assert spans == {(1, 3): "LOC", (5, 6): "ADJ"}
+    with pytest.raises(ValueError):
+        string_with_labels("<A> oops </B>")
+    with pytest.raises(ValueError):
+        string_with_labels("stray </A> end")
+    with pytest.raises(ValueError):
+        string_with_labels("<A> unclosed")
+    # NONE spans are stripped from the markup but omitted from the map
+    clean2, spans2 = string_with_labels("<NONE> the </NONE> <LOC1> lhr </LOC1>")
+    assert clean2 == "the lhr" and spans2 == {(1, 2): "LOC1"}
+
+
+def test_window_converter():
+    from deeplearning4j_tpu.nlp.movingwindow import WindowConverter
+    from deeplearning4j_tpu.nlp.text import windows
+
+    class FakeVec:
+        layer_size = 4
+
+        def word_vector(self, w):
+            if w == "<none>":
+                return None
+            return np.full((4,), float(len(w)), np.float32)
+
+    ws = windows(["a", "bb", "ccc"], window_size=3)
+    ex = WindowConverter.as_example_array(ws[1], FakeVec())
+    assert ex.shape == (12,)
+    np.testing.assert_allclose(ex[:4], 1.0)   # "a"
+    np.testing.assert_allclose(ex[4:8], 2.0)  # focus "bb"
+    mat = WindowConverter.as_example_matrix(ws, FakeVec(), normalize=True)
+    assert mat.shape == (3, 12)
+    # normalized vectors have unit norm per word slot
+    np.testing.assert_allclose(np.linalg.norm(mat[1, 4:8]), 1.0, rtol=1e-6)

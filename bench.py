@@ -391,12 +391,31 @@ def _run_all() -> int:
             env["JAX_PLATFORMS"] = "cpu"
             env["XLA_FLAGS"] = (env.get("XLA_FLAGS", "")
                                 + " --xla_force_host_platform_device_count=8")
-        try:
-            out = subprocess.run(
-                [sys.executable, os.path.abspath(__file__), mode],
-                env=env, capture_output=True, text=True, timeout=900)
-        except subprocess.TimeoutExpired:
+        out = None
+        timed_out = False
+        for attempt in range(2):
+            try:
+                attempt_out = subprocess.run(
+                    [sys.executable, os.path.abspath(__file__), mode],
+                    env=env, capture_output=True, text=True, timeout=900)
+            except subprocess.TimeoutExpired:
+                timed_out = True
+                break
+            out = attempt_out
+            # retry once only when the child was killed by a signal
+            # (rc < 0: OOM/SIGABRT under transient host contention);
+            # ordinary nonzero exits are deterministic — report them
+            if out.returncode >= 0:
+                break
+        if out is None:
             print(json.dumps({"metric": mode, "error": "timeout"}), flush=True)
+            rc = 1
+            continue
+        if timed_out and out.returncode != 0:
+            sys.stderr.write(out.stderr[-2000:])
+            print(json.dumps({"metric": mode,
+                              "error": f"rc={out.returncode}, retry timeout"}),
+                  flush=True)
             rc = 1
             continue
         for line in out.stdout.splitlines():

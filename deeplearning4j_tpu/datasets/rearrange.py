@@ -57,15 +57,23 @@ class LocalUnstructuredDataFormatter:
         self.num_examples_to_train_on = n_train
         self.num_test_examples = self.num_examples_total - n_train
         random.Random(self.seed).shuffle(all_files)
-        for i, path in enumerate(all_files):
-            dest = self.get_new_destination(path, train=i < n_train)
-            os.makedirs(os.path.dirname(dest), exist_ok=True)
-            if os.path.exists(dest):
-                # same basename under the same label from different source
-                # dirs: disambiguate instead of silently overwriting
-                d, name = os.path.split(dest)
-                dest = os.path.join(d, f"{i}-{name}")
-            shutil.copy(path, dest)
+        try:
+            # validate every label BEFORE copying so a bad file name can't
+            # leave a partial split behind (which would then block reruns
+            # with FileExistsError)
+            dests = [self.get_new_destination(p, train=i < n_train)
+                     for i, p in enumerate(all_files)]
+            for i, (path, dest) in enumerate(zip(all_files, dests)):
+                os.makedirs(os.path.dirname(dest), exist_ok=True)
+                if os.path.exists(dest):
+                    # same basename under the same label from different
+                    # source dirs: disambiguate, don't silently overwrite
+                    d, name = os.path.split(dest)
+                    dest = os.path.join(d, f"{i}-{name}")
+                shutil.copy(path, dest)
+        except Exception:
+            shutil.rmtree(self.split_root, ignore_errors=True)
+            raise
 
     def get_new_destination(self, path: str, train: bool) -> str:
         base = self.train_dir if train else self.test_dir

@@ -105,6 +105,10 @@ class StringGrid:
 
     def remove_columns(self, *columns: int) -> None:
         drop = set(columns)
+        bad = [c for c in drop if not 0 <= c < self.num_columns]
+        if bad:
+            raise IndexError(f"column(s) {bad} out of range "
+                             f"(grid has {self.num_columns})")
         self.rows = [[c for j, c in enumerate(r) if j not in drop]
                      for r in self.rows]
         self.num_columns -= len(drop)
@@ -125,10 +129,13 @@ class StringGrid:
         for r in self.rows:
             r[column1], r[column2] = r[column2], r[column1]
 
-    def merge(self, column1: int, column2: int) -> None:
-        """Join two columns with the grid separator, dropping the second."""
+    def merge(self, column1: int, column2: int,
+              join_with: str = " ") -> None:
+        """Join two columns, dropping the second. Joins with a space by
+        default — joining with the grid separator (as the reference does)
+        would make write/read round-trips silently re-split the column."""
         for r in self.rows:
-            r[column1] = r[column1] + self.sep + r[column2]
+            r[column1] = r[column1] + join_with + r[column2]
         self.remove_columns(column2)
 
     def split(self, column: int, sep_by: str) -> None:

@@ -411,7 +411,7 @@ def _run_all() -> int:
             print(json.dumps({"metric": mode, "error": "timeout"}), flush=True)
             rc = 1
             continue
-        if timed_out and out.returncode != 0:
+        if timed_out:  # only reachable after a signal-killed first attempt
             sys.stderr.write(out.stderr[-2000:])
             print(json.dumps({"metric": mode,
                               "error": f"rc={out.returncode}, retry timeout"}),

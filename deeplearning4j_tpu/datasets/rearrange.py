@@ -57,6 +57,7 @@ class LocalUnstructuredDataFormatter:
         self.num_examples_to_train_on = n_train
         self.num_test_examples = self.num_examples_total - n_train
         random.Random(self.seed).shuffle(all_files)
+        ok = False
         try:
             # validate every label BEFORE copying so a bad file name can't
             # leave a partial split behind (which would then block reruns
@@ -71,9 +72,11 @@ class LocalUnstructuredDataFormatter:
                     d, name = os.path.split(dest)
                     dest = os.path.join(d, f"{i}-{name}")
                 shutil.copy(path, dest)
-        except Exception:
-            shutil.rmtree(self.split_root, ignore_errors=True)
-            raise
+            ok = True
+        finally:
+            # finally (not except) so Ctrl-C mid-copy also cleans up
+            if not ok:
+                shutil.rmtree(self.split_root, ignore_errors=True)
 
     def get_new_destination(self, path: str, train: bool) -> str:
         base = self.train_dir if train else self.test_dir

@@ -134,6 +134,11 @@ class StringGrid:
         """Join two columns, dropping the second. Joins with a space by
         default — joining with the grid separator (as the reference does)
         would make write/read round-trips silently re-split the column."""
+        bad = [c for c in (column1, column2)
+               if not 0 <= c < self.num_columns]
+        if bad:  # validate before mutating any row
+            raise IndexError(f"column(s) {bad} out of range "
+                             f"(grid has {self.num_columns})")
         for r in self.rows:
             r[column1] = r[column1] + join_with + r[column2]
         self.remove_columns(column2)

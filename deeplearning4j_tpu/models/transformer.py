@@ -32,8 +32,8 @@ from deeplearning4j_tpu.nn.graph import ComputationGraph
 
 
 def _build_lm(vocab_size, d_model, n_heads, n_layers, max_length, dropout,
-              seed, learning_rate, dtype, remat, ff_builder
-              ) -> ComputationGraph:
+              seed, learning_rate, dtype, remat, ff_builder,
+              seq_parallel_axis="") -> ComputationGraph:
     """Shared pre-norm LM skeleton; `ff_builder(g, name, input_name)` adds
     the per-block feed-forward sublayer(s) and returns the output name —
     the dense and MoE variants differ only there."""
@@ -51,17 +51,22 @@ def _build_lm(vocab_size, d_model, n_heads, n_layers, max_length, dropout,
     g.add_layer("embed", EmbeddingLayer(n_in=vocab_size, n_out=d_model,
                                         activation="identity", has_bias=False),
                 "tokens")
-    g.add_layer("posenc", PositionalEncodingLayer(max_length=max_length,
-                                                  n_features=d_model), "embed")
+    g.add_layer("posenc", PositionalEncodingLayer(
+        max_length=max_length, n_features=d_model,
+        seq_parallel_axis=seq_parallel_axis), "embed")
     prev = "posenc"
     for i in range(n_layers):
         b = f"blk{i}"
         g.add_layer(f"{b}_ln1", LayerNormalization(n_in=d_model, n_out=d_model),
                     prev)
+        # ring attention cannot drop probability mass it never materializes:
+        # under sequence parallelism only input/FF dropout applies
         g.add_layer(f"{b}_attn", SelfAttentionLayer(
             n_in=d_model, n_out=d_model, n_heads=n_heads, causal=True,
-            dropout=dropout, attention_dropout=dropout,
-            activation="identity"), f"{b}_ln1")
+            dropout=dropout,
+            attention_dropout=0.0 if seq_parallel_axis else dropout,
+            activation="identity",
+            seq_parallel_axis=seq_parallel_axis), f"{b}_ln1")
         g.add_vertex(f"{b}_res1", ElementWiseVertexConf(op="add"),
                      prev, f"{b}_attn")
         g.add_layer(f"{b}_ln2", LayerNormalization(n_in=d_model, n_out=d_model),
@@ -83,7 +88,11 @@ def transformer_lm(vocab_size: int = 10000, d_model: int = 256,
                    n_heads: int = 4, n_layers: int = 6, d_ff: int = 1024,
                    max_length: int = 512, dropout: float = 0.0,
                    seed: int = 12345, learning_rate: float = 3e-4,
-                   dtype: str = "float32", remat: bool = False) -> ComputationGraph:
+                   dtype: str = "float32", remat: bool = False,
+                   seq_parallel_axis: str = "") -> ComputationGraph:
+    """seq_parallel_axis: name of a mesh axis to shard TIME over — builds
+    an SP-ready config for parallel/sequence_parallel.py (ring attention +
+    position-offset encodings inside shard_map)."""
     def ff(g, b, src):
         g.add_layer(f"{b}_ff1", DenseLayer(n_in=d_model, n_out=d_ff,
                                            activation="gelu", dropout=dropout),
@@ -93,7 +102,8 @@ def transformer_lm(vocab_size: int = 10000, d_model: int = 256,
         return f"{b}_ff2"
 
     return _build_lm(vocab_size, d_model, n_heads, n_layers, max_length,
-                     dropout, seed, learning_rate, dtype, remat, ff)
+                     dropout, seed, learning_rate, dtype, remat, ff,
+                     seq_parallel_axis=seq_parallel_axis)
 
 
 def transformer_moe_lm(vocab_size: int = 10000, d_model: int = 256,

@@ -318,6 +318,10 @@ class PositionalEncodingLayer(Layer):
     learned: bool = False
     max_length: int = 2048
     n_features: int = 0
+    # inside a sequence-parallel shard_map (see SelfAttentionLayer), each
+    # shard holds rows [idx*Tl, (idx+1)*Tl) of the sequence: offset the
+    # encodings by the shard's global position
+    seq_parallel_axis: str = ""
 
     def set_n_in(self, input_type: InputType) -> None:
         if self.n_features == 0:
@@ -338,6 +342,12 @@ class SelfAttentionLayer(BaseRecurrentLayer):
     causal: bool = True
     attention_dropout: float = 0.0
     use_flash: bool = True  # fused Pallas kernel when the case supports it
+    # when set, the layer runs INSIDE shard_map over a mesh axis of this
+    # name with the time dimension sharded: attention becomes the ppermute
+    # ring (parallel/ring_attention.py) so each shard only ever holds its
+    # local K/V block — the sequence-parallel training path
+    # (parallel/sequence_parallel.py)
+    seq_parallel_axis: str = ""
 
     def set_n_in(self, input_type: InputType) -> None:
         if self.n_in == 0:

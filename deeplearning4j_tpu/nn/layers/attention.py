@@ -39,6 +39,21 @@ class LayerNormImpl(LayerImpl):
         return xn * params["gamma"] + params["beta"], state
 
 
+def _sp_axis_in_scope(name: str) -> bool:
+    """True when `name` is a bound mesh axis (i.e. we are tracing inside
+    the sequence-parallel shard_map). An SP-configured layer used OUTSIDE
+    shard_map — ordinary inference after SP training, a reloaded config —
+    falls back to the dense path, which is the correct full-sequence
+    semantics on one host."""
+    if not name:
+        return False
+    try:
+        jax.lax.axis_index(name)  # unused op when bound; DCE'd
+        return True
+    except NameError:
+        return False
+
+
 @register_impl(PositionalEncodingLayer)
 class PositionalEncodingImpl(LayerImpl):
     def init(self, conf, rng, dtype):
@@ -49,8 +64,8 @@ class PositionalEncodingImpl(LayerImpl):
         return {}, {}
 
     @staticmethod
-    def _sinusoidal(T, d, dtype):
-        pos = jnp.arange(T)[:, None].astype(jnp.float32)
+    def _sinusoidal(T, d, dtype, offset=0):
+        pos = (offset + jnp.arange(T))[:, None].astype(jnp.float32)
         dim = jnp.arange(0, d, 2).astype(jnp.float32)
         angle = pos / jnp.power(10000.0, dim / d)
         pe = jnp.zeros((T, d), jnp.float32)
@@ -60,10 +75,25 @@ class PositionalEncodingImpl(LayerImpl):
 
     def apply(self, conf, params, state, x, *, train=False, rng=None, mask=None):
         T, d = x.shape[1], x.shape[2]
+        offset = 0
+        axis = getattr(conf, "seq_parallel_axis", "")
+        if _sp_axis_in_scope(axis):
+            # inside the sequence-parallel shard_map: x is the LOCAL block
+            # of the sequence — encode its global positions
+            if conf.learned:
+                # psum of a Python scalar is the static axis size; check at
+                # trace time (dynamic_slice would silently CLAMP an
+                # overflowing offset, duplicating pe rows across shards)
+                n_shards = jax.lax.psum(1, axis)
+                if n_shards * T > conf.max_length:
+                    raise ValueError(
+                        f"global sequence {n_shards}x{T} exceeds learned "
+                        f"positional table max_length={conf.max_length}")
+            offset = jax.lax.axis_index(axis) * T
         if conf.learned:
-            pe = params["pe"][:T]
+            pe = jax.lax.dynamic_slice(params["pe"], (offset, 0), (T, d))
         else:
-            pe = self._sinusoidal(T, d, x.dtype)
+            pe = self._sinusoidal(T, d, x.dtype, offset)
         return x + pe, state
 
 
@@ -118,7 +148,23 @@ class SelfAttentionImpl(LayerImpl):
 
         qh, kh, vh = heads(q), heads(k), heads(v)
         drop = conf.attention_dropout if train else 0.0
-        if getattr(conf, "use_flash", True) and flash_supports(
+        if _sp_axis_in_scope(getattr(conf, "seq_parallel_axis", "")):
+            # inside the sequence-parallel shard_map: local q block attends
+            # the K/V blocks rotating around the ICI ring; the full [T, T]
+            # scores never exist on any one shard
+            if mask is not None or drop:
+                raise ValueError(
+                    "sequence-parallel attention supports neither padding "
+                    "masks nor attention dropout — pad to full length and "
+                    "disable attention_dropout")
+            from deeplearning4j_tpu.parallel.ring_attention import (
+                ring_attention,
+            )
+
+            out = ring_attention(qh, kh, vh,
+                                 axis_name=conf.seq_parallel_axis,
+                                 causal=conf.causal)
+        elif getattr(conf, "use_flash", True) and flash_supports(
                 qh.shape, causal=conf.causal, dropout=drop, mask=mask):
             out = flash_attention(qh, kh, vh, causal=conf.causal)
         else:

@@ -1,0 +1,118 @@
+"""Sequence-parallel training — shard the TIME dimension over the mesh.
+
+The reference's only long-sequence mechanisms are truncated BPTT and
+masking (SURVEY.md §5 "long-context"); this is the TPU-era capability that
+replaces them at scale: activations are sharded over a mesh axis along
+time, attention runs as the ppermute ring (parallel/ring_attention.py), and
+every shard holds params replicas that stay bit-identical because gradients
+are pmean'd before the (deterministic) updater runs.
+
+Usage:
+
+    mesh = make_mesh({"seq": 8})
+    net = transformer_lm(..., seq_parallel_axis="seq")   # conf-driven
+    trainer = SequenceParallelTrainer(net, mesh)
+    trainer.fit(iterator, epochs=3)
+
+The model conf carries the axis name (SelfAttentionLayer/
+PositionalEncodingLayer.seq_parallel_axis) so the layer impls know they run
+inside shard_map: attention becomes the ring, positional encodings offset
+by the shard's global position. Works combined with a 'data' axis
+(batch × sequence 2-D mesh): pass data_axis="data".
+
+Constraints: the global sequence length must divide the seq-axis size, no
+padding masks (pad to full length), no attention dropout.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh, PartitionSpec as P
+
+from deeplearning4j_tpu.datasets.api import DataSet, MultiDataSet
+
+
+def make_sp_train_step(net, mesh: Mesh, seq_axis: str = "seq",
+                       data_axis: Optional[str] = None):
+    """Jitted (params, opt_state, state, features, labels) -> (params,
+    opt_state, state, loss) with time sharded over `seq_axis` (and batch
+    over `data_axis` when given). Params/optimizer state are replicated;
+    grads are pmean'd over every mesh axis so shards stay in lockstep."""
+    from jax import shard_map
+
+    axes = (seq_axis,) if data_axis is None else (data_axis, seq_axis)
+    # [B, T] int tokens / [B, T] labels: batch over data, time over seq
+    tok_spec = P(data_axis, seq_axis)
+    repl = P()
+
+    def local_step(params, opt_state, state, rng, x, y):
+        # decorrelate dropout masks across shards: each shard folds its
+        # mesh position into the step key (same key everywhere would apply
+        # identical mask patterns to different token blocks)
+        for ax in axes:
+            rng = jax.random.fold_in(rng, lax.axis_index(ax))
+
+        def loss_fn(p):
+            batch = {"features": (x,), "labels": (y,)}
+            loss, (new_state, _extras) = net._loss(p, state, rng, batch,
+                                                   train=True)
+            return loss, new_state
+
+        (loss, new_state), grads = jax.value_and_grad(
+            loss_fn, has_aux=True)(params)
+        # every shard's loss is a mean over its local tokens; shards are
+        # equal-sized, so pmean of means == the global mean, and pmean'd
+        # grads drive identical updates on every replica
+        for ax in axes:
+            loss = lax.pmean(loss, ax)
+            grads = lax.pmean(grads, ax)
+        updates, new_opt = net.tx.update(grads, opt_state, params)
+        import optax
+
+        new_params = optax.apply_updates(params, updates)
+        return new_params, new_opt, new_state, loss
+
+    fn = shard_map(
+        local_step,
+        mesh=mesh,
+        in_specs=(repl, repl, repl, repl, tok_spec, tok_spec),
+        out_specs=(repl, repl, repl, repl),
+        check_vma=False,
+    )
+    return jax.jit(fn)
+
+
+class SequenceParallelTrainer:
+    """fit()-style wrapper (API symmetry with DataParallelTrainer): every
+    DataSet batch is one SP step over the mesh."""
+
+    def __init__(self, net, mesh: Mesh, seq_axis: str = "seq",
+                 data_axis: Optional[str] = None):
+        self.net = net
+        self.mesh = mesh
+        self.seq_axis = seq_axis
+        self.data_axis = data_axis
+        self._step = None
+
+    def fit(self, iterator, epochs: int = 1):
+        if self._step is None:
+            self._step = make_sp_train_step(self.net, self.mesh,
+                                            self.seq_axis, self.data_axis)
+        net = self.net
+        for _ in range(epochs):
+            iterator.reset()
+            for ds in iterator:
+                if isinstance(ds, MultiDataSet):
+                    x, y = ds.features[0], ds.labels[0]
+                else:
+                    x, y = ds.features, ds.labels
+                net.params, net.opt_state, net.state, loss = self._step(
+                    net.params, net.opt_state, net.state, net._next_rng(),
+                    jnp.asarray(x), jnp.asarray(y))
+                net.score_value = float(loss)
+        return net

@@ -7,8 +7,12 @@ AdaGrad per-element updates — SURVEY.md §2.3).
 TPU design: co-occurrence counting stays host-side (dict accumulation over
 windows, as the reference spills binary CoOccurrence files); training is
 batched weighted-least-squares on device — gather rows, compute
-f(X)·(w·w̃ + b + b̃ − log X)², AdaGrad scatter updates. Final vectors are
-w + w̃ (standard GloVe practice).
+f(X)·(w·w̃ + b + b̃ − log X)², AdaGrad scatter updates — with each EPOCH a
+single jitted dispatch (device-side shuffle + lax.scan over batches).
+Passing `device_mesh` shards every batch's triples over the mesh 'data'
+axis (the distributed path replacing dl4j-spark-nlp GlovePerformer's
+broadcast-weights/per-partition scheme). Final vectors are w + w̃
+(standard GloVe practice).
 """
 
 from __future__ import annotations
@@ -53,9 +57,12 @@ class AbstractCoOccurrences:
         return ij[:, 0].copy(), ij[:, 1].copy(), x
 
 
-@partial(jax.jit, donate_argnums=(0, 1, 2, 3, 4, 5, 6, 7))
-def glove_step(W, Wc, b, bc, hW, hWc, hb, hbc, i, j, logx, fx, lr):
-    """AdaGrad step on a batch of (i, j, X_ij) triples."""
+def _glove_update(carry, xs, lr):
+    """AdaGrad step on a batch of (i, j, log X_ij, f(X_ij)) triples.
+    Padded triples carry fx == 0 (and logx == 0), so they contribute
+    neither loss nor updates."""
+    W, Wc, b, bc, hW, hWc, hb, hbc = carry
+    i, j, logx, fx = xs
     wi, wj = W[i], Wc[j]                                  # [B, D]
     diff = jnp.einsum("bd,bd->b", wi, wj) + b[i] + bc[j] - logx
     wdiff = fx * diff                                     # [B]
@@ -74,7 +81,40 @@ def glove_step(W, Wc, b, bc, hW, hWc, hb, hbc, i, j, logx, fx, lr):
     Wc = Wc.at[j].add(-lr * gwj / jnp.sqrt(hWc[j] + 1e-8))
     b = b.at[i].add(-lr * gb / jnp.sqrt(hb[i] + 1e-8))
     bc = bc.at[j].add(-lr * gb / jnp.sqrt(hbc[j] + 1e-8))
-    return W, Wc, b, bc, hW, hWc, hb, hbc, loss
+    return (W, Wc, b, bc, hW, hWc, hb, hbc), loss
+
+
+def make_glove_epoch(batch: int, shuffle: bool, mesh=None):
+    """One full epoch as a single jitted dispatch: device-side shuffle,
+    reshape into [n_batches, batch], lax.scan of AdaGrad steps. With a
+    mesh, each batch's triples shard over the 'data' axis — the gathers
+    read replicated W and XLA turns the scatter-adds into psum'd updates
+    (the distributed GloVe path; reference dl4j-spark-nlp GlovePerformer
+    trains per-partition against broadcast weights the same way)."""
+
+    @partial(jax.jit, donate_argnums=(0, 1, 2, 3, 4, 5, 6, 7))
+    def epoch(W, Wc, b, bc, hW, hWc, hb, hbc, ii, jj, logx, fx, key, lr):
+        if shuffle:
+            perm = jax.random.permutation(key, ii.shape[0])
+        else:
+            perm = jnp.arange(ii.shape[0])
+
+        def stage(a):
+            a = a[perm].reshape(-1, batch)
+            if mesh is not None:
+                from jax.sharding import NamedSharding, PartitionSpec as P
+
+                a = jax.lax.with_sharding_constraint(
+                    a, NamedSharding(mesh, P(None, "data")))
+            return a
+
+        xs = (stage(ii), stage(jj), stage(logx), stage(fx))
+        carry, losses = jax.lax.scan(
+            partial(_glove_update, lr=lr),
+            (W, Wc, b, bc, hW, hWc, hb, hbc), xs)
+        return carry + (losses,)
+
+    return epoch
 
 
 class Glove(SequenceVectors):
@@ -87,12 +127,12 @@ class Glove(SequenceVectors):
                  learning_rate: float = 0.05, x_max: float = 100.0,
                  alpha: float = 0.75, batch_size: int = 4096,
                  seed: int = 123, symmetric: bool = True, shuffle: bool = True,
-                 vocab_limit: Optional[int] = None):
+                 vocab_limit: Optional[int] = None, device_mesh=None):
         super().__init__(layer_size=layer_size, window_size=window_size,
                          min_word_frequency=min_word_frequency, epochs=epochs,
                          learning_rate=learning_rate, batch_size=batch_size,
                          seed=seed, negative=0, use_hs=False,
-                         vocab_limit=vocab_limit)
+                         vocab_limit=vocab_limit, device_mesh=device_mesh)
         self.x_max = x_max
         self.alpha = alpha
         self.symmetric = symmetric
@@ -123,11 +163,20 @@ class Glove(SequenceVectors):
         ii, jj, xx = cooc.arrays()
         if ii.size == 0:
             raise ValueError("No co-occurrences — corpus too small")
-        logx = np.log(xx)
+        logx = np.log(xx).astype(np.float32)
         fx = np.minimum(1.0, (xx / self.x_max) ** self.alpha).astype(np.float32)
 
-        key = jax.random.PRNGKey(self.seed)
-        k1, k2 = jax.random.split(key)
+        # pad to whole batches ONCE with weight-zero triples (fx == 0 kills
+        # both the loss term and every update; logx == 0 keeps diff finite)
+        B = self.batch_size
+        pad = (-ii.size) % B
+        if pad:
+            ii = np.concatenate([ii, np.zeros(pad, np.int32)])
+            jj = np.concatenate([jj, np.zeros(pad, np.int32)])
+            logx = np.concatenate([logx, np.zeros(pad, np.float32)])
+            fx = np.concatenate([fx, np.zeros(pad, np.float32)])
+
+        key, k1, k2 = jax.random.split(jax.random.PRNGKey(self.seed), 3)
         scale = 0.5 / D
         W = (jax.random.uniform(k1, (V, D)) - 0.5) * 2 * scale
         Wc = (jax.random.uniform(k2, (V, D)) - 0.5) * 2 * scale
@@ -138,29 +187,19 @@ class Glove(SequenceVectors):
         hb = jnp.full(V, 1e-8)
         hbc = jnp.full(V, 1e-8)
 
-        B = self.batch_size
-        n = ii.size
+        epoch_fn = make_glove_epoch(B, self.shuffle, mesh=self.device_mesh)
+        ii_d, jj_d = jnp.asarray(ii), jnp.asarray(jj)
+        logx_d, fx_d = jnp.asarray(logx), jnp.asarray(fx)
+        # `key` continues the stream already split for W/Wc init above —
+        # never reuse a key across init and shuffling
+        epoch_losses = []
         for _ in range(self.epochs):
-            order = self._rng.permutation(n) if self.shuffle else np.arange(n)
-            for s in range(0, n, B):
-                sel = order[s:s + B]
-                if sel.size < B:  # pad tail to keep one compiled shape
-                    sel = np.concatenate(
-                        [sel, self._rng.integers(0, n, B - sel.size)])
-                (W, Wc, b, bc, hW, hWc, hb, hbc, loss) = glove_step(
-                    W, Wc, b, bc, hW, hWc, hb, hbc,
-                    ii[sel], jj[sel], logx[sel], fx[sel], self.learning_rate)
-                # device scalar; one host sync after the run (below)
-                self.loss_history.append(loss)
-        # fetch fresh device entries, then normalize only those — floats
-        # from a previous fit() are already normalized, and dividing on
-        # host avoids one tiny device dispatch per recorded batch
-        from deeplearning4j_tpu.nlp.sequencevectors import _fetch_loss_scalars
-
-        fresh = {i for i, l in enumerate(self.loss_history)
-                 if not isinstance(l, float)}
-        self.loss_history = [
-            l / B if i in fresh else l
-            for i, l in enumerate(_fetch_loss_scalars(self.loss_history))]
+            key, sub = jax.random.split(key)
+            (W, Wc, b, bc, hW, hWc, hb, hbc, losses) = epoch_fn(
+                W, Wc, b, bc, hW, hWc, hb, hbc,
+                ii_d, jj_d, logx_d, fx_d, sub, self.learning_rate)
+            epoch_losses.append(losses)  # device arrays; one sync below
+        for losses in epoch_losses:
+            self.loss_history.extend((np.asarray(losses) / B).tolist())
         self.lookup_table.set_vectors(np.asarray(W + Wc))
         return self

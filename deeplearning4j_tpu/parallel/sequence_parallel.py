@@ -114,5 +114,8 @@ class SequenceParallelTrainer:
                 net.params, net.opt_state, net.state, loss = self._step(
                     net.params, net.opt_state, net.state, net._next_rng(),
                     jnp.asarray(x), jnp.asarray(y))
-                net.score_value = float(loss)
+                net.score_value = loss  # lazy host sync
+                net.iteration_count += 1
+                for lst in net.listeners:
+                    lst.iteration_done(net, net.iteration_count)
         return net

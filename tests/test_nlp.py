@@ -235,6 +235,29 @@ def test_glove_cluster_similarity(rng):
     assert glove.similarity("cat", "dog") > glove.similarity("cat", "gpu")
 
 
+def test_glove_mesh_matches_single_device(rng):
+    """Distributed GloVe (triples sharded over the mesh 'data' axis) is an
+    exact redistribution of the same scan — same seeds, same updates up to
+    float reassociation."""
+    from deeplearning4j_tpu.nlp.glove import Glove
+    from deeplearning4j_tpu.parallel.mesh import make_mesh
+
+    sents, _, _ = synthetic_corpus(rng, 200)
+    corpus = [s.split() for s in sents]
+
+    single = Glove(layer_size=8, window_size=4, epochs=4, seed=3,
+                   batch_size=256)
+    single.fit(corpus)
+    meshed = Glove(layer_size=8, window_size=4, epochs=4, seed=3,
+                   batch_size=256, device_mesh=make_mesh({"data": 4}))
+    meshed.fit(corpus)
+
+    for a, b in [("cat", "dog"), ("cat", "gpu"), ("dog", "mouse")]:
+        np.testing.assert_allclose(single.similarity(a, b),
+                                   meshed.similarity(a, b),
+                                   rtol=1e-3, atol=1e-3)
+
+
 # ------------------------------------------------------------------ tfidf
 def test_tfidf_and_bow_vectorizers():
     from deeplearning4j_tpu.nlp.bagofwords import (

@@ -40,9 +40,10 @@ TARGETS = {
     "word2vec": 300000.0,    # words/sec (r2 measured: 317k, shared negatives)
     "resnet_dp": 1.0,        # allreduce/param-avg speedup (>=1 expected)
     "transformer": 0.30,     # MFU fraction (north star >=30%; r2 measured
-                             # 0.32 at seq 512 with the fused softmax-xent
-                             # head + tuned flash kernel, and 0.395 at
-                             # seq 4096 via the longcontext mode)
+                             # 0.37 at seq 512 with the fused softmax-xent
+                             # head + tuned flash kernels incl. the fused
+                             # single-pass backward, and 0.40 at seq 4096
+                             # via the longcontext mode)
 }
 
 # Peak dense bf16 FLOP/s per chip by TPU generation (public spec sheets);

@@ -45,6 +45,23 @@ def test_backward_matches_dense():
         np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=5e-5)
 
 
+def test_backward_matches_dense_long_sequence():
+    """T > BLOCK_K_MAX exercises the two-kernel (dq + dkv) backward; the
+    shorter tests hit the fused single-pass backward (block_k == T)."""
+    q, k, v = _qkv(B=1, H=1, T=1024)
+
+    def f_flash(q, k, v):
+        return jnp.sum(jnp.sin(flash_attention(q, k, v, causal=True)))
+
+    def f_dense(q, k, v):
+        return jnp.sum(jnp.sin(dot_product_attention(q, k, v, causal=True)))
+
+    g_flash = jax.grad(f_flash, (0, 1, 2))(q, k, v)
+    g_dense = jax.grad(f_dense, (0, 1, 2))(q, k, v)
+    for a, b in zip(g_flash, g_dense):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=5e-5)
+
+
 def test_bf16_forward():
     q, k, v = _qkv(T=128)
     q, k, v = (t.astype(jnp.bfloat16) for t in (q, k, v))

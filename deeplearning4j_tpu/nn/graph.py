@@ -72,6 +72,7 @@ class ComputationGraph:
         self._output_jit = None
         self._rng = None
         self._mesh = None
+        self._zero1 = False
         self._rnn_carries = None  # streaming inference state (rnn_time_step)
         self._rnn_jit = None
 
@@ -106,8 +107,9 @@ class ComputationGraph:
     def set_listeners(self, *listeners):
         self.listeners = list(listeners)
 
-    def set_mesh(self, mesh):
+    def set_mesh(self, mesh, zero1: bool = False):
         self._mesh = mesh
+        self._zero1 = zero1
         self._train_step = None
         self._scan_fit = None
         self._output_jit = None
@@ -348,8 +350,9 @@ class ComputationGraph:
         """Jitted donated train step (same contract as MLN._get_train_step)."""
         if self._train_step is None:
             confs = {n: v.layer for n, v in self.layer_vertices.items()}
-            self._train_step = make_train_step(self._loss, self.tx, confs,
-                                               mesh=self._mesh)
+            self._train_step = make_train_step(
+                self._loss, self.tx, confs, mesh=self._mesh,
+                zero1_opt_state=(self.opt_state if self._zero1 else None))
         return self._train_step
 
     def fit_scanned(self, data, labels=None, epochs: int = 1):

@@ -70,6 +70,7 @@ class MultiLayerNetwork:
         self._rnn_carries = None  # streaming inference state
         self._rnn_jit = None
         self._mesh = None
+        self._zero1 = False
         self.score_value = float("nan")
 
     # ------------------------------------------------------------------ init
@@ -111,10 +112,11 @@ class MultiLayerNetwork:
     def set_listeners(self, *listeners):
         self.listeners = list(listeners)
 
-    def set_mesh(self, mesh):
+    def set_mesh(self, mesh, zero1: bool = False):
         """Enable data-parallel training over a jax.sharding.Mesh with a
         'data' axis (replaces the Spark parameter-averaging master)."""
         self._mesh = mesh
+        self._zero1 = zero1
         self._train_step = None
         self._scan_fit = None
         self._output_jit = None
@@ -231,8 +233,9 @@ class MultiLayerNetwork:
     def _get_train_step(self):
         if self._train_step is None:
             confs = dict(zip(self.layer_names, self.layer_confs))
-            self._train_step = make_train_step(self._loss, self.tx, confs,
-                                               mesh=self._mesh)
+            self._train_step = make_train_step(
+                self._loss, self.tx, confs, mesh=self._mesh,
+                zero1_opt_state=(self.opt_state if self._zero1 else None))
         return self._train_step
 
     @staticmethod

@@ -24,14 +24,39 @@ import optax
 from deeplearning4j_tpu.nn.updater import normalize_gradients
 
 
+def zero1_opt_shardings(opt_state, mesh, axis: str = "data"):
+    """Cross-replica weight-update sharding (ZeRO stage 1; the XLA
+    formulation is arXiv:2004.13336 "Automatic Cross-Replica Sharding of
+    Weight Update in Data-Parallel Training"): optimizer-state leaves
+    shard their leading dim over the data axis when divisible, so each
+    replica stores and updates only 1/n of the Adam moments — GSPMD turns
+    the gradient allreduce into reduce-scatter + sharded update +
+    all-gather of the new params."""
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    n = mesh.shape[axis]
+    repl = NamedSharding(mesh, P())
+
+    def leaf(x):
+        shape = getattr(x, "shape", ())
+        if len(shape) >= 1 and shape[0] >= n and shape[0] % n == 0:
+            return NamedSharding(mesh, P(axis, *([None] * (len(shape) - 1))))
+        return repl
+
+    return jax.tree.map(leaf, opt_state)
+
+
 def make_train_step(loss_fn, tx, layer_confs_by_name, mesh=None,
-                    donate=True):
+                    donate=True, zero1_opt_state=None):
     """loss_fn(params, state, rng, batch) -> (loss, (new_state, extras)).
 
     batch is a dict pytree {features, labels, features_mask?, labels_mask?,
     carries?}; extras carries auxiliary outputs (e.g. RNN carries for TBPTT).
     Returns step(params, opt_state, state, rng, batch) -> (params, opt_state,
     state, loss, extras).
+
+    zero1_opt_state: pass the CURRENT opt_state (with `mesh`) to shard the
+    optimizer state over the data axis (see zero1_opt_shardings).
     """
 
     def step(params, opt_state, state, rng, batch):
@@ -50,13 +75,15 @@ def make_train_step(loss_fn, tx, layer_confs_by_name, mesh=None,
 
         repl = NamedSharding(mesh, P())
         data = NamedSharding(mesh, P("data"))
+        opt_sh = (zero1_opt_shardings(zero1_opt_state, mesh)
+                  if zero1_opt_state is not None else repl)
         # sharding pytree prefixes: one sharding per argument applies to all
         # its leaves — batch leaves are sharded on the 'data' mesh axis
         return jax.jit(
             step,
             donate_argnums=donate_argnums,
-            in_shardings=(repl, repl, repl, repl, data),
-            out_shardings=(repl, repl, repl, repl, repl),
+            in_shardings=(repl, opt_sh, repl, repl, data),
+            out_shardings=(repl, opt_sh, repl, repl, repl),
         )
     return jax.jit(step, donate_argnums=donate_argnums)
 

@@ -30,7 +30,6 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 from jax.experimental import pallas as pl
-from jax.experimental.pallas import tpu as pltpu
 
 BLOCK = 128
 LANES = 128  # lane-broadcast width for per-row scalars (TPU tile rule)
@@ -213,34 +212,24 @@ def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dk_ref,
 
 
 def _bwd_fused_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
-                      dq_ref, dk_ref, dv_ref, dk_scr, dv_scr, *,
-                      sm_scale, causal, block_q, seq_len):
-    """Single-pass backward for the block_k == T case: with the whole K/V
-    resident, dq needs no cross-block accumulation, so one recompute of
-    the probabilities feeds dq, dk AND dv (the two-kernel path recomputes
-    them twice). dk/dv accumulate in scratch across the sequential
-    q-block grid steps."""
-    qi = pl.program_id(1)
-    nq = seq_len // block_q
-
-    @pl.when(qi == 0)
-    def _init():
-        dk_scr[...] = jnp.zeros_like(dk_scr)
-        dv_scr[...] = jnp.zeros_like(dv_scr)
-
-    qb = q_ref[0]                                           # [bq, D]
+                      dq_ref, dk_ref, dv_ref, *, sm_scale, causal, seq_len):
+    """Single-pass backward for the block == T case (T <= BLOCK_K_MAX,
+    i.e. _block_sizes gave both blocks the whole sequence): with Q, K and
+    V all resident, one recompute of the probabilities feeds dq, dk AND
+    dv — the two-kernel path recomputes them twice. Grid is (BH,); no
+    cross-block accumulation exists at this size."""
+    qb = q_ref[0]                                           # [T, D]
     dob = do_ref[0]
-    kb = k_ref[0]                                           # [T, D]
+    kb = k_ref[0]
     vb = v_ref[0]
     lse = jnp.max(lse_ref[0], axis=-1)
     delta = jnp.max(delta_ref[0], axis=-1)
     s = sm_scale * jax.lax.dot_general(
         qb, kb, (((1,), (1,)), ((), ())),
-        preferred_element_type=jnp.float32)                 # [bq, T]
+        preferred_element_type=jnp.float32)                 # [T, T]
     if causal:
-        qpos = qi * block_q + jax.lax.broadcasted_iota(
-            jnp.int32, (block_q, seq_len), 0)
-        kpos = jax.lax.broadcasted_iota(jnp.int32, (block_q, seq_len), 1)
+        qpos = jax.lax.broadcasted_iota(jnp.int32, (seq_len, seq_len), 0)
+        kpos = jax.lax.broadcasted_iota(jnp.int32, (seq_len, seq_len), 1)
         s = jnp.where(qpos >= kpos, s, NEG_INF)
     p = jnp.exp(s - lse[:, None])
     dp = jax.lax.dot_general(dob, vb, (((1,), (1,)), ((), ())),
@@ -249,38 +238,29 @@ def _bwd_fused_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
     dq_ref[0] = jax.lax.dot_general(
         ds, kb, (((1,), (0,)), ((), ())),
         preferred_element_type=jnp.float32).astype(dq_ref.dtype)
-    dv_scr[...] += jax.lax.dot_general(
+    dv_ref[0] = jax.lax.dot_general(
         p.astype(dob.dtype), dob, (((0,), (0,)), ((), ())),
-        preferred_element_type=jnp.float32)
-    dk_scr[...] += jax.lax.dot_general(
+        preferred_element_type=jnp.float32).astype(dv_ref.dtype)
+    dk_ref[0] = jax.lax.dot_general(
         ds, qb, (((0,), (0,)), ((), ())),
-        preferred_element_type=jnp.float32)
-
-    @pl.when(qi == nq - 1)
-    def _emit():
-        dk_ref[0] = dk_scr[...].astype(dk_ref.dtype)
-        dv_ref[0] = dv_scr[...].astype(dv_ref.dtype)
+        preferred_element_type=jnp.float32).astype(dk_ref.dtype)
 
 
-def _flash_bwd_fused(q, k, v, do, lse, delta, sm_scale, causal, block_q):
+def _flash_bwd_fused(q, k, v, do, lse, delta, sm_scale, causal):
     BH, T, D = q.shape
-    qblock = pl.BlockSpec((1, block_q, D), lambda bh, qi: (bh, qi, 0))
-    fullblock = pl.BlockSpec((1, T, D), lambda bh, qi: (bh, 0, 0))
-    lblock = pl.BlockSpec((1, block_q, LANES), lambda bh, qi: (bh, qi, 0))
+    fullblock = pl.BlockSpec((1, T, D), lambda bh: (bh, 0, 0))
+    lblock = pl.BlockSpec((1, T, LANES), lambda bh: (bh, 0, 0))
     return pl.pallas_call(
         functools.partial(_bwd_fused_kernel, sm_scale=sm_scale,
-                          causal=causal, block_q=block_q, seq_len=T),
-        grid=(BH, T // block_q),
-        in_specs=[qblock, fullblock, fullblock, qblock, lblock, lblock],
-        out_specs=[qblock, fullblock, fullblock],
+                          causal=causal, seq_len=T),
+        grid=(BH,),
+        in_specs=[fullblock, fullblock, fullblock, fullblock, lblock,
+                  lblock],
+        out_specs=[fullblock, fullblock, fullblock],
         out_shape=[
             jax.ShapeDtypeStruct((BH, T, D), q.dtype),
             jax.ShapeDtypeStruct((BH, T, D), k.dtype),
             jax.ShapeDtypeStruct((BH, T, D), v.dtype),
-        ],
-        scratch_shapes=[
-            pltpu.VMEM((T, D), jnp.float32),
-            pltpu.VMEM((T, D), jnp.float32),
         ],
         interpret=_use_interpret(),
     )(q, k, v, do, lse, delta)
@@ -295,11 +275,10 @@ def _flash_bwd(sm_scale, causal, res, do):
     lse = jnp.broadcast_to(lse[:, :, None], (BH, T, LANES))
     delta = jnp.broadcast_to(delta[:, :, None], (BH, T, LANES))
 
-    if block_k == T:
-        # whole K/V per program: one fused kernel emits dq, dk and dv
+    if block_q == T and block_k == T:
+        # whole Q/K/V per program: one fused kernel emits dq, dk and dv
         # from a single probability recompute
-        return _flash_bwd_fused(q, k, v, do, lse, delta, sm_scale, causal,
-                                block_q)
+        return _flash_bwd_fused(q, k, v, do, lse, delta, sm_scale, causal)
 
     dq = pl.pallas_call(
         functools.partial(_dq_kernel, sm_scale=sm_scale, causal=causal,

@@ -48,8 +48,11 @@ def _block_v(d: int, v: int) -> int:
     """Vocab chunk width: the VMEM working set ([bn, bv] f32 logits tile,
     [d, bv] f32 dW scratch, double-buffered [d, bv] weight blocks) scales
     with d·bv, so shrink the chunk as the feature dim grows to stay under
-    the 16MB scoped limit the d=256 sweep was tuned against."""
-    return min(v, max(512, BLOCK_V * 256 // d))
+    the 16MB scoped limit the d=256 sweep was tuned against. The width is
+    floored to a lane multiple (128); when the whole vocab fits one chunk
+    the block equals the array dim, which Mosaic also accepts."""
+    bv = max(512, (BLOCK_V * 256 // d) // 128 * 128)
+    return min(v, bv)
 
 # Use the fused kernel only where the dense path's [N, V] materialization
 # actually hurts; small heads fuse fine inside XLA.

@@ -70,6 +70,23 @@ def _alias_sample(key, J, q, shape):
     return jnp.where(coin < q[i], i, J[i]).astype(jnp.int32)
 
 
+def pack_corpus_flat(tokens: np.ndarray, sent_ids: np.ndarray,
+                     multiple: int) -> Tuple[np.ndarray, np.ndarray]:
+    """Pad an already-flat (tokens, sent_ids) pair to a multiple of
+    `multiple`; padding carries sent_id -1 (never pairs). Pairing only
+    compares sent ids for equality, so gaps in the numbering (empty or
+    all-OOV sentences) are fine."""
+    if len(tokens) == 0:
+        raise ValueError("empty corpus")
+    tokens = np.asarray(tokens, np.int32)
+    sent_ids = np.asarray(sent_ids, np.int32)
+    pad = (-len(tokens)) % multiple
+    if pad:
+        tokens = np.concatenate([tokens, np.zeros(pad, np.int32)])
+        sent_ids = np.concatenate([sent_ids, np.full(pad, -1, np.int32)])
+    return tokens, sent_ids
+
+
 def pack_corpus(idx_seqs: List[np.ndarray], multiple: int
                 ) -> Tuple[np.ndarray, np.ndarray]:
     """Flatten index sequences into (tokens [N], sent_ids [N]) padded to a
@@ -80,11 +97,7 @@ def pack_corpus(idx_seqs: List[np.ndarray], multiple: int
     tokens = np.concatenate(seqs)
     sent_ids = np.concatenate(
         [np.full(len(s), i, np.int32) for i, s in enumerate(seqs)])
-    pad = (-len(tokens)) % multiple
-    if pad:
-        tokens = np.concatenate([tokens, np.zeros(pad, np.int32)])
-        sent_ids = np.concatenate([sent_ids, np.full(pad, -1, np.int32)])
-    return tokens, sent_ids
+    return pack_corpus_flat(tokens, sent_ids, multiple)
 
 
 def _chunk_pair_grads(syn0, syn1neg, tokens, sent_ids, alias_J, alias_q,

@@ -324,6 +324,7 @@ class SequenceVectors:
             make_cbow_epoch,
             make_sgns_epoch,
             pack_corpus,
+            pack_corpus_flat,
         )
 
         if (self.algorithm not in ("skipgram", "cbow") or self.use_hs
@@ -365,8 +366,18 @@ class SequenceVectors:
                 # subsampling redraws per epoch (host rng, like the
                 # reference); without it the packed corpus is uploaded once
                 # and reused across epochs
-                idx_seqs = self._corpus_indices(corpus)
-                tokens_np, sent_ids_np = pack_corpus(idx_seqs, per_update)
+                flat = self._corpus_flat_indices(corpus)
+                if flat is not None:
+                    # skip the per-sentence split/re-concatenate round trip
+                    tokens_np, sent_ids_np = pack_corpus_flat(
+                        *flat, per_update)
+                else:
+                    # flat path declined (subsampling / tiny corpus):
+                    # go straight to the per-sentence tokenizing path —
+                    # _corpus_indices would redo the flat attempt
+                    idx_seqs = self._corpus_indices_seq(corpus)
+                    tokens_np, sent_ids_np = pack_corpus(idx_seqs,
+                                                         per_update)
                 packed = (jnp.asarray(tokens_np), jnp.asarray(sent_ids_np))
             tokens, sent_ids = packed
             lr0 = self._alpha(done, total)
@@ -383,33 +394,26 @@ class SequenceVectors:
             self.loss_history.extend((ls / pairs).tolist())
         return self
 
-    @staticmethod
-    def _split_flat_ids(ids, sent, n_sentences):
-        """Drop OOV (-1) entries and split a flat (ids, sentence-id) pair
-        into per-sentence arrays. sent is sorted, so one searchsorted
-        splits all sentences (a per-sentence boolean scan is quadratic)."""
-        keep = ids >= 0
-        ids, sent = ids[keep], sent[keep]
-        cuts = np.searchsorted(sent, np.arange(1, n_sentences))
-        return np.split(ids, cuts)
-
-    def _corpus_indices(self, corpus):
-        """Corpus → per-sequence index arrays. Raw-string sentences go
-        through the native ONE-PASS corpus encoder (native.encode_corpus:
-        whitespace split + vocab hash lookups for the whole corpus in a
-        single call — the hash table is built once); larger pre-tokenized
-        corpora use one flat vectorized vocab lookup. Subsampling>0 needs
-        the host rng, so it takes the per-sentence Python path."""
+    def _corpus_flat_indices(self, corpus):
+        """Corpus → flat (ids, sentence_ids) with OOV dropped, or None
+        when only the per-sentence path applies (subsampling needs the
+        host rng). Raw-string sentences go through the native ONE-PASS
+        corpus encoder (native.encode_corpus: whitespace split + vocab
+        hash lookups for the whole corpus in a single call — the hash
+        table is built once); larger pre-tokenized corpora use one flat
+        vectorized vocab lookup."""
+        if self.sampling != 0:
+            return None
         if corpus and isinstance(corpus[0], str):
-            if self.sampling == 0:
-                from deeplearning4j_tpu import native
+            from deeplearning4j_tpu import native
 
-                enc = native.encode_corpus(corpus, self.vocab.words())
-                if enc is not None:
-                    ids, sent = enc
-                    return self._split_flat_ids(ids, sent, len(corpus))
+            enc = native.encode_corpus(corpus, self.vocab.words())
+            if enc is not None:
+                ids, sent = enc
+                keep = ids >= 0
+                return ids[keep], sent[keep]
             corpus = [line.split() for line in corpus]
-        if self.sampling == 0 and len(corpus) > 64:
+        if len(corpus) > 64:
             # flat dict lookup over the whole corpus instead of a Python
             # loop per sentence (~4x faster at 1M words; identical output)
             get = {w: i for i, w in enumerate(self.vocab.words())}.get
@@ -419,8 +423,28 @@ class SequenceVectors:
             lengths = np.fromiter((len(t) for t in corpus), np.int64,
                                   len(corpus))
             sent = np.repeat(np.arange(len(corpus)), lengths)
-            return self._split_flat_ids(flat_ids, sent, len(corpus))
+            keep = flat_ids >= 0
+            return flat_ids[keep], sent[keep].astype(np.int32)
+        return None
+
+    def _corpus_indices_seq(self, corpus):
+        """Per-sentence fallback: tokenize raw-string sentences, then the
+        (rng-dependent) per-sequence path."""
+        if corpus and isinstance(corpus[0], str):
+            corpus = [line.split() for line in corpus]
         return [self._sequence_indices(toks) for toks in corpus]
+
+    def _corpus_indices(self, corpus):
+        """Corpus → per-sequence index arrays (the host-loop algorithms'
+        shape; the device pipeline consumes the flat form directly)."""
+        flat = self._corpus_flat_indices(corpus)
+        if flat is not None:
+            ids, sent = flat
+            # sent is sorted: one searchsorted splits all sentences (a
+            # per-sentence boolean scan would be quadratic)
+            cuts = np.searchsorted(sent, np.arange(1, len(corpus)))
+            return np.split(ids, cuts)
+        return self._corpus_indices_seq(corpus)
 
     def _finalize_losses(self):
         """One deferred host sync for the whole run (see _flush_sg): stack

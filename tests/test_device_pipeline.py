@@ -168,3 +168,23 @@ def test_strict_per_pair_negative_sampling_opt_out():
     w.fit(sents)
     assert w.pipeline_share_negatives is False
     assert w.similarity("a3", "b3") > w.similarity("a3", "b11")
+
+
+def test_raw_string_corpus_with_subsampling_tokenizes():
+    """Raw-string sentences + subsampling force the per-sentence fallback;
+    sentences must be tokenized by whitespace, not iterated char-by-char
+    (regression: the flat-path refactor once dropped the split)."""
+    from deeplearning4j_tpu.nlp.word2vec import Word2Vec
+
+    rng = np.random.default_rng(0)
+    words = [f"tok{i}" for i in range(50)]
+    sents = [" ".join(words[j] for j in rng.integers(0, 50, 12))
+             for _ in range(120)]
+    w2v = (Word2Vec.builder().layer_size(8).window_size(3)
+           .min_word_frequency(1).negative_sample(2).sampling(1e-3)
+           .use_device_pipeline(True).epochs(1).seed(4).build())
+    w2v.build_vocab([s.split() for s in sents])
+    assert w2v.vocab.index_of("tok0") >= 0
+    w2v.fit(sents)  # raw strings on purpose
+    v = w2v.word_vector("tok0")
+    assert v is not None and np.isfinite(np.asarray(v)).all()

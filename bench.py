@@ -394,7 +394,7 @@ def _run_all() -> int:
                                 + " --xla_force_host_platform_device_count=8")
         out = None
         timed_out = False
-        for attempt in range(2):
+        for attempt in range(3):
             try:
                 attempt_out = subprocess.run(
                     [sys.executable, os.path.abspath(__file__), mode],
@@ -403,11 +403,14 @@ def _run_all() -> int:
                 timed_out = True
                 break
             out = attempt_out
-            # retry once only when the child was killed by a signal
-            # (rc < 0: OOM/SIGABRT under transient host contention);
-            # ordinary nonzero exits are deterministic — report them
+            # retry only when the child was killed by a signal (rc < 0 —
+            # e.g. XLA CPU's 40s collectives-rendezvous abort when host
+            # contention starves the virtual-device threads); ordinary
+            # nonzero exits are deterministic — report them
             if out.returncode >= 0:
                 break
+            if attempt < 2:
+                time.sleep(20)  # let transient contention drain
         if out is None:
             print(json.dumps({"metric": mode, "error": "timeout"}), flush=True)
             rc = 1

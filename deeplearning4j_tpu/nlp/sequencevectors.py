@@ -373,8 +373,7 @@ class SequenceVectors:
                         *flat, per_update)
                 else:
                     # flat path declined (subsampling / tiny corpus):
-                    # go straight to the per-sentence tokenizing path —
-                    # _corpus_indices would redo the flat attempt
+                    # the per-sentence tokenizing path
                     idx_seqs = self._corpus_indices_seq(corpus)
                     tokens_np, sent_ids_np = pack_corpus(idx_seqs,
                                                          per_update)
@@ -434,17 +433,6 @@ class SequenceVectors:
             corpus = [line.split() for line in corpus]
         return [self._sequence_indices(toks) for toks in corpus]
 
-    def _corpus_indices(self, corpus):
-        """Corpus → per-sequence index arrays (the host-loop algorithms'
-        shape; the device pipeline consumes the flat form directly)."""
-        flat = self._corpus_flat_indices(corpus)
-        if flat is not None:
-            ids, sent = flat
-            # sent is sorted: one searchsorted splits all sentences (a
-            # per-sentence boolean scan would be quadratic)
-            cuts = np.searchsorted(sent, np.arange(1, len(corpus)))
-            return np.split(ids, cuts)
-        return self._corpus_indices_seq(corpus)
 
     def _finalize_losses(self):
         """One deferred host sync for the whole run (see _flush_sg): stack

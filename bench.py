@@ -37,7 +37,10 @@ TARGETS = {
                              # steady-state; per-step Python dispatch caps a
                              # naive loop far lower)
     "vgg16": 55000.0,        # images/sec/chip (r2 measured: 59.3k, fit_scanned)
-    "word2vec": 300000.0,    # words/sec (r2 measured: 317k, shared negatives)
+    "word2vec": 600000.0,    # words/sec (r2 measured: ~790-960k after the
+                             # flat corpus packing + 2048x4 chunking + the
+                             # warmup-drain timing fix; 600k floor guards
+                             # those optimizations with chip-state margin)
     "resnet_dp": 1.0,        # allreduce/param-avg speedup (>=1 expected)
     "transformer": 0.30,     # MFU fraction (north star >=30%; r2 measured
                              # 0.37 at seq 512 with the fused softmax-xent
@@ -220,9 +223,14 @@ def bench_word2vec() -> None:
     w2v = (Word2Vec.builder().layer_size(128).window_size(5)
            .min_word_frequency(1).negative_sample(5)
            .use_device_pipeline(True).epochs(1).seed(1).build())
-    w2v.pipeline_chunk, w2v.pipeline_group = 1024, 8
+    # swept on v5e: 2048x4 runs ~2.3x faster than 1024x8 at the SAME
+    # 8192-token update granularity (bigger vmapped chunks, fewer scan
+    # steps — no change to the SGD semantics)
+    w2v.pipeline_chunk, w2v.pipeline_group = 2048, 4
     w2v.build_vocab(sents)  # one-time host-side work, not training throughput
     w2v.fit(sents)          # warmup fit: compiles the epoch scan
+    np.asarray(w2v.word_vector("w0"))  # DRAIN the warmup's device epoch —
+    # without this the timed fit queues behind it and absorbs its runtime
     t0 = time.perf_counter()
     w2v.fit(sents)          # timed fit: repack + full on-device epoch
     np.asarray(w2v.word_vector("w0"))  # force pending device work to finish

@@ -284,32 +284,44 @@ def bench_resnet_dp() -> None:
           paramavg_steps_per_sec=round(sps_paramavg, 3))
 
 
+VOCAB_LM = 10000
+
+
+def _lm_harness(seq_tpu, batch_tpu, steps_tpu, seq_cpu=128, batch_cpu=2,
+                steps_cpu=2):
+    """Shared Transformer-LM bench scaffolding: backend-dependent dims and
+    a token batch with next-token (sparse int) labels — the mcxent gather
+    path (O(N) vs O(N*V) HBM traffic)."""
+    import jax
+
+    from deeplearning4j_tpu.datasets.api import DataSet
+
+    backend = jax.default_backend()
+    on_tpu = backend == "tpu"
+    seq = seq_tpu if on_tpu else seq_cpu
+    batch = batch_tpu if on_tpu else batch_cpu
+    steps = steps_tpu if on_tpu else steps_cpu
+    rng = np.random.default_rng(0)
+    toks = np.asarray(rng.integers(0, VOCAB_LM, (batch, seq)), np.int32)
+    ds = DataSet(toks, np.roll(toks, -1, axis=1))
+    return backend, on_tpu, seq, batch, steps, ds
+
+
 def bench_transformer() -> None:
     import jax
-    import jax.numpy as jnp
 
     from deeplearning4j_tpu.models.transformer import (
         transformer_flops_per_token,
         transformer_lm,
     )
 
-    backend = jax.default_backend()
-    on_tpu = backend == "tpu"
-    vocab, d_model, heads, layers, d_ff = 10000, 256, 4, 6, 1024
-    seq = 512 if on_tpu else 128
-    batch = 32 if on_tpu else 2
-    steps = 40 if on_tpu else 2
+    backend, on_tpu, seq, batch, steps, ds = _lm_harness(512, 32, 40)
+    vocab, d_model, heads, layers, d_ff = VOCAB_LM, 256, 4, 6, 1024
     net = transformer_lm(vocab_size=vocab, d_model=d_model, n_heads=heads,
                          n_layers=layers, d_ff=d_ff, max_length=seq,
                          dtype="bfloat16" if on_tpu else "float32")
     net.init()
-    rng = np.random.default_rng(0)
-    toks = np.asarray(rng.integers(0, vocab, (batch, seq)), np.int32)
-    shifted = np.roll(toks, -1, axis=1)
-    from deeplearning4j_tpu.datasets.api import DataSet
-
-    # sparse int labels: the mcxent gather path (O(N) vs O(N*V) HBM traffic)
-    sec = _time_net_steps(net, DataSet(toks, shifted), steps=steps)
+    sec = _time_net_steps(net, ds, steps=steps)
 
     tokens_per_sec = batch * seq / sec
     flops_tok = transformer_flops_per_token(vocab, d_model, layers, d_ff, seq)
@@ -344,25 +356,18 @@ def bench_longcontext() -> None:
     requirement measured on hardware."""
     import jax
 
-    from deeplearning4j_tpu.datasets.api import DataSet
     from deeplearning4j_tpu.models.transformer import (
         transformer_flops_per_token,
         transformer_lm,
     )
 
-    backend = jax.default_backend()
-    on_tpu = backend == "tpu"
-    vocab, d_model, heads, layers, d_ff = 10000, 256, 4, 6, 1024
-    seq = 4096 if on_tpu else 256
-    batch = 4 if on_tpu else 1
-    steps = 20 if on_tpu else 2
+    backend, on_tpu, seq, batch, steps, ds = _lm_harness(
+        4096, 4, 20, seq_cpu=256, batch_cpu=1)
+    vocab, d_model, heads, layers, d_ff = VOCAB_LM, 256, 4, 6, 1024
     net = transformer_lm(vocab_size=vocab, d_model=d_model, n_heads=heads,
                          n_layers=layers, d_ff=d_ff, max_length=seq,
                          dtype="bfloat16" if on_tpu else "float32")
     net.init()
-    rng = np.random.default_rng(0)
-    toks = np.asarray(rng.integers(0, vocab, (batch, seq)), np.int32)
-    ds = DataSet(toks, np.roll(toks, -1, axis=1))
     sec = _time_net_steps(net, ds, steps=steps)
     tokens_per_sec = batch * seq / sec
     flops_tok = transformer_flops_per_token(vocab, d_model, layers, d_ff, seq)
@@ -379,6 +384,27 @@ def bench_longcontext() -> None:
     print(json.dumps(line), flush=True)
 
 
+def bench_moe() -> None:
+    """Mixture-of-Experts LM step throughput (informational — no BASELINE
+    anchor): the top-k gated expert FFN blocks from nn/layers/moe.py in
+    the same 6-layer harness as the dense transformer bench."""
+    from deeplearning4j_tpu.models.transformer import transformer_moe_lm
+
+    backend, on_tpu, seq, batch, steps, ds = _lm_harness(512, 32, 40)
+    net = transformer_moe_lm(vocab_size=VOCAB_LM, d_model=256, n_heads=4,
+                             n_layers=6, n_experts=8, top_k=2,
+                             d_expert_hidden=512, max_length=seq,
+                             dtype="bfloat16" if on_tpu else "float32")
+    net.init()
+    sec = _time_net_steps(net, ds, steps=steps)
+    print(json.dumps({
+        "metric": f"transformer_moe_lm_tokens_per_sec_{backend}",
+        "value": round(batch * seq / sec, 1),
+        "unit": "tokens/sec",
+        "vs_baseline": None,  # informational: beyond-reference capability
+        "n_experts": 8, "top_k": 2}), flush=True)
+
+
 MODES = {
     "lenet": bench_lenet,
     "vgg16": bench_vgg16,
@@ -386,6 +412,7 @@ MODES = {
     "resnet_dp": bench_resnet_dp,
     "transformer": bench_transformer,
     "longcontext": bench_longcontext,
+    "moe": bench_moe,
 }
 
 

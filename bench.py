@@ -71,6 +71,9 @@ def _peak_flops(device):
     return None
 
 
+REGRESSION_FLOOR = 0.9  # anchored metric below 0.9x its anchor fails loudly
+
+
 def _emit(mode: str, value: float, unit: str, **extra) -> None:
     line = {
         "metric": mode if "metric" not in extra else extra.pop("metric"),
@@ -79,6 +82,14 @@ def _emit(mode: str, value: float, unit: str, **extra) -> None:
         "vs_baseline": round(float(value) / TARGETS[mode], 4),
     }
     line.update(extra)
+    if line["vs_baseline"] < REGRESSION_FLOOR:
+        # the regression gate VERDICT r2 asked for: a below-anchor number
+        # can no longer pass silently — the artifact self-reports it
+        line["regression"] = True
+        sys.stderr.write(
+            f"REGRESSION: {line['metric']} = {line['value']} is "
+            f"{line['vs_baseline']:.2f}x its anchor "
+            f"({TARGETS[mode]})\n")
     print(json.dumps(line), flush=True)
 
 
@@ -281,7 +292,10 @@ def bench_resnet_dp() -> None:
     _emit("resnet_dp", sps_allreduce / sps_paramavg, "x",
           metric="resnet20_dp_allreduce_vs_paramavg_speedup",
           allreduce_steps_per_sec=round(sps_allreduce, 3),
-          paramavg_steps_per_sec=round(sps_paramavg, 3))
+          paramavg_steps_per_sec=round(sps_paramavg, 3),
+          # self-describing artifact: this ratio is measured on the virtual
+          # CPU mesh (one real chip available), NOT an ICI measurement
+          mesh=f"virtual-cpu-{n_dev}")
 
 
 VOCAB_LM = 10000
@@ -460,6 +474,11 @@ def _run_all() -> int:
         for line in out.stdout.splitlines():
             if line.startswith("{"):
                 print(line, flush=True)
+                # the child's stderr is captured; re-raise its regression
+                # flag loudly at the parent level so the default
+                # `python bench.py` run can't bury it
+                if '"regression": true' in line:
+                    sys.stderr.write(f"REGRESSION: {line}\n")
         if out.returncode != 0:
             sys.stderr.write(out.stderr[-2000:])
             print(json.dumps({"metric": mode, "error": f"rc={out.returncode}"}),

@@ -50,8 +50,10 @@ def _block_v(d: int, v: int) -> int:
     with d·bv, so shrink the chunk as the feature dim grows to stay under
     the 16MB scoped limit the d=256 sweep was tuned against. The width is
     floored to a lane multiple (128); when the whole vocab fits one chunk
-    the block equals the array dim, which Mosaic also accepts."""
-    bv = max(512, (BLOCK_V * 256 // d) // 128 * 128)
+    the block equals the array dim, which Mosaic also accepts. The chunk
+    is also capped at the swept BLOCK_V so a small d (e.g. 128) cannot
+    inflate the [bn, bv] f32 logits tile past the swept envelope."""
+    bv = max(512, min(BLOCK_V, (BLOCK_V * 256 // d) // 128 * 128))
     return min(v, bv)
 
 # Use the fused kernel only where the dense path's [N, V] materialization
@@ -75,9 +77,12 @@ def _block_n(N: int) -> int:
 
 
 def supports(n: int, d: int, v: int) -> bool:
-    """Whether the fused head handles this shape (else: dense path)."""
-    return (v >= MIN_FUSED_VOCAB and n % 128 == 0 and d % 128 == 0
-            and d <= MAX_FUSED_D)
+    """Whether the fused head handles this shape (else: dense path).
+
+    Ragged row counts are fine — softmax_xent_head pads tokens to the
+    128-row grid internally — so `n` does not gate the dispatch."""
+    del n
+    return v >= MIN_FUSED_VOCAB and d % 128 == 0 and d <= MAX_FUSED_D
 
 
 # ------------------------------------------------------------------ forward

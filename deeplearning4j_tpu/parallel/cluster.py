@@ -25,14 +25,16 @@ with a single small TCP coordinator plus worker clients:
   coordinator still provides registration/heartbeat/elastic restart
   around it.
 
-The wire protocol is newline-delimited JSON with base64 float32 payloads —
-dependency-free and debuggable. Latency is amortized: one round-trip per
-averaging round, not per step.
+The wire protocol is a newline-delimited JSON control line, optionally
+followed by a length-prefixed raw float32 frame (the JSON line carries
+`payload_bytes`): control messages stay human-debuggable JSON while
+parameter vectors travel as binary — no base64 bloat (~33%) and no full
+string copy per round, so 100MB+ models move at socket speed. Latency is
+amortized: one round-trip per averaging round, not per step.
 """
 
 from __future__ import annotations
 
-import base64
 import json
 import os
 import socket
@@ -44,23 +46,39 @@ from typing import Dict, Optional
 import numpy as np
 
 
-def _encode(arr: np.ndarray) -> str:
-    return base64.b64encode(np.asarray(arr, np.float32).tobytes()).decode()
+def _to_bytes(arr: np.ndarray) -> bytes:
+    return np.ascontiguousarray(arr, np.float32).tobytes()
 
 
-def _decode(payload: str) -> np.ndarray:
-    return np.frombuffer(base64.b64decode(payload), np.float32).copy()
+def _from_bytes(payload: bytes) -> np.ndarray:
+    return np.frombuffer(payload, np.float32).copy()
 
 
-def _send_json(sock: socket.socket, obj) -> None:
+def _send_msg(sock: socket.socket, obj, payload: Optional[bytes] = None) -> None:
+    """One message = JSON header line (+ optional raw binary frame whose
+    length the header announces in `payload_bytes`)."""
+    if payload is not None:
+        obj = dict(obj, payload_bytes=len(payload))
     sock.sendall((json.dumps(obj) + "\n").encode())
+    if payload:  # separate send: no header+payload concatenation copy
+        sock.sendall(payload)
 
 
-def _recv_json(fileobj):
+def _recv_msg(fileobj):
+    """Read (msg, payload) from a BINARY buffered stream; payload is None
+    for pure-control messages (header without `payload_bytes`) and b"" for
+    an announced zero-length frame."""
     line = fileobj.readline()
     if not line:
         raise ConnectionError("peer closed")
-    return json.loads(line)
+    msg = json.loads(line)
+    n = msg.pop("payload_bytes", None)
+    payload = None
+    if n is not None:
+        payload = fileobj.read(int(n))
+        if payload is None or len(payload) < int(n):
+            raise ConnectionError("peer closed mid-payload")
+    return msg, payload
 
 
 class _Round:
@@ -70,6 +88,7 @@ class _Round:
         self.contributions: Dict[str, np.ndarray] = {}
         self.done = threading.Event()
         self.result: Optional[np.ndarray] = None
+        self.result_bytes: Optional[bytes] = None
 
 
 class ClusterCoordinator:
@@ -104,9 +123,9 @@ class ClusterCoordinator:
             def handle(self):
                 try:
                     while True:
-                        msg = _recv_json(self.rfile)
-                        reply = coord._dispatch(msg)
-                        _send_json(self.request, reply)
+                        msg, payload = _recv_msg(self.rfile)
+                        reply, reply_payload = coord._dispatch(msg, payload)
+                        _send_msg(self.request, reply, reply_payload)
                 except (ConnectionError, OSError, json.JSONDecodeError):
                     pass
 
@@ -139,7 +158,8 @@ class ClusterCoordinator:
             return dict(self._workers)
 
     # ------------------------------------------------------------ dispatch
-    def _dispatch(self, msg: dict) -> dict:
+    def _dispatch(self, msg: dict, payload: Optional[bytes] = None):
+        """Returns (reply_dict, reply_payload_bytes_or_None)."""
         op = msg.get("op")
         if op == "register":
             with self._lock:
@@ -152,39 +172,39 @@ class ClusterCoordinator:
                 return {"ok": True, "rank": self._ranks[wid],
                         "n_workers": len(self._workers),
                         "heartbeat_timeout": self.heartbeat_timeout,
-                        "round_timeout": self.round_timeout}
+                        "round_timeout": self.round_timeout}, None
         if op == "heartbeat":
             with self._lock:
                 if msg["worker"] in self._workers:
                     self._workers[msg["worker"]]["last_seen"] = time.monotonic()
-                    return {"ok": True}
-            return {"ok": False, "error": "unknown worker (re-register)"}
+                    return {"ok": True}, None
+            return {"ok": False, "error": "unknown worker (re-register)"}, None
         if op == "deregister":
             with self._lock:
                 self._workers.pop(msg["worker"], None)
-            return {"ok": True}
+            return {"ok": True}, None
         if op == "workers":
-            return {"ok": True, "workers": sorted(self.alive_workers())}
+            return {"ok": True, "workers": sorted(self.alive_workers())}, None
         if op == "set_config":
             with self._lock:
                 self._configs[msg["key"]] = msg["value"]
-            return {"ok": True}
+            return {"ok": True}, None
         if op == "get_config":
             with self._lock:
                 if msg["key"] not in self._configs:
-                    return {"ok": False, "error": "no such config"}
-                return {"ok": True, "value": self._configs[msg["key"]]}
+                    return {"ok": False, "error": "no such config"}, None
+                return {"ok": True, "value": self._configs[msg["key"]]}, None
         if op == "average":
-            return self._average(msg)
+            return self._average(msg, payload)
         if op == "barrier":
-            return self._barrier(msg)
-        return {"ok": False, "error": f"unknown op {op!r}"}
+            return self._barrier(msg), None
+        return {"ok": False, "error": f"unknown op {op!r}"}, None
 
     # ----------------------------------------------------- averaging round
-    def _average(self, msg: dict) -> dict:
+    def _average(self, msg: dict, payload: bytes):
         step = int(msg["step"])
         worker = msg["worker"]
-        arr = _decode(msg["payload"])
+        arr = _from_bytes(payload)
         with self._lock:
             if worker in self._workers:
                 self._workers[worker]["last_seen"] = time.monotonic()
@@ -210,13 +230,15 @@ class ClusterCoordinator:
             # (and hanging on) a fresh round; prune well-past steps
             for old in [k for k in self._avg_rounds if k < step - 16]:
                 del self._avg_rounds[old]
-        return {"ok": True, "payload": _encode(rnd.result),
-                "n": len(rnd.contributions)}
+        return ({"ok": True, "n": len(rnd.contributions)},
+                rnd.result_bytes)
 
     def _finish_round(self, rnd: _Round) -> None:
         if rnd.done.is_set():
             return
         rnd.result = np.mean(list(rnd.contributions.values()), axis=0)
+        # serialize ONCE per round, not once per contributor's reply
+        rnd.result_bytes = _to_bytes(rnd.result)
         rnd.done.set()
 
     # -------------------------------------------------------------- barrier
@@ -250,8 +272,8 @@ class ClusterClient:
         self.worker_id = worker_id
         self._lock = threading.Lock()
         self._sock = socket.create_connection(self.address, timeout=120)
-        self._file = self._sock.makefile("r")
-        reply = self._call({"op": "register"})
+        self._file = self._sock.makefile("rb")
+        reply, _ = self._call({"op": "register"})
         self.rank = reply["rank"]
         # a blocked average() waits up to the server's round_timeout; give
         # the socket comfortable headroom beyond it
@@ -262,52 +284,52 @@ class ClusterClient:
             daemon=True)
         self._hb.start()
 
-    def _call(self, msg: dict) -> dict:
+    def _call(self, msg: dict, payload: Optional[bytes] = None):
         msg = dict(msg, worker=self.worker_id)
         with self._lock:
-            _send_json(self._sock, msg)
-            reply = _recv_json(self._file)
+            _send_msg(self._sock, msg, payload)
+            reply, reply_payload = _recv_msg(self._file)
         if not reply.get("ok"):
             raise RuntimeError(f"coordinator error: {reply.get('error')}")
-        return reply
+        return reply, reply_payload
 
     def _heartbeat_loop(self, interval: float) -> None:
         # separate connection so heartbeats never queue behind a long
         # averaging round
         try:
             sock = socket.create_connection(self.address, timeout=30)
-            f = sock.makefile("r")
+            f = sock.makefile("rb")
             while not self._hb_stop.wait(interval):
-                _send_json(sock, {"op": "heartbeat", "worker": self.worker_id})
-                reply = _recv_json(f)
+                _send_msg(sock, {"op": "heartbeat", "worker": self.worker_id})
+                reply, _ = _recv_msg(f)
                 if not reply.get("ok") and not self._hb_stop.is_set():
                     # demoted after a transient stall: re-register (the
                     # coordinator keeps ranks stable across re-registration).
                     # The _hb_stop guard avoids re-registering a worker whose
                     # close() already deregistered it (in-flight heartbeat).
-                    _send_json(sock, {"op": "register",
-                                      "worker": self.worker_id})
-                    _recv_json(f)
+                    _send_msg(sock, {"op": "register",
+                                     "worker": self.worker_id})
+                    _recv_msg(f)
         except (OSError, ConnectionError):
             pass
 
     # ---------------------------------------------------------------- API
     def workers(self):
-        return self._call({"op": "workers"})["workers"]
+        return self._call({"op": "workers"})[0]["workers"]
 
     def set_config(self, key: str, value) -> None:
         self._call({"op": "set_config", "key": key, "value": value})
 
     def get_config(self, key: str):
-        return self._call({"op": "get_config", "key": key})["value"]
+        return self._call({"op": "get_config", "key": key})[0]["value"]
 
     def barrier(self, name: str) -> None:
         self._call({"op": "barrier", "name": name})
 
     def average(self, step: int, flat_params: np.ndarray) -> np.ndarray:
-        reply = self._call({"op": "average", "step": step,
-                            "payload": _encode(flat_params)})
-        return _decode(reply["payload"])
+        _, payload = self._call({"op": "average", "step": step},
+                                _to_bytes(flat_params))
+        return _from_bytes(payload)
 
     def close(self) -> None:
         self._hb_stop.set()

@@ -67,7 +67,21 @@ def make_sp_train_step(net, mesh: Mesh, seq_axis: str = "seq",
             loss_fn, has_aux=True)(params)
         # every shard's loss is a mean over its local tokens; shards are
         # equal-sized, so pmean of means == the global mean, and pmean'd
-        # grads drive identical updates on every replica
+        # grads drive identical updates on every replica. Mutable layer
+        # state computed from local shards (e.g. batchnorm running stats
+        # over a shard's time block) is pmean'd too so the state leaving
+        # the step is the global average, not one shard's view; integer
+        # leaves (step counters) advance identically on every shard and
+        # pass through untouched.
+        def _avg_state(a):
+            if jnp.issubdtype(jnp.asarray(a).dtype, jnp.floating):
+                out = a
+                for ax in axes:
+                    out = lax.pmean(out, ax)
+                return out
+            return a
+
+        new_state = jax.tree.map(_avg_state, new_state)
         for ax in axes:
             loss = lax.pmean(loss, ax)
             grads = lax.pmean(grads, ax)

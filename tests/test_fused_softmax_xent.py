@@ -97,6 +97,6 @@ def test_ragged_row_count_padded(head):
 def test_supports_gate():
     assert fsx.supports(256, 128, 4096)
     assert not fsx.supports(256, 128, 512)      # small vocab: dense fuses fine
-    assert not fsx.supports(250, 128, 4096)     # ragged N
+    assert fsx.supports(250, 128, 4096)         # ragged N pads internally
     assert not fsx.supports(256, 130, 4096)     # ragged d
     assert not fsx.supports(256, 2048, 4096)    # d too big for VMEM scratch

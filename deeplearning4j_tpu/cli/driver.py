@@ -10,6 +10,16 @@ Usage:
         --num-classes 3
     python -m deeplearning4j_tpu.cli predict --model out.zip --input d.csv \
         --output preds.csv
+
+Distributed runtimes (reference Train.java `-runtime local|spark|hadoop`
++ cli-spark/SparkTrain.java; here the TPU-native equivalents):
+
+    # single-process mesh (pjit over local devices — the Spark-local case)
+    ... train --mesh data=4[,model=2][,pipe=2] [--microbatches 4] ...
+    # multi-process elastic cluster (the Spark/Akka-cluster case)
+    python -m deeplearning4j_tpu.cli coordinator [--port P]
+    ... train --cluster HOST:PORT --num-workers 2 [--worker-id w0] \
+        [--sync-every 1] [--checkpoint ck.zip] ...
 """
 
 from __future__ import annotations
@@ -52,7 +62,31 @@ def _build_parser() -> argparse.ArgumentParser:
     t.add_argument("--epochs", type=int, default=1)
     t.add_argument("--output", "-o", default=None,
                    help="alias of --model for reference-flag parity")
+    t.add_argument("--mesh", default=None,
+                   help="single-process mesh axes, e.g. data=4 or "
+                        "data=2,model=2,pipe=2 (roles: data/model/pipe/"
+                        "expert; uses jax.sharding over local devices)")
+    t.add_argument("--microbatches", type=int, default=None,
+                   help="pipeline microbatches (with a pipe mesh axis)")
+    t.add_argument("--cluster", default=None,
+                   help="coordinator HOST:PORT for multi-process elastic "
+                        "data-parallel training (parameter averaging)")
+    t.add_argument("--num-workers", type=int, default=1,
+                   help="expected cluster size (data shards by rank)")
+    t.add_argument("--worker-id", default=None,
+                   help="stable worker id (default: host-pid)")
+    t.add_argument("--sync-every", type=int, default=1,
+                   help="local steps between cluster averaging rounds")
+    t.add_argument("--checkpoint", default=None,
+                   help="worker checkpoint path (elastic restart resumes)")
     common(t, model_required=False)
+
+    co = sub.add_parser("coordinator",
+                        help="run the cluster coordinator (registry + "
+                             "heartbeats + averaging rounds)")
+    co.add_argument("--host", default="0.0.0.0")
+    co.add_argument("--port", type=int, default=9085)
+    co.add_argument("--heartbeat-timeout", type=float, default=10.0)
 
     te = sub.add_parser("test", help="evaluate a trained model")
     common(te)
@@ -90,6 +124,85 @@ def _load_model(path: str):
     return ModelSerializer.restore(path)
 
 
+def _parse_mesh(spec: str):
+    """'data=2,model=2' -> {"data": 2, "model": 2} (ordered)."""
+    axes = {}
+    for part in spec.split(","):
+        if "=" not in part:
+            raise SystemExit(f"bad --mesh entry {part!r}; expected role=N")
+        role, _, n = part.partition("=")
+        role = role.strip()
+        if role not in ("data", "model", "pipe", "expert"):
+            raise SystemExit(f"unknown mesh role {role!r} "
+                             "(valid: data, model, pipe, expert)")
+        try:
+            size = int(n)
+        except ValueError:
+            raise SystemExit(f"bad --mesh size {n!r} for {role}; "
+                             "expected a positive integer") from None
+        if size < 1:
+            raise SystemExit(f"--mesh {role}={size}: size must be >= 1")
+        axes[role] = size
+    return axes
+
+
+def _apply_mesh(net, args) -> None:
+    """Route --mesh through the unified set_mesh entry point
+    (parallel/placement.py) — the Spark-local runtime analogue."""
+    import jax
+
+    from deeplearning4j_tpu.parallel.mesh import make_mesh
+
+    axes = _parse_mesh(args.mesh)
+    need = int(np.prod(list(axes.values())))
+    have = len(jax.devices())
+    if need > have:
+        raise SystemExit(
+            f"--mesh {args.mesh} needs {need} devices but only {have} are "
+            "visible (for CPU simulation set JAX_PLATFORMS=cpu and "
+            f"XLA_FLAGS=--xla_force_host_platform_device_count={need})")
+    mesh = make_mesh(axes)
+    net.set_mesh(mesh, axes={r: r for r in axes},
+                 n_microbatches=args.microbatches)
+    print(f"mesh: {dict(axes)} over {need} {jax.devices()[0].platform} "
+          "devices")
+
+
+def _train_on_cluster(net, args, it) -> None:
+    """Multi-process elastic parameter-averaging worker (the Spark/Akka
+    cluster runtime analogue — reference cli-spark/SparkTrain.java):
+    register with the coordinator, wait for the expected fleet, shard the
+    batches by rank, then run the elastic averaging loop."""
+    import os
+    import socket
+    import time
+
+    from deeplearning4j_tpu.parallel.cluster import (
+        ClusterClient,
+        run_elastic_worker,
+    )
+
+    worker_id = args.worker_id or f"{socket.gethostname()}-{os.getpid()}"
+    probe = ClusterClient(args.cluster, worker_id)
+    try:
+        deadline = time.monotonic() + 120
+        while len(probe.workers()) < args.num_workers:
+            if time.monotonic() > deadline:
+                raise SystemExit(
+                    f"cluster at {args.cluster} has {len(probe.workers())} "
+                    f"workers; expected {args.num_workers}")
+            time.sleep(0.2)
+        rank = probe.rank
+    finally:
+        probe.close()
+    batches = [ds for i, ds in enumerate(it)
+               if i % args.num_workers == rank % args.num_workers]
+    print(f"worker {worker_id} rank {rank}: {len(batches)} local batches")
+    run_elastic_worker(args.cluster, worker_id, net, batches,
+                       sync_every=args.sync_every,
+                       checkpoint_path=args.checkpoint, epochs=args.epochs)
+
+
 def _cmd_train(args) -> int:
     from deeplearning4j_tpu.nn.conf.graph_conf import (
         ComputationGraphConfiguration,
@@ -102,6 +215,10 @@ def _cmd_train(args) -> int:
     from deeplearning4j_tpu.optimize.listeners import ScoreIterationListener
     from deeplearning4j_tpu.util.model_serializer import ModelSerializer
 
+    if args.mesh and args.cluster:
+        raise SystemExit("--mesh (single-process pjit) and --cluster "
+                         "(multi-process averaging) are separate runtimes; "
+                         "pick one per process")
     with open(args.conf) as f:
         conf_json = f.read()
     if args.type == "computation_graph":
@@ -110,15 +227,36 @@ def _cmd_train(args) -> int:
         net = MultiLayerNetwork(MultiLayerConfiguration.from_json(conf_json))
     net.init()
     net.set_listeners(ScoreIterationListener(10, printer=print))
+    if args.mesh:
+        _apply_mesh(net, args)
 
     it = _make_iterator(args)
-    net.fit(it, epochs=args.epochs)
+    if args.cluster:
+        _train_on_cluster(net, args, it)
+    else:
+        net.fit(it, epochs=args.epochs)
 
     out = args.model or args.output
     if not out:
         raise SystemExit("need --model (or --output) to save the trained model")
     ModelSerializer.write_model(net, out)
     print(f"model saved to {out}")
+    return 0
+
+
+def _cmd_coordinator(args) -> int:
+    from deeplearning4j_tpu.parallel.cluster import ClusterCoordinator
+
+    coord = ClusterCoordinator(host=args.host, port=args.port,
+                               heartbeat_timeout=args.heartbeat_timeout)
+    coord.start()
+    print(f"coordinator listening on {coord.address}", flush=True)
+    try:
+        import threading
+
+        threading.Event().wait()
+    except KeyboardInterrupt:
+        coord.shutdown()
     return 0
 
 
@@ -162,7 +300,8 @@ def _cmd_predict(args) -> int:
 def main(argv=None) -> int:
     args = _build_parser().parse_args(argv)
     return {"train": _cmd_train, "test": _cmd_test,
-            "predict": _cmd_predict}[args.command](args)
+            "predict": _cmd_predict,
+            "coordinator": _cmd_coordinator}[args.command](args)
 
 
 if __name__ == "__main__":

@@ -107,12 +107,23 @@ class ComputationGraph:
     def set_listeners(self, *listeners):
         self.listeners = list(listeners)
 
-    def set_mesh(self, mesh, zero1: bool = False):
-        self._mesh = mesh
-        self._zero1 = zero1
-        self._train_step = None
-        self._scan_fit = None
-        self._output_jit = None
+    def set_mesh(self, mesh, zero1: bool = False, axes=None,
+                 n_microbatches=None, tp_rules=None):
+        """Single distributed entry point: axes maps parallelism roles
+        ("data"/"model"/"pipe"/"expert") to mesh axis names — see
+        parallel/placement.py. Without axes: round-1 pure DP over 'data'."""
+        from deeplearning4j_tpu.parallel.placement import configure_mesh
+
+        return configure_mesh(self, mesh, zero1=zero1, axes=axes,
+                              n_microbatches=n_microbatches,
+                              tp_rules=tp_rules)
+
+    def _canonical_params(self):
+        """Params in the per-layer layout regardless of an active pipeline
+        restructure (read paths: output/score/serialization/flat views)."""
+        if getattr(self, "_pp_plan", None) is not None:
+            return self._pp_plan.to_canonical(self.params)
+        return self.params
 
     def set_optimizer(self, tx):
         self.tx = tx
@@ -175,7 +186,7 @@ class ComputationGraph:
 
     def _forward(self, params, state, input_dict, *, train, rng, masks=None,
                  collect=False, carries=None):
-        masks = masks or {}
+        masks = dict(masks) if masks else {}
         acts = {}
         cdtype = self.compute_dtype
         for k, v in input_dict.items():
@@ -219,6 +230,15 @@ class ComputationGraph:
             else:
                 acts[name] = self._vertex_forward(
                     name, vconf, inputs, params, state, train, k, masks, acts)
+            # propagate time masks along the DAG (reference
+            # setLayerMaskArrays/feedForwardMaskArrays semantics): a
+            # time-preserving vertex carries its first input's mask so
+            # downstream recurrent/attention layers see the padding
+            m = masks.get(self.conf.vertex_inputs[name][0])
+            y_out = acts[name]
+            if (m is not None and hasattr(y_out, "ndim") and y_out.ndim == 3
+                    and y_out.shape[1] == m.shape[1]):
+                masks[name] = m
         for n in self.layer_vertices:
             new_state.setdefault(n, state.get(n, {}))
         if collect:
@@ -349,10 +369,22 @@ class ComputationGraph:
     def _get_train_step(self):
         """Jitted donated train step (same contract as MLN._get_train_step)."""
         if self._train_step is None:
-            confs = {n: v.layer for n, v in self.layer_vertices.items()}
-            self._train_step = make_train_step(
-                self._loss, self.tx, confs, mesh=self._mesh,
-                zero1_opt_state=(self.opt_state if self._zero1 else None))
+            if getattr(self, "_pp_plan", None) is not None:
+                from deeplearning4j_tpu.parallel.pipeline import (
+                    make_pp_train_step,
+                )
+
+                self._train_step = make_pp_train_step(
+                    self, self._pp_plan, self._mesh, self._mesh_axes,
+                    self._pp_microbatches, self._resolved_rules)
+            else:
+                confs = {n: v.layer for n, v in self.layer_vertices.items()}
+                axes = getattr(self, "_mesh_axes", None)
+                self._train_step = make_train_step(
+                    self._loss, self.tx, confs, mesh=self._mesh,
+                    zero1_opt_state=(self.opt_state if self._zero1 else None),
+                    data_axis=(axes or {}).get("data", "data"),
+                    param_sharding=getattr(self, "_param_sh", None))
         return self._train_step
 
     def fit_scanned(self, data, labels=None, epochs: int = 1):
@@ -463,6 +495,9 @@ class ComputationGraph:
         """Greedy layer-wise pretraining over the DAG (reference
         ComputationGraph.pretrain): for each pretrain-capable layer vertex in
         topological order, train its params on the activations feeding it."""
+        if getattr(self, "_pp_plan", None) is not None:
+            raise ValueError("pretrain is not supported while a pipeline "
+                             "mesh is active — set_mesh(None) first")
         if self.params is None:
             self.init()
         if isinstance(it, (DataSet, MultiDataSet)):
@@ -520,30 +555,43 @@ class ComputationGraph:
         """Outputs for given inputs (reference output). Returns a list (one
         per network output), or the single array if one output."""
         input_dict = dict(zip(self.conf.network_inputs, inputs))
+        axes = getattr(self, "_mesh_axes", None)
+        data_axis = (axes or {}).get("data", "data")
+        has_data = (self._mesh is not None
+                    and data_axis in self._mesh.axis_names)
         if self._output_jit is None:
             def _out(params, state, input_dict):
+                if getattr(self, "_pp_plan", None) is not None:
+                    # pipelined layout at rest: slice back to per-layer
+                    # params inside the jit (free data movement)
+                    params = self._pp_plan.to_canonical(params)
                 ys, _, _ = self._forward(params, state, input_dict, train=False,
                                          rng=None)
                 return ys
-            if self._mesh is not None:
-                # distributed evaluation: batch sharded over 'data'
+            if has_data:
+                # distributed evaluation: batch sharded over the data axis
                 # (reference EvaluateFlatMapFunction + Evaluation.merge)
                 from deeplearning4j_tpu.nn.training import mesh_shardings
 
-                repl, data = mesh_shardings(self._mesh)
+                repl, data = mesh_shardings(self._mesh, data_axis)
+                # committed TP/PP params keep their placement (None);
+                # plain-DP params are explicitly replicated
+                p_in = (None if (getattr(self, "_pp_plan", None) is not None
+                                 or getattr(self, "_param_sh", None)
+                                 is not None) else repl)
                 self._output_jit = jax.jit(
-                    _out, in_shardings=(repl, repl, data),
+                    _out, in_shardings=(p_in, repl, data),
                     out_shardings=data)
             else:
                 self._output_jit = jax.jit(_out)
         input_dict = {k: jnp.asarray(v) for k, v in input_dict.items()}
         pad = 0
-        if self._mesh is not None:
+        if has_data:
             # pad batch to a multiple of the data axis, slice back below
             from deeplearning4j_tpu.nn.training import pad_batch_to_multiple
 
             input_dict, pad = pad_batch_to_multiple(
-                input_dict, self._mesh.shape["data"])
+                input_dict, self._mesh.shape[data_axis])
         ys = self._output_jit(self.params, self.state, input_dict)
         if pad:
             ys = [y[:-pad] for y in ys]
@@ -559,8 +607,8 @@ class ComputationGraph:
         if ds is None:
             return self.score_value
         mds = self._to_mds(ds)
-        loss, _ = self._loss(self.params, self.state, None, self._batch_dict(mds),
-                             train=training)
+        loss, _ = self._loss(self._canonical_params(), self.state, None,
+                             self._batch_dict(mds), train=training)
         return float(loss)
 
     def evaluate(self, it, top_n: int = 1):
@@ -591,6 +639,9 @@ class ComputationGraph:
         chunks. Raises for layers that cannot stream causally (bidirectional
         LSTM, self-attention — the reference throws
         UnsupportedOperationException for these)."""
+        if getattr(self, "_pp_plan", None) is not None:
+            raise ValueError("rnn_time_step is not supported while a "
+                             "pipeline mesh is active — set_mesh(None) first")
         for name, v in self.layer_vertices.items():
             if isinstance(v.layer, BaseRecurrentLayer) and not hasattr(
                     self.impls[name], "initial_carry"):
@@ -631,15 +682,20 @@ class ComputationGraph:
         return sum(int(np.prod(p.shape)) for p in jax.tree.leaves(self.params))
 
     def params_flat(self):
-        leaves = jax.tree.leaves(self.params)
+        leaves = jax.tree.leaves(self._canonical_params())
         return (np.concatenate([np.asarray(l).ravel() for l in leaves])
                 if leaves else np.zeros(0))
 
     def set_params_flat(self, flat):
-        leaves, treedef = jax.tree.flatten(self.params)
+        canonical = self._canonical_params()
+        leaves, treedef = jax.tree.flatten(canonical)
         out, off = [], 0
         for l in leaves:
             n = int(np.prod(l.shape))
             out.append(jnp.asarray(flat[off:off + n], l.dtype).reshape(l.shape))
             off += n
-        self.params = jax.tree.unflatten(treedef, out)
+        params = jax.tree.unflatten(treedef, out)
+        if getattr(self, "_pp_plan", None) is not None:
+            self.params = self._pp_plan.to_pipelined(params)
+        else:
+            self.params = params

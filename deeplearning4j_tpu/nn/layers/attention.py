@@ -166,7 +166,7 @@ class SelfAttentionImpl(LayerImpl):
                                  causal=conf.causal)
         elif getattr(conf, "use_flash", True) and flash_supports(
                 qh.shape, causal=conf.causal, dropout=drop, mask=mask):
-            out = flash_attention(qh, kh, vh, causal=conf.causal)
+            out = flash_attention(qh, kh, vh, causal=conf.causal, mask=mask)
         else:
             out = dot_product_attention(
                 qh, kh, vh, causal=conf.causal, mask=mask,

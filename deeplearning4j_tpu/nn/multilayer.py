@@ -112,14 +112,18 @@ class MultiLayerNetwork:
     def set_listeners(self, *listeners):
         self.listeners = list(listeners)
 
-    def set_mesh(self, mesh, zero1: bool = False):
-        """Enable data-parallel training over a jax.sharding.Mesh with a
-        'data' axis (replaces the Spark parameter-averaging master)."""
-        self._mesh = mesh
-        self._zero1 = zero1
-        self._train_step = None
-        self._scan_fit = None
-        self._output_jit = None
+    def set_mesh(self, mesh, zero1: bool = False, axes=None,
+                 n_microbatches=None, tp_rules=None):
+        """Enable distributed training over a jax.sharding.Mesh (replaces
+        the Spark parameter-averaging master). axes maps parallelism roles
+        ("data"/"model"/"expert"; "pipe" needs the graph container) to mesh
+        axis names — see parallel/placement.py. Without axes: pure DP over
+        a 'data' axis."""
+        from deeplearning4j_tpu.parallel.placement import configure_mesh
+
+        return configure_mesh(self, mesh, zero1=zero1, axes=axes,
+                              n_microbatches=n_microbatches,
+                              tp_rules=tp_rules)
 
     # --------------------------------------------------------------- forward
     def _next_rng(self):
@@ -233,9 +237,12 @@ class MultiLayerNetwork:
     def _get_train_step(self):
         if self._train_step is None:
             confs = dict(zip(self.layer_names, self.layer_confs))
+            axes = getattr(self, "_mesh_axes", None)
             self._train_step = make_train_step(
                 self._loss, self.tx, confs, mesh=self._mesh,
-                zero1_opt_state=(self.opt_state if self._zero1 else None))
+                zero1_opt_state=(self.opt_state if self._zero1 else None),
+                data_axis=(axes or {}).get("data", "data"),
+                param_sharding=getattr(self, "_param_sh", None))
         return self._train_step
 
     @staticmethod
@@ -440,17 +447,23 @@ class MultiLayerNetwork:
         """Network output (reference output:1500-1582). With a mesh set,
         inference shards the batch over the 'data' axis — the distributed-
         evaluation path (reference EvaluateFlatMapFunction + merge)."""
+        axes = getattr(self, "_mesh_axes", None)
+        data_axis = (axes or {}).get("data", "data")
+        has_data = (self._mesh is not None
+                    and data_axis in self._mesh.axis_names)
         if self._output_jit is None:
             def _out(params, state, x, mask):
                 y, _, _ = self._forward(params, state, x, train=False, rng=None,
                                         mask=mask)
                 return y
-            if self._mesh is not None:
+            if has_data:
                 from deeplearning4j_tpu.nn.training import mesh_shardings
 
-                repl, data = mesh_shardings(self._mesh)
+                repl, data = mesh_shardings(self._mesh, data_axis)
+                p_in = (None if getattr(self, "_param_sh", None) is not None
+                        else repl)
                 self._output_jit = jax.jit(
-                    _out, in_shardings=(repl, repl, data, None),
+                    _out, in_shardings=(p_in, repl, data, None),
                     out_shardings=data)
             else:
                 self._output_jit = jax.jit(_out)
@@ -459,7 +472,7 @@ class MultiLayerNetwork:
                                     train=True, rng=self._next_rng(), mask=mask)
             return y
         x = jnp.asarray(x)
-        if self._mesh is not None:
+        if has_data:
             # sharded inference needs batch % mesh == 0: pad-and-slice
             # (EvaluateFlatMapFunction handles uneven shards semantically)
             from deeplearning4j_tpu.nn.training import pad_batch_to_multiple
@@ -467,7 +480,7 @@ class MultiLayerNetwork:
             B = x.shape[0]
             bundle = (x,) if mask is None else (x, mask)
             bundle, pad = pad_batch_to_multiple(bundle,
-                                                self._mesh.shape["data"])
+                                                self._mesh.shape[data_axis])
             if pad:
                 x = bundle[0]
                 mask = bundle[1] if mask is not None else None

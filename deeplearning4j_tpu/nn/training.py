@@ -47,7 +47,8 @@ def zero1_opt_shardings(opt_state, mesh, axis: str = "data"):
 
 
 def make_train_step(loss_fn, tx, layer_confs_by_name, mesh=None,
-                    donate=True, zero1_opt_state=None):
+                    donate=True, zero1_opt_state=None, data_axis="data",
+                    param_sharding=None):
     """loss_fn(params, state, rng, batch) -> (loss, (new_state, extras)).
 
     batch is a dict pytree {features, labels, features_mask?, labels_mask?,
@@ -57,6 +58,12 @@ def make_train_step(loss_fn, tx, layer_confs_by_name, mesh=None,
 
     zero1_opt_state: pass the CURRENT opt_state (with `mesh`) to shard the
     optimizer state over the data axis (see zero1_opt_shardings).
+
+    data_axis: mesh axis name the batch shards over (None: replicated —
+    e.g. a pure tensor-parallel mesh). param_sharding: a pytree of
+    NamedShardings for the params (TP/EP placement from
+    parallel/tensor_parallel.py) — optimizer-state moments then inherit
+    their committed placement instead of being forced replicated.
     """
 
     def step(params, opt_state, state, rng, batch):
@@ -74,16 +81,24 @@ def make_train_step(loss_fn, tx, layer_confs_by_name, mesh=None,
         from jax.sharding import NamedSharding, PartitionSpec as P
 
         repl = NamedSharding(mesh, P())
-        data = NamedSharding(mesh, P("data"))
-        opt_sh = (zero1_opt_shardings(zero1_opt_state, mesh)
-                  if zero1_opt_state is not None else repl)
+        data = (NamedSharding(mesh, P(data_axis))
+                if data_axis and data_axis in mesh.axis_names else repl)
+        p_sh = param_sharding if param_sharding is not None else repl
+        if zero1_opt_state is not None:
+            opt_in = opt_out = zero1_opt_shardings(zero1_opt_state, mesh)
+        elif param_sharding is not None:
+            # moments were committed alongside the params; None lets jit
+            # respect (in) and propagate (out) that placement
+            opt_in = opt_out = None
+        else:
+            opt_in = opt_out = repl
         # sharding pytree prefixes: one sharding per argument applies to all
-        # its leaves — batch leaves are sharded on the 'data' mesh axis
+        # its leaves — batch leaves are sharded on the data mesh axis
         return jax.jit(
             step,
             donate_argnums=donate_argnums,
-            in_shardings=(repl, opt_sh, repl, repl, data),
-            out_shardings=(repl, opt_sh, repl, repl, repl),
+            in_shardings=(p_sh, opt_in, repl, repl, data),
+            out_shardings=(p_sh, opt_out, repl, repl, repl),
         )
     return jax.jit(step, donate_argnums=donate_argnums)
 
@@ -196,11 +211,11 @@ def fused_fit(net, batches, epochs):
     return net
 
 
-def mesh_shardings(mesh):
-    """(replicated, data-sharded) NamedShardings for a mesh's 'data' axis."""
+def mesh_shardings(mesh, data_axis: str = "data"):
+    """(replicated, data-sharded) NamedShardings for a mesh data axis."""
     from jax.sharding import NamedSharding, PartitionSpec as P
 
-    return NamedSharding(mesh, P()), NamedSharding(mesh, P("data"))
+    return NamedSharding(mesh, P()), NamedSharding(mesh, P(data_axis))
 
 
 def pad_batch_to_multiple(tree, n):

@@ -1,0 +1,155 @@
+"""Unified mesh/axes entry point for the network containers.
+
+`net.set_mesh(mesh, axes={...})` is the single switch that turns a
+builder-API network distributed (the capability the reference reached
+only through the Spark/Akka masters, SparkDl4jMultiLayer.java:335 — and
+only for data parallelism). Roles map to mesh axis names:
+
+    net.set_mesh(mesh, axes={"data": "data"})                  # DP
+    net.set_mesh(mesh, axes={"data": "data", "model": "model"})# DP x TP
+    net.set_mesh(mesh, axes={"data": "data", "model": "model",
+                             "pipe": "pipe"}, n_microbatches=8)# DP x TP x PP
+    net.set_mesh(mesh, axes={"data": "data", "expert": "expert"})  # DP x EP
+
+- "data": batch leaves shard over the axis; XLA inserts the gradient
+  allreduce (replaces the Spark broadcast/accumulator round-trip).
+- "model": Megatron-style TP placement rules
+  (tensor_parallel.TRANSFORMER_TP_RULES or custom via `tp_rules`);
+  GSPMD propagates and inserts the per-block psums.
+- "expert": MoE expert tensors shard their expert dim
+  (tensor_parallel.MOE_EP_RULES); the gate-combine psum is inserted by
+  GSPMD — a differentiable, composable EP train path.
+- "pipe": the network conf is partitioned into pipeline stages
+  (parallel/pipeline.py); params restructure into the pipelined layout
+  (stages stacked on a [S] axis sharded over the pipe axis) and the train
+  step becomes the microbatched GPipe schedule. Composes with data/model/
+  expert axes, which stay AUTO inside the schedule's shard_map.
+
+`set_mesh(mesh)` with no axes keeps the round-1 behavior (pure DP over a
+'data' axis, optional ZeRO-1).
+"""
+
+from __future__ import annotations
+
+import jax
+
+ROLES = ("data", "model", "pipe", "expert")
+
+
+def _map_param_shaped(tree, ref_params, convert):
+    """Apply `convert` to every subtree of `tree` whose pytree structure
+    equals ref_params' (optimizer moments mirror the param tree; counts
+    and scalars pass through). Used to carry optimizer state across the
+    canonical <-> pipelined restructure without resetting moments."""
+    ref = jax.tree.structure(ref_params)
+
+    def is_param_shaped(x):
+        try:
+            return jax.tree.structure(x) == ref
+        except Exception:
+            return False
+
+    def maybe(x):
+        return convert(x) if is_param_shaped(x) else x
+
+    return jax.tree.map(maybe, tree, is_leaf=is_param_shaped)
+
+
+def exit_pipeline(net):
+    """Restore canonical per-layer params/opt_state from the pipelined
+    layout (called when the mesh is cleared or re-configured)."""
+    plan = net._pp_plan
+    if plan is None:
+        return
+    pipelined = net.params
+    net.params = plan.to_canonical(pipelined)
+    if net.opt_state is not None:
+        net.opt_state = _map_param_shaped(
+            net.opt_state, pipelined, plan.to_canonical)
+    net._pp_plan = None
+    net._pp_microbatches = None
+
+
+def configure_mesh(net, mesh, *, zero1=False, axes=None, n_microbatches=None,
+                   tp_rules=None):
+    """Shared body of MultiLayerNetwork/ComputationGraph.set_mesh."""
+    from deeplearning4j_tpu.parallel.tensor_parallel import (
+        param_shardings,
+        resolve_rules,
+        shard_params,
+    )
+
+    if getattr(net, "_pp_plan", None) is not None:
+        exit_pipeline(net)
+    net._mesh = mesh
+    net._zero1 = zero1
+    net._mesh_axes = dict(axes) if axes else None
+    net._param_sh = None
+    net._resolved_rules = None
+    net._pp_plan = None
+    net._pp_microbatches = None
+    net._train_step = None
+    net._scan_fit = None
+    net._output_jit = None
+    if mesh is None or axes is None:
+        return net
+
+    bad = set(axes) - set(ROLES)
+    if bad:
+        raise ValueError(f"unknown mesh roles {sorted(bad)}; valid: {ROLES}")
+    for role, ax in axes.items():
+        if ax not in mesh.axis_names:
+            raise ValueError(
+                f"axes[{role!r}]={ax!r} is not a mesh axis "
+                f"(mesh has {mesh.axis_names})")
+    if zero1 and set(axes) - {"data"}:
+        raise ValueError("zero1 currently composes with the 'data' axis "
+                         "only — drop it or the model/pipe/expert axes")
+
+    rules = resolve_rules(axes, tp_rules)
+    net._resolved_rules = rules
+
+    if "pipe" in axes:
+        from deeplearning4j_tpu.parallel.pipeline import (
+            PipelinePlan,
+            check_pp_supported,
+        )
+
+        if not hasattr(net, "layer_vertices"):
+            raise ValueError(
+                "the 'pipe' axis requires the ComputationGraph container "
+                "(stage partitioning runs on the DAG conf); wrap the "
+                "layer stack in a graph via .graph_builder()")
+        if net.params is None:
+            net.init()
+        check_pp_supported(net)
+        plan = PipelinePlan(net, mesh.shape[axes["pipe"]])
+        if n_microbatches is None:
+            n_microbatches = 2 * plan.S
+        canonical = net.params
+        pp = plan.to_pipelined(canonical)
+        sh = plan.placements(mesh, axes, rules)
+        net.params = jax.tree.map(jax.device_put, pp, sh)
+        net._pp_plan = plan
+        net._pp_microbatches = n_microbatches
+        if net.opt_state is not None:
+            if net.iteration_count == 0:
+                # fresh net: re-init in pipelined space; jit propagates the
+                # input shardings onto the zero moments
+                net.opt_state = jax.jit(net.tx.init)(net.params)
+            else:
+                converted = _map_param_shaped(
+                    net.opt_state, canonical, plan.to_pipelined)
+                net.opt_state = _map_param_shaped(
+                    converted, net.params,
+                    lambda t: jax.tree.map(jax.device_put, t, sh))
+    elif "model" in axes or "expert" in axes:
+        if net.params is None:
+            net.init()  # placement needs materialized params — same as pipe
+        net.params = shard_params(net.params, mesh, rules)
+        net._param_sh = param_shardings(net.params, mesh, rules)
+        if net.opt_state is not None:
+            net.opt_state = _map_param_shaped(
+                net.opt_state, net.params,
+                lambda t: jax.tree.map(jax.device_put, t, net._param_sh))
+    return net

@@ -18,6 +18,8 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 # {param-name regex -> PartitionSpec} for the transformer_lm param tree.
 # Column-parallel: hidden/output dim sharded; row-parallel: input dim sharded.
+# Specs are written against the ROLE name "model"; resolve_rules() renames
+# them to the caller's actual mesh axis.
 TRANSFORMER_TP_RULES = [
     (r".*_attn/Wqkv$", P(None, "model")),   # column: heads sharded
     (r".*_attn/bqkv$", P("model")),
@@ -32,6 +34,42 @@ TRANSFORMER_TP_RULES = [
     (r"out/b$", P("model")),
 ]
 
+# Expert parallelism as placement rules (role axis "expert"): the stacked
+# expert tensors of nn/layers/moe.py shard their leading E dim; the router
+# Wg stays replicated. GSPMD shards the all-experts einsum over E and
+# inserts the psum for the gate-weighted combine — the same math the
+# manual shard_map in expert_parallel.py proves exact, now differentiable
+# and composable with data/model axes in one jitted train step.
+MOE_EP_RULES = [
+    (r".*/We1$", P("expert", None, None)),
+    (r".*/be1$", P("expert", None)),
+    (r".*/We2$", P("expert", None, None)),
+    (r".*/be2$", P("expert", None)),
+]
+
+_ROLE_RULES = {"model": TRANSFORMER_TP_RULES, "expert": MOE_EP_RULES}
+
+
+def _rename_spec(spec: P, mapping: dict) -> P:
+    return P(*(mapping.get(ax, ax) if isinstance(ax, str) else ax
+               for ax in spec))
+
+
+def resolve_rules(axes: dict, custom_rules=None):
+    """Build the active placement rule list for a role->mesh-axis mapping
+    (e.g. {"data": "data", "model": "mdl", "expert": "expert"}). Role rule
+    sets activate when their role is present; specs are renamed to the
+    mapped mesh axis names. custom_rules (role-named) take precedence."""
+    mapping = {role: ax for role, ax in axes.items() if isinstance(ax, str)}
+    rules = []
+    for pat, spec in (custom_rules or []):
+        rules.append((pat, _rename_spec(spec, mapping)))
+    for role in ("model", "expert"):
+        if role in axes:
+            for pat, spec in _ROLE_RULES[role]:
+                rules.append((pat, _rename_spec(spec, mapping)))
+    return rules
+
 
 def _flatten_names(params, prefix=""):
     out = {}
@@ -45,13 +83,15 @@ def _flatten_names(params, prefix=""):
 
 
 def sharding_for(name: str, mesh: Mesh, rules=None) -> NamedSharding:
-    """Resolve the sharding for one param name (replicated if no rule or the
-    'model' axis is absent/size-1)."""
+    """Resolve the sharding for one param name (replicated if no rule
+    matches or a rule references a mesh axis that is absent/size-1)."""
     rules = rules if rules is not None else TRANSFORMER_TP_RULES
-    if "model" in mesh.axis_names and mesh.shape["model"] > 1:
-        for pat, spec in rules:
-            if re.match(pat, name):
+    for pat, spec in rules:
+        if re.match(pat, name):
+            if all(ax in mesh.axis_names and mesh.shape[ax] > 1
+                   for ax in spec if isinstance(ax, str)):
                 return NamedSharding(mesh, spec)
+            break
     return NamedSharding(mesh, P())
 
 

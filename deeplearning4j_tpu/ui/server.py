@@ -12,6 +12,9 @@ Stdlib ThreadingHTTPServer replacement. Endpoints (all JSON):
     GET  /activations/data?sid=S
     POST /tsne/coords?sid=S        [[x, y], ...] embedding coords
     GET  /tsne/data?sid=S
+    GET  /weights|/flow|/activations|/tsne?sid=S  — RENDERED live views
+         (self-contained HTML + SVG from ui/views.py, auto-refreshing;
+         the reference's in-browser histogram/flow/activation/tsne pages)
     POST /nearestneighbors/vectors labelled vectors {labels, vectors}
     POST /nearestneighbors/query   {word, k} → {words, distances}
     GET  /sessions                 list of session ids
@@ -35,6 +38,9 @@ from .storage import HistoryStorage
 _INDEX_HTML = """<!doctype html>
 <html><head><title>deeplearning4j_tpu UI</title></head>
 <body><h1>deeplearning4j_tpu training UI</h1>
+<p>Views: <a href="/weights">weights</a> | <a href="/flow">flow</a> |
+<a href="/activations">activations</a> | <a href="/tsne">tsne</a>
+(append ?sid=&lt;session&gt; to pick a session)</p>
 <p>Sessions: <span id="s"></span></p>
 <script>
 fetch('/sessions').then(r => r.json()).then(d => {
@@ -66,20 +72,44 @@ class _Handler(BaseHTTPRequestHandler):
         length = int(self.headers.get("Content-Length", 0))
         return json.loads(self.rfile.read(length) or b"{}")
 
+    def _html(self, body: str, code: int = 200) -> None:
+        data = body.encode()
+        self.send_response(code)
+        self.send_header("Content-Type", "text/html; charset=utf-8")
+        self.send_header("Content-Length", str(len(data)))
+        self.end_headers()
+        self.wfile.write(data)
+
     def do_GET(self):  # noqa: N802
+        from deeplearning4j_tpu.ui import views
+
         url = urlparse(self.path)
         sid = parse_qs(url.query).get("sid", ["default"])[0]
         route = url.path.rstrip("/")
         if route == "":
-            body = _INDEX_HTML.encode()
-            self.send_response(200)
-            self.send_header("Content-Type", "text/html")
-            self.send_header("Content-Length", str(len(body)))
-            self.end_headers()
-            self.wfile.write(body)
+            self._html(_INDEX_HTML)
             return
         if route == "/sessions":
             self._json(self.ui.storage.sessions())
+            return
+        # live in-browser views (the reference's rendered weights/flow/
+        # activation/tsne pages) — data views stay on /<kind>/data
+        storage = self.ui.storage
+        if route == "/weights":
+            self._html(views.weights_page(storage.get(sid, "weights"),
+                                          storage.history(sid, "weights"),
+                                          sid))
+            return
+        if route == "/flow":
+            self._html(views.flow_page(storage.get(sid, "flow"),
+                                       storage.history(sid, "flow"), sid))
+            return
+        if route == "/activations":
+            self._html(views.activations_page(
+                storage.history(sid, "activations"), sid))
+            return
+        if route == "/tsne":
+            self._html(views.tsne_page(storage.get(sid, "tsne"), sid))
             return
         for kind in ("weights", "flow", "activations", "tsne"):
             if route == f"/{kind}/data":

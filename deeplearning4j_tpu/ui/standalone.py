@@ -102,14 +102,17 @@ class StaticPageUtil:
 
     @staticmethod
     def render_html(components: Sequence[Component],
-                    title: str = "deeplearning4j_tpu report") -> str:
+                    title: str = "deeplearning4j_tpu report",
+                    refresh_seconds: int = 0) -> str:
         # escape for <script> context: "<" inside JSON strings becomes <
         # so neither "</script>" nor "<!--" (script-data-escaped state) in a
         # ComponentText can break out of the block or inject HTML
         payload = json.dumps([c.to_dict() for c in components]).replace(
             "<", "\\u003c")
+        refresh = (f'<meta http-equiv="refresh" content="{int(refresh_seconds)}">'
+                   if refresh_seconds else "")
         return f"""<!doctype html>
-<html><head><meta charset="utf-8"><title>{html.escape(title)}</title>
+<html><head><meta charset="utf-8">{refresh}<title>{html.escape(title)}</title>
 <script>{_RENDER_JS}</script></head>
 <body><h1>{html.escape(title)}</h1><div id="root"></div>
 <script>

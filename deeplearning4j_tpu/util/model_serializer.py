@@ -62,12 +62,25 @@ class ModelSerializer:
         from deeplearning4j_tpu.nn.graph import ComputationGraph
 
         kind = "ComputationGraph" if isinstance(net, ComputationGraph) else "MultiLayerNetwork"
+        # an active pipeline mesh keeps params in the stacked-stage layout;
+        # checkpoints always store the portable canonical per-layer tree
+        params = net.params
+        opt_state = net.opt_state
+        plan = getattr(net, "_pp_plan", None)
+        if plan is not None:
+            from deeplearning4j_tpu.parallel.placement import _map_param_shaped
+
+            canonical = plan.to_canonical(params)
+            if opt_state is not None:
+                opt_state = _map_param_shaped(opt_state, params,
+                                              plan.to_canonical)
+            params = canonical
         with zipfile.ZipFile(path, "w", zipfile.ZIP_DEFLATED) as zf:
             zf.writestr("configuration.json", net.conf.to_json())
-            _save_tree(zf, "params.npz", net.params)
+            _save_tree(zf, "params.npz", params)
             _save_tree(zf, "state.npz", net.state)
-            if save_updater and net.opt_state is not None:
-                _save_tree(zf, "updater.npz", net.opt_state)
+            if save_updater and opt_state is not None:
+                _save_tree(zf, "updater.npz", opt_state)
             zf.writestr("meta.json", json.dumps({
                 "format_version": _FORMAT_VERSION,
                 "kind": kind,

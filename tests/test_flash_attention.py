@@ -79,11 +79,73 @@ def test_supports_gate():
     # short sequences use XLA's fused dense path (faster below the cutoff)
     assert not supports((2, 2, MIN_FLASH_SEQ // 2, 64), causal=True,
                         dropout=0.0, mask=None)
-    # dropout and padding masks are dense-only cases
+    # attention dropout is a dense-only case
     assert not supports((2, 2, MIN_FLASH_SEQ, 64), causal=True, dropout=0.1,
                         mask=None)
-    assert not supports((2, 2, MIN_FLASH_SEQ, 64), causal=True, dropout=0.0,
-                        mask=np.ones((2, MIN_FLASH_SEQ)))
+    # padding masks keep the fused path (VERDICT r2 #3)
+    assert supports((2, 2, MIN_FLASH_SEQ, 64), causal=True, dropout=0.0,
+                    mask=np.ones((2, MIN_FLASH_SEQ)))
     # non-divisible lengths fall back
     assert not supports((2, 2, MIN_FLASH_SEQ + 40, 64), causal=True,
                         dropout=0.0, mask=None)
+
+
+def _varlen_mask(B, T, lengths):
+    m = np.zeros((B, T), np.float32)
+    for b, L in enumerate(lengths):
+        m[b, :L] = 1.0
+    return jnp.asarray(m)
+
+
+@pytest.mark.parametrize("causal", [True, False])
+def test_masked_forward_matches_dense(causal):
+    """Variable-length batches: the [B, T] key padding mask folds into the
+    kernel's block predicate and matches the dense masked path on every
+    VALID query row (padded rows are downstream-masked by the loss)."""
+    B, T = 3, 256
+    q, k, v = _qkv(B=B, T=T)
+    lengths = [256, 200, 64]
+    mask = _varlen_mask(B, T, lengths)
+    o_flash = flash_attention(q, k, v, causal=causal, mask=mask)
+    o_dense = dot_product_attention(q, k, v, causal=causal, mask=mask)
+    valid = np.asarray(mask, bool)
+    for b in range(B):
+        np.testing.assert_allclose(
+            np.asarray(o_flash)[b, :, valid[b]],
+            np.asarray(o_dense)[b, :, valid[b]], atol=2e-5)
+
+
+@pytest.mark.parametrize("T", [128, 1024])
+def test_masked_backward_matches_dense(T):
+    """Masked fwd+grad parity on both backward paths (fused single-pass at
+    T=128; two-kernel dq+dkv at T=1024). The loss only reads valid rows —
+    the realistic setting where padded-query outputs never matter."""
+    B = 2
+    q, k, v = _qkv(B=B, T=T)
+    lengths = [T, T - T // 4]
+    mask = _varlen_mask(B, T, lengths)
+    w = mask[:, None, :, None]  # zero out padded query rows like the loss
+
+    def f_flash(q, k, v):
+        return jnp.sum(jnp.sin(
+            flash_attention(q, k, v, causal=True, mask=mask)) * w)
+
+    def f_dense(q, k, v):
+        return jnp.sum(jnp.sin(dot_product_attention(
+            q, k, v, causal=True, mask=mask)) * w)
+
+    g_flash = jax.grad(f_flash, (0, 1, 2))(q, k, v)
+    g_dense = jax.grad(f_dense, (0, 1, 2))(q, k, v)
+    for a, b in zip(g_flash, g_dense):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=5e-5)
+
+
+def test_masked_fully_padded_row_is_finite():
+    """A fully padded sequence (all keys masked) must yield zeros, not NaN
+    (the all-masked softmax row is the classic flash-attention bug)."""
+    B, T = 2, 128
+    q, k, v = _qkv(B=B, T=T)
+    mask = _varlen_mask(B, T, [T, 0])
+    o = flash_attention(q, k, v, causal=False, mask=mask)
+    assert np.isfinite(np.asarray(o)).all()
+    np.testing.assert_allclose(np.asarray(o)[1], 0.0, atol=1e-6)

@@ -245,7 +245,7 @@ def _topic_corpus(rng, vocab, n_words, sent_len, n_topics=20):
     return [[words[j] for j in row] for row in ids]
 
 
-def _topic_separation(w2v, vocab, n_topics=20, top_ranks=10):
+def _topic_separation(w2v, n_topics=20, top_ranks=10):
     """quality = mean within-topic cosine - mean across-topic cosine over
     the most frequent words of each topic. Random vectors score ~0; a
     model that learned the planted structure scores well above it."""
@@ -313,17 +313,15 @@ def bench_word2vec() -> None:
     np.asarray(w2v.word_vector("w0"))  # force pending device work to finish
     dt = time.perf_counter() - t0
 
-    quality = _topic_separation(w2v, vocab)
+    quality = _topic_separation(w2v)
     # apples-to-apples quality comparison on a common sub-corpus: the
     # timed config vs unshared negatives vs the host path
     sub = sents[:8000]  # 200k words — host path tractable
-    q_dev = _topic_separation(_quality_w2v(sub, use_device_pipeline=True),
-                              vocab)
+    q_dev = _topic_separation(_quality_w2v(sub, use_device_pipeline=True))
     q_unshared = _topic_separation(
-        _quality_w2v(sub, use_device_pipeline=True, share_negatives=False),
-        vocab)
+        _quality_w2v(sub, use_device_pipeline=True, share_negatives=False))
     q_host = _topic_separation(
-        _quality_w2v(sub, use_device_pipeline=False), vocab)
+        _quality_w2v(sub, use_device_pipeline=False))
     _emit("word2vec", n_words / dt, "words/sec",
           metric="word2vec_sgns_words_per_sec",
           quality=round(quality, 4),

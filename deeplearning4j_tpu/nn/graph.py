@@ -41,7 +41,7 @@ from deeplearning4j_tpu.nn.conf.layers import (
     validate_layer_names,
 )
 from deeplearning4j_tpu.nn.layers import get_impl, l1_l2_penalty
-from deeplearning4j_tpu.nn.training import make_train_step
+from deeplearning4j_tpu.nn.training import make_train_step, tree_cast
 from deeplearning4j_tpu.nn.updater import build_optimizer
 
 _DTYPES = {"float32": jnp.float32, "bfloat16": jnp.bfloat16, "float64": jnp.float64}
@@ -208,9 +208,7 @@ class ComputationGraph:
                     x = vconf.preprocessor.pre_process(x)
                 p = params.get(name, {})
                 if cdtype != self.param_dtype:
-                    p = jax.tree.map(
-                        lambda a: a.astype(cdtype)
-                        if jnp.issubdtype(a.dtype, jnp.floating) else a, p)
+                    p = tree_cast(p, cdtype)
                 in_mask = masks.get(self.conf.vertex_inputs[name][0])
                 want_carry = (carries is not None
                               and isinstance(vconf.layer, BaseRecurrentLayer)
@@ -265,6 +263,7 @@ class ComputationGraph:
         loss = 0.0
         labels_list = batch["labels"]
         lmasks = batch.get("labels_masks") or [None] * len(labels_list)
+        cdtype = self.compute_dtype
         for out_name, labels, lmask, k_out in zip(
                 self.conf.network_outputs, labels_list, lmasks, k_outs):
             vconf = self.conf.vertices[out_name]
@@ -274,8 +273,15 @@ class ComputationGraph:
             x = acts[self.conf.vertex_inputs[out_name][0]]
             if vconf.preprocessor is not None:
                 x = vconf.preprocessor.pre_process(x)
+            # cast output-layer params to the compute dtype like _forward
+            # does for every other layer — otherwise a bf16 model streams
+            # its [d, V] LM-head weight through the loss kernels in f32
+            # (2x the HBM traffic of the declared policy; profiled r3)
+            p_out = params[out_name]
+            if cdtype != self.param_dtype:
+                p_out = tree_cast(p_out, cdtype)
             loss = loss + self.impls[out_name].loss(
-                vconf.layer, params[out_name], x, labels, train=train, rng=k_out,
+                vconf.layer, p_out, x, labels, train=train, rng=k_out,
                 mask=lmask)
         for name, v in self.layer_vertices.items():
             loss = loss + l1_l2_penalty(v.layer, params[name])
@@ -677,6 +683,36 @@ class ComputationGraph:
         self._rnn_carries = {**carries, **new_carries}
         outs = [y[:, -1, :] if single and y.ndim == 3 else y for y in ys]
         return outs[0] if len(outs) == 1 else outs
+
+    def rnn_activate_using_stored_state(self, *inputs,
+                                        training: bool = False,
+                                        store_last_for_tbptt: bool = False):
+        """Full-sequence activations from the STORED streaming state
+        (reference rnnActivateUsingStoredState semantics on the graph):
+        recurrent vertices resume from the rnn_time_step state; the stored
+        state only advances when store_last_for_tbptt=True. Returns the
+        acts dict {vertex_name: activation}."""
+        cdtype = self.compute_dtype
+        arrs = []
+        for x in inputs:
+            x = jnp.asarray(x)
+            if jnp.issubdtype(x.dtype, jnp.floating):
+                x = x.astype(cdtype)
+            if x.ndim != 3:
+                raise ValueError("rnn_activate_using_stored_state expects "
+                                 f"[batch, time, n_in]; got {x.shape}")
+            arrs.append(x)
+        carries = self._rnn_carries
+        if carries is None:
+            carries = self._initial_carries(arrs[0].shape[0])
+        input_dict = dict(zip(self.conf.network_inputs, arrs))
+        acts, _, new_carries = self._forward(
+            self.params, self.state, input_dict,
+            train=training, rng=self._next_rng() if training else None,
+            collect=True, carries=carries)
+        if store_last_for_tbptt:
+            self._rnn_carries = {**carries, **new_carries}
+        return acts
 
     def num_params(self) -> int:
         return sum(int(np.prod(p.shape)) for p in jax.tree.leaves(self.params))

@@ -12,3 +12,4 @@ import deeplearning4j_tpu.nn.layers.convolution  # noqa: F401
 import deeplearning4j_tpu.nn.layers.recurrent  # noqa: F401
 import deeplearning4j_tpu.nn.layers.attention  # noqa: F401
 import deeplearning4j_tpu.nn.layers.moe  # noqa: F401
+import deeplearning4j_tpu.nn.layers.nested  # noqa: F401

@@ -1,0 +1,108 @@
+"""Networks as layers (VERDICT r2 #8 / missing #3).
+
+Reference: MultiLayerNetwork itself `implements ... Layer`
+(nn/multilayer/MultiLayerNetwork.java:78), so whole networks nest inside
+other networks or as ComputationGraph vertices. The TPU-native analogue is
+a NetworkLayer config wrapping an inner MultiLayerConfiguration or
+ComputationGraphConfiguration: init() materializes the inner network's
+param/state pytrees as this layer's subtree, apply() runs the inner pure
+forward — so jax.grad differentiates straight through the nested network
+and the nested params train with the outer optimizer.
+
+Notes:
+- the inner net's output layer contributes its ACTIVATION (softmax etc.),
+  not its loss — exactly the reference's activate() path for nested MLNs;
+- inner per-layer l1/l2 penalties are not re-applied by the outer
+  container (set them on the outer NetworkLayer if needed);
+- inner graphs must be single-input/single-output to act as a layer.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Optional
+
+import jax.numpy as jnp
+
+from deeplearning4j_tpu.nn.conf.inputs import InputType
+from deeplearning4j_tpu.nn.conf.layers import Layer
+from deeplearning4j_tpu.nn.conf.serde import register_config
+from deeplearning4j_tpu.nn.layers.base import LayerImpl, register_impl
+
+
+@register_config
+@dataclasses.dataclass
+class NetworkLayer(Layer):
+    """A whole network used as one layer (reference
+    MultiLayerNetwork.java:78 `implements Layer`)."""
+
+    conf: Optional[Any] = None  # MultiLayerConfiguration | ComputationGraphConfiguration
+
+    def _inner(self):
+        """Build (and cache) the inner container — structure only; params
+        and state live in the OUTER network's pytrees."""
+        net = getattr(self, "_inner_cache", None)
+        if net is None:
+            from deeplearning4j_tpu.nn.conf.graph_conf import (
+                ComputationGraphConfiguration,
+            )
+            from deeplearning4j_tpu.nn.graph import ComputationGraph
+            from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+
+            if self.conf is None:
+                raise ValueError("NetworkLayer needs conf=<inner network "
+                                 "configuration>")
+            if isinstance(self.conf, ComputationGraphConfiguration):
+                if (len(self.conf.network_inputs) != 1
+                        or len(self.conf.network_outputs) != 1):
+                    raise ValueError(
+                        "a nested graph must have exactly one input and "
+                        "one output to act as a layer")
+                net = ComputationGraph(self.conf)
+            else:
+                net = MultiLayerNetwork(self.conf)
+            object.__setattr__(self, "_inner_cache", net)
+        return net
+
+    def get_output_type(self, input_type: InputType) -> InputType:
+        from deeplearning4j_tpu.nn.conf.graph_conf import (
+            ComputationGraphConfiguration,
+        )
+
+        if isinstance(self.conf, ComputationGraphConfiguration):
+            return input_type  # DAG shape inference runs inside the graph
+        t = input_type
+        for lc in self.conf.layers:
+            t = lc.get_output_type(t)
+        return t
+
+
+@register_impl(NetworkLayer)
+class NetworkLayerImpl(LayerImpl):
+    def init(self, conf, rng, dtype):
+        del rng  # the inner conf's own seed drives its init (reference
+        # nested nets are initialized from their own configuration)
+        net = conf._inner()
+        net.init()
+        params, state = net.params, net.state
+        # the outer container owns the pytrees from here on
+        net.params = None
+        net.state = None
+        net.opt_state = None
+        return params, state
+
+    def apply(self, conf, params, state, x, *, train=False, rng=None,
+              mask=None):
+        from deeplearning4j_tpu.nn.graph import ComputationGraph
+
+        net = conf._inner()
+        if isinstance(net, ComputationGraph):
+            inp = net.conf.network_inputs[0]
+            masks = {inp: mask} if mask is not None else None
+            ys, new_state, _ = net._forward(params, state, {inp: x},
+                                            train=train, rng=rng,
+                                            masks=masks)
+            return ys[0], new_state
+        y, new_state, _ = net._forward(params, state, x, train=train,
+                                       rng=rng, mask=mask)
+        return y, new_state

@@ -41,7 +41,7 @@ from deeplearning4j_tpu.nn.conf.layers import (
 )
 from deeplearning4j_tpu.nn.conf.neural_net_configuration import MultiLayerConfiguration
 from deeplearning4j_tpu.nn.layers import get_impl, l1_l2_penalty
-from deeplearning4j_tpu.nn.training import make_train_step
+from deeplearning4j_tpu.nn.training import make_train_step, tree_cast
 from deeplearning4j_tpu.nn.updater import build_optimizer
 
 _DTYPES = {"float32": jnp.float32, "bfloat16": jnp.bfloat16, "float64": jnp.float64,
@@ -152,9 +152,7 @@ class MultiLayerNetwork:
                 x = proc.pre_process(x)
             p = params.get(name, {})
             if cdtype != self.param_dtype:
-                p = jax.tree.map(
-                    lambda a: a.astype(cdtype)
-                    if jnp.issubdtype(a.dtype, jnp.floating) else a, p)
+                p = tree_cast(p, cdtype)
             want_carry = (carries is not None and isinstance(lc, BaseRecurrentLayer)
                           and hasattr(impl, "initial_carry"))
 
@@ -206,7 +204,14 @@ class MultiLayerNetwork:
         out_name = self.layer_names[-1]
         mask = lmask if lmask is not None else (
             fmask if isinstance(out_conf, RnnOutputLayer) else None)
-        loss = out_impl.loss(out_conf, params[out_name], h, labels, train=train,
+        # cast output-layer params to the compute dtype like _forward does
+        # for the body — a bf16 model must not stream its head weight in
+        # f32 through the loss kernels (2x HBM traffic; profiled r3)
+        p_out = params[out_name]
+        cdtype = self.compute_dtype
+        if cdtype != self.param_dtype:
+            p_out = tree_cast(p_out, cdtype)
+        loss = out_impl.loss(out_conf, p_out, h, labels, train=train,
                              rng=k_out, mask=mask)
         new_state[out_name] = state.get(out_name, {})
         # L1/L2 (reference BaseLayer calcL1/calcL2 summed into score)
@@ -546,6 +551,30 @@ class MultiLayerNetwork:
         y, _, new_carries = self._rnn_jit(self.params, self.state, x, carries)
         self._rnn_carries = {**carries, **new_carries}
         return y[:, -1, :] if single and y.ndim == 3 else y
+
+    def rnn_activate_using_stored_state(self, x, *, training: bool = False,
+                                        store_last_for_tbptt: bool = False):
+        """Full-sequence activations starting from the STORED streaming
+        state (reference rnnActivateUsingStoredState,
+        MultiLayerNetwork.java:2203): unlike feed_forward, recurrent layers
+        resume from the rnn_time_step/TBPTT state map instead of zeros;
+        unlike rnn_time_step, the stored state is NOT advanced unless
+        store_last_for_tbptt=True. Returns the list of layer activations
+        (one per layer, like feed_forward)."""
+        x = jnp.asarray(x, self.compute_dtype)
+        if x.ndim != 3:
+            raise ValueError("rnn_activate_using_stored_state expects "
+                             f"[batch, time, n_in]; got {x.shape}")
+        carries = self._rnn_carries
+        if carries is None:
+            carries = self._initial_carries(x.shape[0])
+        acts, _, new_carries = self._forward(
+            self.params, self.state, x,
+            train=training, rng=self._next_rng() if training else None,
+            carries=carries, collect=True)
+        if store_last_for_tbptt:
+            self._rnn_carries = {**carries, **new_carries}
+        return acts
 
     # -------------------------------------------------------- params plumbing
     def num_params(self) -> int:

@@ -85,7 +85,8 @@ def make_train_step(loss_fn, tx, layer_confs_by_name, mesh=None,
                 if data_axis and data_axis in mesh.axis_names else repl)
         p_sh = param_sharding if param_sharding is not None else repl
         if zero1_opt_state is not None:
-            opt_in = opt_out = zero1_opt_shardings(zero1_opt_state, mesh)
+            opt_in = opt_out = zero1_opt_shardings(
+                zero1_opt_state, mesh, axis=data_axis)
         elif param_sharding is not None:
             # moments were committed alongside the params; None lets jit
             # respect (in) and propagate (out) that placement

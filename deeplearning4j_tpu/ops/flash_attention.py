@@ -7,12 +7,19 @@ is computed online per key block (running max + running sum), and the
 backward pass recomputes probabilities from the saved logsumexp instead of
 storing them — O(T) HBM traffic instead of O(T^2).
 
-Kernel layout (per (batch*head, q-block) program):
+Kernel layout (per (batch*head group, q-block) program):
   fwd:  loop key blocks -> online softmax into an f32 accumulator; saves
         out and logsumexp.
   bwd:  two kernels — dq (loop over key blocks per q block) and dk/dv
         (loop over q blocks per key block) — using the standard
         ds = p * (dp - delta) identity with delta = rowsum(do * o).
+
+Per-program G-batching: at LM-scale shapes ([B*H, 512, 64]) one (bh,
+q-block) program runs ~1us of MXU work against ~2us of fixed program
+cost, so the grid is batched G batch-head slices per program (batched
+dot_generals amortize the overhead; measured 263us -> 129us per fwd call
+at B32 H4 T512 D64 on v5e). G is sized against the 16MB scoped-VMEM
+budget and drops to 1 when key/value blocks stream (T > block cap).
 
 Constraints: T divisible by the block size (128), no attention dropout
 (the dense path handles it); [B, T] key padding masks fold into the block
@@ -31,9 +38,10 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
 
 BLOCK = 128
-LANES = 128  # lane-broadcast width for per-row scalars (TPU tile rule)
+LANES = 128  # lane width (used by fused_softmax_xent block sizing)
 NEG_INF = -1e30
 
 # Block-size caps (swept on v5e): larger q/k blocks amortize the per-program
@@ -41,6 +49,12 @@ NEG_INF = -1e30
 # and the full-T K/V copies comfortably inside VMEM.
 BLOCK_Q_MAX = 512
 BLOCK_K_MAX = 512
+
+# Scoped-VMEM budget a G-batched program's working set must fit. The
+# kernels raise their scoped limit to 32MB (v5e has 128MB of VMEM; the
+# default 16MB limit rejects G=8, measured the fastest fwd config).
+_VMEM_LIMIT = 32 * 1024 * 1024
+_VMEM_BUDGET = 26 * 1024 * 1024
 
 
 def pick_block(n: int, cap: int, base: int = BLOCK) -> int:
@@ -54,6 +68,29 @@ def pick_block(n: int, cap: int, base: int = BLOCK) -> int:
 
 def _block_sizes(T):
     return pick_block(T, BLOCK_Q_MAX), pick_block(T, BLOCK_K_MAX)
+
+
+def _pick_g(BH: int, T: int, D: int, bytes_per_slice: int) -> int:
+    """Largest divisor-of-BH group size whose working set fits the scoped
+    VMEM budget. G>1 only pays off when per-program work is small (the
+    block == T case); callers pass the per-slice byte estimate."""
+    g = 1
+    for cand in (2, 4, 8):
+        if BH % cand == 0 and cand * bytes_per_slice <= _VMEM_BUDGET:
+            g = cand
+    return g
+
+
+def _fwd_slice_bytes(T, D):
+    # double-buffered q/k/v/o bf16 + scores AND p f32 + f32 acc/carries
+    # (measured: the compiled G=8 fwd stack is ~2.6MB per slice at
+    # T=512 D=64)
+    return 2 * 4 * T * D * 2 + 2 * T * T * 4 + 2 * T * D * 4
+
+
+def _bwd_slice_bytes(T, D):
+    # double-buffered q/k/v/do/dq/dk/dv bf16 + s/p/dp f32 + ds bf16
+    return 2 * 7 * T * D * 2 + 3 * T * T * 4 + T * T * 2 + 3 * T * D * 4
 
 
 def _use_interpret() -> bool:
@@ -72,87 +109,124 @@ def _fwd_kernel(q_ref, k_ref, v_ref, *rest, sm_scale, causal, masked,
     # keep the MXU operands in the input dtype (bf16 on TPU runs the MXU at
     # full rate; f32 operands decompose into multiple passes) and accumulate
     # in f32 via preferred_element_type; only softmax math is f32.
-    q = q_ref[0]                                           # [bq, D]
+    q = q_ref[...]                                         # [G, bq, D]
+    G = q.shape[0]
     nk = seq_len // block_k
+
+    if nk == 1 and block_q == seq_len:
+        # single-block specialization: a direct softmax (no running
+        # max/sum carries, no fori_loop) — the loop+rescale structure
+        # costs ~2x at these shapes even when it runs exactly once
+        # (measured 286us vs 129us per call at [128,512,64] G=8 on v5e)
+        kb = k_ref[...]
+        vb = v_ref[...]
+        s = sm_scale * jax.lax.dot_general(
+            q, kb, (((2,), (2,)), ((0,), (0,))),
+            preferred_element_type=jnp.float32)            # [G, T, T]
+        if causal:
+            qpos = jax.lax.broadcasted_iota(jnp.int32, (seq_len, seq_len), 0)
+            kpos = jax.lax.broadcasted_iota(jnp.int32, (seq_len, seq_len), 1)
+            s = jnp.where((qpos >= kpos)[None], s, NEG_INF)
+        if masked:
+            s = jnp.where(kmask_ref[:, 0][:, None, :] > 0, s, NEG_INF)
+        m = jnp.max(s, axis=-1)
+        if masked:
+            m = jnp.maximum(m, -1e20)  # all-masked rows underflow to 0
+        # exp in the operand dtype (see the backward's note); l is
+        # accumulated f32 so the normalizer and lse stay accurate
+        p = jnp.exp((s - m[..., None]).astype(vb.dtype))
+        l = jnp.maximum(
+            jnp.sum(p.astype(jnp.float32), axis=-1), 1e-30)
+        acc = jax.lax.dot_general(
+            p, vb, (((2,), (1,)), ((0,), (0,))),
+            preferred_element_type=jnp.float32)
+        o_ref[...] = (acc / l[..., None]).astype(o_ref.dtype)
+        lse_ref[:, 0] = m + jnp.log(l)
+        return
+
     hi = (qi * block_q) // block_k + 1 if causal else nk
 
     def body(j, carry):
         m, l, acc = carry
-        kb = k_ref[0, pl.ds(j * block_k, block_k), :]
-        vb = v_ref[0, pl.ds(j * block_k, block_k), :]
+        kb = k_ref[:, pl.ds(j * block_k, block_k), :]      # [G, bk, D]
+        vb = v_ref[:, pl.ds(j * block_k, block_k), :]
         s = sm_scale * jax.lax.dot_general(
-            q, kb, (((1,), (1,)), ((), ())),
-            preferred_element_type=jnp.float32)
+            q, kb, (((2,), (2,)), ((0,), (0,))),
+            preferred_element_type=jnp.float32)            # [G, bq, bk]
         if causal:
             qpos = qi * block_q + jax.lax.broadcasted_iota(
                 jnp.int32, (block_q, block_k), 0)
             kpos = j * block_k + jax.lax.broadcasted_iota(
                 jnp.int32, (block_q, block_k), 1)
-            s = jnp.where(qpos >= kpos, s, NEG_INF)
+            s = jnp.where((qpos >= kpos)[None], s, NEG_INF)
         if masked:
             # padding mask gates KEYS (dense-path semantics,
             # nn/layers/attention.dot_product_attention)
-            km = kmask_ref[0, 0, pl.ds(j * block_k, block_k)]  # [bk]
-            s = jnp.where(km[None, :] > 0, s, NEG_INF)
+            km = kmask_ref[:, 0, pl.ds(j * block_k, block_k)]  # [G, bk]
+            s = jnp.where(km[:, None, :] > 0, s, NEG_INF)
         m_new = jnp.maximum(m, jnp.max(s, axis=-1))
         if masked:
             # an all-masked row (fully padded sequence) must not softmax
             # into uniform weights: floor the running max so exp(s - m)
             # underflows to 0 and the l-guard zeroes the output row
             m_new = jnp.maximum(m_new, -1e20)
-        p = jnp.exp(s - m_new[:, None])
+        p = jnp.exp(s - m_new[..., None])
         alpha = jnp.exp(m - m_new)
         l = l * alpha + jnp.sum(p, axis=-1)
-        acc = acc * alpha[:, None] + jax.lax.dot_general(
-            p.astype(vb.dtype), vb, (((1,), (0,)), ((), ())),
-            preferred_element_type=jnp.float32)
+        acc = acc * alpha[..., None] + jax.lax.dot_general(
+            p.astype(vb.dtype), vb, (((2,), (1,)), ((0,), (0,))),
+            preferred_element_type=jnp.float32)            # [G, bq, D]
         return m_new, l, acc
 
     D = q_ref.shape[-1]
-    m0 = jnp.full((block_q,), NEG_INF, jnp.float32)
-    l0 = jnp.zeros((block_q,), jnp.float32)
-    acc0 = jnp.zeros((block_q, D), jnp.float32)
+    m0 = jnp.full((G, block_q), NEG_INF, jnp.float32)
+    l0 = jnp.zeros((G, block_q), jnp.float32)
+    acc0 = jnp.zeros((G, block_q, D), jnp.float32)
     m, l, acc = jax.lax.fori_loop(0, hi, body, (m0, l0, acc0))
     l = jnp.maximum(l, 1e-30)
-    o_ref[0] = (acc / l[:, None]).astype(o_ref.dtype)
-    # TPU tiling requires >=2D (8,128)-aligned blocks: broadcast the
-    # per-row scalar across a 128-lane dim (same trick as jax's kernel)
-    lse_ref[0] = jax.lax.broadcast_in_dim(
-        m + jnp.log(l), (block_q, LANES), (0,))
+    o_ref[...] = (acc / l[..., None]).astype(o_ref.dtype)
+    # per-row scalars ride a [G, 1, block_q] block (middle dim equals the
+    # array dim, so the (8,128) tile rule is satisfied) — no 128-lane
+    # broadcast, which cost ~0.6ms/step of pure HBM traffic in the r2
+    # [BH, T, LANES] layout
+    lse_ref[:, 0] = m + jnp.log(l)
 
 
 def _flash_fwd(q, k, v, kmask, sm_scale, causal):
     BH, T, D = q.shape
     block_q, block_k = _block_sizes(T)
-    grid = (BH, T // block_q)
     masked = kmask is not None
+    G = (_pick_g(BH, T, D, _fwd_slice_bytes(T, D))
+         if block_q == T and block_k == T else 1)
+    grid = (BH // G, T // block_q)
     kern = functools.partial(_fwd_kernel, sm_scale=sm_scale, causal=causal,
                              masked=masked, block_q=block_q,
                              block_k=block_k, seq_len=T)
     in_specs = [
-        pl.BlockSpec((1, block_q, D), lambda bh, qi: (bh, qi, 0)),
-        pl.BlockSpec((1, T, D), lambda bh, qi: (bh, 0, 0)),
-        pl.BlockSpec((1, T, D), lambda bh, qi: (bh, 0, 0)),
+        pl.BlockSpec((G, block_q, D), lambda bh, qi: (bh, qi, 0)),
+        pl.BlockSpec((G, T, D), lambda bh, qi: (bh, 0, 0)),
+        pl.BlockSpec((G, T, D), lambda bh, qi: (bh, 0, 0)),
     ]
     args = [q, k, v]
     if masked:
-        in_specs.append(pl.BlockSpec((1, 1, T), lambda bh, qi: (bh, 0, 0)))
+        in_specs.append(pl.BlockSpec((G, 1, T), lambda bh, qi: (bh, 0, 0)))
         args.append(kmask)
     o, lse = pl.pallas_call(
         kern,
         grid=grid,
         in_specs=in_specs,
         out_specs=[
-            pl.BlockSpec((1, block_q, D), lambda bh, qi: (bh, qi, 0)),
-            pl.BlockSpec((1, block_q, LANES), lambda bh, qi: (bh, qi, 0)),
+            pl.BlockSpec((G, block_q, D), lambda bh, qi: (bh, qi, 0)),
+            pl.BlockSpec((G, 1, block_q), lambda bh, qi: (bh, 0, qi)),
         ],
         out_shape=[
             jax.ShapeDtypeStruct((BH, T, D), q.dtype),
-            jax.ShapeDtypeStruct((BH, T, LANES), jnp.float32),
+            jax.ShapeDtypeStruct((BH, 1, T), jnp.float32),
         ],
+        compiler_params=pltpu.CompilerParams(vmem_limit_bytes=_VMEM_LIMIT),
         interpret=_use_interpret(),
     )(*args)
-    return o, lse[:, :, 0]
+    return o, lse[:, 0, :]
 
 
 # ----------------------------------------------------------------- backward
@@ -164,38 +238,40 @@ def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, *rest,
     else:
         (dq_ref,) = rest
     qi = pl.program_id(1)
-    q = q_ref[0]                                            # [bq, D]
-    do = do_ref[0]
-    lse = jnp.max(lse_ref[0], axis=-1)      # lanes are identical copies
-    delta = jnp.max(delta_ref[0], axis=-1)
+    q = q_ref[...]                                          # [G, bq, D]
+    do = do_ref[...]
+    lse = lse_ref[:, 0]                                     # [G, bq]
+    delta = delta_ref[:, 0]
+    G = q.shape[0]
     nk = seq_len // block_k
     hi = (qi * block_q) // block_k + 1 if causal else nk
 
     def body(j, dq):
-        kb = k_ref[0, pl.ds(j * block_k, block_k), :]
-        vb = v_ref[0, pl.ds(j * block_k, block_k), :]
+        kb = k_ref[:, pl.ds(j * block_k, block_k), :]
+        vb = v_ref[:, pl.ds(j * block_k, block_k), :]
         s = sm_scale * jax.lax.dot_general(
-            q, kb, (((1,), (1,)), ((), ())),
+            q, kb, (((2,), (2,)), ((0,), (0,))),
             preferred_element_type=jnp.float32)
         if causal:
             qpos = qi * block_q + jax.lax.broadcasted_iota(
                 jnp.int32, (block_q, block_k), 0)
             kpos = j * block_k + jax.lax.broadcasted_iota(
                 jnp.int32, (block_q, block_k), 1)
-            s = jnp.where(qpos >= kpos, s, NEG_INF)
+            s = jnp.where((qpos >= kpos)[None], s, NEG_INF)
         if masked:
-            km = kmask_ref[0, 0, pl.ds(j * block_k, block_k)]
-            s = jnp.where(km[None, :] > 0, s, NEG_INF)
-        p = jnp.exp(s - lse[:, None])                      # [bq, bk]
-        dp = jax.lax.dot_general(do, vb, (((1,), (1,)), ((), ())),
+            km = kmask_ref[:, 0, pl.ds(j * block_k, block_k)]
+            s = jnp.where(km[:, None, :] > 0, s, NEG_INF)
+        p = jnp.exp(s - lse[..., None])                    # [G, bq, bk]
+        dp = jax.lax.dot_general(do, vb, (((2,), (2,)), ((0,), (0,))),
                                  preferred_element_type=jnp.float32)
-        ds = (p * (dp - delta[:, None]) * sm_scale).astype(kb.dtype)
-        return dq + jax.lax.dot_general(ds, kb, (((1,), (0,)), ((), ())),
-                                        preferred_element_type=jnp.float32)
+        ds = (p * (dp - delta[..., None]) * sm_scale).astype(kb.dtype)
+        return dq + jax.lax.dot_general(
+            ds, kb, (((2,), (1,)), ((0,), (0,))),
+            preferred_element_type=jnp.float32)
 
-    dq0 = jnp.zeros((block_q, q_ref.shape[-1]), jnp.float32)
+    dq0 = jnp.zeros((G, block_q, q_ref.shape[-1]), jnp.float32)
     dq = jax.lax.fori_loop(0, hi, body, dq0)
-    dq_ref[0] = dq.astype(dq_ref.dtype)
+    dq_ref[...] = dq.astype(dq_ref.dtype)
 
 
 def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, *rest,
@@ -205,46 +281,48 @@ def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, *rest,
     else:
         dk_ref, dv_ref = rest
     ki = pl.program_id(1)
-    kb = k_ref[0]                                           # [bk, D]
-    vb = v_ref[0]
+    kb = k_ref[...]                                         # [G, bk, D]
+    vb = v_ref[...]
+    G = kb.shape[0]
     nq = seq_len // block_q
     lo = (ki * block_k) // block_q if causal else 0
 
     def body(j, carry):
         dk, dv = carry
-        qb = q_ref[0, pl.ds(j * block_q, block_q), :]
-        dob = do_ref[0, pl.ds(j * block_q, block_q), :]
-        lse = jnp.max(lse_ref[0, pl.ds(j * block_q, block_q), :], axis=-1)
-        delta = jnp.max(delta_ref[0, pl.ds(j * block_q, block_q), :], axis=-1)
+        qb = q_ref[:, pl.ds(j * block_q, block_q), :]
+        dob = do_ref[:, pl.ds(j * block_q, block_q), :]
+        lse = lse_ref[:, 0, pl.ds(j * block_q, block_q)]
+        delta = delta_ref[:, 0, pl.ds(j * block_q, block_q)]
         s = sm_scale * jax.lax.dot_general(
-            qb, kb, (((1,), (1,)), ((), ())),
+            qb, kb, (((2,), (2,)), ((0,), (0,))),
             preferred_element_type=jnp.float32)
         if causal:
             qpos = j * block_q + jax.lax.broadcasted_iota(
                 jnp.int32, (block_q, block_k), 0)
             kpos = ki * block_k + jax.lax.broadcasted_iota(
                 jnp.int32, (block_q, block_k), 1)
-            s = jnp.where(qpos >= kpos, s, NEG_INF)
+            s = jnp.where((qpos >= kpos)[None], s, NEG_INF)
         if masked:
-            km = kmask_ref[0, 0]                           # [bk] this block
-            s = jnp.where(km[None, :] > 0, s, NEG_INF)
-        p = jnp.exp(s - lse[:, None])                      # [bq, bk]
+            km = kmask_ref[:, 0]                           # [G, bk]
+            s = jnp.where(km[:, None, :] > 0, s, NEG_INF)
+        p = jnp.exp(s - lse[..., None])                    # [G, bq, bk]
         dv = dv + jax.lax.dot_general(
-            p.astype(dob.dtype), dob, (((0,), (0,)), ((), ())),
-            preferred_element_type=jnp.float32)
-        dp = jax.lax.dot_general(dob, vb, (((1,), (1,)), ((), ())),
+            p.astype(dob.dtype), dob, (((1,), (1,)), ((0,), (0,))),
+            preferred_element_type=jnp.float32)            # [G, bk, D]
+        dp = jax.lax.dot_general(dob, vb, (((2,), (2,)), ((0,), (0,))),
                                  preferred_element_type=jnp.float32)
-        ds = (p * (dp - delta[:, None]) * sm_scale).astype(qb.dtype)
-        dk = dk + jax.lax.dot_general(ds, qb, (((0,), (0,)), ((), ())),
-                                      preferred_element_type=jnp.float32)
+        ds = (p * (dp - delta[..., None]) * sm_scale).astype(qb.dtype)
+        dk = dk + jax.lax.dot_general(
+            ds, qb, (((1,), (1,)), ((0,), (0,))),
+            preferred_element_type=jnp.float32)
         return dk, dv
 
     D = k_ref.shape[-1]
-    dk0 = jnp.zeros((block_k, D), jnp.float32)
-    dv0 = jnp.zeros((block_k, D), jnp.float32)
+    dk0 = jnp.zeros((G, block_k, D), jnp.float32)
+    dv0 = jnp.zeros((G, block_k, D), jnp.float32)
     dk, dv = jax.lax.fori_loop(lo, nq, body, (dk0, dv0))
-    dk_ref[0] = dk.astype(dk_ref.dtype)
-    dv_ref[0] = dv.astype(dv_ref.dtype)
+    dk_ref[...] = dk.astype(dk_ref.dtype)
+    dv_ref[...] = dv.astype(dv_ref.dtype)
 
 
 def _bwd_fused_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
@@ -252,56 +330,62 @@ def _bwd_fused_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
     """Single-pass backward for the block == T case (T <= BLOCK_K_MAX,
     i.e. _block_sizes gave both blocks the whole sequence): with Q, K and
     V all resident, one recompute of the probabilities feeds dq, dk AND
-    dv — the two-kernel path recomputes them twice. Grid is (BH,); no
+    dv — the two-kernel path recomputes them twice. Grid is (BH/G,); no
     cross-block accumulation exists at this size."""
     if masked:
         kmask_ref, dq_ref, dk_ref, dv_ref = rest
     else:
         dq_ref, dk_ref, dv_ref = rest
-    qb = q_ref[0]                                           # [T, D]
-    dob = do_ref[0]
-    kb = k_ref[0]
-    vb = v_ref[0]
-    lse = jnp.max(lse_ref[0], axis=-1)
-    delta = jnp.max(delta_ref[0], axis=-1)
+    qb = q_ref[...]                                         # [G, T, D]
+    dob = do_ref[...]
+    kb = k_ref[...]
+    vb = v_ref[...]
+    lse = lse_ref[:, 0]                                     # [G, T]
+    delta = delta_ref[:, 0]
     s = sm_scale * jax.lax.dot_general(
-        qb, kb, (((1,), (1,)), ((), ())),
-        preferred_element_type=jnp.float32)                 # [T, T]
+        qb, kb, (((2,), (2,)), ((0,), (0,))),
+        preferred_element_type=jnp.float32)                 # [G, T, T]
     if causal:
         qpos = jax.lax.broadcasted_iota(jnp.int32, (seq_len, seq_len), 0)
         kpos = jax.lax.broadcasted_iota(jnp.int32, (seq_len, seq_len), 1)
-        s = jnp.where(qpos >= kpos, s, NEG_INF)
+        s = jnp.where((qpos >= kpos)[None], s, NEG_INF)
     if masked:
-        s = jnp.where(kmask_ref[0, 0][None, :] > 0, s, NEG_INF)
-    p = jnp.exp(s - lse[:, None])
-    dp = jax.lax.dot_general(dob, vb, (((1,), (1,)), ((), ())),
+        s = jnp.where(kmask_ref[:, 0][:, None, :] > 0, s, NEG_INF)
+    # softmax math in the operand dtype: for bf16 models the exp and
+    # the ds product run at 2x VPU rate with ~0.4% p error (f32 models
+    # keep f32 — the parity tests exercise that path); the MXU consumes
+    # p/ds as bf16 regardless
+    cdt = kb.dtype
+    p = jnp.exp((s - lse[..., None]).astype(cdt))
+    dp = jax.lax.dot_general(dob, vb, (((2,), (2,)), ((0,), (0,))),
                              preferred_element_type=jnp.float32)
-    ds = (p * (dp - delta[:, None]) * sm_scale).astype(kb.dtype)
-    dq_ref[0] = jax.lax.dot_general(
-        ds, kb, (((1,), (0,)), ((), ())),
+    ds = (p * ((dp - delta[..., None]) * sm_scale).astype(cdt))
+    dq_ref[...] = jax.lax.dot_general(
+        ds, kb, (((2,), (1,)), ((0,), (0,))),
         preferred_element_type=jnp.float32).astype(dq_ref.dtype)
-    dv_ref[0] = jax.lax.dot_general(
-        p.astype(dob.dtype), dob, (((0,), (0,)), ((), ())),
+    dv_ref[...] = jax.lax.dot_general(
+        p.astype(dob.dtype), dob, (((1,), (1,)), ((0,), (0,))),
         preferred_element_type=jnp.float32).astype(dv_ref.dtype)
-    dk_ref[0] = jax.lax.dot_general(
-        ds, qb, (((0,), (0,)), ((), ())),
+    dk_ref[...] = jax.lax.dot_general(
+        ds, qb, (((1,), (1,)), ((0,), (0,))),
         preferred_element_type=jnp.float32).astype(dk_ref.dtype)
 
 
 def _flash_bwd_fused(q, k, v, do, lse, delta, kmask, sm_scale, causal):
     BH, T, D = q.shape
     masked = kmask is not None
-    fullblock = pl.BlockSpec((1, T, D), lambda bh: (bh, 0, 0))
-    lblock = pl.BlockSpec((1, T, LANES), lambda bh: (bh, 0, 0))
+    G = _pick_g(BH, T, D, _bwd_slice_bytes(T, D))
+    fullblock = pl.BlockSpec((G, T, D), lambda bh: (bh, 0, 0))
+    lblock = pl.BlockSpec((G, 1, T), lambda bh: (bh, 0, 0))
     in_specs = [fullblock, fullblock, fullblock, fullblock, lblock, lblock]
     args = [q, k, v, do, lse, delta]
     if masked:
-        in_specs.append(pl.BlockSpec((1, 1, T), lambda bh: (bh, 0, 0)))
+        in_specs.append(pl.BlockSpec((G, 1, T), lambda bh: (bh, 0, 0)))
         args.append(kmask)
     return pl.pallas_call(
         functools.partial(_bwd_fused_kernel, sm_scale=sm_scale,
                           causal=causal, masked=masked, seq_len=T),
-        grid=(BH,),
+        grid=(BH // G,),
         in_specs=in_specs,
         out_specs=[fullblock, fullblock, fullblock],
         out_shape=[
@@ -309,6 +393,7 @@ def _flash_bwd_fused(q, k, v, do, lse, delta, kmask, sm_scale, causal):
             jax.ShapeDtypeStruct((BH, T, D), k.dtype),
             jax.ShapeDtypeStruct((BH, T, D), v.dtype),
         ],
+        compiler_params=pltpu.CompilerParams(vmem_limit_bytes=_VMEM_LIMIT),
         interpret=_use_interpret(),
     )(*args)
 
@@ -318,9 +403,10 @@ def _flash_bwd_impl(q, k, v, o, lse, do, kmask, sm_scale, causal):
     block_q, block_k = _block_sizes(T)
     masked = kmask is not None
     delta = jnp.sum(do.astype(jnp.float32) * o.astype(jnp.float32), axis=-1)
-    # lane-broadcast the per-row scalars for tile-legal kernel blocks
-    lse = jnp.broadcast_to(lse[:, :, None], (BH, T, LANES))
-    delta = jnp.broadcast_to(delta[:, :, None], (BH, T, LANES))
+    # [BH, 1, T] layout for the per-row scalars (tile-legal via the
+    # middle singleton dim) — replaces the r2 [BH, T, LANES] broadcast
+    lse = lse[:, None, :]
+    delta = delta[:, None, :]
 
     if block_q == T and block_k == T:
         # whole Q/K/V per program: one fused kernel emits dq, dk and dv
@@ -333,8 +419,8 @@ def _flash_bwd_impl(q, k, v, o, lse, do, kmask, sm_scale, causal):
         pl.BlockSpec((1, T, D), lambda bh, qi: (bh, 0, 0)),
         pl.BlockSpec((1, T, D), lambda bh, qi: (bh, 0, 0)),
         pl.BlockSpec((1, block_q, D), lambda bh, qi: (bh, qi, 0)),
-        pl.BlockSpec((1, block_q, LANES), lambda bh, qi: (bh, qi, 0)),
-        pl.BlockSpec((1, block_q, LANES), lambda bh, qi: (bh, qi, 0)),
+        pl.BlockSpec((1, 1, block_q), lambda bh, qi: (bh, 0, qi)),
+        pl.BlockSpec((1, 1, block_q), lambda bh, qi: (bh, 0, qi)),
     ]
     dq_args = [q, k, v, do, lse, delta]
     if masked:
@@ -356,12 +442,13 @@ def _flash_bwd_impl(q, k, v, o, lse, do, kmask, sm_scale, causal):
         pl.BlockSpec((1, block_k, D), lambda bh, ki: (bh, ki, 0)),
         pl.BlockSpec((1, block_k, D), lambda bh, ki: (bh, ki, 0)),
         pl.BlockSpec((1, T, D), lambda bh, ki: (bh, 0, 0)),
-        pl.BlockSpec((1, T, LANES), lambda bh, ki: (bh, 0, 0)),
-        pl.BlockSpec((1, T, LANES), lambda bh, ki: (bh, 0, 0)),
+        pl.BlockSpec((1, 1, T), lambda bh, ki: (bh, 0, 0)),
+        pl.BlockSpec((1, 1, T), lambda bh, ki: (bh, 0, 0)),
     ]
     dkv_args = [q, k, v, do, lse, delta]
     if masked:
-        dkv_specs.append(pl.BlockSpec((1, 1, block_k), lambda bh, ki: (bh, 0, ki)))
+        dkv_specs.append(pl.BlockSpec((1, 1, block_k),
+                                      lambda bh, ki: (bh, 0, ki)))
         dkv_args.append(kmask)
     dk, dv = pl.pallas_call(
         functools.partial(_dkv_kernel, sm_scale=sm_scale, causal=causal,

@@ -184,20 +184,17 @@ class PipelinePlan:
         self.stage_groups = [
             sum(body_segs[g * seg_per_stage:(g + 1) * seg_per_stage], [])
             for g in range(n_stages)]
-        best_lo, best_hi = lo, hi
-        self.pre_names = sum(segs[:best_lo], [])
-        post = sum(segs[best_hi:], [])
+        self.pre_names = sum(segs[:lo], [])
+        post = sum(segs[hi:], [])
         if post and post[-1] == out_name:
-            post = post[:-1]
-        elif topo[-1] == out_name and not post:
-            pass
+            post = post[:-1]  # the loss layer runs via post_loss, not here
         self.post_names = post
         self.out_name = out_name
         self.out_vconf = out_v
 
         # external input value feeding each region
         self.pre_ext = self.input_name
-        self.body_ext = (segs[best_lo - 1][-1] if best_lo > 0
+        self.body_ext = (segs[lo - 1][-1] if lo > 0
                          else self.input_name)
         self.post_ext = body_segs[-1][-1] if body_segs else self.body_ext
         # consistency: the value feeding the loss layer
@@ -300,9 +297,16 @@ class PipelinePlan:
         v = self.out_vconf
         if v.preprocessor is not None:
             h = v.preprocessor.pre_process(h)
+        # same compute-dtype policy as the non-PP loss path: the head
+        # weight must not stream through the loss kernels in f32 for a
+        # bf16 model
+        p_out = post_params[self.out_name]
+        if net.compute_dtype != net.param_dtype:
+            from deeplearning4j_tpu.nn.training import tree_cast
+
+            p_out = tree_cast(p_out, net.compute_dtype)
         return net.impls[self.out_name].loss(
-            v.layer, post_params[self.out_name], h, labels, train=train,
-            rng=rng, mask=mask)
+            v.layer, p_out, h, labels, train=train, rng=rng, mask=mask)
 
     # ----------------------------------------------------- tree restructure
     def stage_local(self, stacked, g=None):

@@ -181,6 +181,15 @@ def test_ep_train_via_set_mesh_matches_dense(lm_data):
     assert tuple(net.params["blk0_moe"]["We1"].sharding.spec)[0] == "expert"
 
 
+def test_zero1_with_renamed_data_axis(dense, lm_data):
+    """zero1 must follow the MAPPED data axis name, not the literal
+    'data' (regression: zero1_opt_shardings hardcoded the default)."""
+    net = _fresh_lm()
+    net.set_mesh(make_mesh({"dp": 8}), zero1=True, axes={"data": "dp"})
+    net.fit(lm_data, epochs=3)
+    assert abs(net.score_value - dense.score_value) < ATOL
+
+
 def test_axes_validation_errors():
     net = _fresh_lm()
     mesh = make_mesh({"data": 8})

@@ -375,7 +375,30 @@ class ComputationGraph:
     def _get_train_step(self):
         """Jitted donated train step (same contract as MLN._get_train_step)."""
         if self._train_step is None:
-            if getattr(self, "_pp_plan", None) is not None:
+            axes_map = getattr(self, "_mesh_axes", None) or {}
+            if "seq" in axes_map:
+                from deeplearning4j_tpu.parallel.sequence_parallel import (
+                    make_sp_train_step,
+                )
+
+                sp = make_sp_train_step(self, self._mesh,
+                                        seq_axis=axes_map["seq"],
+                                        data_axis=axes_map.get("data"))
+
+                def step(params, opt_state, state, rng, batch):
+                    masks = list(batch.get("features_masks") or []) + list(
+                        batch.get("labels_masks") or [])
+                    if any(m is not None for m in masks):
+                        raise ValueError(
+                            "masks are not supported under sequence "
+                            "parallelism — pad to full length")
+                    p, o, s, loss = sp(params, opt_state, state, rng,
+                                       batch["features"][0],
+                                       batch["labels"][0])
+                    return p, o, s, loss, {}
+
+                self._train_step = step
+            elif getattr(self, "_pp_plan", None) is not None:
                 from deeplearning4j_tpu.parallel.pipeline import (
                     make_pp_train_step,
                 )

@@ -65,14 +65,35 @@ class NetworkLayer(Layer):
         return net
 
     def get_output_type(self, input_type: InputType) -> InputType:
+        """Propagate the outer shape inference THROUGH the nested network
+        (preprocessors included) so downstream n_in inference sees the
+        inner net's true output size."""
         from deeplearning4j_tpu.nn.conf.graph_conf import (
             ComputationGraphConfiguration,
+            LayerVertexConf,
         )
 
         if isinstance(self.conf, ComputationGraphConfiguration):
-            return input_type  # DAG shape inference runs inside the graph
+            g = self.conf
+            types = {g.network_inputs[0]: input_type}
+            for name in g.topological_order():
+                if name in g.network_inputs:
+                    continue
+                v = g.vertices[name]
+                in_types = [types[i] for i in g.vertex_inputs[name]]
+                if isinstance(v, LayerVertexConf):
+                    t = in_types[0]
+                    if v.preprocessor is not None:
+                        t = v.preprocessor.get_output_type(t)
+                    types[name] = v.layer.get_output_type(t)
+                else:
+                    types[name] = v.get_output_type(*in_types)
+            return types[g.network_outputs[0]]
         t = input_type
-        for lc in self.conf.layers:
+        for i, lc in enumerate(self.conf.layers):
+            proc = self.conf.get_preprocessor(i)
+            if proc is not None:
+                t = proc.get_output_type(t)
             t = lc.get_output_type(t)
         return t
 

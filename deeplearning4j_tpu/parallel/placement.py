@@ -10,6 +10,7 @@ only for data parallelism). Roles map to mesh axis names:
     net.set_mesh(mesh, axes={"data": "data", "model": "model",
                              "pipe": "pipe"}, n_microbatches=8)# DP x TP x PP
     net.set_mesh(mesh, axes={"data": "data", "expert": "expert"})  # DP x EP
+    net.set_mesh(mesh, axes={"data": "data", "seq": "seq"})    # DP x SP
 
 - "data": batch leaves shard over the axis; XLA inserts the gradient
   allreduce (replaces the Spark broadcast/accumulator round-trip).
@@ -24,6 +25,9 @@ only for data parallelism). Roles map to mesh axis names:
   (stages stacked on a [S] axis sharded over the pipe axis) and the train
   step becomes the microbatched GPipe schedule. Composes with data/model/
   expert axes, which stay AUTO inside the schedule's shard_map.
+- "seq": TIME shards over the axis — ring attention + offset positional
+  encodings inside shard_map (parallel/sequence_parallel.py). Requires a
+  conf built with seq_parallel_axis; composes with "data".
 
 `set_mesh(mesh)` with no axes keeps the round-1 behavior (pure DP over a
 'data' axis, optional ZeRO-1).
@@ -33,7 +37,13 @@ from __future__ import annotations
 
 import jax
 
-ROLES = ("data", "model", "pipe", "expert")
+ROLES = ("data", "model", "pipe", "expert", "seq")
+
+
+def _iter_layer_confs(net):
+    if hasattr(net, "layer_vertices"):
+        return [v.layer for v in net.layer_vertices.values()]
+    return list(net.layer_confs)
 
 
 def _map_param_shaped(tree, ref_params, convert):
@@ -104,7 +114,43 @@ def configure_mesh(net, mesh, *, zero1=False, axes=None, n_microbatches=None,
                 f"(mesh has {mesh.axis_names})")
     if zero1 and set(axes) - {"data"}:
         raise ValueError("zero1 currently composes with the 'data' axis "
-                         "only — drop it or the model/pipe/expert axes")
+                         "only — drop it or the model/pipe/expert/seq axes")
+    if "seq" in axes:
+        # sequence parallelism shards TIME inside shard_map: the layer
+        # impls must know the ring axis (ring attention, offset posenc) —
+        # the conf carries it (transformer_lm(seq_parallel_axis=...))
+        if set(axes) - {"seq", "data"}:
+            raise ValueError(
+                "the 'seq' axis composes with 'data' only (time-sharded "
+                "ring attention runs fully manual inside shard_map; "
+                "model/pipe/expert need the GSPMD-auto path)")
+        if not hasattr(net, "layer_vertices"):
+            raise ValueError(
+                "the 'seq' axis requires the ComputationGraph container "
+                "(only its train step routes through the sequence-parallel "
+                "shard_map); build the model via .graph_builder()")
+        if (len(net.conf.network_inputs) != 1
+                or len(net.conf.network_outputs) != 1):
+            raise ValueError(
+                "the 'seq' axis supports single-input single-output "
+                "graphs (the SP step shards one token/label pair over "
+                "time)")
+        sp_layers = [
+            lc for lc in _iter_layer_confs(net)
+            if getattr(lc, "seq_parallel_axis", "")]
+        if not sp_layers:
+            raise ValueError(
+                "axes['seq'] needs a sequence-parallel-ready conf: build "
+                "the model with seq_parallel_axis set to the mesh axis "
+                "name (e.g. transformer_lm(seq_parallel_axis="
+                f"{axes['seq']!r}))")
+        for lc in sp_layers:
+            if lc.seq_parallel_axis != axes["seq"]:
+                raise ValueError(
+                    f"conf layer '{getattr(lc, 'name', '?')}' is built for "
+                    f"seq axis {lc.seq_parallel_axis!r} but axes['seq'] is "
+                    f"{axes['seq']!r}")
+        return net
 
     rules = resolve_rules(axes, tp_rules)
     net._resolved_rules = rules

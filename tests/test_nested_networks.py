@@ -109,6 +109,47 @@ def test_nested_graph_in_mln(rng):
     assert net.evaluate(ds).accuracy() > 0.8
 
 
+def test_nested_graph_output_type_inference(rng):
+    """Outer shape inference must see the nested graph's TRUE output size
+    (a 4->6 nested graph followed by an n_in-inferred output layer)."""
+    from deeplearning4j_tpu.nn.conf.inputs import InputType
+
+    g = (NeuralNetConfiguration.builder().seed(9).graph_builder()
+         .add_inputs("x"))
+    g.add_layer("d1", DenseLayer(n_in=4, n_out=8, activation="tanh"), "x")
+    g.add_layer("d2", DenseLayer(n_in=8, n_out=6, activation="identity"),
+                "d1")
+    g.set_outputs("d2")
+    inner = g.build()
+    nl = NetworkLayer(conf=inner)
+    out_t = nl.get_output_type(InputType.feed_forward(4))
+    assert out_t.flat_size() == 6
+    # end-to-end: outer OutputLayer's n_in inferred from the nested output
+    conf = (NeuralNetConfiguration.builder().seed(5).learning_rate(0.1)
+            .updater("adam").list()
+            .layer(NetworkLayer(conf=inner))
+            .layer(OutputLayer(n_out=3, activation="softmax",
+                               loss_function="mcxent"))
+            .input_type(InputType.feed_forward(4))
+            .build())
+    net = MultiLayerNetwork(conf).init()
+    assert net.params["layer_1"]["W"].shape == (6, 3)
+    net.fit(_blob(rng), epochs=5)
+    assert np.isfinite(net.score_value)
+
+
+def test_seq_axis_rejects_mln():
+    from deeplearning4j_tpu.parallel.mesh import make_mesh
+
+    conf = (NeuralNetConfiguration.builder().seed(3).list()
+            .layer(DenseLayer(n_in=4, n_out=8, activation="tanh"))
+            .layer(OutputLayer(n_in=8, n_out=2, activation="softmax",
+                               loss_function="mcxent")).build())
+    net = MultiLayerNetwork(conf).init()
+    with pytest.raises(ValueError, match="ComputationGraph"):
+        net.set_mesh(make_mesh({"seq": 8}), axes={"seq": "seq"})
+
+
 def test_network_layer_conf_roundtrip():
     conf = (NeuralNetConfiguration.builder().seed(5).list()
             .layer(NetworkLayer(conf=_inner_mln_conf()))

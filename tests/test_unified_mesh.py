@@ -181,6 +181,35 @@ def test_ep_train_via_set_mesh_matches_dense(lm_data):
     assert tuple(net.params["blk0_moe"]["We1"].sharding.spec)[0] == "expert"
 
 
+def test_sp_via_set_mesh_matches_dense(lm_data):
+    """The fifth axis joins the entry point: axes={'seq': ...} routes fit()
+    through the ring-attention sequence-parallel step (time sharded over
+    the mesh, grads pmean'd). Int next-token labels keep the SP step's
+    per-shard loss exact."""
+    toks = np.asarray(lm_data.features)
+    labs_int = np.roll(toks, -1, axis=1).astype(np.int32)
+    from deeplearning4j_tpu.datasets.api import DataSet as DS
+
+    data_int = DS(toks, labs_int)
+    dense_net = transformer_lm(vocab_size=V, d_model=D, n_heads=H,
+                               n_layers=L, d_ff=FF, max_length=T)
+    dense_net.init()
+    dense_net.fit(data_int, epochs=3)
+    net = transformer_lm(vocab_size=V, d_model=D, n_heads=H, n_layers=L,
+                         d_ff=FF, max_length=T, seq_parallel_axis="seq")
+    net.init()
+    net.set_mesh(make_mesh({"data": 2, "seq": 4}),
+                 axes={"data": "data", "seq": "seq"})
+    net.fit(data_int, epochs=3)
+    assert abs(net.score_value - dense_net.score_value) < ATOL
+
+
+def test_seq_axis_requires_sp_conf():
+    net = _fresh_lm()  # built WITHOUT seq_parallel_axis
+    with pytest.raises(ValueError, match="seq_parallel_axis"):
+        net.set_mesh(make_mesh({"seq": 8}), axes={"seq": "seq"})
+
+
 def test_zero1_with_renamed_data_axis(dense, lm_data):
     """zero1 must follow the MAPPED data axis name, not the literal
     'data' (regression: zero1_opt_shardings hardcoded the default)."""

@@ -37,11 +37,20 @@ TARGETS = {
                              # steady-state; per-step Python dispatch caps a
                              # naive loop far lower)
     "vgg16": 55000.0,        # images/sec/chip (r2 measured: 59.3k, fit_scanned)
-    "word2vec": 600000.0,    # words/sec (r2 measured: ~790-960k after the
-                             # flat corpus packing + 2048x4 chunking + the
-                             # warmup-drain timing fix; 600k floor guards
-                             # those optimizations with chip-state margin)
+    "word2vec": 800000.0,    # words/sec — ~0.9x the sustained shared-
+                             # negatives rate (r2-r4 healthy windows:
+                             # 875k-1.04M; r4 re-measured 944k at a 163
+                             # TF/s ceiling). The old 600k floor let the
+                             # r3 driver window's 699k (-33% vs r2) pass
+                             # silently (VERDICT r3 #3); now it flags,
+                             # and the line carries chip_matmul_tflops
+                             # so throttle windows are distinguishable.
     "resnet_dp": 1.0,        # allreduce/param-avg speedup (>=1 expected)
+    "moe": 650000.0,         # routed-MoE tokens/sec (r4 measured: 978k =
+                             # 0.66x the dense LM line after argmax top-k
+                             # gating replaced the lax.top_k sort + [N,E]
+                             # scatter; anchor = the 0.6x-of-dense bar
+                             # VERDICT r3 set, at the dense anchor's MFU)
     "transformer": 0.30,     # MFU fraction (north star >=30%; r2 measured
                              # 0.37 at seq 512 with the fused softmax-xent
                              # head + tuned flash kernels incl. the fused
@@ -72,6 +81,12 @@ def _peak_flops(device):
 
 
 REGRESSION_FLOOR = 0.9  # anchored metric below 0.9x its anchor fails loudly
+
+# word2vec device path must keep >= this fraction of the host (reference-
+# semantics) path's embedding quality on the shared sub-corpus (r4
+# measured ~0.87; shared negatives + trust-region clipping account for
+# the gap — `share_negatives=False` reaches ~0.95+ at 2.7x the runtime)
+W2V_QUALITY_RATIO = 0.8
 
 
 def _emit(mode: str, value: float, unit: str, **extra) -> None:
@@ -322,12 +337,31 @@ def bench_word2vec() -> None:
         _quality_w2v(sub, use_device_pipeline=True, share_negatives=False))
     q_host = _topic_separation(
         _quality_w2v(sub, use_device_pipeline=False))
+    extra = {
+        "quality": round(quality, 4),
+        "quality_subcorpus": round(q_dev, 4),
+        "quality_subcorpus_unshared_negatives": round(q_unshared, 4),
+        "quality_subcorpus_host_path": round(q_host, 4),
+        # r3 #3 quality GATE: the fast shared-negatives device path must
+        # stay within tolerance of reference (host-path) semantics on the
+        # same seed/sub-corpus — a silent quality slide now flags
+        "quality_gate_min_ratio": W2V_QUALITY_RATIO,
+        "quality_ratio_vs_host": round(q_dev / max(q_host, 1e-9), 4),
+    }
+    if q_dev < W2V_QUALITY_RATIO * q_host:
+        extra["regression"] = True
+        sys.stderr.write(
+            f"REGRESSION: word2vec device-path quality {q_dev:.4f} fell "
+            f"below {W2V_QUALITY_RATIO}x the host path ({q_host:.4f})\n")
+    # chip-state context like the conv/transformer lines: the w2v number
+    # swung 1.04M -> 699k between the r2/r3 driver windows on unchanged
+    # NLP code (r4 re-measured 944k at a 163 TF/s ceiling) — the ceiling
+    # lets an artifact reader separate throttling from real regressions
+    achieved = _measure_matmul_tflops()
+    if achieved:
+        extra["chip_matmul_tflops"] = round(achieved / 1e12, 1)
     _emit("word2vec", n_words / dt, "words/sec",
-          metric="word2vec_sgns_words_per_sec",
-          quality=round(quality, 4),
-          quality_subcorpus=round(q_dev, 4),
-          quality_subcorpus_unshared_negatives=round(q_unshared, 4),
-          quality_subcorpus_host_path=round(q_host, 4))
+          metric="word2vec_sgns_words_per_sec", **extra)
 
 
 def bench_resnet_dp() -> None:
@@ -410,7 +444,9 @@ def bench_transformer() -> None:
     )
 
     backend, on_tpu, seq, batch, steps, ds = _lm_harness(512, 32, 40)
-    vocab, d_model, heads, layers, d_ff = VOCAB_LM, 256, 4, 6, 1024
+    vocab, d_model, heads, layers, d_ff = VOCAB_LM, 256, 2, 6, 1024
+    # 2 heads -> head_dim 128: fills the MXU contraction (r3: D=64 ran
+    # flash at half rate) and unlocks the packed no-relayout kernels
     net = transformer_lm(vocab_size=vocab, d_model=d_model, n_heads=heads,
                          n_layers=layers, d_ff=d_ff, max_length=seq,
                          dtype="bfloat16" if on_tpu else "float32")
@@ -458,7 +494,9 @@ def bench_transformer_masked() -> None:
     )
 
     backend, on_tpu, seq, batch, steps, _ = _lm_harness(512, 32, 40)
-    vocab, d_model, heads, layers, d_ff = VOCAB_LM, 256, 4, 6, 1024
+    vocab, d_model, heads, layers, d_ff = VOCAB_LM, 256, 2, 6, 1024
+    # 2 heads -> head_dim 128: fills the MXU contraction (r3: D=64 ran
+    # flash at half rate) and unlocks the packed no-relayout kernels
     rng = np.random.default_rng(0)
     toks = np.asarray(rng.integers(0, vocab, (batch, seq)), np.int32)
     # realistic NLP batch: lengths spread over [seq/2, seq]
@@ -499,7 +537,9 @@ def bench_longcontext() -> None:
 
     backend, on_tpu, seq, batch, steps, ds = _lm_harness(
         4096, 4, 20, seq_cpu=256, batch_cpu=1)
-    vocab, d_model, heads, layers, d_ff = VOCAB_LM, 256, 4, 6, 1024
+    vocab, d_model, heads, layers, d_ff = VOCAB_LM, 256, 2, 6, 1024
+    # 2 heads -> head_dim 128: fills the MXU contraction (r3: D=64 ran
+    # flash at half rate) and unlocks the packed no-relayout kernels
     net = transformer_lm(vocab_size=vocab, d_model=d_model, n_heads=heads,
                          n_layers=layers, d_ff=d_ff, max_length=seq,
                          dtype="bfloat16" if on_tpu else "float32")
@@ -533,12 +573,125 @@ def bench_moe() -> None:
                              dtype="bfloat16" if on_tpu else "float32")
     net.init()
     sec = _time_net_steps(net, ds, steps=steps)
+    tokens_per_sec = batch * seq / sec
+    if on_tpu:
+        _emit("moe", tokens_per_sec, "tokens/sec",
+              metric=f"transformer_moe_lm_tokens_per_sec_{backend}",
+              n_experts=8, top_k=2, routing="routed",
+              capacity_factor=1.25)
+    else:
+        print(json.dumps({
+            "metric": f"transformer_moe_lm_tokens_per_sec_{backend}",
+            "value": round(tokens_per_sec, 1),
+            "unit": "tokens/sec",
+            "vs_baseline": None,  # CPU smoke: no anchor
+            "n_experts": 8, "top_k": 2}), flush=True)
+
+
+def bench_transformer_dropout() -> None:
+    """Masked + attention-dropout LM step (informational, VERDICT r3 #6):
+    dropout is the reference's default regularizer — with the in-kernel
+    counter-hash masks this config keeps the fused flash path instead of
+    silently falling to dense O(T^2)."""
+    import jax
+
+    from deeplearning4j_tpu.datasets.api import DataSet
+    from deeplearning4j_tpu.models.transformer import (
+        transformer_flops_per_token,
+        transformer_lm,
+    )
+
+    backend, on_tpu, seq, batch, steps, _ = _lm_harness(512, 32, 40)
+    vocab, d_model, heads, layers, d_ff = VOCAB_LM, 256, 2, 6, 1024
+    rng = np.random.default_rng(0)
+    toks = np.asarray(rng.integers(0, vocab, (batch, seq)), np.int32)
+    lengths = rng.integers(seq // 2, seq + 1, batch)
+    mask = (np.arange(seq)[None, :] < lengths[:, None]).astype(np.float32)
+    ds = DataSet(toks, np.roll(toks, -1, axis=1), features_mask=mask)
+    net = transformer_lm(vocab_size=vocab, d_model=d_model, n_heads=heads,
+                         n_layers=layers, d_ff=d_ff, max_length=seq,
+                         attention_dropout=0.1,
+                         dtype="bfloat16" if on_tpu else "float32")
+    net.init()
+    sec = _time_net_steps(net, ds, steps=steps)
+    tokens_per_sec = batch * seq / sec
+    flops_tok = transformer_flops_per_token(vocab, d_model, layers, d_ff, seq)
+    peak = _peak_flops(jax.devices()[0])
     print(json.dumps({
-        "metric": f"transformer_moe_lm_tokens_per_sec_{backend}",
-        "value": round(batch * seq / sec, 1),
-        "unit": "tokens/sec",
-        "vs_baseline": None,  # informational: beyond-reference capability
-        "n_experts": 8, "top_k": 2}), flush=True)
+        "metric": f"transformer_lm_masked_dropout_mfu_{backend}",
+        "value": (round(flops_tok * tokens_per_sec / peak, 4) if peak
+                  else round(tokens_per_sec, 1)),
+        "unit": "MFU fraction" if peak else "tokens/sec",
+        "vs_baseline": None,  # informational: compare to the clean mode
+        "tokens_per_sec": round(tokens_per_sec, 1),
+        "attention_dropout": 0.1}), flush=True)
+
+
+def bench_ringhop() -> None:
+    """Per-hop kernel rate inside ring attention (informational, VERDICT
+    r3 #4): one ring hop = local Q against a visiting K/V block. Times
+    the Pallas flash hop (flash_attention_lse) against the f32 einsum
+    blockwise-softmax hop it replaced, single chip, fwd+bwd."""
+    import functools
+
+    import jax
+    import jax.numpy as jnp
+
+    from deeplearning4j_tpu.ops.flash_attention import flash_attention_lse
+
+    backend = jax.default_backend()
+    on_tpu = backend == "tpu"
+    BH, Tl, D = (64, 2048, 128) if on_tpu else (4, 256, 32)
+    rng = np.random.default_rng(0)
+    dt = jnp.bfloat16 if on_tpu else jnp.float32
+    q, k, v = (jnp.asarray(rng.standard_normal((BH, Tl, D)), dt)
+               for _ in range(3))
+    scale = 1.0 / float(np.sqrt(D))
+
+    def einsum_hop(q, k, v):
+        s = jnp.einsum("btd,bsd->bts", q.astype(jnp.float32),
+                       k.astype(jnp.float32)) * scale
+        m = s.max(-1)
+        p = jnp.exp(s - m[..., None])
+        o = jnp.einsum("bts,bsd->btd", p, v.astype(jnp.float32))
+        return o / jnp.maximum(p.sum(-1), 1e-30)[..., None]
+
+    def flash_hop(q, k, v):
+        o, _ = flash_attention_lse(q, k, v, scale, False)
+        return o
+
+    def grad_loop(hop, K):
+        g = jax.grad(lambda q: jnp.sum(hop(q, k, v).astype(jnp.float32)
+                                       ** 2))
+
+        def body(i, c):
+            return g(c) * dt(1e-3) + q
+        return jax.lax.fori_loop(0, K, body, q)
+
+    flops = 2 * 2 * BH * Tl * Tl * D * 3  # qk + pv, fwd + ~2x bwd
+
+    def rate(hop):
+        fns = {K: jax.jit(functools.partial(grad_loop, hop, K))
+               for K in (4, 12)}
+        for f in fns.values():
+            _sync(f())
+        t1 = min((lambda: (lambda t0: (_sync(fns[4]()),
+                                       time.perf_counter() - t0)[1])(
+            time.perf_counter()))() for _ in range(3))
+        t3 = min((lambda: (lambda t0: (_sync(fns[12]()),
+                                       time.perf_counter() - t0)[1])(
+            time.perf_counter()))() for _ in range(3))
+        per = (t3 - t1) / 8
+        return flops / per if per > 0 else float("nan")
+
+    f_rate, e_rate = rate(flash_hop), rate(einsum_hop)
+    print(json.dumps({
+        "metric": f"ring_hop_flash_tflops_{backend}",
+        "value": round(f_rate / 1e12, 2), "unit": "TFLOP/s",
+        "vs_baseline": None,
+        "einsum_hop_tflops": round(e_rate / 1e12, 2),
+        "speedup_vs_einsum_hop": round(f_rate / e_rate, 2),
+        "shape": [BH, Tl, D]}), flush=True)
 
 
 MODES = {
@@ -550,6 +703,8 @@ MODES = {
     "masked": bench_transformer_masked,
     "longcontext": bench_longcontext,
     "moe": bench_moe,
+    "dropout": bench_transformer_dropout,
+    "ringhop": bench_ringhop,
 }
 
 

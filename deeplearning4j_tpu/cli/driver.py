@@ -192,12 +192,31 @@ def _train_on_cluster(net, args, it) -> None:
                     f"cluster at {args.cluster} has {len(probe.workers())} "
                     f"workers; expected {args.num_workers}")
             time.sleep(0.2)
-        rank = probe.rank
+        # claim a shard slot ATOMICALLY on the coordinator instead of
+        # `rank % num_workers` — an elastically replaced worker's fresh
+        # monotonic rank could collide with a survivor's modulo
+        # num_workers, duplicating one shard while another went
+        # unprocessed (ADVICE r3). claim_slot does the read-modify-write
+        # under the coordinator lock (a set/read-back protocol lets two
+        # sweepers confirm the same slot).
+        while True:
+            shard_idx = probe.claim_slot(args.num_workers)
+            if shard_idx is not None:
+                break
+            if time.monotonic() > deadline:
+                raise SystemExit(f"no free shard slot at {args.cluster}")
+            time.sleep(0.5)
+        # single pass over the source iterator keeping only this shard
+        # (the full dataset is never materialized on one worker); done
+        # while the probe still heartbeats so the claim cannot be stolen
+        batches = [ds for i, ds in enumerate(it)
+                   if i % args.num_workers == shard_idx]
     finally:
-        probe.close()
-    batches = [ds for i, ds in enumerate(it)
-               if i % args.num_workers == rank % args.num_workers]
-    print(f"worker {worker_id} rank {rank}: {len(batches)} local batches")
+        # keep the worker in the alive set through the handoff to the
+        # training client (same worker_id): deregistering here would free
+        # the slot for a sweeping replacement during the gap
+        probe.close(deregister=False)
+    print(f"worker {worker_id} shard {shard_idx}: {len(batches)} local batches")
     run_elastic_worker(args.cluster, worker_id, net, batches,
                        sync_every=args.sync_every,
                        checkpoint_path=args.checkpoint, epochs=args.epochs)

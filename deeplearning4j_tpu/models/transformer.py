@@ -33,7 +33,8 @@ from deeplearning4j_tpu.nn.graph import ComputationGraph
 
 def _build_lm(vocab_size, d_model, n_heads, n_layers, max_length, dropout,
               seed, learning_rate, dtype, remat, ff_builder,
-              seq_parallel_axis="") -> ComputationGraph:
+              seq_parallel_axis="",
+              attention_dropout=None) -> ComputationGraph:
     """Shared pre-norm LM skeleton; `ff_builder(g, name, input_name)` adds
     the per-block feed-forward sublayer(s) and returns the output name —
     the dense and MoE variants differ only there."""
@@ -64,7 +65,9 @@ def _build_lm(vocab_size, d_model, n_heads, n_layers, max_length, dropout,
         g.add_layer(f"{b}_attn", SelfAttentionLayer(
             n_in=d_model, n_out=d_model, n_heads=n_heads, causal=True,
             dropout=dropout,
-            attention_dropout=0.0 if seq_parallel_axis else dropout,
+            attention_dropout=(0.0 if seq_parallel_axis
+                               else (dropout if attention_dropout is None
+                                     else attention_dropout)),
             activation="identity",
             seq_parallel_axis=seq_parallel_axis), f"{b}_ln1")
         g.add_vertex(f"{b}_res1", ElementWiseVertexConf(op="add"),
@@ -89,10 +92,13 @@ def transformer_lm(vocab_size: int = 10000, d_model: int = 256,
                    max_length: int = 512, dropout: float = 0.0,
                    seed: int = 12345, learning_rate: float = 3e-4,
                    dtype: str = "float32", remat: bool = False,
-                   seq_parallel_axis: str = "") -> ComputationGraph:
+                   seq_parallel_axis: str = "",
+                   attention_dropout: float = None) -> ComputationGraph:
     """seq_parallel_axis: name of a mesh axis to shard TIME over — builds
     an SP-ready config for parallel/sequence_parallel.py (ring attention +
-    position-offset encodings inside shard_map)."""
+    position-offset encodings inside shard_map). attention_dropout
+    overrides the attention-weight dropout independently of the
+    input/FF `dropout` (None: follow it)."""
     def ff(g, b, src):
         g.add_layer(f"{b}_ff1", DenseLayer(n_in=d_model, n_out=d_ff,
                                            activation="gelu", dropout=dropout),
@@ -103,7 +109,8 @@ def transformer_lm(vocab_size: int = 10000, d_model: int = 256,
 
     return _build_lm(vocab_size, d_model, n_heads, n_layers, max_length,
                      dropout, seed, learning_rate, dtype, remat, ff,
-                     seq_parallel_axis=seq_parallel_axis)
+                     seq_parallel_axis=seq_parallel_axis,
+                     attention_dropout=attention_dropout)
 
 
 def transformer_moe_lm(vocab_size: int = 10000, d_model: int = 256,
@@ -112,17 +119,20 @@ def transformer_moe_lm(vocab_size: int = 10000, d_model: int = 256,
                        d_expert_hidden: int = 512, max_length: int = 512,
                        dropout: float = 0.0, seed: int = 12345,
                        learning_rate: float = 3e-4, dtype: str = "float32",
-                       remat: bool = False) -> ComputationGraph:
+                       remat: bool = False, routing: str = "routed",
+                       capacity_factor: float = 1.25) -> ComputationGraph:
     """Mixture-of-Experts LM: each block's dense FF replaced by a top-k
     gated expert FFN (nn/layers/moe.py; dropout applies to the expert
     input like the dense variant's first FF layer). Experts shard over a
-    mesh 'expert' axis for EP execution (parallel/expert_parallel.py)."""
+    mesh 'expert' axis for EP execution; routing="routed" (default) uses
+    capacity-factor token dispatch, "dense" the compute-all oracle."""
     from deeplearning4j_tpu.nn.layers.moe import MixtureOfExpertsLayer
 
     def ff(g, b, src):
         g.add_layer(f"{b}_moe", MixtureOfExpertsLayer(
             n_in=d_model, n_out=d_model, n_experts=n_experts, top_k=top_k,
-            d_hidden=d_expert_hidden, activation="gelu", dropout=dropout),
+            d_hidden=d_expert_hidden, activation="gelu", dropout=dropout,
+            routing=routing, capacity_factor=capacity_factor),
             src)
         return f"{b}_moe"
 

@@ -41,6 +41,7 @@ from deeplearning4j_tpu.nn.conf.layers import (
     validate_layer_names,
 )
 from deeplearning4j_tpu.nn.layers import get_impl, l1_l2_penalty
+from deeplearning4j_tpu.nn.layers.base import pop_aux_losses
 from deeplearning4j_tpu.nn.training import make_train_step, tree_cast
 from deeplearning4j_tpu.nn.updater import build_optimizer
 
@@ -184,6 +185,27 @@ class ComputationGraph:
             return jnp.split(inputs[0], vconf.stack_size, axis=0)[vconf.from_idx]
         raise ValueError(f"Unhandled vertex type {type(vconf).__name__} for '{name}'")
 
+    def _time_preserving(self, vconf, T):
+        """Whether a vertex maps [B, T, f] -> [B, T, f'] keeping the time
+        axis: elementwise/merge/scale vertices by construction; layer
+        vertices by their declared InputType mapping (recurrent in ->
+        recurrent out of the same length)."""
+        if isinstance(vconf, (MergeVertexConf, ElementWiseVertexConf,
+                              ScaleVertexConf)):
+            return True
+        if isinstance(vconf, LayerVertexConf):
+            from deeplearning4j_tpu.nn.conf.inputs import InputType
+
+            lc = vconf.layer
+            try:
+                ot = lc.get_output_type(
+                    InputType.recurrent(getattr(lc, "n_in", 0) or 0, T))
+            except Exception:
+                return False
+            return (ot.kind == "recurrent"
+                    and ot.timeseries_length == T)
+        return False
+
     def _forward(self, params, state, input_dict, *, train, rng, masks=None,
                  collect=False, carries=None):
         masks = dict(masks) if masks else {}
@@ -231,11 +253,17 @@ class ComputationGraph:
             # propagate time masks along the DAG (reference
             # setLayerMaskArrays/feedForwardMaskArrays semantics): a
             # time-preserving vertex carries its first input's mask so
-            # downstream recurrent/attention layers see the padding
+            # downstream recurrent/attention layers see the padding.
+            # Gated on vertex SEMANTICS (declared time-preserving kinds /
+            # recurrent-output layers), not just output shape — a vertex
+            # permuting axes to [B, C, T'] with C == T must not inherit a
+            # time mask (ADVICE r3)
             m = masks.get(self.conf.vertex_inputs[name][0])
             y_out = acts[name]
             if (m is not None and hasattr(y_out, "ndim") and y_out.ndim == 3
-                    and y_out.shape[1] == m.shape[1]):
+                    and y_out.shape[0] == m.shape[0]
+                    and y_out.shape[1] == m.shape[1]
+                    and self._time_preserving(vconf, m.shape[1])):
                 masks[name] = m
         for n in self.layer_vertices:
             new_state.setdefault(n, state.get(n, {}))
@@ -285,6 +313,9 @@ class ComputationGraph:
                 mask=lmask)
         for name, v in self.layer_vertices.items():
             loss = loss + l1_l2_penalty(v.layer, params[name])
+        aux, new_state = pop_aux_losses(new_state)
+        if train:
+            loss = loss + aux
         extras = ({"carries": new_carries} if batch.get("carries") is not None
                   else {})
         return loss, (new_state, extras)

@@ -14,7 +14,9 @@ import jax.numpy as jnp
 
 from deeplearning4j_tpu.ops.flash_attention import (
     flash_attention,
+    flash_attention_qkv,
     supports as flash_supports,
+    supports_qkv as flash_supports_qkv,
 )
 from deeplearning4j_tpu.nn.conf.layers import (
     LayerNormalization,
@@ -141,18 +143,28 @@ class SelfAttentionImpl(LayerImpl):
         n = conf.n_out
         D = n // H
         qkv = x @ params["Wqkv"] + params["bqkv"]  # [B, T, 3n]
+        drop_attn = conf.attention_dropout if train else 0.0
+        if (getattr(conf, "use_flash", True)
+                and not _sp_axis_in_scope(getattr(conf, "seq_parallel_axis",
+                                                  ""))
+                and flash_supports_qkv(B, T, n, H, dropout=drop_attn)):
+            # packed path: the kernels read head column-slices straight
+            # from the projection output — no [B,T,H,D]->[B,H,T,D]
+            # relayout in either direction (r4 MFU item a)
+            out = flash_attention_qkv(qkv, H, causal=conf.causal, mask=mask)
+            y = out @ params["Wo"] + params["bo"]
+            return get_activation(conf.activation or "identity")(y), state
         q, k, v = jnp.split(qkv, 3, axis=-1)
 
         def heads(t):
             return t.reshape(B, T, H, D).transpose(0, 2, 1, 3)
 
         qh, kh, vh = heads(q), heads(k), heads(v)
-        drop = conf.attention_dropout if train else 0.0
         if _sp_axis_in_scope(getattr(conf, "seq_parallel_axis", "")):
             # inside the sequence-parallel shard_map: local q block attends
             # the K/V blocks rotating around the ICI ring; the full [T, T]
             # scores never exist on any one shard
-            if mask is not None or drop:
+            if mask is not None or drop_attn:
                 raise ValueError(
                     "sequence-parallel attention supports neither padding "
                     "masks nor attention dropout — pad to full length and "
@@ -165,8 +177,9 @@ class SelfAttentionImpl(LayerImpl):
                                  axis_name=conf.seq_parallel_axis,
                                  causal=conf.causal)
         elif getattr(conf, "use_flash", True) and flash_supports(
-                qh.shape, causal=conf.causal, dropout=drop, mask=mask):
-            out = flash_attention(qh, kh, vh, causal=conf.causal, mask=mask)
+                qh.shape, causal=conf.causal, dropout=drop_attn, mask=mask):
+            out = flash_attention(qh, kh, vh, causal=conf.causal, mask=mask,
+                                  dropout=drop_attn, dropout_rng=rng)
         else:
             out = dot_product_attention(
                 qh, kh, vh, causal=conf.causal, mask=mask,

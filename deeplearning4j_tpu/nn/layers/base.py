@@ -22,6 +22,29 @@ import jax.numpy as jnp
 
 _IMPL_REGISTRY: dict[type, "LayerImpl"] = {}
 
+# State-channel key for per-batch auxiliary losses (e.g. the MoE router
+# load-balance loss). A layer may stash a scalar under this key in its
+# returned state during training; the containers sum every such entry
+# into the training loss and the key never persists into eval state.
+AUX_LOSS_KEY = "__aux_loss__"
+
+
+def pop_aux_losses(state):
+    """Sum and REMOVE ephemeral `AUX_LOSS_KEY` scalars from a state pytree.
+    Returns (total, cleaned_state). The key must not survive into the
+    persisted state: it is per-batch, and leaving it in would change the
+    state pytree structure between init ({}) and post-forward (breaking
+    lax.scan carries and checkpoints)."""
+    total = 0.0
+    cleaned = {}
+    for name, s in state.items():
+        if isinstance(s, dict) and AUX_LOSS_KEY in s:
+            total = total + s[AUX_LOSS_KEY]
+            cleaned[name] = {k: v for k, v in s.items() if k != AUX_LOSS_KEY}
+        else:
+            cleaned[name] = s
+    return total, cleaned
+
 
 def register_impl(conf_cls):
     def wrap(impl_cls):

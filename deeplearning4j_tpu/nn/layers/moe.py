@@ -1,17 +1,38 @@
-"""Mixture-of-Experts layer with top-k gating + expert parallelism (EP).
+"""Mixture-of-Experts layer: top-k router with token-routed dispatch + EP.
 
 New TPU-first capability (no reference analogue — the reference predates
-MoE): E expert FFNs with a learned router. The dense path computes every
-expert for every token and masks by the top-k gate — compiler-friendly
-(static shapes, no gather/scatter of token groups) and exact; the
-expert-parallel path (parallel/expert_parallel.py) shards the expert
-dimension over a mesh axis and psum-combines partial outputs, bitwise
-matching the dense path on any device count.
+MoE): E expert FFNs with a learned router. Two execution paths:
+
+- ``routing="routed"`` (default): GShard/Switch-style capacity-factor
+  einsum dispatch. Tokens are split into groups of ``router_group_size``;
+  within each group every token's top-k experts claim a slot in that
+  expert's capacity buffer (C = ceil(S * top_k * capacity_factor / E),
+  token-order priority), a one-hot dispatch tensor [G,S,E,C] gathers the
+  claimed tokens into [E,G,C,D], the expert FFNs run as batched einsums
+  over the E-leading stacked weights, and a combine einsum (dispatch x
+  renormalized gate) scatters results back. Everything is static-shaped
+  einsum — MXU-friendly, differentiable, and GSPMD shards it over an
+  'expert' mesh axis from the weight shardings alone (the combine's
+  contraction over E becomes the psum; data-sharded tokens x
+  expert-sharded buffers become the all-to-all). Tokens over capacity are
+  dropped (contribute zero; the surrounding residual carries them) — the
+  router is regularized toward balance by a Switch-style aux loss
+  (``router_aux_weight``) surfaced through the layer-state channel as
+  ``__aux_loss__`` and summed into the training loss by the containers.
+
+- ``routing="dense"``: every expert on every token, zero-masked by the
+  gate. Exact, smooth (finite-difference-checkable), no drops — the
+  parity oracle for the routed path and the manual EP shard_map
+  (parallel/expert_parallel.py). At top_k/E compute overcost E/top_k.
+
+With ample capacity (capacity_factor >= E/top_k) the routed path drops
+nothing and matches the dense path to float tolerance.
 """
 
 from __future__ import annotations
 
 import dataclasses
+import math
 
 import jax
 import jax.numpy as jnp
@@ -20,6 +41,7 @@ from deeplearning4j_tpu.nn.conf.inputs import InputType
 from deeplearning4j_tpu.nn.conf.layers import FeedForwardLayer
 from deeplearning4j_tpu.nn.conf.serde import register_config
 from deeplearning4j_tpu.nn.layers.base import (
+    AUX_LOSS_KEY,
     LayerImpl,
     apply_dropout,
     register_impl,
@@ -36,6 +58,10 @@ class MixtureOfExpertsLayer(FeedForwardLayer):
     n_experts: int = 8
     top_k: int = 2
     d_hidden: int = 0  # defaults to 4*n_in
+    routing: str = "routed"  # "routed" (capacity dispatch) | "dense" (oracle)
+    capacity_factor: float = 1.25
+    router_group_size: int = 0  # tokens per routing group; 0 = auto (<=1024)
+    router_aux_weight: float = 0.01  # Switch-style load-balance loss weight
 
     def get_output_type(self, input_type: InputType) -> InputType:
         if input_type.kind == "recurrent":
@@ -43,23 +69,121 @@ class MixtureOfExpertsLayer(FeedForwardLayer):
         return InputType.feed_forward(self.n_out)
 
 
-def moe_gates(x2d, Wg, top_k):
-    """Top-k renormalized softmax gates [N, E] (zeros outside the top-k)."""
-    logits = x2d @ Wg                                     # [N, E]
+def moe_gates_from_logits(logits, top_k):
+    """Top-k renormalized softmax gates [N, E] (zeros outside the top-k).
+
+    For the practical regime (small k, modest E) the top-k runs as k
+    argmax+mask passes and the gate matrix is built from one-hots —
+    lax.top_k lowers to a full sort and the scatter writing [N, E] cost
+    ~2 ms each at [16k, 8] on v5e (r4 trace); the iterative form fuses
+    into cheap VPU elementwise work. Tie-breaking (first index wins)
+    matches lax.top_k.
+    """
     E = logits.shape[-1]
+    N = logits.shape[0]
+    if top_k <= 4 and E <= 64:
+        x = logits
+        onehots, vals = [], []
+        for _ in range(top_k):
+            i = jnp.argmax(x, axis=-1)
+            oh = jax.nn.one_hot(i, E, dtype=logits.dtype)   # [N, E]
+            vals.append(jnp.max(x, axis=-1))
+            onehots.append(oh)
+            x = jnp.where(oh > 0, jnp.finfo(x.dtype).min, x)
+        probs = jax.nn.softmax(jnp.stack(vals, -1), axis=-1)  # [N, k]
+        gates = sum(oh * probs[:, j:j + 1] for j, oh in enumerate(onehots))
+        return gates
     top_vals, top_idx = jax.lax.top_k(logits, top_k)      # [N, k]
     probs = jax.nn.softmax(top_vals, axis=-1)             # renormalized
-    gates = jnp.zeros((x2d.shape[0], E), logits.dtype).at[
-        jnp.arange(x2d.shape[0])[:, None], top_idx].set(probs)
+    gates = jnp.zeros((N, E), logits.dtype).at[
+        jnp.arange(N)[:, None], top_idx].set(probs)
     return gates
 
 
+def moe_gates(x2d, Wg, top_k):
+    """Top-k renormalized softmax gates [N, E] (zeros outside the top-k)."""
+    return moe_gates_from_logits(x2d @ Wg, top_k)
+
+
 def moe_expert_outputs(params, x2d, activation):
-    """All experts applied to all tokens: [N, E, n_out]."""
+    """All experts applied to all tokens: [N, E, n_out] (dense oracle)."""
     act = get_activation(activation)
     h = jnp.einsum("nd,edh->neh", x2d, params["We1"]) + params["be1"]
     h = act(h)
     return jnp.einsum("neh,eho->neo", h, params["We2"]) + params["be2"]
+
+
+def moe_apply_dense(params, x2d, *, top_k, activation):
+    """Dense-path MoE forward: compute-all-experts, gate-masked combine."""
+    gates = moe_gates(x2d, params["Wg"], top_k)            # [N, E]
+    outs = moe_expert_outputs(params, x2d, activation)     # [N, E, O]
+    return jnp.einsum("ne,neo->no", gates, outs)
+
+
+def expert_capacity(group_size, top_k, capacity_factor, n_experts):
+    """Per-group per-expert capacity, rounded up to a multiple of 8
+    (sublane-friendly), capped at group_size * top_k (never useful past
+    every token claiming every one of its k slots in one expert)."""
+    c = math.ceil(group_size * top_k * capacity_factor / n_experts)
+    c = -(-c // 8) * 8
+    return min(c, group_size * top_k)
+
+
+def moe_load_balance_loss(logits, gates, top_k):
+    """Switch Transformer aux loss (arXiv:2101.03961 eq. 4 generalized to
+    top-k): E * sum_e f_e * P_e, where f_e is the fraction of routing
+    assignments sent to expert e and P_e the mean full-softmax router
+    probability. Minimized (=1) at uniform routing; gradient reaches the
+    router only (f is piecewise-constant)."""
+    E = logits.shape[-1]
+    probs = jax.nn.softmax(logits.astype(jnp.float32), axis=-1)   # [N, E]
+    frac = jnp.mean((gates > 0).astype(jnp.float32), axis=0) / top_k
+    importance = jnp.mean(probs, axis=0)
+    return E * jnp.sum(frac * importance)
+
+
+def moe_apply_routed(params, x2d, *, top_k, capacity_factor, activation,
+                     group_size=0, return_aux=False):
+    """Token-routed MoE forward via capacity-factor einsum dispatch.
+
+    Returns y [N, O] (and the unweighted load-balance aux loss when
+    ``return_aux``). Within each group, slots are claimed in token order;
+    a token whose expert buffer is full is dropped (zero output row).
+    """
+    N, D = x2d.shape
+    E = params["We1"].shape[0]
+    O = params["We2"].shape[-1]
+    S = group_size or min(N, 1024)
+    G = -(-N // S)
+    pad = G * S - N
+
+    logits = x2d @ params["Wg"]                            # [N, E]
+    gates = moe_gates_from_logits(logits, top_k)
+    aux = moe_load_balance_loss(logits, gates, top_k) if return_aux else None
+
+    xp = jnp.pad(x2d, ((0, pad), (0, 0))) if pad else x2d
+    gg = (jnp.pad(gates, ((0, pad), (0, 0))) if pad else gates)
+    gg = gg.reshape(G, S, E)
+    C = expert_capacity(S, top_k, capacity_factor, E)
+
+    routed = gg > 0                                        # [G, S, E]
+    pos = jnp.cumsum(routed.astype(jnp.int32), axis=1) - 1  # slot per expert
+    keep = routed & (pos < C)
+    # one_hot(-1) is the all-zero row: dropped/pad tokens vanish from both
+    # the dispatch gather and the combine scatter.
+    dispatch = jax.nn.one_hot(jnp.where(keep, pos, -1), C, dtype=xp.dtype)
+    combine = dispatch * gg[..., None].astype(xp.dtype)    # [G, S, E, C]
+
+    xg = xp.reshape(G, S, D)
+    expert_in = jnp.einsum("gsec,gsd->egcd", dispatch, xg)  # [E, G, C, D]
+    act = get_activation(activation)
+    h = act(jnp.einsum("egcd,edh->egch", expert_in, params["We1"])
+            + params["be1"][:, None, None, :])
+    out = (jnp.einsum("egch,eho->egco", h, params["We2"])
+           + params["be2"][:, None, None, :])
+    y = jnp.einsum("gsec,egco->gso", combine, out).reshape(G * S, O)
+    y = y[:N] if pad else y
+    return (y, aux) if return_aux else y
 
 
 @register_impl(MixtureOfExpertsLayer)
@@ -87,8 +211,21 @@ class MixtureOfExpertsImpl(LayerImpl):
             x = apply_dropout(x, conf.dropout, rng, train=train)
         shape = x.shape
         x2d = x.reshape(-1, shape[-1])
-        gates = moe_gates(x2d, params["Wg"], conf.top_k)   # [N, E]
-        outs = moe_expert_outputs(params, x2d, conf.activation or "gelu")
-        y = jnp.einsum("ne,neo->no", gates, outs)
+        new_state = {k: v for k, v in state.items() if k != AUX_LOSS_KEY}
+        if conf.routing == "dense":
+            y = moe_apply_dense(params, x2d, top_k=conf.top_k,
+                                activation=conf.activation or "gelu")
+        else:
+            want_aux = train and conf.router_aux_weight > 0
+            out = moe_apply_routed(
+                params, x2d, top_k=conf.top_k,
+                capacity_factor=conf.capacity_factor,
+                activation=conf.activation or "gelu",
+                group_size=conf.router_group_size, return_aux=want_aux)
+            if want_aux:
+                y, aux = out
+                new_state[AUX_LOSS_KEY] = conf.router_aux_weight * aux
+            else:
+                y = out
         y = y.reshape(*shape[:-1], y.shape[-1])
-        return y, state
+        return y, new_state

@@ -41,6 +41,7 @@ from deeplearning4j_tpu.nn.conf.layers import (
 )
 from deeplearning4j_tpu.nn.conf.neural_net_configuration import MultiLayerConfiguration
 from deeplearning4j_tpu.nn.layers import get_impl, l1_l2_penalty
+from deeplearning4j_tpu.nn.layers.base import pop_aux_losses
 from deeplearning4j_tpu.nn.training import make_train_step, tree_cast
 from deeplearning4j_tpu.nn.updater import build_optimizer
 
@@ -217,6 +218,9 @@ class MultiLayerNetwork:
         # L1/L2 (reference BaseLayer calcL1/calcL2 summed into score)
         for name, lc in zip(self.layer_names, self.layer_confs):
             loss = loss + l1_l2_penalty(lc, params[name])
+        aux, new_state = pop_aux_losses(new_state)
+        if train:
+            loss = loss + aux
         extras = {"carries": new_carries} if carries is not None else {}
         return loss, (new_state, extras)
 

@@ -102,13 +102,63 @@ def _single_transform(conf, updater, lr_sched):
                      f"optax.GradientTransformation via network.set_optimizer)")
 
 
-def build_optimizer(conf, layer_confs):
+import typing
+
+
+class FlatViewTransform(typing.NamedTuple):
+    """A GradientTransformation running its inner update over ONE
+    concatenated f32 vector. The per-leaf moment updates of adam & friends
+    compile to dozens of small fusions (~0.9 ms/step at the 13M-param
+    transformer bench, r4 trace); over the flat view they are a single
+    fused elementwise kernel. Only valid for ELEMENTWISE update rules
+    (sgd/momentum/adam/adamw/adagrad/adadelta/rmsprop/lion) — anything
+    with per-layer geometry (lamb trust ratios, multi_transform) keeps the
+    tree layout. The mesh paths (TP/EP/PP placement, ZeRO-1) rebuild a
+    tree-shaped optimizer via build_optimizer(flat=False): a flat state
+    cannot carry per-leaf shardings."""
+
+    init: typing.Callable
+    update: typing.Callable
+
+
+_FLAT_OK = {Updater.SGD, Updater.NESTEROVS, Updater.ADAM, Updater.ADAMW,
+            Updater.ADADELTA, Updater.ADAGRAD, Updater.RMSPROP,
+            Updater.LION, Updater.NONE, None}
+
+
+def _flatten_leaves(tree):
+    return jnp.concatenate(
+        [jnp.ravel(l).astype(jnp.float32) for l in jax.tree.leaves(tree)])
+
+
+def flatten_transform(inner) -> FlatViewTransform:
+    def init(params):
+        return inner.init(_flatten_leaves(params))
+
+    def update(grads, state, params=None):
+        leaves, treedef = jax.tree.flatten(grads)
+        flat_g = _flatten_leaves(grads)
+        flat_p = None if params is None else _flatten_leaves(params)
+        upd, new_state = inner.update(flat_g, state, flat_p)
+        outs = []
+        off = 0
+        for l in leaves:
+            seg = jax.lax.dynamic_slice_in_dim(upd, off, l.size, 0)
+            outs.append(seg.reshape(l.shape).astype(l.dtype))
+            off += l.size
+        return jax.tree.unflatten(treedef, outs), new_state
+
+    return FlatViewTransform(init, update)
+
+
+def build_optimizer(conf, layer_confs, flat: bool = True):
     """Build the network optimizer.
 
     layer_confs: {layer_name: layer_conf}. If no layer overrides
     updater/learning_rate the result is a single transform; otherwise an
     optax.multi_transform keyed by top-level param-tree key (= layer name),
-    mirroring the reference's MultiLayerUpdater.
+    mirroring the reference's MultiLayerUpdater. `flat` (default) lets an
+    elementwise update rule run fused over the flat param view.
     """
     overrides = {
         name: lc for name, lc in layer_confs.items()
@@ -116,7 +166,16 @@ def build_optimizer(conf, layer_confs):
         or getattr(lc, "learning_rate", None) is not None
     }
     if not overrides:
-        return _single_transform(conf, conf.updater, make_schedule(conf))
+        tx = _single_transform(conf, conf.updater, make_schedule(conf))
+        u = conf.updater
+        if isinstance(u, str):
+            try:
+                u = Updater(u)
+            except ValueError:
+                u = None if u == "" else u
+        if flat and u in _FLAT_OK:
+            return flatten_transform(tx)
+        return tx
 
     transforms = {"__default__": _single_transform(conf, conf.updater, make_schedule(conf))}
     labels = {}

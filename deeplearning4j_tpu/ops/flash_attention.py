@@ -21,10 +21,12 @@ dot_generals amortize the overhead; measured 263us -> 129us per fwd call
 at B32 H4 T512 D64 on v5e). G is sized against the 16MB scoped-VMEM
 budget and drops to 1 when key/value blocks stream (T > block cap).
 
-Constraints: T divisible by the block size (128), no attention dropout
-(the dense path handles it); [B, T] key padding masks fold into the block
-predicates, so variable-length batches keep the fused path; head_dim is
-padded to the 128-lane tile internally by Mosaic when smaller.
+Constraints: T divisible by the block size (128); [B, T] key padding
+masks fold into the block predicates, so variable-length batches keep the
+fused path; attention dropout runs IN-KERNEL via a counter-hash keep mask
+(r4); head_dim is padded to the 128-lane tile internally by Mosaic when
+smaller, and head_dim % 128 == 0 unlocks the packed-qkv no-relayout entry
+point (flash_attention_qkv).
 
 Falls back to interpret mode off-TPU so the unit tests exercise the same
 kernel code on CPU.
@@ -97,15 +99,81 @@ def _use_interpret() -> bool:
     return jax.default_backend() != "tpu"
 
 
+# ------------------------------------------------- in-kernel dropout hash
+#
+# Attention dropout inside the kernels (VERDICT r3 #6) uses a COUNTER-BASED
+# hash instead of pltpu.prng_*: the keep decision for score element
+# (bh, gq, gk) is murmur3-fmix32 of its absolute coordinates + the step
+# seed, so every kernel (fwd/dq/dkv/fused, any block size or G-batching)
+# regenerates the identical mask, and CPU interpret mode matches TPU
+# bit-for-bit (pltpu's PRNG is a zero-stub under interpret). ~10 u32 VPU
+# ops per element — noise next to the exp.
+
+def _fmix32(x):
+    u = jnp.uint32
+    x = x ^ (x >> u(16))
+    x = x * u(0x85EBCA6B)
+    x = x ^ (x >> u(13))
+    x = x * u(0xC2B2AE35)
+    x = x ^ (x >> u(16))
+    return x
+
+
+def _keep_mask(seed, bh0, stride, G, q0, k0, bq, bk, seq_len, rate):
+    """[G, bq, bk] bool keep mask. seed: traced scalar; bh0: this
+    program's first absolute batch*head row; stride: bh step between the
+    G slices; q0/k0: absolute row/col offsets of the block."""
+    u = jnp.uint32
+    bh = (jnp.asarray(bh0).astype(jnp.uint32)
+          + jax.lax.broadcasted_iota(jnp.uint32, (G, 1, 1), 0) * u(stride))
+    key = _fmix32(seed.astype(jnp.uint32) + bh * u(0x9E3779B9))
+    gq = (jnp.asarray(q0).astype(jnp.uint32)
+          + jax.lax.broadcasted_iota(jnp.uint32, (bq, bk), 0))
+    gk = (jnp.asarray(k0).astype(jnp.uint32)
+          + jax.lax.broadcasted_iota(jnp.uint32, (bq, bk), 1))
+    h = _fmix32(key + (gq * u(seq_len) + gk)[None])
+    thr = u(min(int((1.0 - rate) * 4294967296.0), 4294967295))
+    return h < thr
+
+
+def dropout_keep_mask_host(seed, bh, T, rate):
+    """NumPy twin of the kernels' keep mask for one bh slice: [T, T]
+    bool. Test oracle — reconstructs the exact in-kernel mask."""
+    def fmix(x):
+        x = np.uint32(x).copy()
+        x ^= x >> np.uint32(16)
+        x = (x * np.uint32(0x85EBCA6B)).astype(np.uint32)
+        x ^= x >> np.uint32(13)
+        x = (x * np.uint32(0xC2B2AE35)).astype(np.uint32)
+        x ^= x >> np.uint32(16)
+        return x
+
+    with np.errstate(over="ignore"):
+        key = fmix(np.uint32(seed) + np.uint32(bh) * np.uint32(0x9E3779B9))
+        gq, gk = np.meshgrid(np.arange(T, dtype=np.uint32),
+                             np.arange(T, dtype=np.uint32), indexing="ij")
+        h = fmix((key + gq * np.uint32(T) + gk).astype(np.uint32))
+        thr = np.uint32(min(int((1.0 - rate) * 4294967296.0), 4294967295))
+    return h < thr
+
+
 # ------------------------------------------------------------------ forward
 
 def _fwd_kernel(q_ref, k_ref, v_ref, *rest, sm_scale, causal, masked,
-                block_q, block_k, seq_len):
-    if masked:
-        kmask_ref, o_ref, lse_ref = rest
-    else:
-        o_ref, lse_ref = rest
+                block_q, block_k, seq_len, dropout=0.0, bh_stride=1):
+    rest = list(rest)
+    kmask_ref = rest.pop(0) if masked else None
+    seed_ref = rest.pop(0) if dropout else None
+    o_ref, lse_ref = rest
     qi = pl.program_id(1)
+    if dropout:
+        G_ = q_ref.shape[0]
+        bh0 = pl.program_id(0) * G_ * bh_stride
+
+        def keep_scale(q0, k0, bq, bk):
+            keep = _keep_mask(seed_ref[0, 0], bh0, bh_stride, G_, q0, k0,
+                              bq, bk, seq_len, dropout)
+            return keep.astype(jnp.float32) * (1.0 / (1.0 - dropout))
     # keep the MXU operands in the input dtype (bf16 on TPU runs the MXU at
     # full rate; f32 operands decompose into multiple passes) and accumulate
     # in f32 via preferred_element_type; only softmax math is f32.
@@ -137,11 +205,18 @@ def _fwd_kernel(q_ref, k_ref, v_ref, *rest, sm_scale, causal, masked,
         p = jnp.exp((s - m[..., None]).astype(vb.dtype))
         l = jnp.maximum(
             jnp.sum(p.astype(jnp.float32), axis=-1), 1e-30)
+        pd = p
+        if dropout:
+            # drop normalized-attention mass: l comes from the UNDROPPED
+            # p (dense semantics: dropout applies to softmax output)
+            pd = (p * keep_scale(0, 0, seq_len, seq_len).astype(p.dtype))
         acc = jax.lax.dot_general(
-            p, vb, (((2,), (1,)), ((0,), (0,))),
+            pd, vb, (((2,), (1,)), ((0,), (0,))),
             preferred_element_type=jnp.float32)
         o_ref[...] = (acc / l[..., None]).astype(o_ref.dtype)
-        lse_ref[:, 0] = m + jnp.log(l)
+        # reshape-write keeps this branch layout-agnostic: the flat path
+        # passes a [G, 1, T] lse block, the packed-qkv path [G, 1, 1, T]
+        lse_ref[...] = (m + jnp.log(l)).reshape(lse_ref.shape)
         return
 
     hi = (qi * block_q) // block_k + 1 if causal else nk
@@ -173,8 +248,12 @@ def _fwd_kernel(q_ref, k_ref, v_ref, *rest, sm_scale, causal, masked,
         p = jnp.exp(s - m_new[..., None])
         alpha = jnp.exp(m - m_new)
         l = l * alpha + jnp.sum(p, axis=-1)
+        pd = p
+        if dropout:
+            pd = p * keep_scale(qi * block_q, j * block_k,
+                                block_q, block_k)
         acc = acc * alpha[..., None] + jax.lax.dot_general(
-            p.astype(vb.dtype), vb, (((2,), (1,)), ((0,), (0,))),
+            pd.astype(vb.dtype), vb, (((2,), (1,)), ((0,), (0,))),
             preferred_element_type=jnp.float32)            # [G, bq, D]
         return m_new, l, acc
 
@@ -192,16 +271,17 @@ def _fwd_kernel(q_ref, k_ref, v_ref, *rest, sm_scale, causal, masked,
     lse_ref[:, 0] = m + jnp.log(l)
 
 
-def _flash_fwd(q, k, v, kmask, sm_scale, causal):
+def _flash_fwd(q, k, v, kmask, sm_scale, causal, dropout=0.0, seed=None):
     BH, T, D = q.shape
     block_q, block_k = _block_sizes(T)
     masked = kmask is not None
-    G = (_pick_g(BH, T, D, _fwd_slice_bytes(T, D))
+    extra = int(T * T * 4) if dropout else 0  # f32 keep mask per slice
+    G = (_pick_g(BH, T, D, _fwd_slice_bytes(T, D) + extra)
          if block_q == T and block_k == T else 1)
     grid = (BH // G, T // block_q)
     kern = functools.partial(_fwd_kernel, sm_scale=sm_scale, causal=causal,
                              masked=masked, block_q=block_q,
-                             block_k=block_k, seq_len=T)
+                             block_k=block_k, seq_len=T, dropout=dropout)
     in_specs = [
         pl.BlockSpec((G, block_q, D), lambda bh, qi: (bh, qi, 0)),
         pl.BlockSpec((G, T, D), lambda bh, qi: (bh, 0, 0)),
@@ -211,6 +291,9 @@ def _flash_fwd(q, k, v, kmask, sm_scale, causal):
     if masked:
         in_specs.append(pl.BlockSpec((G, 1, T), lambda bh, qi: (bh, 0, 0)))
         args.append(kmask)
+    if dropout:
+        in_specs.append(pl.BlockSpec((1, 1), lambda bh, qi: (0, 0)))
+        args.append(seed)
     o, lse = pl.pallas_call(
         kern,
         grid=grid,
@@ -232,11 +315,12 @@ def _flash_fwd(q, k, v, kmask, sm_scale, causal):
 # ----------------------------------------------------------------- backward
 
 def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, *rest,
-               sm_scale, causal, masked, block_q, block_k, seq_len):
-    if masked:
-        kmask_ref, dq_ref = rest
-    else:
-        (dq_ref,) = rest
+               sm_scale, causal, masked, block_q, block_k, seq_len,
+               dropout=0.0, bh_stride=1):
+    rest = list(rest)
+    kmask_ref = rest.pop(0) if masked else None
+    seed_ref = rest.pop(0) if dropout else None
+    (dq_ref,) = rest
     qi = pl.program_id(1)
     q = q_ref[...]                                          # [G, bq, D]
     do = do_ref[...]
@@ -264,6 +348,12 @@ def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, *rest,
         p = jnp.exp(s - lse[..., None])                    # [G, bq, bk]
         dp = jax.lax.dot_general(do, vb, (((2,), (2,)), ((0,), (0,))),
                                  preferred_element_type=jnp.float32)
+        if dropout:
+            ks = _keep_mask(seed_ref[0, 0],
+                            pl.program_id(0) * G * bh_stride, bh_stride,
+                            G, qi * block_q, j * block_k, block_q,
+                            block_k, seq_len, dropout).astype(jnp.float32)
+            dp = dp * (ks * (1.0 / (1.0 - dropout)))
         ds = (p * (dp - delta[..., None]) * sm_scale).astype(kb.dtype)
         return dq + jax.lax.dot_general(
             ds, kb, (((2,), (1,)), ((0,), (0,))),
@@ -275,11 +365,12 @@ def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, *rest,
 
 
 def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, *rest,
-                sm_scale, causal, masked, block_q, block_k, seq_len):
-    if masked:
-        kmask_ref, dk_ref, dv_ref = rest
-    else:
-        dk_ref, dv_ref = rest
+                sm_scale, causal, masked, block_q, block_k, seq_len,
+                dropout=0.0, bh_stride=1):
+    rest = list(rest)
+    kmask_ref = rest.pop(0) if masked else None
+    seed_ref = rest.pop(0) if dropout else None
+    dk_ref, dv_ref = rest
     ki = pl.program_id(1)
     kb = k_ref[...]                                         # [G, bk, D]
     vb = v_ref[...]
@@ -306,11 +397,20 @@ def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, *rest,
             km = kmask_ref[:, 0]                           # [G, bk]
             s = jnp.where(km[:, None, :] > 0, s, NEG_INF)
         p = jnp.exp(s - lse[..., None])                    # [G, bq, bk]
-        dv = dv + jax.lax.dot_general(
-            p.astype(dob.dtype), dob, (((1,), (1,)), ((0,), (0,))),
-            preferred_element_type=jnp.float32)            # [G, bk, D]
+        pd = p
         dp = jax.lax.dot_general(dob, vb, (((2,), (2,)), ((0,), (0,))),
                                  preferred_element_type=jnp.float32)
+        if dropout:
+            ks = _keep_mask(seed_ref[0, 0],
+                            pl.program_id(0) * G * bh_stride, bh_stride,
+                            G, j * block_q, ki * block_k, block_q,
+                            block_k, seq_len, dropout).astype(jnp.float32)
+            ks = ks * (1.0 / (1.0 - dropout))
+            pd = p * ks
+            dp = dp * ks
+        dv = dv + jax.lax.dot_general(
+            pd.astype(dob.dtype), dob, (((1,), (1,)), ((0,), (0,))),
+            preferred_element_type=jnp.float32)            # [G, bk, D]
         ds = (p * (dp - delta[..., None]) * sm_scale).astype(qb.dtype)
         dk = dk + jax.lax.dot_general(
             ds, qb, (((1,), (1,)), ((0,), (0,))),
@@ -326,22 +426,24 @@ def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, *rest,
 
 
 def _bwd_fused_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
-                      *rest, sm_scale, causal, masked, seq_len):
+                      *rest, sm_scale, causal, masked, seq_len,
+                      dropout=0.0, bh_stride=1):
     """Single-pass backward for the block == T case (T <= BLOCK_K_MAX,
     i.e. _block_sizes gave both blocks the whole sequence): with Q, K and
     V all resident, one recompute of the probabilities feeds dq, dk AND
     dv — the two-kernel path recomputes them twice. Grid is (BH/G,); no
     cross-block accumulation exists at this size."""
-    if masked:
-        kmask_ref, dq_ref, dk_ref, dv_ref = rest
-    else:
-        dq_ref, dk_ref, dv_ref = rest
+    rest = list(rest)
+    kmask_ref = rest.pop(0) if masked else None
+    seed_ref = rest.pop(0) if dropout else None
+    dq_ref, dk_ref, dv_ref = rest
     qb = q_ref[...]                                         # [G, T, D]
     dob = do_ref[...]
     kb = k_ref[...]
     vb = v_ref[...]
-    lse = lse_ref[:, 0]                                     # [G, T]
-    delta = delta_ref[:, 0]
+    G = qb.shape[0]
+    lse = lse_ref[...].reshape(G, seq_len)                  # [G, T]
+    delta = delta_ref[...].reshape(G, seq_len)
     s = sm_scale * jax.lax.dot_general(
         qb, kb, (((2,), (2,)), ((0,), (0,))),
         preferred_element_type=jnp.float32)                 # [G, T, T]
@@ -357,24 +459,34 @@ def _bwd_fused_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
     # p/ds as bf16 regardless
     cdt = kb.dtype
     p = jnp.exp((s - lse[..., None]).astype(cdt))
+    pd = p
     dp = jax.lax.dot_general(dob, vb, (((2,), (2,)), ((0,), (0,))),
                              preferred_element_type=jnp.float32)
+    if dropout:
+        bh0 = pl.program_id(0) * G * bh_stride
+        ks = _keep_mask(seed_ref[0, 0], bh0, bh_stride, G, 0, 0, seq_len,
+                        seq_len, seq_len, dropout).astype(jnp.float32)
+        ks = ks * (1.0 / (1.0 - dropout))
+        pd = p * ks.astype(cdt)
+        dp = dp * ks
     ds = (p * ((dp - delta[..., None]) * sm_scale).astype(cdt))
     dq_ref[...] = jax.lax.dot_general(
         ds, kb, (((2,), (1,)), ((0,), (0,))),
         preferred_element_type=jnp.float32).astype(dq_ref.dtype)
     dv_ref[...] = jax.lax.dot_general(
-        p.astype(dob.dtype), dob, (((1,), (1,)), ((0,), (0,))),
+        pd.astype(dob.dtype), dob, (((1,), (1,)), ((0,), (0,))),
         preferred_element_type=jnp.float32).astype(dv_ref.dtype)
     dk_ref[...] = jax.lax.dot_general(
         ds, qb, (((1,), (1,)), ((0,), (0,))),
         preferred_element_type=jnp.float32).astype(dk_ref.dtype)
 
 
-def _flash_bwd_fused(q, k, v, do, lse, delta, kmask, sm_scale, causal):
+def _flash_bwd_fused(q, k, v, do, lse, delta, kmask, sm_scale, causal,
+                     dropout=0.0, seed=None):
     BH, T, D = q.shape
     masked = kmask is not None
-    G = _pick_g(BH, T, D, _bwd_slice_bytes(T, D))
+    extra = int(T * T * 4) if dropout else 0
+    G = _pick_g(BH, T, D, _bwd_slice_bytes(T, D) + extra)
     fullblock = pl.BlockSpec((G, T, D), lambda bh: (bh, 0, 0))
     lblock = pl.BlockSpec((G, 1, T), lambda bh: (bh, 0, 0))
     in_specs = [fullblock, fullblock, fullblock, fullblock, lblock, lblock]
@@ -382,9 +494,13 @@ def _flash_bwd_fused(q, k, v, do, lse, delta, kmask, sm_scale, causal):
     if masked:
         in_specs.append(pl.BlockSpec((G, 1, T), lambda bh: (bh, 0, 0)))
         args.append(kmask)
+    if dropout:
+        in_specs.append(pl.BlockSpec((1, 1), lambda bh: (0, 0)))
+        args.append(seed)
     return pl.pallas_call(
         functools.partial(_bwd_fused_kernel, sm_scale=sm_scale,
-                          causal=causal, masked=masked, seq_len=T),
+                          causal=causal, masked=masked, seq_len=T,
+                          dropout=dropout),
         grid=(BH // G,),
         in_specs=in_specs,
         out_specs=[fullblock, fullblock, fullblock],
@@ -398,11 +514,17 @@ def _flash_bwd_fused(q, k, v, do, lse, delta, kmask, sm_scale, causal):
     )(*args)
 
 
-def _flash_bwd_impl(q, k, v, o, lse, do, kmask, sm_scale, causal):
+def _flash_bwd_impl(q, k, v, o, lse, do, kmask, sm_scale, causal,
+                    dlse=None, dropout=0.0, seed=None):
     BH, T, D = q.shape
     block_q, block_k = _block_sizes(T)
     masked = kmask is not None
     delta = jnp.sum(do.astype(jnp.float32) * o.astype(jnp.float32), axis=-1)
+    if dlse is not None:
+        # lse cotangent (ring-attention merge weights differentiate
+        # through lse): d lse/d s = p, so ds = p*(dp - delta + dlse) —
+        # folding -dlse into delta reuses the kernels unchanged
+        delta = delta - dlse.astype(jnp.float32)
     # [BH, 1, T] layout for the per-row scalars (tile-legal via the
     # middle singleton dim) — replaces the r2 [BH, T, LANES] broadcast
     lse = lse[:, None, :]
@@ -412,7 +534,7 @@ def _flash_bwd_impl(q, k, v, o, lse, do, kmask, sm_scale, causal):
         # whole Q/K/V per program: one fused kernel emits dq, dk and dv
         # from a single probability recompute
         return _flash_bwd_fused(q, k, v, do, lse, delta, kmask, sm_scale,
-                                causal)
+                                causal, dropout=dropout, seed=seed)
 
     dq_specs = [
         pl.BlockSpec((1, block_q, D), lambda bh, qi: (bh, qi, 0)),
@@ -426,10 +548,13 @@ def _flash_bwd_impl(q, k, v, o, lse, do, kmask, sm_scale, causal):
     if masked:
         dq_specs.append(pl.BlockSpec((1, 1, T), lambda bh, qi: (bh, 0, 0)))
         dq_args.append(kmask)
+    if dropout:
+        dq_specs.append(pl.BlockSpec((1, 1), lambda bh, qi: (0, 0)))
+        dq_args.append(seed)
     dq = pl.pallas_call(
         functools.partial(_dq_kernel, sm_scale=sm_scale, causal=causal,
                           masked=masked, block_q=block_q, block_k=block_k,
-                          seq_len=T),
+                          seq_len=T, dropout=dropout),
         grid=(BH, T // block_q),
         in_specs=dq_specs,
         out_specs=pl.BlockSpec((1, block_q, D), lambda bh, qi: (bh, qi, 0)),
@@ -450,10 +575,13 @@ def _flash_bwd_impl(q, k, v, o, lse, do, kmask, sm_scale, causal):
         dkv_specs.append(pl.BlockSpec((1, 1, block_k),
                                       lambda bh, ki: (bh, 0, ki)))
         dkv_args.append(kmask)
+    if dropout:
+        dkv_specs.append(pl.BlockSpec((1, 1), lambda bh, ki: (0, 0)))
+        dkv_args.append(seed)
     dk, dv = pl.pallas_call(
         functools.partial(_dkv_kernel, sm_scale=sm_scale, causal=causal,
                           masked=masked, block_q=block_q, block_k=block_k,
-                          seq_len=T),
+                          seq_len=T, dropout=dropout),
         grid=(BH, T // block_k),
         in_specs=dkv_specs,
         out_specs=[
@@ -511,6 +639,208 @@ def _flash_core_masked_bwd(sm_scale, causal, res, do):
 _flash_core_masked.defvjp(_flash_core_masked_fwd, _flash_core_masked_bwd)
 
 
+@functools.partial(jax.custom_vjp, nondiff_argnums=(5, 6, 7))
+def _flash_core_drop(q, k, v, kmask, seed, sm_scale, causal, dropout):
+    """Dropout-enabled core (kmask always an operand — pass ones when
+    there is no padding mask; seed: [1,1] int32 step key)."""
+    o, _ = _flash_fwd(q, k, v, kmask, sm_scale, causal, dropout=dropout,
+                      seed=seed)
+    return o
+
+
+def _flash_core_drop_fwd(q, k, v, kmask, seed, sm_scale, causal, dropout):
+    o, lse = _flash_fwd(q, k, v, kmask, sm_scale, causal, dropout=dropout,
+                        seed=seed)
+    return o, (q, k, v, o, lse, kmask, seed)
+
+
+def _flash_core_drop_bwd(sm_scale, causal, dropout, res, do):
+    q, k, v, o, lse, kmask, seed = res
+    dq, dk, dv = _flash_bwd_impl(q, k, v, o, lse, do, kmask, sm_scale,
+                                 causal, dropout=dropout, seed=seed)
+    return dq, dk, dv, jnp.zeros_like(kmask), jnp.zeros_like(seed)
+
+
+_flash_core_drop.defvjp(_flash_core_drop_fwd, _flash_core_drop_bwd)
+
+
+# --------------------------------------------- (o, lse) core for ring hops
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4))
+def flash_attention_lse(q, k, v, sm_scale, causal):
+    """Flat-layout flash returning BOTH outputs: (o [BH, T, D], lse
+    [BH, T]) — differentiable in o AND lse. This is the per-hop primitive
+    of ring attention (parallel/ring_attention.py): each hop's normalized
+    block result merges with the carry via the two-way lse combine, whose
+    weights need d(lse) to flow. Requires T % 128 == 0."""
+    return _flash_fwd(q, k, v, None, sm_scale, causal)
+
+
+def _fal_fwd(q, k, v, sm_scale, causal):
+    o, lse = _flash_fwd(q, k, v, None, sm_scale, causal)
+    return (o, lse), (q, k, v, o, lse)
+
+
+def _fal_bwd(sm_scale, causal, res, cts):
+    do, dlse = cts
+    q, k, v, o, lse = res
+    return _flash_bwd_impl(q, k, v, o, lse, do, None, sm_scale, causal,
+                           dlse=dlse)
+
+
+flash_attention_lse.defvjp(_fal_fwd, _fal_bwd)
+
+
+# ------------------------------------------------- packed-qkv (no relayout)
+#
+# When head_dim is a multiple of the 128-lane tile, the kernels can read
+# Q/K/V STRAIGHT out of the [B, T, 3n] projection output — BlockSpecs
+# slice the head's D-column window (legal: the last block dim is a
+# multiple of 128) — and write the output back in [B, T, n]. The
+# [B,T,H,D]->[B,H,T,D] head transposes and their backward twins (~0.9
+# ms/step at the r4 bench shapes) disappear entirely. Scope: the
+# single-block regime (T <= BLOCK_Q_MAX) that covers the T=512 flagship;
+# longer sequences keep the flat [B*H, T, D] streaming path.
+
+
+def _flash_fwd_qkv(qkv, H, kmask, sm_scale, causal):
+    B, T, three_n = qkv.shape
+    n = three_n // 3
+    D = n // H
+    masked = kmask is not None
+    G = _pick_g(B, T, D, _fwd_slice_bytes(T, D))
+    grid = (B // G, H)
+    kern = functools.partial(_fwd_kernel, sm_scale=sm_scale, causal=causal,
+                             masked=masked, block_q=T, block_k=T, seq_len=T)
+    in_specs = [
+        pl.BlockSpec((G, T, D), lambda b, h: (b, 0, h)),           # q cols
+        pl.BlockSpec((G, T, D), lambda b, h: (b, 0, H + h)),       # k cols
+        pl.BlockSpec((G, T, D), lambda b, h: (b, 0, 2 * H + h)),   # v cols
+    ]
+    args = [qkv, qkv, qkv]
+    if masked:
+        in_specs.append(pl.BlockSpec((G, 1, T), lambda b, h: (b, 0, 0)))
+        args.append(kmask)
+    o, lse = pl.pallas_call(
+        kern,
+        grid=grid,
+        in_specs=in_specs,
+        out_specs=[
+            pl.BlockSpec((G, T, D), lambda b, h: (b, 0, h)),
+            pl.BlockSpec((G, 1, 1, T), lambda b, h: (b, h, 0, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((B, T, n), qkv.dtype),
+            jax.ShapeDtypeStruct((B, H, 1, T), jnp.float32),
+        ],
+        compiler_params=pltpu.CompilerParams(vmem_limit_bytes=_VMEM_LIMIT),
+        interpret=_use_interpret(),
+    )(*args)
+    return o, lse
+
+
+def _flash_bwd_qkv(qkv, o, lse, do, H, kmask, sm_scale, causal):
+    B, T, three_n = qkv.shape
+    n = three_n // 3
+    D = n // H
+    masked = kmask is not None
+    # delta = rowsum(do * o) per head: [B, T, H] -> [B, H, 1, T]
+    dd = (do.astype(jnp.float32) * o.astype(jnp.float32)).reshape(
+        B, T, H, D).sum(-1)
+    delta = dd.transpose(0, 2, 1)[:, :, None, :]
+    G = _pick_g(B, T, D, _bwd_slice_bytes(T, D))
+    rows = pl.BlockSpec((G, 1, 1, T), lambda b, h: (b, h, 0, 0))
+    in_specs = [
+        pl.BlockSpec((G, T, D), lambda b, h: (b, 0, h)),           # q
+        pl.BlockSpec((G, T, D), lambda b, h: (b, 0, H + h)),       # k
+        pl.BlockSpec((G, T, D), lambda b, h: (b, 0, 2 * H + h)),   # v
+        pl.BlockSpec((G, T, D), lambda b, h: (b, 0, h)),           # do cols
+        rows, rows,
+    ]
+    args = [qkv, qkv, qkv, do, lse, delta]
+    if masked:
+        in_specs.append(pl.BlockSpec((G, 1, T), lambda b, h: (b, 0, 0)))
+        args.append(kmask)
+    col = pl.BlockSpec((G, T, D), lambda b, h: (b, 0, h))
+    dq, dk, dv = pl.pallas_call(
+        functools.partial(_bwd_fused_kernel, sm_scale=sm_scale,
+                          causal=causal, masked=masked, seq_len=T),
+        grid=(B // G, H),
+        in_specs=in_specs,
+        out_specs=[col, col, col],
+        out_shape=[jax.ShapeDtypeStruct((B, T, n), qkv.dtype)] * 3,
+        compiler_params=pltpu.CompilerParams(vmem_limit_bytes=_VMEM_LIMIT),
+        interpret=_use_interpret(),
+    )(*args)
+    return jnp.concatenate([dq, dk, dv], axis=-1)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(1, 2, 3))
+def _flash_qkv_core(qkv, H, sm_scale, causal):
+    o, _ = _flash_fwd_qkv(qkv, H, None, sm_scale, causal)
+    return o
+
+
+def _flash_qkv_core_fwd(qkv, H, sm_scale, causal):
+    o, lse = _flash_fwd_qkv(qkv, H, None, sm_scale, causal)
+    return o, (qkv, o, lse)
+
+
+def _flash_qkv_core_bwd(H, sm_scale, causal, res, do):
+    qkv, o, lse = res
+    return (_flash_bwd_qkv(qkv, o, lse, do, H, None, sm_scale, causal),)
+
+
+_flash_qkv_core.defvjp(_flash_qkv_core_fwd, _flash_qkv_core_bwd)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(2, 3, 4))
+def _flash_qkv_core_masked(qkv, kmask, H, sm_scale, causal):
+    o, _ = _flash_fwd_qkv(qkv, H, kmask, sm_scale, causal)
+    return o
+
+
+def _flash_qkv_core_masked_fwd(qkv, kmask, H, sm_scale, causal):
+    o, lse = _flash_fwd_qkv(qkv, H, kmask, sm_scale, causal)
+    return o, (qkv, o, lse, kmask)
+
+
+def _flash_qkv_core_masked_bwd(H, sm_scale, causal, res, do):
+    qkv, o, lse, kmask = res
+    dqkv = _flash_bwd_qkv(qkv, o, lse, do, H, kmask, sm_scale, causal)
+    return dqkv, jnp.zeros_like(kmask)
+
+
+_flash_qkv_core_masked.defvjp(_flash_qkv_core_masked_fwd,
+                              _flash_qkv_core_masked_bwd)
+
+
+def supports_qkv(B, T, n, H, *, dropout) -> bool:
+    """Envelope of the packed no-relayout path: head_dim a lane-tile
+    multiple (column BlockSpecs), single-block sequence length, head
+    count dividing a G-batchable batch."""
+    D = n // H
+    return (not dropout and D % 128 == 0 and n % H == 0
+            and MIN_FLASH_SEQ <= T <= BLOCK_Q_MAX and T % BLOCK == 0)
+
+
+def flash_attention_qkv(qkv, n_heads, *, causal=True, sm_scale=None,
+                        mask=None):
+    """Packed-projection attention: qkv [B, T, 3n] (the x @ Wqkv output,
+    q|k|v each n = H*D wide) -> out [B, T, n], never materializing a
+    [B, H, T, D] relayout. Check `supports_qkv` first."""
+    B, T, three_n = qkv.shape
+    n = three_n // 3
+    D = n // n_heads
+    if sm_scale is None:
+        sm_scale = 1.0 / float(np.sqrt(D))
+    if mask is None:
+        return _flash_qkv_core(qkv, n_heads, sm_scale, bool(causal))
+    kmask = jnp.asarray(mask, jnp.float32)[:, None, :]      # [B, 1, T]
+    return _flash_qkv_core_masked(qkv, kmask, n_heads, sm_scale,
+                                  bool(causal))
+
+
 # Below this sequence length XLA's fused dense attention wins on TPU (the
 # kernel's fixed per-program cost dominates once [T,T] traffic is small).
 # Measured on v5e with bf16 MXU operands + 512-blocks: flash fwd+bwd beats
@@ -522,25 +852,41 @@ MIN_FLASH_SEQ = 512
 def supports(q_shape, *, causal, dropout, mask) -> bool:
     """Whether the fused kernel handles this case (else: dense path).
     q_shape is [B, H, T, D] — T at index 2. Padding masks fold into the
-    kernels' block predicates (VERDICT r2 #3: variable-length batches keep
-    the fused path); attention dropout still routes dense."""
+    kernels' block predicates (VERDICT r2 #3); attention dropout runs
+    IN-KERNEL via the counter-hash keep mask (VERDICT r3 #6), so dropout
+    configs keep the fused path too."""
     T = q_shape[2]
-    return not dropout and T >= MIN_FLASH_SEQ and T % BLOCK == 0
+    return T >= MIN_FLASH_SEQ and T % BLOCK == 0
 
 
-def flash_attention(q, k, v, *, causal=True, sm_scale=None, mask=None):
+def flash_attention(q, k, v, *, causal=True, sm_scale=None, mask=None,
+                    dropout=0.0, dropout_rng=None):
     """q, k, v: [B, H, T, D] -> [B, H, T, D]; differentiable (custom VJP).
 
     mask: optional [B, T] padding mask keyed on KEYS (1 = valid), the
     dense path's semantics (nn/layers/attention.dot_product_attention) —
-    masked keys contribute no probability mass and receive zero dk/dv."""
+    masked keys contribute no probability mass and receive zero dk/dv.
+    dropout: attention-weight dropout rate, generated INSIDE the kernels
+    from `dropout_rng` (a jax PRNG key) via the counter-based hash — the
+    [B, H, T, T] mask never materializes in HBM."""
     B, H, T, D = q.shape
     if sm_scale is None:
         sm_scale = 1.0 / float(np.sqrt(D))
     qf = q.reshape(B * H, T, D)
     kf = k.reshape(B * H, T, D)
     vf = v.reshape(B * H, T, D)
-    if mask is None:
+    if dropout:
+        if dropout_rng is None:
+            raise ValueError("dropout > 0 requires dropout_rng")
+        seed = jax.random.randint(dropout_rng, (1, 1), 0, 2**31 - 1,
+                                  dtype=jnp.int32)
+        kmask = (jnp.ones((B * H, 1, T), jnp.float32) if mask is None
+                 else jnp.broadcast_to(
+                     jnp.asarray(mask, jnp.float32)[:, None, :],
+                     (B, H, T)).reshape(B * H, 1, T))
+        o = _flash_core_drop(qf, kf, vf, kmask, seed, sm_scale,
+                             bool(causal), float(dropout))
+    elif mask is None:
         o = _flash_core(qf, kf, vf, sm_scale, bool(causal))
     else:
         # [BH, 1, T]: Mosaic block shapes must be (8,128)-divisible or

@@ -64,10 +64,20 @@ def _send_msg(sock: socket.socket, obj, payload: Optional[bytes] = None) -> None
         sock.sendall(payload)
 
 
-def _recv_msg(fileobj):
+# Upper bound on a single binary frame (1 GiB ~= a 268M-param float32
+# flat view — far above any model this coordinator averages). A corrupt
+# or hostile header cannot make the peer allocate arbitrary memory in one
+# read (ADVICE r3). Module-level and read at CALL time, so genuinely
+# larger models raise it process-wide (cluster.MAX_FRAME_BYTES = ...),
+# or per-endpoint via the max_frame_bytes constructor args.
+MAX_FRAME_BYTES = 1 << 30
+
+
+def _recv_msg(fileobj, max_frame_bytes: Optional[int] = None):
     """Read (msg, payload) from a BINARY buffered stream; payload is None
     for pure-control messages (header without `payload_bytes`) and b"" for
     an announced zero-length frame."""
+    cap = MAX_FRAME_BYTES if max_frame_bytes is None else max_frame_bytes
     line = fileobj.readline()
     if not line:
         raise ConnectionError("peer closed")
@@ -75,8 +85,14 @@ def _recv_msg(fileobj):
     n = msg.pop("payload_bytes", None)
     payload = None
     if n is not None:
-        payload = fileobj.read(int(n))
-        if payload is None or len(payload) < int(n):
+        n = int(n)
+        if n < 0 or n > cap:
+            raise ConnectionError(
+                f"frame of {n} bytes exceeds the {cap}-byte "
+                "limit (corrupt header? raise cluster.MAX_FRAME_BYTES "
+                "for larger models)")
+        payload = fileobj.read(n)
+        if payload is None or len(payload) < n:
             raise ConnectionError("peer closed mid-payload")
     return msg, payload
 
@@ -194,6 +210,31 @@ class ClusterCoordinator:
                 if msg["key"] not in self._configs:
                     return {"ok": False, "error": "no such config"}, None
                 return {"ok": True, "value": self._configs[msg["key"]]}, None
+        if op == "claim_slot":
+            # atomic data-shard claim (the read-modify-write happens under
+            # the coordinator lock — a set_config/get_config read-back is
+            # racy): assign the caller the lowest slot in [0, n_slots)
+            # that is unclaimed, already its own, or whose owner left the
+            # alive set. Claims live in the config registry under
+            # "shard_owner/<s>" so operators can inspect them.
+            with self._lock:
+                alive = set(self.alive_workers())
+                wid = msg["worker"]
+                n = int(msg["n_slots"])
+                # the caller's EXISTING claim wins over reassignable
+                # slots: otherwise a re-claiming worker could be handed a
+                # lower dead-owner slot while still registered as its old
+                # slot's (alive) owner, orphaning that shard forever
+                for s in range(n):
+                    if self._configs.get(f"shard_owner/{s}") == wid:
+                        return {"ok": True, "slot": s}, None
+                for s in range(n):
+                    key = f"shard_owner/{s}"
+                    owner = self._configs.get(key)
+                    if owner is None or owner not in alive:
+                        self._configs[key] = wid
+                        return {"ok": True, "slot": s}, None
+                return {"ok": True, "slot": None}, None
         if op == "average":
             return self._average(msg, payload)
         if op == "barrier":
@@ -320,8 +361,14 @@ class ClusterClient:
     def set_config(self, key: str, value) -> None:
         self._call({"op": "set_config", "key": key, "value": value})
 
-    def get_config(self, key: str):
-        return self._call({"op": "get_config", "key": key})[0]["value"]
+    def get_config(self, key: str, default=None):
+        """Config value, or `default` for a key nobody has set."""
+        try:
+            return self._call({"op": "get_config", "key": key})[0]["value"]
+        except RuntimeError as e:
+            if "no such config" in str(e):
+                return default
+            raise
 
     def barrier(self, name: str) -> None:
         self._call({"op": "barrier", "name": name})
@@ -331,13 +378,24 @@ class ClusterClient:
                                 _to_bytes(flat_params))
         return _from_bytes(payload)
 
-    def close(self) -> None:
+    def close(self, deregister: bool = True) -> None:
+        """deregister=False drops the connection but keeps the worker in
+        the coordinator's alive set until heartbeat expiry — a probe
+        handing off to a training client under the SAME worker_id uses it
+        so a claimed shard slot cannot be stolen during the handoff."""
         self._hb_stop.set()
-        try:
-            self._call({"op": "deregister"})
-        except Exception:
-            pass
+        if deregister:
+            try:
+                self._call({"op": "deregister"})
+            except Exception:
+                pass
         self._sock.close()
+
+    def claim_slot(self, n_slots: int):
+        """Atomically claim a data-shard slot in [0, n_slots); None when
+        every slot is held by an alive worker (retry after a beat)."""
+        return self._call({"op": "claim_slot",
+                           "n_slots": int(n_slots)})[0]["slot"]
 
 
 # ---------------------------------------------------------------- training

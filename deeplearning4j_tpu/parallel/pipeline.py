@@ -150,12 +150,6 @@ class PipelinePlan:
                              "as the single network output")
         if net.params is None:
             net.init()
-        for name, sub in net.state.items():
-            if jax.tree.leaves(sub):
-                raise ValueError(
-                    f"pipeline parallelism requires stateless layers; "
-                    f"'{name}' carries mutable state (e.g. batchnorm "
-                    "running stats) which cannot thread a microbatch ring")
 
         topo, cuts = _chain_cuts(conf)
         if not cuts:
@@ -212,21 +206,22 @@ class PipelinePlan:
         self.group_layers = [
             [n for n in g if isinstance(conf.vertices[n], LayerVertexConf)]
             for g in self.stage_groups]
-        tmpl = []
-        for name in self.group_layers[0]:
-            leaves, treedef = jax.tree.flatten(net.params[name])
-            tmpl.append((name, treedef, len(leaves)))
-        self.stage_template = tmpl
+        self.stage_template = self._make_template(net.params)
         self.pre_layers = [n for n in self.pre_names
                            if isinstance(conf.vertices[n], LayerVertexConf)]
         self.post_layers = [n for n in self.post_names
                             if isinstance(conf.vertices[n], LayerVertexConf)
                             ] + [out_name]
+        # mutable layer state (BatchNorm running stats) threads the same
+        # pipelined layout as params: per-stage state rides the tick scan
+        # carry, updated only on real-microbatch ticks
+        self.state_template = self._make_template(net.state, default={})
+        self.has_state = bool(jax.tree.leaves(net.state))
 
         # leaf paths for TP/EP rule matching on stacked leaves, named by
         # the template (group-0) layer names
         self.stage_leaf_names = []
-        for name, _, _ in tmpl:
+        for name, _, _ in self.stage_template:
             flat = jax.tree_util.tree_flatten_with_path(
                 net.params[name])[0]
             for path, _leaf in flat:
@@ -245,14 +240,28 @@ class PipelinePlan:
             steps.append((n, v, refs))
         return steps
 
-    def _apply_steps(self, steps, params, x, *, train, rng):
-        """Run a region's vertices on one activation; returns the final
-        activation. params: {template_layer_name: subtree}."""
+    def _make_template(self, tree, default=None):
+        """Per-layer (name, treedef, n_leaves) stacking template for any
+        per-layer-keyed pytree sharing the params' layer names."""
+        tmpl = []
+        for name in self.group_layers[0]:
+            sub = tree[name] if default is None else tree.get(name, default)
+            leaves, treedef = jax.tree.flatten(sub)
+            tmpl.append((name, treedef, len(leaves)))
+        return tmpl
+
+    def _apply_steps(self, steps, params, state, x, *, train, rng,
+                     mask=None):
+        """Run a region's vertices on one activation. Returns (final
+        activation, new_state). params/state: {template_layer_name:
+        subtree}; `mask` is the [B, T] features mask threaded to every
+        layer apply (the non-PP _forward contract)."""
         net = self.net
         cdtype = net.compute_dtype
         if jnp.issubdtype(jnp.asarray(x).dtype, jnp.floating):
             x = jnp.asarray(x, cdtype)
         acts = {}
+        new_state = {}
         keys = (jax.random.split(rng, max(len(steps), 1))
                 if rng is not None else [None] * len(steps))
         out = x
@@ -267,33 +276,41 @@ class PipelinePlan:
                     p = jax.tree.map(
                         lambda a: a.astype(cdtype)
                         if jnp.issubdtype(a.dtype, jnp.floating) else a, p)
-                y, _s = net.impls[n].apply(
-                    v.layer, p, {}, xi, train=train, rng=k, mask=None)
+                y, s = net.impls[n].apply(
+                    v.layer, p, state.get(n, {}), xi, train=train, rng=k,
+                    mask=mask)
+                new_state[n] = s
             else:
                 y = net._vertex_forward(n, v, ins, params, {}, train, k,
                                         {}, acts)
             acts[n] = y
             out = y
-        return out
+        return out, new_state
 
-    def pre_apply(self, pre_params, x, *, train, rng):
+    def pre_apply(self, pre_params, pre_state, x, *, train, rng, mask=None):
         if not self._steps_pre:
-            return jnp.asarray(x, self.net.compute_dtype) \
+            x = jnp.asarray(x, self.net.compute_dtype) \
                 if jnp.issubdtype(jnp.asarray(x).dtype, jnp.floating) else x
-        return self._apply_steps(self._steps_pre, pre_params, x,
-                                 train=train, rng=rng)
+            return x, dict(pre_state)
+        return self._apply_steps(self._steps_pre, pre_params, pre_state, x,
+                                 train=train, rng=rng, mask=mask)
 
-    def stage_apply(self, stage_params, x, *, train, rng):
-        return self._apply_steps(self._steps_stage, stage_params, x,
-                                 train=train, rng=rng)
+    def stage_apply(self, stage_params, stage_state, x, *, train, rng,
+                    mask=None):
+        return self._apply_steps(self._steps_stage, stage_params,
+                                 stage_state, x, train=train, rng=rng,
+                                 mask=mask)
 
-    def post_loss(self, post_params, h, labels, *, train, rng, mask=None):
+    def post_loss(self, post_params, post_state, h, labels, *, train, rng,
+                  mask=None, feat_mask=None):
         """POST region + output-layer loss for a batch of finished
-        activations."""
+        activations. Returns (loss, new_post_state)."""
         net = self.net
+        new_state = dict(post_state)
         if self._steps_post:
-            h = self._apply_steps(self._steps_post, post_params, h,
-                                  train=train, rng=rng)
+            h, new_state = self._apply_steps(
+                self._steps_post, post_params, post_state, h, train=train,
+                rng=rng, mask=feat_mask)
         v = self.out_vconf
         if v.preprocessor is not None:
             h = v.preprocessor.pre_process(h)
@@ -305,44 +322,71 @@ class PipelinePlan:
             from deeplearning4j_tpu.nn.training import tree_cast
 
             p_out = tree_cast(p_out, net.compute_dtype)
-        return net.impls[self.out_name].loss(
+        loss = net.impls[self.out_name].loss(
             v.layer, p_out, h, labels, train=train, rng=rng, mask=mask)
+        new_state.setdefault(self.out_name, post_state.get(self.out_name, {}))
+        return loss, new_state
 
     # ----------------------------------------------------- tree restructure
+    def _stage_local(self, tmpl, stacked, g=None):
+        tree = {}
+        i = 0
+        for name, treedef, n in tmpl:
+            leaves = [stacked[i + j] if g is None else stacked[i + j][g]
+                      for j in range(n)]
+            tree[name] = jax.tree.unflatten(treedef, leaves)
+            i += n
+        return tree
+
     def stage_local(self, stacked, g=None):
         """Rebuild {template_name: subtree} from a tuple of stacked leaves.
         g=None: leaves already have the stage axis stripped (inside
         shard_map); integer g: take stage g's slice (tracing-safe)."""
-        params = {}
-        i = 0
-        for name, treedef, n in self.stage_template:
-            leaves = [stacked[i + j] if g is None else stacked[i + j][g]
-                      for j in range(n)]
-            params[name] = jax.tree.unflatten(treedef, leaves)
-            i += n
-        return params
+        return self._stage_local(self.stage_template, stacked, g)
 
-    def to_pipelined(self, params):
-        pre = {n: params[n] for n in self.pre_layers}
-        post = {n: params[n] for n in self.post_layers}
+    def stage_local_state(self, stacked, g=None):
+        return self._stage_local(self.state_template, stacked, g)
+
+    def _to_pipelined(self, tree, default=None):
+        def get(n):
+            return tree[n] if default is None else tree.get(n, default)
+
+        pre = {n: get(n) for n in self.pre_layers}
+        post = {n: get(n) for n in self.post_layers}
         per_group = []
         for g in self.group_layers:
             per_group.append([leaf for name in g
-                              for leaf in jax.tree.leaves(params[name])])
+                              for leaf in jax.tree.leaves(get(name))])
         stages = tuple(jnp.stack([per_group[g][i]
                                   for g in range(self.S)])
                        for i in range(len(per_group[0])))
         return {"pre": pre, "stages": stages, "post": post}
 
-    def to_canonical(self, pp):
-        params = {}
-        params.update(pp["pre"])
-        params.update(pp["post"])
+    def _to_canonical(self, pp, tmpl):
+        tree = {}
+        tree.update(pp["pre"])
+        tree.update(pp["post"])
         for g, names in enumerate(self.group_layers):
-            local = self.stage_local(pp["stages"], g=g)
+            local = self._stage_local(tmpl, pp["stages"], g=g)
             for tmpl_name, name in zip(self.group_layers[0], names):
-                params[name] = local[tmpl_name]
-        return params
+                tree[name] = local[tmpl_name]
+        return tree
+
+    def to_pipelined(self, params):
+        return self._to_pipelined(params)
+
+    def to_canonical(self, pp):
+        return self._to_canonical(pp, self.stage_template)
+
+    def to_pipelined_state(self, state):
+        return self._to_pipelined(state, default={})
+
+    def to_canonical_state(self, pp_state, full_state=None):
+        """Canonical per-layer state from the pipelined layout; layers
+        outside the plan's regions (none today) fall back to full_state."""
+        out = dict(full_state or {})
+        out.update(self._to_canonical(pp_state, self.state_template))
+        return out
 
     # --------------------------------------------------------- param place
     def placements(self, mesh: Mesh, axes: dict, rules):
@@ -410,12 +454,20 @@ def make_pp_train_step(net, plan: PipelinePlan, mesh: Mesh, axes: dict,
                        n_microbatches: int, rules):
     """Jitted train step over the pipelined param tree, standard container
     contract: step(pp_params, opt_state, state, rng, batch) ->
-    (pp_params, opt_state, state, loss, {}).
+    (pp_params, opt_state, new_state, loss, {}).
 
     batch: {"features": (tokens [B, ...],), "labels": (labels [B, ...],)}
-    with B divisible into n_microbatches x (data-axis multiple).
+    with B divisible into n_microbatches x (data-axis multiple). [B, T]
+    feature/label masks ride the (replicated) microbatch stream: the
+    features mask reaches every stage's layer apply for its current
+    microbatch, the labels mask reaches the head loss. Mutable layer state
+    (BatchNorm running stats) threads the tick scan per stage, updated
+    only on real-microbatch ticks; MoE router aux losses are accumulated
+    across stages/microbatches and added to the training loss.
     """
     import optax
+
+    from deeplearning4j_tpu.nn.layers.base import pop_aux_losses
 
     pipe = axes["pipe"]
     data = axes.get("data")
@@ -424,70 +476,177 @@ def make_pp_train_step(net, plan: PipelinePlan, mesh: Mesh, axes: dict,
         raise ValueError(f"{M} microbatches do not divide over {S} stages")
     k_slots = M // S
     T_total = M + 2 * S - 2
+    ring = [(i, (i + 1) % S) for i in range(S)]
+    # the data axis runs MANUAL alongside pipe (model/expert stay auto):
+    # GSPMD's subgroup partitioner CHECK-fails composing an auto data
+    # axis with expert-sharded stage leaves inside a manual-pipe region
+    # (spmd_partitioner_util.cc:495 on a data x pipe x expert mesh), and
+    # manual data costs nothing — the batch is embarrassingly parallel
+    # and the loss/state combines below psum/pmean over both axes.
+    manual = {pipe} | ({data} if data is not None else set())
+    dax = (pipe,) if data is None else (pipe, data)
+    d_only = () if data is None else (data,)
 
-    def program(pre_p, stages_p, post_p, toks, labs, key):
-        # local stage slice: shard_map strips the leading [S] axis to 1
-        stage_p = plan.stage_local(tuple(a[0] for a in stages_p))
-        idx = lax.axis_index(pipe)
-        u = (idx + 1) % S  # done-lane hops from the last stage to here
+    def _pmean_floats(tree, ax):
+        if not ax:
+            return tree
+        return jax.tree.map(
+            lambda a: (lax.pmean(a, ax)
+                       if jnp.issubdtype(a.dtype, jnp.floating) else a),
+            tree)
 
-        probe = plan.pre_apply(pre_p, toks[0], train=True,
-                               rng=jax.random.fold_in(key, 0))
-        zero = jnp.zeros_like(probe)
+    def _local_shard(arr_m, idx):
+        """Device idx's share of a [M, mb, ...] stream: microbatches
+        j = s*S + idx, flattened to [k_slots*mb, ...]."""
+        r = arr_m.reshape((k_slots, S) + arr_m.shape[1:])
+        local = lax.dynamic_index_in_dim(jnp.moveaxis(r, 1, 0), idx, 0,
+                                         False)
+        return local.reshape((k_slots * arr_m.shape[1],) + arr_m.shape[2:])
 
-        def tick(carry, t):
-            inflight, done_lane, store = carry
-            kt = jax.random.fold_in(key, t)
-            # stage 0 injects microbatch t while t < M (the PRE segment is
-            # an embedding-scale gather — computing it replicated over
-            # pipe is far cheaper than ringing the token stream)
-            inject = jnp.where(t < M, t, 0)
-            x0 = plan.pre_apply(
-                pre_p, lax.dynamic_index_in_dim(toks, inject, 0, False),
-                train=True, rng=jax.random.fold_in(kt, S))
-            x_in = jnp.where(idx == 0,
-                             jnp.where(t < M, x0, zero), inflight)
-            y = plan.stage_apply(stage_p, x_in, train=True,
-                                 rng=jax.random.fold_in(kt, idx))
-            # done lane: last stage injects its finished microbatch; each
-            # device captures the ones assigned to it (j % S == idx)
-            done_in = jnp.where(idx == S - 1, y, done_lane)
-            j = t - (S - 1) - u
-            cap = (j % S == idx) & (j >= 0) & (j < M)
-            slot = jnp.clip(j // S, 0, k_slots - 1)
-            store = jnp.where(cap, store.at[slot].set(done_in), store)
-            done_lane = lax.ppermute(done_in, pipe,
-                                     [(i, (i + 1) % S) for i in range(S)])
-            inflight = lax.ppermute(y, pipe,
-                                    [(i, (i + 1) % S) for i in range(S)])
-            return (inflight, done_lane, store), None
+    def make_program(has_f, has_l):
+        def program(pre_p, stages_p, post_p, stages_s, pre_s, post_s,
+                    toks, labs, fm, lm, key):
+            # local stage slice: shard_map strips the leading [S] axis to 1
+            stage_p = plan.stage_local(tuple(a[0] for a in stages_p))
+            stage_s0 = plan.stage_local_state(
+                tuple(a[0] for a in stages_s))
+            idx = lax.axis_index(pipe)
+            u = (idx + 1) % S  # done-lane hops from the last stage to here
 
-        store0 = jnp.zeros((k_slots,) + probe.shape, probe.dtype)
-        carry0 = tuple(
-            lax.pcast(a, (pipe,), to="varying")
-            for a in (zero, zero, store0))
-        (_, _, store), _ = lax.scan(tick, carry0, jnp.arange(T_total))
+            probe, _ = plan.pre_apply(
+                pre_p, pre_s, toks[0], train=True,
+                rng=jax.random.fold_in(key, 0),
+                mask=(fm[0] if has_f else None))
+            zero = jnp.zeros_like(probe)
 
-        # POST + loss once per microbatch, balanced over pipe devices:
-        # device d holds microbatches j = s*S + d in slots s
-        mb = toks.shape[1]
-        h = store.reshape((k_slots * mb,) + store.shape[2:])
-        labs_r = labs.reshape((k_slots, S) + labs.shape[1:])
-        labs_local = lax.dynamic_index_in_dim(
-            jnp.moveaxis(labs_r, 1, 0), idx, 0, False)
-        labs_local = labs_local.reshape((k_slots * mb,) + labs.shape[2:])
-        local = plan.post_loss(post_p, h, labs_local, train=True,
-                               rng=jax.random.fold_in(key, T_total))
-        # equal shard sizes: global mean = pmean of local means
-        return lax.pmean(local, pipe)
+            def tick(carry, t):
+                (inflight, done_lane, store, st_stage, st_pre,
+                 aux_stage, aux_pre) = carry
+                kt = jax.random.fold_in(key, t)
+                # stage 0 injects microbatch t while t < M (the PRE
+                # segment is an embedding-scale gather — computing it
+                # replicated over pipe is far cheaper than ringing the
+                # token stream)
+                inject = jnp.where(t < M, t, 0)
+                fm_in = (lax.dynamic_index_in_dim(fm, inject, 0, False)
+                         if has_f else None)
+                x0, pre_new = plan.pre_apply(
+                    pre_p, st_pre,
+                    lax.dynamic_index_in_dim(toks, inject, 0, False),
+                    train=True, rng=jax.random.fold_in(kt, S), mask=fm_in)
+                aux0, pre_new = pop_aux_losses(pre_new)
+                real_pre = t < M
+                st_pre = jax.tree.map(
+                    lambda a, b: jnp.where(real_pre, a, b), pre_new, st_pre)
+                aux_pre = aux_pre + jnp.where(real_pre, aux0, 0.0)
+                x_in = jnp.where(idx == 0,
+                                 jnp.where(t < M, x0, zero), inflight)
+                # this device's stage processes microbatch t - idx
+                jb = t - idx
+                real = (jb >= 0) & (jb < M)
+                fm_b = (lax.dynamic_index_in_dim(
+                    fm, jnp.clip(jb, 0, M - 1), 0, False)
+                    if has_f else None)
+                y, st_new = plan.stage_apply(
+                    stage_p, st_stage, x_in, train=True,
+                    rng=jax.random.fold_in(kt, idx), mask=fm_b)
+                auxb, st_new = pop_aux_losses(st_new)
+                st_stage = jax.tree.map(
+                    lambda a, b: jnp.where(real, a, b), st_new, st_stage)
+                aux_stage = aux_stage + jnp.where(real, auxb, 0.0)
+                # done lane: last stage injects its finished microbatch;
+                # each device captures the ones assigned to it (j%S == idx)
+                done_in = jnp.where(idx == S - 1, y, done_lane)
+                j = t - (S - 1) - u
+                cap = (j % S == idx) & (j >= 0) & (j < M)
+                slot = jnp.clip(j // S, 0, k_slots - 1)
+                store = jnp.where(cap, store.at[slot].set(done_in), store)
+                done_lane = lax.ppermute(done_in, pipe, ring)
+                inflight = lax.ppermute(y, pipe, ring)
+                return (inflight, done_lane, store, st_stage, st_pre,
+                        aux_stage, aux_pre), None
 
-    sm = jax.shard_map(
-        program, mesh=mesh,
-        in_specs=(P(), P(pipe), P(), P(), P(), P()),
-        out_specs=P(), axis_names={pipe}, check_vma=False)
+            store0 = jnp.zeros((k_slots,) + probe.shape, probe.dtype)
+            carry0 = jax.tree.map(
+                lambda a: lax.pcast(a, (pipe,), to="varying"),
+                (zero, zero, store0, stage_s0, pre_s,
+                 jnp.zeros(()), jnp.zeros(())))
+            (_, _, store, st_stage, st_pre, aux_stage, aux_pre), _ = (
+                lax.scan(tick, carry0, jnp.arange(T_total)))
 
-    def loss_fn(pp, rng, toks_m, labs_m):
-        loss = sm(pp["pre"], pp["stages"], pp["post"], toks_m, labs_m, rng)
+            # POST + loss once per microbatch, balanced over pipe devices:
+            # device d holds microbatches j = s*S + d in slots s
+            h = store.reshape((k_slots * toks.shape[1],) + store.shape[2:])
+            labs_local = _local_shard(labs, idx)
+            lm_local = _local_shard(lm, idx) if has_l else None
+            fm_local = _local_shard(fm, idx) if has_f else None
+            local, post_new = plan.post_loss(
+                post_p, post_s, h, labs_local, train=True,
+                rng=jax.random.fold_in(key, T_total), mask=lm_local,
+                feat_mask=fm_local)
+            auxp, post_new = pop_aux_losses(post_new)
+            # post/pre/stage state shards differ per device (disjoint
+            # microbatch/data shards) — pmean is the EMA combine;
+            # non-float leaves keep the local copy (update counters,
+            # identical across devices)
+            post_new = _pmean_floats(post_new, dax)
+            st_pre = _pmean_floats(st_pre, d_only)
+            st_stage = _pmean_floats(st_stage, d_only)
+            # equal shard sizes: global mean = pmean of local means. With a
+            # labels mask the local losses are masked means (sum/valid), so
+            # the exact global combine weights each shard by its valid
+            # count: psum(local*w)/psum(w) == sum(per*m)/sum(m) over all.
+            if has_l:
+                w = jnp.maximum(jnp.sum(lm_local.astype(jnp.float32)), 1.0)
+                data_loss = lax.psum(local * w, dax) / lax.psum(w, dax)
+            else:
+                data_loss = lax.pmean(local, dax)
+            # aux accounting: each microbatch visits every stage device
+            # once -> psum over pipe / M is the per-batch mean aux summed
+            # over all blocks (then averaged over data shards); the
+            # replicated-over-pipe PRE contributes via pmean. POST runs
+            # ONCE per device over its k_slots-microbatch shard, so its
+            # per-shard aux values combine as a pmean over pipe — /M
+            # would underweight them by k_slots.
+            aux_total = (lax.psum(aux_stage, pipe) / M
+                         + lax.pmean(aux_pre, pipe) / M
+                         + lax.pmean(auxp, pipe))
+            if d_only:
+                aux_total = lax.pmean(aux_total, d_only)
+            loss = data_loss + aux_total
+            # re-stack the local stage state with its [1] pipe axis for
+            # the P(pipe) out_spec
+            flat_stage_state = []
+            for name, treedef, n in plan.state_template:
+                flat_stage_state.extend(
+                    jax.tree.leaves(st_stage[name]))
+            st_stage_out = tuple(a[None] for a in flat_stage_state)
+            return loss, st_stage_out, st_pre, post_new
+
+        return program
+
+    def run_sm(pp, pp_state, rng, toks_m, labs_m, fm_m, lm_m):
+        has_f, has_l = fm_m is not None, lm_m is not None
+        program = make_program(has_f, has_l)
+        operands = (pp["pre"], pp["stages"], pp["post"],
+                    pp_state["stages"], pp_state["pre"], pp_state["post"],
+                    toks_m, labs_m,
+                    fm_m if has_f else (), lm_m if has_l else (), rng)
+        stream = P(None, data) if data is not None else P()
+        sm = jax.shard_map(
+            program, mesh=mesh,
+            in_specs=(P(), P(pipe), P(), P(pipe), P(), P(),
+                      stream, stream, stream if has_f else P(),
+                      stream if has_l else P(), P()),
+            out_specs=(P(), P(pipe), P(), P()),
+            axis_names=manual, check_vma=False)
+        loss, st_stage, st_pre, st_post = sm(*operands)
+        new_pp_state = {"pre": st_pre, "stages": st_stage, "post": st_post}
+        return loss, new_pp_state
+
+    def loss_fn(pp, pp_state, rng, toks_m, labs_m, fm_m, lm_m):
+        loss, new_pp_state = run_sm(pp, pp_state, rng, toks_m, labs_m,
+                                    fm_m, lm_m)
         # L1/L2 penalties (stacked leaves sum over stages exactly like the
         # canonical per-block sum — all blocks share one conf)
         for name in plan.pre_layers + plan.post_layers:
@@ -503,28 +662,40 @@ def make_pp_train_step(net, plan: PipelinePlan, mesh: Mesh, axes: dict,
         for tname in stage_tree:
             loss = loss + l1_l2_penalty(
                 net.layer_vertices[tname].layer, stage_tree[tname])
-        return loss
+        return loss, new_pp_state
+
+    def _first_mask(ms):
+        return next((m for m in (ms or []) if m is not None), None)
 
     def step(pp_params, opt_state, state, rng, batch):
         toks = batch["features"][0]
         labs = batch["labels"][0]
-        if batch.get("features_masks") or batch.get("labels_masks"):
-            raise ValueError("masks are not supported under pipeline "
-                             "parallelism — pad to full length")
+        fmask = _first_mask(batch.get("features_masks"))
+        lmask = _first_mask(batch.get("labels_masks"))
         B = toks.shape[0]
         if B % M:
             raise ValueError(f"batch {B} not divisible into {M} microbatches")
         mb = B // M
-        toks_m = toks.reshape((M, mb) + toks.shape[1:])
-        labs_m = labs.reshape((M, mb) + labs.shape[1:])
-        if data is not None:
-            dsh = NamedSharding(mesh, P(None, data))
-            toks_m = lax.with_sharding_constraint(toks_m, dsh)
-            labs_m = lax.with_sharding_constraint(labs_m, dsh)
-        loss, grads = jax.value_and_grad(loss_fn)(pp_params, rng,
-                                                  toks_m, labs_m)
+        if data is not None and mb % mesh.shape[data]:
+            raise ValueError(
+                f"microbatch size {mb} not divisible over the "
+                f"{mesh.shape[data]}-way data axis")
+
+        def to_stream(a):
+            if a is None:
+                return None
+            return a.reshape((M, mb) + a.shape[1:])
+
+        toks_m, labs_m, fm_m, lm_m = map(to_stream,
+                                         (toks, labs, fmask, lmask))
+        pp_state = plan.to_pipelined_state(state)
+        (loss, new_pp_state), grads = jax.value_and_grad(
+            loss_fn, has_aux=True)(pp_params, pp_state, rng, toks_m,
+                                   labs_m, fm_m, lm_m)
         updates, opt_state = net.tx.update(grads, opt_state, pp_params)
         pp_params = optax.apply_updates(pp_params, updates)
-        return pp_params, opt_state, state, loss, {}
+        new_state = (plan.to_canonical_state(new_pp_state, state)
+                     if plan.has_state else state)
+        return pp_params, opt_state, new_state, loss, {}
 
     return jax.jit(step, donate_argnums=(0, 1))

@@ -80,6 +80,28 @@ def exit_pipeline(net):
     net._pp_microbatches = None
 
 
+def _ensure_tree_optimizer(net, axes, zero1):
+    """The flat-view fused optimizer (updater.FlatViewTransform) cannot
+    carry per-leaf shardings; param-placement roles (model/expert/pipe)
+    and ZeRO-1 need tree-shaped moments — rebuild them. Moments restart
+    at zero only when the optimizer was never stepped (fresh nets); a
+    mid-training re-shard keeps nothing to convert from a flat vector, so
+    it restarts them too (documented trade: re-sharding mid-run is a
+    topology change, not a resume)."""
+    from deeplearning4j_tpu.nn.updater import FlatViewTransform, build_optimizer
+
+    needs_tree = zero1 or bool(set(axes or {}) & {"model", "expert", "pipe"})
+    if not needs_tree or not isinstance(net.tx, FlatViewTransform):
+        return
+    if hasattr(net, "layer_vertices"):
+        layer_confs = {n: v.layer for n, v in net.layer_vertices.items()}
+    else:
+        layer_confs = dict(zip(net.layer_names, net.layer_confs))
+    net.tx = build_optimizer(net.conf.conf, layer_confs, flat=False)
+    if net.params is not None:
+        net.opt_state = net.tx.init(net.params)
+
+
 def configure_mesh(net, mesh, *, zero1=False, axes=None, n_microbatches=None,
                    tp_rules=None):
     """Shared body of MultiLayerNetwork/ComputationGraph.set_mesh."""
@@ -101,6 +123,8 @@ def configure_mesh(net, mesh, *, zero1=False, axes=None, n_microbatches=None,
     net._train_step = None
     net._scan_fit = None
     net._output_jit = None
+    if mesh is not None:
+        _ensure_tree_optimizer(net, axes, zero1)
     if mesh is None or axes is None:
         return net
 
@@ -118,12 +142,16 @@ def configure_mesh(net, mesh, *, zero1=False, axes=None, n_microbatches=None,
     if "seq" in axes:
         # sequence parallelism shards TIME inside shard_map: the layer
         # impls must know the ring axis (ring attention, offset posenc) —
-        # the conf carries it (transformer_lm(seq_parallel_axis=...))
-        if set(axes) - {"seq", "data"}:
+        # the conf carries it (transformer_lm(seq_parallel_axis=...)).
+        # 'data' and 'model' compose: the shard_map is manual over
+        # seq/data only, so Megatron TP placements on a 'model' axis
+        # propagate GSPMD-auto through the per-shard compute (r3 #4
+        # lifted the seq-with-data-only restriction).
+        if set(axes) - {"seq", "data", "model"}:
             raise ValueError(
-                "the 'seq' axis composes with 'data' only (time-sharded "
-                "ring attention runs fully manual inside shard_map; "
-                "model/pipe/expert need the GSPMD-auto path)")
+                "the 'seq' axis composes with 'data' and 'model' only "
+                "(time-sharded ring attention runs manual inside "
+                "shard_map; pipe/expert need a different schedule)")
         if not hasattr(net, "layer_vertices"):
             raise ValueError(
                 "the 'seq' axis requires the ComputationGraph container "
@@ -150,6 +178,23 @@ def configure_mesh(net, mesh, *, zero1=False, axes=None, n_microbatches=None,
                     f"conf layer '{getattr(lc, 'name', '?')}' is built for "
                     f"seq axis {lc.seq_parallel_axis!r} but axes['seq'] is "
                     f"{axes['seq']!r}")
+        if "model" in axes:
+            from deeplearning4j_tpu.parallel.tensor_parallel import (
+                param_shardings,
+                resolve_rules as _resolve,
+                shard_params,
+            )
+
+            rules = _resolve(axes, tp_rules)
+            net._resolved_rules = rules
+            if net.params is None:
+                net.init()
+            net.params = shard_params(net.params, mesh, rules)
+            net._param_sh = param_shardings(net.params, mesh, rules)
+            if net.opt_state is not None:
+                net.opt_state = _map_param_shaped(
+                    net.opt_state, net.params,
+                    lambda t: jax.tree.map(jax.device_put, t, net._param_sh))
         return net
 
     rules = resolve_rules(axes, tp_rules)

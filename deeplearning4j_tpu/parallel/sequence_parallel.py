@@ -38,11 +38,16 @@ from deeplearning4j_tpu.datasets.api import DataSet, MultiDataSet
 
 
 def make_sp_train_step(net, mesh: Mesh, seq_axis: str = "seq",
-                       data_axis: Optional[str] = None):
+                       data_axis: Optional[str] = None,
+                       model_axis: Optional[str] = None):
     """Jitted (params, opt_state, state, features, labels) -> (params,
     opt_state, state, loss) with time sharded over `seq_axis` (and batch
-    over `data_axis` when given). Params/optimizer state are replicated;
-    grads are pmean'd over every mesh axis so shards stay in lockstep."""
+    over `data_axis` when given). Params/optimizer state are replicated
+    over seq/data; grads are pmean'd over those axes so shards stay in
+    lockstep. A `model_axis` composes as GSPMD-AUTO (the shard_map is
+    manual over seq/data only): Megatron TP placements on the params
+    propagate through the per-shard compute and XLA inserts the model
+    psums — the same partial-manual composition the PP schedule uses."""
     from jax import shard_map
 
     axes = (seq_axis,) if data_axis is None else (data_axis, seq_axis)
@@ -97,6 +102,7 @@ def make_sp_train_step(net, mesh: Mesh, seq_axis: str = "seq",
         in_specs=(repl, repl, repl, repl, tok_spec, tok_spec),
         out_specs=(repl, repl, repl, repl),
         check_vma=False,
+        axis_names=set(axes),  # model (if any) stays GSPMD-auto
     )
     return jax.jit(fn)
 

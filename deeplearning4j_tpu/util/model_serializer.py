@@ -111,8 +111,29 @@ class ModelSerializer:
             net.params = _restore_tree(net.params, _load_leaves(zf, "params.npz"))
             net.state = _restore_tree(net.state, _load_leaves(zf, "state.npz"))
             if "updater.npz" in zf.namelist():
-                net.opt_state = _restore_tree(net.opt_state,
-                                              _load_leaves(zf, "updater.npz"))
+                leaves = _load_leaves(zf, "updater.npz")
+                try:
+                    net.opt_state = _restore_tree(net.opt_state, leaves)
+                except ValueError:
+                    # layout bridge: the checkpoint's updater state may be
+                    # in the other optimizer layout (per-leaf tree vs the
+                    # r4 flat-view fused state) — rebuild the optimizer in
+                    # the matching layout and retry
+                    from deeplearning4j_tpu.nn.updater import (
+                        FlatViewTransform,
+                        build_optimizer,
+                    )
+
+                    if hasattr(net, "layer_vertices"):
+                        lcs = {n: v.layer
+                               for n, v in net.layer_vertices.items()}
+                    else:
+                        lcs = dict(zip(net.layer_names, net.layer_confs))
+                    was_flat = isinstance(net.tx, FlatViewTransform)
+                    net.tx = build_optimizer(net.conf.conf, lcs,
+                                             flat=not was_flat)
+                    net.opt_state = _restore_tree(
+                        net.tx.init(net.params), leaves)
             net.iteration_count = meta.get("iteration", 0)
             if hasattr(net, "epoch_count"):
                 net.epoch_count = meta.get("epoch", 0)

@@ -33,8 +33,8 @@ def test_register_ranks_and_config(coord):
     assert a.workers() == ["wA", "wB"]
     a.set_config("training", {"lr": 0.1, "layers": [4, 3]})
     assert b.get_config("training") == {"lr": 0.1, "layers": [4, 3]}
-    with pytest.raises(RuntimeError):
-        b.get_config("missing")
+    assert b.get_config("missing") is None  # unset key -> default
+    assert b.get_config("missing", 7) == 7
     a.close()
     b.close()
 
@@ -202,3 +202,20 @@ def test_two_process_computation_graph_training(tmp_path):
     flat1 = np.load(str(tmp_path / "w1.zip.params.npy"))
     np.testing.assert_allclose(flat0, flat1, atol=1e-6)
     assert np.isfinite(flat0).all()
+
+
+def test_claim_slot_atomic_and_elastic(coord):
+    a = ClusterClient(coord.address, "wA", heartbeat_interval=0.2)
+    b = ClusterClient(coord.address, "wB", heartbeat_interval=0.2)
+    sa, sb = a.claim_slot(2), b.claim_slot(2)
+    assert {sa, sb} == {0, 1}          # distinct slots
+    assert a.claim_slot(2) == sa       # idempotent re-claim
+    c = ClusterClient(coord.address, "wC", heartbeat_interval=0.2)
+    assert c.claim_slot(2) is None     # full: nothing stealable
+    # close WITHOUT deregistering: wB stays alive until heartbeat expiry,
+    # so its slot still can't be stolen
+    b.close(deregister=False)
+    assert c.claim_slot(2) is None
+    time.sleep(2.5)                    # > coord heartbeat_timeout (2.0)
+    assert c.claim_slot(2) == sb       # dead owner's slot is reassigned
+    a.close(); c.close()

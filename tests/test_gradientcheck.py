@@ -243,13 +243,15 @@ def test_computation_graph_vertices(rng):
 def test_moe_layer_gradients(rng):
     """Mixture-of-Experts: top-k gated expert FFNs (the gate top_k mask is
     piecewise-constant, so finite differences remain valid away from
-    routing boundaries — tanh-bounded inputs keep logits well-separated)."""
+    routing boundaries — tanh-bounded inputs keep logits well-separated).
+    FD runs against the smooth dense oracle; the routed path's analytic
+    gradients are checked against the dense path's in test_pipeline_moe."""
     from deeplearning4j_tpu.nn.layers.moe import MixtureOfExpertsLayer
 
     conf = (_builder().list()
             .layer(MixtureOfExpertsLayer(n_in=4, n_out=5, n_experts=3,
                                          top_k=2, d_hidden=6,
-                                         activation="tanh"))
+                                         activation="tanh", routing="dense"))
             .layer(OutputLayer(n_in=5, n_out=3, activation="softmax",
                                loss_function="mcxent"))
             .build())

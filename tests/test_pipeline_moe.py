@@ -135,11 +135,105 @@ def test_moe_conf_json_round_trip():
     assert back.n_experts == 6 and back.top_k == 1 and back.d_hidden == 12
 
 
+def test_routed_matches_dense_at_ample_capacity():
+    """The routed dispatch path is exact vs the dense oracle when no token
+    drops (capacity_factor >= E/top_k): same per-token FFN + gate math."""
+    from deeplearning4j_tpu.nn.layers.moe import (
+        moe_apply_dense,
+        moe_apply_routed,
+    )
+
+    lc = MixtureOfExpertsLayer(n_in=8, n_out=8, n_experts=4, top_k=2,
+                               d_hidden=16, activation="gelu",
+                               weight_init="xavier")
+    params, _ = MixtureOfExpertsImpl().init(lc, jax.random.PRNGKey(1),
+                                            jnp.float32)
+    x = jnp.asarray(np.random.default_rng(0).standard_normal((50, 8)),
+                    jnp.float32)
+    dense = moe_apply_dense(params, x, top_k=2, activation="gelu")
+    # group_size 16 also exercises the pad-to-group path (50 = 3*16 + 2)
+    routed = moe_apply_routed(params, x, top_k=2, capacity_factor=2.0,
+                              activation="gelu", group_size=16)
+    np.testing.assert_allclose(np.asarray(routed), np.asarray(dense),
+                               atol=1e-5)
+    # analytic gradients agree too (routing is piecewise-constant; away
+    # from drops the two paths are the same differentiable function)
+    gd = jax.grad(lambda p: jnp.sum(
+        moe_apply_dense(p, x, top_k=2, activation="gelu") ** 2))(params)
+    gr = jax.grad(lambda p: jnp.sum(
+        moe_apply_routed(p, x, top_k=2, capacity_factor=2.0,
+                         activation="gelu", group_size=16) ** 2))(params)
+    for k in gd:
+        np.testing.assert_allclose(np.asarray(gr[k]), np.asarray(gd[k]),
+                                   atol=1e-4)
+
+
+def test_routed_drops_over_capacity_and_balances():
+    """At a tight capacity factor, over-capacity tokens produce exactly-zero
+    output rows (the residual carries them), and the Switch aux loss is >= 1
+    with equality only at uniform routing."""
+    from deeplearning4j_tpu.nn.layers.moe import (
+        moe_apply_routed,
+        moe_load_balance_loss,
+    )
+
+    lc = MixtureOfExpertsLayer(n_in=8, n_out=8, n_experts=4, top_k=2,
+                               d_hidden=16, activation="gelu",
+                               weight_init="xavier")
+    params, _ = MixtureOfExpertsImpl().init(lc, jax.random.PRNGKey(1),
+                                            jnp.float32)
+    x = jnp.asarray(np.random.default_rng(0).standard_normal((64, 8)),
+                    jnp.float32)
+    y, aux = moe_apply_routed(params, x, top_k=2, capacity_factor=0.25,
+                              activation="gelu", return_aux=True)
+    dropped = np.asarray(jnp.abs(y).sum(-1) == 0)
+    assert dropped.any()          # tight capacity must drop something
+    assert not dropped.all()
+    # E * sum(f*P) ~ 1 near balance (exactly 1 when f == P == uniform; the
+    # top-k assignment fraction f can differ slightly from the softmax mass P)
+    assert 0.8 <= float(aux) <= 4.0
+    # perfectly balanced top-2 assignments + uniform router probs -> aux == 1
+    g = jnp.zeros((32, 4)).at[jnp.arange(32)[:, None],
+                              jnp.stack([jnp.arange(32) % 4,
+                                         (jnp.arange(32) + 1) % 4], 1)].set(0.5)
+    uniform = moe_load_balance_loss(jnp.zeros((32, 4)), g, 2)
+    np.testing.assert_allclose(float(uniform), 1.0, atol=1e-5)
+
+
+def test_moe_aux_loss_reaches_training_loss():
+    """The router load-balance loss flows through the state channel into
+    the container training loss (train only; eval score excludes it)."""
+    conf = (
+        NeuralNetConfiguration.builder()
+        .seed(0)
+        .learning_rate(0.05)
+        .updater("adam")
+        .list()
+        .layer(MixtureOfExpertsLayer(n_in=8, n_out=8, n_experts=4, top_k=2,
+                                     d_hidden=16, activation="gelu",
+                                     router_aux_weight=0.5))
+        .layer(OutputLayer(n_in=8, n_out=3, activation="softmax",
+                           loss_function="mcxent"))
+        .build()
+    )
+    net = MultiLayerNetwork(conf).init()
+    rng = np.random.default_rng(0)
+    x = rng.random((32, 8), dtype=np.float32)
+    y = np.eye(3, dtype=np.float32)[rng.integers(0, 3, 32)]
+    batch = {"features": jnp.asarray(x), "labels": jnp.asarray(y)}
+    train_loss, _ = net._loss(net.params, net.state, jax.random.PRNGKey(0),
+                              batch, train=True)
+    eval_loss, _ = net._loss(net.params, net.state, jax.random.PRNGKey(0),
+                             batch, train=False)
+    # aux >= weight * 1.0 at any routing; train loss strictly above eval
+    assert float(train_loss) > float(eval_loss) + 0.45
+
+
 @pytest.mark.parametrize("n_dev", [2, 4, 8])
 def test_expert_parallel_matches_dense(n_dev):
     lc = MixtureOfExpertsLayer(n_in=8, n_out=8, n_experts=8, top_k=2,
                                d_hidden=16, activation="gelu",
-                               weight_init="xavier")
+                               weight_init="xavier", routing="dense")
     impl = MixtureOfExpertsImpl()
     params, _ = impl.init(lc, jax.random.PRNGKey(1), jnp.float32)
     rng = np.random.default_rng(3)

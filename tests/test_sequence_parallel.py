@@ -163,3 +163,70 @@ def test_sp_posenc_offsets_match_dense():
     y_dense, _ = impl.apply(conf_dense, {}, {}, x)
     np.testing.assert_allclose(np.asarray(y_sp), np.asarray(y_dense),
                                rtol=1e-6)
+
+
+def test_ring_flash_hop_matches_reference():
+    """VERDICT r3 #4: kernel-legal local blocks (Tl % 128 == 0) run the
+    Pallas flash kernel per hop with the two-way lse merge — forward and
+    gradients match the unsharded reference (the lse cotangent folds into
+    the kernel backward's delta term)."""
+    from functools import partial
+
+    import jax
+    from jax.sharding import PartitionSpec as P
+
+    from deeplearning4j_tpu.parallel.ring_attention import (
+        ring_attention,
+        ring_self_attention,
+        sequence_sharded_attention_reference,
+    )
+
+    mesh = make_mesh({"seq": 4})
+    B, H, T, D = 2, 2, 512, 32  # Tl = 128: flash hop path
+    rng = np.random.default_rng(0)
+    q, k, v = (jnp.asarray(rng.standard_normal((B, H, T, D)), jnp.float32)
+               for _ in range(3))
+    for causal in (True, False):
+        out = ring_self_attention(q, k, v, mesh, causal=causal)
+        ref = sequence_sharded_attention_reference(q, k, v, causal=causal)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   atol=2e-5)
+    spec = P(None, None, "seq", None)
+    fn = jax.shard_map(partial(ring_attention, axis_name="seq", causal=True),
+                       mesh=mesh, in_specs=(spec, spec, spec),
+                       out_specs=spec, check_vma=False)
+    g_ring = jax.grad(lambda q, k, v: jnp.sum(fn(q, k, v) ** 2),
+                      (0, 1, 2))(q, k, v)
+    g_ref = jax.grad(lambda q, k, v: jnp.sum(
+        sequence_sharded_attention_reference(q, k, v, causal=True) ** 2),
+        (0, 1, 2))(q, k, v)
+    for a, b in zip(g_ring, g_ref):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=5e-5)
+
+
+def test_sp_composes_with_model_axis():
+    """VERDICT r3 #4: set_mesh accepts {data, seq, model} — the SP
+    shard_map is manual over seq/data only, so Megatron TP placements on
+    the model axis propagate GSPMD-auto; loss matches dense."""
+    from deeplearning4j_tpu.datasets.api import DataSet
+
+    V, T, B = 64, 16, 8
+    rng = np.random.default_rng(0)
+    toks = np.asarray(rng.integers(0, V, (B, T)), np.int32)
+    labs = np.eye(V, dtype=np.float32)[np.roll(toks, -1, axis=1)]
+    ds = DataSet(toks, labs)
+
+    def build(sp):
+        net = transformer_lm(vocab_size=V, d_model=16, n_heads=2,
+                             n_layers=2, d_ff=32, max_length=T,
+                             seq_parallel_axis=("seq" if sp else ""))
+        net.init()
+        return net
+
+    dense = build(False)
+    dense.fit(ds, epochs=3)
+    sp = build(True)
+    sp.set_mesh(make_mesh({"data": 2, "seq": 2, "model": 2}),
+                axes={"data": "data", "seq": "seq", "model": "model"})
+    sp.fit(ds, epochs=3)
+    assert abs(float(dense.score_value) - float(sp.score_value)) < 2e-3

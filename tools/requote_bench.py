@@ -1,0 +1,124 @@
+#!/usr/bin/env python
+"""Regenerate the measured-performance blocks of README.md and PARITY.md
+from the NEWEST driver bench artifact (BENCH_r*.json).
+
+VERDICT r3 #7: round after round, prose tables drifted from the driver
+artifacts. This script is the only writer of the blocks between
+`<!-- BENCH:BEGIN -->` / `<!-- BENCH:END -->`; run it after every round:
+
+    python tools/requote_bench.py            # newest BENCH_r*.json
+    python tools/requote_bench.py BENCH_r04.json
+"""
+
+from __future__ import annotations
+
+import glob
+import json
+import os
+import re
+import sys
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+ROWS = [
+    ("lenet_mnist_images_per_sec", "LeNet-5 / MNIST, `fit_scanned`",
+     lambda l: f"{l['value'] / 1e6:.2f}M images/sec"),
+    ("vgg16_cifar_images_per_sec", "VGG-16 / CIFAR-10 (DAG API)",
+     lambda l: f"{l['value'] / 1e3:.1f}k images/sec"),
+    ("word2vec_sgns_words_per_sec",
+     "Word2Vec skip-gram NS, 1M-word zipf corpus",
+     lambda l: f"{l['value'] / 1e3:.0f}k words/sec"
+               + (f" (quality {l['quality']:.2f})" if "quality" in l else "")),
+    ("resnet20_dp_allreduce_vs_paramavg_speedup",
+     "ResNet-20 allreduce-DP vs param-averaging (virtual 8-dev mesh)",
+     lambda l: f"{l['value']:.2f}x"),
+    ("transformer_lm_mfu", "6-layer Transformer-LM, seq 512",
+     lambda l: f"{l.get('tokens_per_sec', 0) / 1e6:.2f}M tokens/sec, "
+               f"**{l['value']:.3f} MFU**"),
+    ("transformer_lm_masked_mfu", "same model, variable-length masked batch",
+     lambda l: f"{l['value']:.3f} MFU"),
+    ("transformer_lm_masked_dropout_mfu", "same model, masked + attention dropout",
+     lambda l: f"{l['value']:.3f} MFU"),
+    ("transformer_lm_seq4096_tokens_per_sec",
+     "same model, seq 4096 (long-context mode)",
+     lambda l: f"{l['value'] / 1e3:.0f}k tokens/sec"
+               + (f", {l['mfu']:.3f} MFU" if "mfu" in l else "")),
+    ("transformer_moe_lm_tokens_per_sec",
+     "MoE-LM (8 experts, top-2)",
+     lambda l: f"{l['value'] / 1e3:.0f}k tokens/sec"),
+    ("ring_hop_flash_tflops", "ring-attention hop kernel",
+     lambda l: f"{l['value']:.0f} TFLOP/s"
+               + (f" ({l['speedup_vs_einsum_hop']:.1f}x the einsum hop)"
+                  if "speedup_vs_einsum_hop" in l else "")),
+]
+
+
+def load(path):
+    """Accepts either raw JSON-lines (bench.py stdout) or the driver's
+    wrapper object whose `tail` field holds the captured stdout."""
+    with open(path) as f:
+        text = f.read()
+    try:
+        wrapper = json.loads(text)
+        if isinstance(wrapper, dict) and "tail" in wrapper:
+            text = wrapper["tail"]
+    except json.JSONDecodeError:
+        pass
+    lines = {}
+    for raw in text.splitlines():
+        raw = raw.strip()
+        if not raw.startswith("{"):
+            continue
+        try:
+            line = json.loads(raw)
+        except json.JSONDecodeError:
+            continue
+        if "metric" in line:
+            lines[line["metric"]] = line
+    return lines
+
+
+def render(lines, artifact_name):
+    out = [f"Driver-captured artifact `{artifact_name}` (the authoritative "
+           "record — the driver runs `python bench.py` at the end of each "
+           "round; regenerate this block with `python tools/requote_bench.py`):",
+           "",
+           f"| benchmark (BASELINE.md config) | {artifact_name} |",
+           "|---|---|"]
+    for prefix, label, fmt in ROWS:
+        match = [l for m, l in lines.items() if m.startswith(prefix)]
+        if match:
+            line = match[0]
+            flag = " ⚠regression" if line.get("regression") else ""
+            out.append(f"| {label} | {fmt(line)}{flag} |")
+    return "\n".join(out)
+
+
+def splice(path, block):
+    with open(path) as f:
+        text = f.read()
+    pat = re.compile(r"<!-- BENCH:BEGIN -->.*?<!-- BENCH:END -->", re.S)
+    if not pat.search(text):
+        raise SystemExit(f"{path} has no BENCH:BEGIN/END markers")
+    text = pat.sub(f"<!-- BENCH:BEGIN -->\n{block}\n<!-- BENCH:END -->", text)
+    with open(path, "w") as f:
+        f.write(text)
+    print(f"updated {path}")
+
+
+def main():
+    if len(sys.argv) > 1:
+        artifact = sys.argv[1]
+    else:
+        arts = sorted(glob.glob(os.path.join(ROOT, "BENCH_r*.json")))
+        if not arts:
+            raise SystemExit("no BENCH_r*.json artifact found")
+        artifact = arts[-1]
+    lines = load(artifact)
+    block = render(lines, os.path.basename(artifact))
+    splice(os.path.join(ROOT, "README.md"), block)
+    splice(os.path.join(ROOT, "PARITY.md"), block)
+
+
+if __name__ == "__main__":
+    main()

@@ -674,13 +674,15 @@ def bench_ringhop() -> None:
         fns = {K: jax.jit(functools.partial(grad_loop, hop, K))
                for K in (4, 12)}
         for f in fns.values():
+            _sync(f())  # compile
+
+        def timed(f) -> float:
+            t0 = time.perf_counter()
             _sync(f())
-        t1 = min((lambda: (lambda t0: (_sync(fns[4]()),
-                                       time.perf_counter() - t0)[1])(
-            time.perf_counter()))() for _ in range(3))
-        t3 = min((lambda: (lambda t0: (_sync(fns[12]()),
-                                       time.perf_counter() - t0)[1])(
-            time.perf_counter()))() for _ in range(3))
+            return time.perf_counter() - t0
+
+        t1 = min(timed(fns[4]) for _ in range(3))
+        t3 = min(timed(fns[12]) for _ in range(3))
         per = (t3 - t1) / 8
         return flops / per if per > 0 else float("nan")
 

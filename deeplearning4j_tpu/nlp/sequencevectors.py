@@ -80,7 +80,8 @@ class SequenceVectors:
                  vocab_limit: Optional[int] = None,
                  use_device_pipeline: bool = False, device_mesh=None,
                  pipeline_chunk: int = 512, pipeline_group: int = 4,
-                 pipeline_share_negatives: bool = True):
+                 pipeline_share_negatives: bool = True,
+                 n_workers: int = 1):
         self.layer_size = layer_size
         self.window_size = window_size
         self.min_word_frequency = min_word_frequency
@@ -99,6 +100,7 @@ class SequenceVectors:
         self.pipeline_chunk = pipeline_chunk
         self.pipeline_group = pipeline_group
         self.pipeline_share_negatives = pipeline_share_negatives
+        self.n_workers = n_workers  # host-parallel vocab counting
         self._epoch_fn = None
 
         self.vocab: Optional[VocabCache] = None
@@ -113,7 +115,8 @@ class SequenceVectors:
     def build_vocab(self, sequences: Iterable[List[str]]):
         constructor = VocabConstructor(self.min_word_frequency,
                                        self.vocab_limit,
-                                       build_huffman=self.use_hs)
+                                       build_huffman=self.use_hs,
+                                       n_workers=self.n_workers)
         constructor.add_source(sequences)
         self.vocab = constructor.build_joint_vocabulary()
         self._init_from_vocab()

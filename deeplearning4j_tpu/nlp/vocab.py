@@ -176,13 +176,18 @@ class Huffman:
 
 class VocabConstructor:
     """Builds a joint vocabulary from token-sequence sources (reference
-    VocabConstructor.buildJointVocabulary:163 — count, filter, Huffman)."""
+    VocabConstructor.buildJointVocabulary:163 — count, filter, Huffman).
+    n_workers > 1 counts corpus chunks in a process pool (the reference
+    constructor is multi-threaded; Counter merge is associative, so the
+    result is identical to the serial pass)."""
 
     def __init__(self, min_word_frequency: int = 1,
-                 limit: Optional[int] = None, build_huffman: bool = True):
+                 limit: Optional[int] = None, build_huffman: bool = True,
+                 n_workers: int = 1):
         self.min_word_frequency = min_word_frequency
         self.limit = limit
         self.build_huffman = build_huffman
+        self.n_workers = n_workers
         self._sources: List[Iterable[List[str]]] = []
 
     def add_source(self, token_sequences: Iterable[List[str]]):
@@ -190,20 +195,25 @@ class VocabConstructor:
         return self
 
     def build_joint_vocabulary(self) -> VocabCache:
+        from deeplearning4j_tpu.nlp.distributed_vocab import (
+            cache_from_counts,
+            parallel_count,
+        )
+
         counts: Counter = Counter()
         n_sequences = 0
         for source in self._sources:
-            for tokens in source:
-                counts.update(tokens)
-                n_sequences += 1
-        cache = VocabCache()
-        for word, c in counts.items():
-            cache.add_token(VocabWord(word, float(c)))
-        cache.finish(self.min_word_frequency, self.limit)
-        if self.build_huffman:
-            Huffman(cache.vocab_words()).build()
-        cache.n_sequences = n_sequences
-        return cache
+            if self.n_workers > 1:
+                c, n = parallel_count(source, n_workers=self.n_workers)
+                counts.update(c)
+                n_sequences += n
+            else:
+                for tokens in source:
+                    counts.update(tokens)
+                    n_sequences += 1
+        return cache_from_counts(counts, n_sequences,
+                                 self.min_word_frequency, self.limit,
+                                 self.build_huffman)
 
 
 def unigram_table(cache: VocabCache, power: float = 0.75) -> np.ndarray:

@@ -90,6 +90,13 @@ class Word2Vec(SequenceVectors):
             self._kw["seed"] = int(s)
             return self
 
+        def workers(self, n: int):
+            """Host-parallel vocabulary counting processes (reference
+            Builder.workers — its multi-threaded VocabConstructor /
+            Spark TextPipeline analogue; see nlp/distributed_vocab.py)."""
+            self._kw["n_workers"] = int(n)
+            return self
+
         def use_device_pipeline(self, flag=True):
             """Whole-epoch on-device training (see nlp/device_pipeline.py)."""
             self._kw["use_device_pipeline"] = flag

@@ -122,11 +122,12 @@ def moe_apply_dense(params, x2d, *, top_k, activation):
 
 def expert_capacity(group_size, top_k, capacity_factor, n_experts):
     """Per-group per-expert capacity, rounded up to a multiple of 8
-    (sublane-friendly), capped at group_size * top_k (never useful past
-    every token claiming every one of its k slots in one expert)."""
+    (sublane-friendly), capped at group_size — a token claims a given
+    expert at most once (the argmax gate masks each chosen expert), so
+    an expert can never receive more than the group's tokens."""
     c = math.ceil(group_size * top_k * capacity_factor / n_experts)
     c = -(-c // 8) * 8
-    return min(c, group_size * top_k)
+    return min(c, group_size)
 
 
 def moe_load_balance_loss(logits, gates, top_k):

@@ -131,6 +131,38 @@ def _flatten_leaves(tree):
         [jnp.ravel(l).astype(jnp.float32) for l in jax.tree.leaves(tree)])
 
 
+def named_layer_confs(net):
+    """{layer_name: layer_conf} for either container kind (shared by
+    build_optimizer's callers: mesh placement, checkpoint restore)."""
+    if hasattr(net, "layer_vertices"):
+        return {n: v.layer for n, v in net.layer_vertices.items()}
+    return dict(zip(net.layer_names, net.layer_confs))
+
+
+def unflatten_state_like(flat_state, params):
+    """Convert a FlatViewTransform optimizer state into the tree-shaped
+    layout of the same update rule: any 1-D f32 moment vector of
+    total-param length unflattens into the param pytree (the flat layout
+    is the concatenation of jax.tree.leaves(params) raveled, in order).
+    Scalars (step counts) pass through."""
+    leaves = jax.tree.leaves(params)
+    total = sum(l.size for l in leaves)
+    treedef = jax.tree.structure(params)
+
+    def conv(x):
+        if hasattr(x, "ndim") and x.ndim == 1 and x.size == total:
+            outs = []
+            off = 0
+            for l in leaves:
+                seg = jax.lax.dynamic_slice_in_dim(x, off, l.size, 0)
+                outs.append(seg.reshape(l.shape).astype(l.dtype))
+                off += l.size
+            return jax.tree.unflatten(treedef, outs)
+        return x
+
+    return jax.tree.map(conv, flat_state)
+
+
 def flatten_transform(inner) -> FlatViewTransform:
     def init(params):
         return inner.init(_flatten_leaves(params))

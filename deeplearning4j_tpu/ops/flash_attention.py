@@ -425,17 +425,21 @@ def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, *rest,
     dv_ref[...] = dv.astype(dv_ref.dtype)
 
 
-def _bwd_fused_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+def _bwd_fused_kernel(q_ref, k_ref, v_ref, do_ref, o_ref, lse_ref,
                       *rest, sm_scale, causal, masked, seq_len,
-                      dropout=0.0, bh_stride=1):
+                      dropout=0.0, bh_stride=1, has_dlse=False):
     """Single-pass backward for the block == T case (T <= BLOCK_K_MAX,
     i.e. _block_sizes gave both blocks the whole sequence): with Q, K and
     V all resident, one recompute of the probabilities feeds dq, dk AND
     dv — the two-kernel path recomputes them twice. Grid is (BH/G,); no
-    cross-block accumulation exists at this size."""
+    cross-block accumulation exists at this size. delta = rowsum(do*o)
+    is computed IN-KERNEL (r4: the host-side delta pass cost ~0.6 ms/step
+    of reduce+relayout traffic on the packed layout); an optional dlse
+    operand (ring-attention lse cotangent) subtracts from it."""
     rest = list(rest)
     kmask_ref = rest.pop(0) if masked else None
     seed_ref = rest.pop(0) if dropout else None
+    dlse_ref = rest.pop(0) if has_dlse else None
     dq_ref, dk_ref, dv_ref = rest
     qb = q_ref[...]                                         # [G, T, D]
     dob = do_ref[...]
@@ -443,7 +447,10 @@ def _bwd_fused_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
     vb = v_ref[...]
     G = qb.shape[0]
     lse = lse_ref[...].reshape(G, seq_len)                  # [G, T]
-    delta = delta_ref[...].reshape(G, seq_len)
+    delta = jnp.sum(dob.astype(jnp.float32) * o_ref[...].astype(jnp.float32),
+                    axis=-1)                                # [G, T]
+    if has_dlse:
+        delta = delta - dlse_ref[...].reshape(G, seq_len)
     s = sm_scale * jax.lax.dot_general(
         qb, kb, (((2,), (2,)), ((0,), (0,))),
         preferred_element_type=jnp.float32)                 # [G, T, T]
@@ -481,26 +488,30 @@ def _bwd_fused_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         preferred_element_type=jnp.float32).astype(dk_ref.dtype)
 
 
-def _flash_bwd_fused(q, k, v, do, lse, delta, kmask, sm_scale, causal,
-                     dropout=0.0, seed=None):
+def _flash_bwd_fused(q, k, v, do, o, lse, kmask, sm_scale, causal,
+                     dropout=0.0, seed=None, dlse=None):
     BH, T, D = q.shape
     masked = kmask is not None
     extra = int(T * T * 4) if dropout else 0
     G = _pick_g(BH, T, D, _bwd_slice_bytes(T, D) + extra)
     fullblock = pl.BlockSpec((G, T, D), lambda bh: (bh, 0, 0))
     lblock = pl.BlockSpec((G, 1, T), lambda bh: (bh, 0, 0))
-    in_specs = [fullblock, fullblock, fullblock, fullblock, lblock, lblock]
-    args = [q, k, v, do, lse, delta]
+    in_specs = [fullblock, fullblock, fullblock, fullblock, fullblock,
+                lblock]
+    args = [q, k, v, do, o, lse]
     if masked:
         in_specs.append(pl.BlockSpec((G, 1, T), lambda bh: (bh, 0, 0)))
         args.append(kmask)
     if dropout:
         in_specs.append(pl.BlockSpec((1, 1), lambda bh: (0, 0)))
         args.append(seed)
+    if dlse is not None:
+        in_specs.append(lblock)
+        args.append(dlse)
     return pl.pallas_call(
         functools.partial(_bwd_fused_kernel, sm_scale=sm_scale,
                           causal=causal, masked=masked, seq_len=T,
-                          dropout=dropout),
+                          dropout=dropout, has_dlse=dlse is not None),
         grid=(BH // G,),
         in_specs=in_specs,
         out_specs=[fullblock, fullblock, fullblock],
@@ -519,6 +530,17 @@ def _flash_bwd_impl(q, k, v, o, lse, do, kmask, sm_scale, causal,
     BH, T, D = q.shape
     block_q, block_k = _block_sizes(T)
     masked = kmask is not None
+
+    if block_q == T and block_k == T:
+        # whole Q/K/V per program: one fused kernel emits dq, dk and dv
+        # from a single probability recompute; delta = rowsum(do*o) (and
+        # the optional ring dlse fold) happens in-kernel
+        return _flash_bwd_fused(
+            q, k, v, do, o, lse[:, None, :], kmask, sm_scale, causal,
+            dropout=dropout, seed=seed,
+            dlse=None if dlse is None else
+            dlse.astype(jnp.float32)[:, None, :])
+
     delta = jnp.sum(do.astype(jnp.float32) * o.astype(jnp.float32), axis=-1)
     if dlse is not None:
         # lse cotangent (ring-attention merge weights differentiate
@@ -529,12 +551,6 @@ def _flash_bwd_impl(q, k, v, o, lse, do, kmask, sm_scale, causal,
     # middle singleton dim) — replaces the r2 [BH, T, LANES] broadcast
     lse = lse[:, None, :]
     delta = delta[:, None, :]
-
-    if block_q == T and block_k == T:
-        # whole Q/K/V per program: one fused kernel emits dq, dk and dv
-        # from a single probability recompute
-        return _flash_bwd_fused(q, k, v, do, lse, delta, kmask, sm_scale,
-                                causal, dropout=dropout, seed=seed)
 
     dq_specs = [
         pl.BlockSpec((1, block_q, D), lambda bh, qi: (bh, qi, 0)),
@@ -744,24 +760,24 @@ def _flash_bwd_qkv(qkv, o, lse, do, H, kmask, sm_scale, causal):
     n = three_n // 3
     D = n // H
     masked = kmask is not None
-    # delta = rowsum(do * o) per head: [B, T, H] -> [B, H, 1, T]
-    dd = (do.astype(jnp.float32) * o.astype(jnp.float32)).reshape(
-        B, T, H, D).sum(-1)
-    delta = dd.transpose(0, 2, 1)[:, :, None, :]
     G = _pick_g(B, T, D, _bwd_slice_bytes(T, D))
     rows = pl.BlockSpec((G, 1, 1, T), lambda b, h: (b, h, 0, 0))
+    col = pl.BlockSpec((G, T, D), lambda b, h: (b, 0, h))
     in_specs = [
-        pl.BlockSpec((G, T, D), lambda b, h: (b, 0, h)),           # q
+        col,                                                       # q
         pl.BlockSpec((G, T, D), lambda b, h: (b, 0, H + h)),       # k
         pl.BlockSpec((G, T, D), lambda b, h: (b, 0, 2 * H + h)),   # v
-        pl.BlockSpec((G, T, D), lambda b, h: (b, 0, h)),           # do cols
-        rows, rows,
+        col,                                                       # do cols
+        col,                                                       # o cols
+        rows,
     ]
-    args = [qkv, qkv, qkv, do, lse, delta]
+    # delta = rowsum(do*o) happens in-kernel from the o column slice —
+    # the host-side per-head reduce + [B,T,H]->[B,H,1,T] relayout cost
+    # ~0.6 ms/step at the r4 flagship shapes
+    args = [qkv, qkv, qkv, do, o, lse]
     if masked:
         in_specs.append(pl.BlockSpec((G, 1, T), lambda b, h: (b, 0, 0)))
         args.append(kmask)
-    col = pl.BlockSpec((G, T, D), lambda b, h: (b, 0, h))
     dq, dk, dv = pl.pallas_call(
         functools.partial(_bwd_fused_kernel, sm_scale=sm_scale,
                           causal=causal, masked=masked, seq_len=T),

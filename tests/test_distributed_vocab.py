@@ -1,0 +1,100 @@
+"""Parallel + cluster-distributed vocabulary construction
+(nlp/distributed_vocab.py; reference TextPipeline.buildVocabCache and the
+multi-threaded VocabConstructor)."""
+
+import threading
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.nlp.distributed_vocab import (
+    build_vocab_distributed,
+    parallel_count,
+)
+from deeplearning4j_tpu.nlp.vocab import VocabConstructor
+
+
+def _corpus(n=5000, vocab=200, seed=0):
+    rng = np.random.default_rng(seed)
+    return [[f"w{int(i)}" for i in rng.integers(0, vocab, 12)]
+            for _ in range(n)]
+
+
+def test_parallel_count_matches_serial():
+    sents = _corpus()
+    serial, n1 = parallel_count(sents, n_workers=1)
+    par, n2 = parallel_count(sents, n_workers=4, chunk_size=500)
+    assert serial == par and n1 == n2 == len(sents)
+
+
+def test_parallel_constructor_identical_vocab():
+    """n_workers>1 must produce a bit-identical VocabCache (same counts,
+    same index order, same Huffman codes) — the device pipeline depends
+    on deterministic word indexing."""
+    sents = _corpus()
+    a = (VocabConstructor(min_word_frequency=2, n_workers=1,
+                          build_huffman=True)
+         .add_source(sents).build_joint_vocabulary())
+    b = (VocabConstructor(min_word_frequency=2, n_workers=4,
+                          build_huffman=True)
+         .add_source(sents).build_joint_vocabulary())
+    assert a.words() == b.words()
+    for w in a.words():
+        va, vb = a.word_for(w), b.word_for(w)
+        assert va.count == vb.count
+        assert getattr(va, "codes", None) == getattr(vb, "codes", None)
+
+
+def test_parallel_count_with_tokenizer():
+    from deeplearning4j_tpu.nlp.text import DefaultTokenizerFactory
+
+    raw = ["the quick brown fox", "the lazy dog", "the fox"] * 100
+    counts, n = parallel_count(raw, tokenizer_factory=DefaultTokenizerFactory(),
+                               n_workers=2, chunk_size=50)
+    assert n == 300
+    assert counts["the"] == 300 and counts["fox"] == 200
+
+
+def test_build_vocab_distributed_identical_across_workers():
+    """Every cluster worker ends with the same cache from disjoint
+    corpus shards, equal to a single-host build over the full corpus."""
+    from deeplearning4j_tpu.parallel.cluster import (
+        ClusterClient,
+        ClusterCoordinator,
+    )
+
+    sents = _corpus(2000)
+    shards = [sents[0::2], sents[1::2]]
+    coord = ClusterCoordinator(heartbeat_timeout=10.0).start()
+    results = {}
+
+    def worker(wid, shard):
+        c = ClusterClient(coord.address, wid)
+        try:
+            results[wid] = build_vocab_distributed(
+                c, shard, min_word_frequency=2, build_huffman=True)
+        finally:
+            c.close()
+
+    try:
+        a = ClusterClient(coord.address, "wA")
+        b = ClusterClient(coord.address, "wB")
+        a.close(deregister=False)
+        b.close(deregister=False)  # pre-register so workers() sees both
+        ts = [threading.Thread(target=worker, args=(w, s))
+              for w, s in zip(("wA", "wB"), shards)]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join(timeout=60)
+    finally:
+        coord.shutdown()
+
+    ref = (VocabConstructor(min_word_frequency=2, build_huffman=True)
+           .add_source(sents).build_joint_vocabulary())
+    assert set(results) == {"wA", "wB"}
+    for cache in results.values():
+        assert cache.words() == ref.words()
+        assert cache.n_sequences == len(sents)
+        for w in ref.words():
+            assert cache.word_frequency(w) == ref.word_frequency(w)

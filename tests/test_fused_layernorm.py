@@ -1,0 +1,58 @@
+"""Fused Pallas LayerNorm (ops/fused_layernorm.py) vs the jnp reference."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.ops.fused_layernorm import (
+    fused_layer_norm,
+    supports,
+)
+
+
+def _ref(x, g, b, eps=1e-5):
+    mu = jnp.mean(x, -1, keepdims=True)
+    var = jnp.var(x, -1, keepdims=True)
+    return (x - mu) * jax.lax.rsqrt(var + eps) * g + b
+
+
+@pytest.mark.parametrize("shape", [(4, 64, 256), (32, 128), (2, 8, 384)])
+def test_forward_and_grads_match_reference(shape):
+    rng = np.random.default_rng(0)
+    C = shape[-1]
+    x = jnp.asarray(rng.standard_normal(shape) * 2 + 1, jnp.float32)
+    g = jnp.asarray(rng.standard_normal(C) * 0.5 + 1, jnp.float32)
+    b = jnp.asarray(rng.standard_normal(C) * 0.1, jnp.float32)
+    assert supports(shape)
+    np.testing.assert_allclose(
+        np.asarray(fused_layer_norm(x, g, b, 1e-5)),
+        np.asarray(_ref(x, g, b)), atol=2e-5)
+    gr = jax.grad(lambda *a: jnp.sum(jnp.sin(_ref(*a))), (0, 1, 2))(x, g, b)
+    gf = jax.grad(lambda *a: jnp.sum(jnp.sin(fused_layer_norm(*a, 1e-5))),
+                  (0, 1, 2))(x, g, b)
+    for a, r in zip(gf, gr):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(r), atol=2e-4)
+
+
+def test_bf16_path():
+    rng = np.random.default_rng(1)
+    x = jnp.asarray(rng.standard_normal((8, 256)), jnp.bfloat16)
+    g = jnp.ones(256, jnp.bfloat16)
+    b = jnp.zeros(256, jnp.bfloat16)
+    y = fused_layer_norm(x, g, b, 1e-5)
+    assert y.dtype == jnp.bfloat16
+    np.testing.assert_allclose(
+        np.asarray(y, np.float32),
+        np.asarray(_ref(x.astype(jnp.float32), 1.0, 0.0), np.float32),
+        atol=2e-2)
+
+
+def test_supports_envelope():
+    assert supports((32, 512, 256))
+    assert not supports((32, 512, 200))   # C not lane-tile
+    assert not supports((3, 256))         # N % 8
+    assert not supports((256,))           # needs a batch dim
+    # bn must be lane-tile or full-N for the stat rows
+    assert supports((8, 256))             # bn == N == 8
+    assert not supports((24, 256))        # bn=8, N=24: illegal stat block

@@ -352,3 +352,34 @@ def test_dp_only_axes_still_works(dense, lm_data):
     net.set_mesh(make_mesh({"data": 8}), axes={"data": "data"})
     net.fit(lm_data, epochs=3)
     assert abs(net.score_value - dense.score_value) < ATOL
+
+
+def test_mid_training_set_mesh_preserves_flat_moments(lm_data):
+    """The flat fused optimizer's accumulated moments unflatten into the
+    tree layout when a param-placement mesh arrives mid-training — no
+    silent Adam warm-restart."""
+    import jax
+    import jax.numpy as jnp
+
+    from deeplearning4j_tpu.nn.updater import FlatViewTransform
+
+    net = _fresh_lm()
+    net.fit(lm_data, epochs=2)
+    assert isinstance(net.tx, FlatViewTransform)
+    # the flat mu vector, for comparison after the re-shard
+    flat_mu = None
+    for leaf in jax.tree.leaves(net.opt_state):
+        if getattr(leaf, "ndim", 0) == 1 and leaf.size > 1000:
+            flat_mu = np.asarray(leaf)
+            break
+    assert flat_mu is not None and np.abs(flat_mu).max() > 0
+    net.set_mesh(make_mesh({"model": 2}), axes={"model": "model"})
+    assert not isinstance(net.tx, FlatViewTransform)
+    tree_leaves = [np.ravel(np.asarray(l)) for l in
+                   jax.tree.leaves(net.opt_state)
+                   if getattr(l, "ndim", 0) >= 1]
+    total = np.abs(np.concatenate(tree_leaves)).max()
+    assert total > 0, "moments were zeroed by the re-shard"
+    # and training continues
+    net.fit(lm_data, epochs=1)
+    assert np.isfinite(float(net.score_value))

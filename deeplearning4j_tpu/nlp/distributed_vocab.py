@@ -53,6 +53,10 @@ def parallel_count(sequences: Iterable, tokenizer_factory=None,
     part). Falls back to inline counting for n_workers <= 1.
     """
     n_workers = n_workers or multiprocessing.cpu_count()
+    if n_workers <= 1:
+        # stream — never buffer the corpus (the serial constructor's
+        # memory profile)
+        return _count_chunk((sequences, tokenizer_factory))
     chunks: List[list] = []
     buf: list = []
     for s in sequences:
@@ -62,9 +66,9 @@ def parallel_count(sequences: Iterable, tokenizer_factory=None,
             buf = []
     if buf:
         chunks.append(buf)
-    if n_workers <= 1 or len(chunks) <= 1:
-        total, n_seq = _count_chunk((sum(chunks, []), tokenizer_factory))
-        return total, n_seq
+    if len(chunks) <= 1:
+        only = chunks[0] if chunks else []
+        return _count_chunk((only, tokenizer_factory))
     total: Counter = Counter()
     n_seq = 0
     with multiprocessing.Pool(min(n_workers, len(chunks))) as pool:

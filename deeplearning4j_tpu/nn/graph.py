@@ -101,7 +101,8 @@ class ComputationGraph:
         self.params = params
         self.state = state
         self.tx = build_optimizer(
-            g, {n: v.layer for n, v in self.layer_vertices.items()})
+            g, {n: v.layer for n, v in self.layer_vertices.items()},
+            params=params)
         self.opt_state = self.tx.init(params)
         return self
 

@@ -35,17 +35,12 @@ class LayerNormImpl(LayerImpl):
         return {"gamma": jnp.ones((n,), dtype), "beta": jnp.zeros((n,), dtype)}, {}
 
     def apply(self, conf, params, state, x, *, train=False, rng=None, mask=None):
-        from deeplearning4j_tpu.ops.fused_layernorm import (
-            fused_layer_norm,
-            supports as ln_supports,
-        )
-
-        if ln_supports(x.shape):
-            # single-pass Pallas kernel fwd+bwd (XLA's lowering spends
-            # ~1 ms/step across the flagship's 12 LNs on multi-pass
-            # fusions with f32 intermediates; r4 trace)
-            return fused_layer_norm(x, params["gamma"], params["beta"],
-                                    float(conf.eps)), state
+        # Deliberately the plain jnp form: a Pallas fused LN exists
+        # (ops/fused_layernorm.py) but LOST a same-window A/B on v5e
+        # (0.494 MFU with XLA's lowering vs 0.455 fused at the flagship
+        # shapes) — XLA fuses the normalize into neighboring residual/
+        # matmul fusions, which a pallas_call boundary forbids. Kept as
+        # an op for shapes where that tradeoff flips.
         mu = jnp.mean(x, axis=-1, keepdims=True)
         var = jnp.var(x, axis=-1, keepdims=True)
         xn = (x - mu) * jax.lax.rsqrt(var + conf.eps)

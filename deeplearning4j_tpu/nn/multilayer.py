@@ -98,7 +98,8 @@ class MultiLayerNetwork:
             state[name] = s
         self.params = params
         self.state = state
-        self.tx = build_optimizer(g, dict(zip(self.layer_names, self.layer_confs)))
+        self.tx = build_optimizer(g, dict(zip(self.layer_names, self.layer_confs)),
+                                  params=params)
         self.opt_state = self.tx.init(params)
         return self
 

@@ -91,8 +91,10 @@ def _fwd_slice_bytes(T, D):
 
 
 def _bwd_slice_bytes(T, D):
-    # double-buffered q/k/v/do/dq/dk/dv bf16 + s/p/dp f32 + ds bf16
-    return 2 * 7 * T * D * 2 + 3 * T * T * 4 + T * T * 2 + 3 * T * D * 4
+    # double-buffered q/k/v/do/o/dq/dk/dv bf16 + s/p/dp f32 + ds bf16
+    # (o streams in since the fused kernel computes delta = rowsum(do*o)
+    # in-kernel, r4)
+    return 2 * 8 * T * D * 2 + 3 * T * T * 4 + T * T * 2 + 3 * T * D * 4
 
 
 def _use_interpret() -> bool:

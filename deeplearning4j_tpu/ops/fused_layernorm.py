@@ -1,16 +1,21 @@
-"""Fused LayerNorm via Pallas (r4 MFU work).
+"""Fused LayerNorm via Pallas — available but NOT the default.
 
-XLA lowers LayerNorm fwd+bwd into several elementwise/reduce fusions with
-f32 intermediates (~1 ms/step across the 12 LNs of the 6-block flagship,
-r4 trace). These kernels do one read + one write per pass: the forward
-saves per-row (mu, rstd) for the backward; the backward emits dx plus
-per-block dgamma/dbeta partials that sum outside (a [n_blocks, C] sum is
-noise next to the saved traffic).
+One read + one write per pass: the forward saves per-row (mu, rstd), the
+backward emits dx plus per-block dgamma/dbeta partials that sum outside.
 
-Envelope: feature dim C a lane-tile multiple (C % 128 == 0) and row count
-divisible by the row block; anything else falls back to the jnp path in
-nn/layers/attention.LayerNormImpl. Interpret mode runs the same kernels
-on CPU for the unit tests.
+Measured result (v5e, same-window A/B at the r4 flagship shapes — 6
+blocks, d_model 256, seq 512): the fused kernel LOSES to XLA's native
+lowering, 0.455 vs 0.494 MFU. XLA fuses the normalize chain INTO the
+neighboring residual adds and matmul prologues; a pallas_call is a
+fusion barrier, so the kernel's saved LN-local traffic is outweighed by
+the materialization it forces around itself. `nn/layers/attention.
+LayerNormImpl` therefore keeps the jnp form; this op remains for
+compositions where LN has no fusable neighbors (e.g. standalone
+normalization passes) and as the measured record of the experiment.
+
+Envelope: feature dim C a lane-tile multiple (C % 128 == 0) and a
+lane-legal row block. Interpret mode runs the same kernels on CPU for
+the unit tests.
 """
 
 from __future__ import annotations

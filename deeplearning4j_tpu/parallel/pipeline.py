@@ -170,7 +170,14 @@ class PipelinePlan:
         if units % n_stages:
             raise ValueError(
                 f"the {units} repeated blocks do not divide into "
-                f"{n_stages} pipeline stages")
+                f"{n_stages} pipeline stages. The GPipe schedule runs one "
+                "stage program over params stacked on a [S] axis, so the "
+                "pipelined body must be a run of structurally IDENTICAL "
+                "blocks (uniform transformer blocks qualify; VGG/ResNet-"
+                "style conv stacks whose channel widths grow between "
+                "stages do not — their per-stage compute differs, which "
+                "would need a heterogeneous-stage schedule; shard those "
+                "over the data axis instead)")
         per_stage = units // n_stages
         hi = lo + units * period
         body_segs = segs[lo:hi]

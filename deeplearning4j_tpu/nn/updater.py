@@ -152,6 +152,17 @@ def _unflatten_into(vec, leaves, treedef):
     return jax.tree.unflatten(treedef, outs)
 
 
+def rebuild_other_layout(net):
+    """A GradientTransformation in the OPPOSITE updater-state layout of
+    net.tx (per-leaf tree <-> flat view) — the checkpoint layout bridge
+    shared by ModelSerializer.restore and the orbax ShardedCheckpointer
+    (a checkpoint may hold either layout regardless of the target net's
+    default)."""
+    was_flat = isinstance(net.tx, FlatViewTransform)
+    return build_optimizer(net.conf.conf, named_layer_confs(net),
+                           flat=not was_flat)
+
+
 def unflatten_state_like(flat_state, params):
     """Convert a FlatViewTransform optimizer state into the tree-shaped
     layout of the same update rule: any 1-D f32 moment vector of

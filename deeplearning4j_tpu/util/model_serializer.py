@@ -117,18 +117,13 @@ class ModelSerializer:
                 except ValueError:
                     # layout bridge: the checkpoint's updater state may be
                     # in the other optimizer layout (per-leaf tree vs the
-                    # r4 flat-view fused state) — rebuild the optimizer in
-                    # the matching layout and retry
+                    # flat-view fused state) — rebuild and retry (`net` is
+                    # local to this restore, so mutating is safe)
                     from deeplearning4j_tpu.nn.updater import (
-                        FlatViewTransform,
-                        build_optimizer,
-                        named_layer_confs,
+                        rebuild_other_layout,
                     )
 
-                    was_flat = isinstance(net.tx, FlatViewTransform)
-                    net.tx = build_optimizer(net.conf.conf,
-                                             named_layer_confs(net),
-                                             flat=not was_flat)
+                    net.tx = rebuild_other_layout(net)
                     net.opt_state = _restore_tree(
                         net.tx.init(net.params), leaves)
             net.iteration_count = meta.get("iteration", 0)

@@ -129,13 +129,33 @@ class ShardedCheckpointer:
         d = self._step_dir(step)
         if net.params is None:
             net.init()
-        abstract = jax.tree.map(
-            lambda x: jax.ShapeDtypeStruct(x.shape, x.dtype,
-                                           sharding=getattr(x, "sharding",
-                                                            None)),
-            _tree(net))
-        restored = ocp.StandardCheckpointer().restore(
-            os.path.join(d, "model"), abstract)
+        def _abstract(tree):
+            return jax.tree.map(
+                lambda x: jax.ShapeDtypeStruct(x.shape, x.dtype,
+                                               sharding=getattr(
+                                                   x, "sharding", None)),
+                tree)
+
+        ckptr = ocp.StandardCheckpointer()
+        try:
+            restored = ckptr.restore(os.path.join(d, "model"),
+                                     _abstract(_tree(net)))
+        except ValueError:
+            # optimizer-layout bridge (updater.rebuild_other_layout): the
+            # checkpoint may hold the OTHER updater-state layout (per-leaf
+            # tree vs the flat-view fused state). Retry against the
+            # opposite layout's template WITHOUT touching the net — only
+            # on success does set_optimizer swap the transform in (which
+            # also invalidates any cached jitted train step built over
+            # the old one); a genuinely corrupt checkpoint re-raises with
+            # the net unchanged.
+            from deeplearning4j_tpu.nn.updater import rebuild_other_layout
+
+            alt_tx = rebuild_other_layout(net)
+            tmpl = dict(_tree(net), opt_state=alt_tx.init(net.params))
+            restored = ckptr.restore(os.path.join(d, "model"),
+                                     _abstract(tmpl))
+            net.set_optimizer(alt_tx)
         net.params = restored["params"]
         net.opt_state = restored["opt_state"]
         net.state = restored["state"]

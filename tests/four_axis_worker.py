@@ -1,0 +1,42 @@
+"""Subprocess body for the 4-axis composition test (needs 16 virtual
+devices; the suite conftest pins the process to 8)."""
+from deeplearning4j_tpu.util.virtual_devices import ensure_cpu_devices
+
+ensure_cpu_devices(16)
+
+import numpy as np
+
+from deeplearning4j_tpu.datasets.api import DataSet
+from deeplearning4j_tpu.models.transformer import transformer_moe_lm
+from deeplearning4j_tpu.parallel.mesh import make_mesh
+
+
+def main():
+    V, T, B = 64, 8, 8
+    rng = np.random.default_rng(0)
+    toks = np.asarray(rng.integers(0, V, (B, T)), np.int32)
+    labs = np.eye(V, dtype=np.float32)[np.roll(toks, -1, axis=1)]
+    ds = DataSet(toks, labs)
+
+    def net_():
+        n = transformer_moe_lm(vocab_size=V, d_model=16, n_heads=2,
+                               n_layers=4, n_experts=4, top_k=2,
+                               d_expert_hidden=24, max_length=T,
+                               capacity_factor=2.0)
+        n.init()
+        return n
+
+    dense = net_()
+    dense.fit(ds)
+    four = net_()
+    four.set_mesh(make_mesh({"data": 2, "model": 2, "pipe": 2, "expert": 2}),
+                  axes={"data": "data", "model": "model", "pipe": "pipe",
+                        "expert": "expert"}, n_microbatches=2)
+    four.fit(ds)
+    diff = abs(float(four.score_value) - float(dense.score_value))
+    assert diff < 2e-3, (float(four.score_value), float(dense.score_value))
+    print(f"FOUR_AXIS_OK {diff:.2e}")
+
+
+if __name__ == "__main__":
+    main()

@@ -59,3 +59,48 @@ def test_restore_empty_dir_raises(tmp_path):
     net = _net()
     with pytest.raises(FileNotFoundError):
         ShardedCheckpointer(str(tmp_path)).restore(net)
+
+
+def test_restore_bridges_optimizer_layouts(tmp_path):
+    """A checkpoint saved with the per-leaf (tree) updater state restores
+    into a net whose default optimizer is the flat fused layout, and vice
+    versa (the r4 flat-view optimizer changed the opt-state pytree)."""
+    import jax
+    import numpy as np
+
+    from deeplearning4j_tpu.datasets.api import DataSet
+    from deeplearning4j_tpu.models.transformer import transformer_lm
+    from deeplearning4j_tpu.nn.updater import (
+        FlatViewTransform,
+        build_optimizer,
+        named_layer_confs,
+    )
+    from deeplearning4j_tpu.util.orbax_checkpoint import ShardedCheckpointer
+
+    def build(flat):
+        net = transformer_lm(vocab_size=64, d_model=16, n_heads=2,
+                             n_layers=2, d_ff=32, max_length=8)
+        net.init()
+        net.set_optimizer(build_optimizer(net.conf.conf,
+                                          named_layer_confs(net), flat=flat))
+        return net
+
+    rng = np.random.default_rng(0)
+    toks = np.asarray(rng.integers(0, 64, (4, 8)), np.int32)
+    ds = DataSet(toks, np.eye(64, dtype=np.float32)[np.roll(toks, -1, 1)])
+
+    src = build(flat=True)
+    assert isinstance(src.tx, FlatViewTransform)
+    src.fit(ds)
+    mgr = ShardedCheckpointer(str(tmp_path / "ck"))
+    mgr.save(src, step=1)
+    mgr.wait()
+
+    dst = build(flat=False)  # the OTHER layout
+    mgr2 = ShardedCheckpointer(str(tmp_path / "ck"))
+    mgr2.restore(dst)
+    np.testing.assert_allclose(
+        np.asarray(dst.output(toks)[0], np.float32),
+        np.asarray(src.output(toks)[0], np.float32), atol=1e-6)
+    dst.fit(ds)  # training continues with the restored (flat) state
+    assert np.isfinite(float(dst.score_value))

@@ -324,6 +324,9 @@ def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, *rest,
     seed_ref = rest.pop(0) if dropout else None
     (dq_ref,) = rest
     qi = pl.program_id(1)
+    # program_id must be read OUTSIDE the fori_loop body (interpret mode
+    # cannot lower it from inside the loop's closed jaxpr)
+    bh0 = pl.program_id(0) if dropout else None
     q = q_ref[...]                                          # [G, bq, D]
     do = do_ref[...]
     lse = lse_ref[:, 0]                                     # [G, bq]
@@ -351,10 +354,10 @@ def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, *rest,
         dp = jax.lax.dot_general(do, vb, (((2,), (2,)), ((0,), (0,))),
                                  preferred_element_type=jnp.float32)
         if dropout:
-            ks = _keep_mask(seed_ref[0, 0],
-                            pl.program_id(0) * G * bh_stride, bh_stride,
-                            G, qi * block_q, j * block_k, block_q,
-                            block_k, seq_len, dropout).astype(jnp.float32)
+            ks = _keep_mask(seed_ref[0, 0], bh0 * G * bh_stride,
+                            bh_stride, G, qi * block_q, j * block_k,
+                            block_q, block_k, seq_len,
+                            dropout).astype(jnp.float32)
             dp = dp * (ks * (1.0 / (1.0 - dropout)))
         ds = (p * (dp - delta[..., None]) * sm_scale).astype(kb.dtype)
         return dq + jax.lax.dot_general(
@@ -374,6 +377,7 @@ def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, *rest,
     seed_ref = rest.pop(0) if dropout else None
     dk_ref, dv_ref = rest
     ki = pl.program_id(1)
+    bh0 = pl.program_id(0) if dropout else None  # see _dq_kernel note
     kb = k_ref[...]                                         # [G, bk, D]
     vb = v_ref[...]
     G = kb.shape[0]
@@ -403,10 +407,10 @@ def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, *rest,
         dp = jax.lax.dot_general(dob, vb, (((2,), (2,)), ((0,), (0,))),
                                  preferred_element_type=jnp.float32)
         if dropout:
-            ks = _keep_mask(seed_ref[0, 0],
-                            pl.program_id(0) * G * bh_stride, bh_stride,
-                            G, j * block_q, ki * block_k, block_q,
-                            block_k, seq_len, dropout).astype(jnp.float32)
+            ks = _keep_mask(seed_ref[0, 0], bh0 * G * bh_stride,
+                            bh_stride, G, j * block_q, ki * block_k,
+                            block_q, block_k, seq_len,
+                            dropout).astype(jnp.float32)
             ks = ks * (1.0 / (1.0 - dropout))
             pd = p * ks
             dp = dp * ks

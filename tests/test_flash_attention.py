@@ -302,3 +302,31 @@ def test_bf16_backward_matches_f32_reference():
         scale = max(np.abs(b32).max(), 1e-3)
         assert np.abs(a32 - b32).max() / scale < 0.05, (
             np.abs(a32 - b32).max(), scale)
+
+
+def test_dropout_streaming_kernels_match_dense():
+    """T > BLOCK_K_MAX routes the backward through the streaming dq+dkv
+    kernels — the dropout keep-mask must regenerate identically there
+    (absolute-coordinate hash), not just in the fused single-block path
+    the other dropout tests cover."""
+    from deeplearning4j_tpu.ops.flash_attention import flash_attention
+
+    B, H, T, D = 1, 2, 1024, 32
+    rate = 0.15
+    rng = np.random.default_rng(9)
+    q, k, v = (jnp.asarray(rng.standard_normal((B, H, T, D)), jnp.float32)
+               for _ in range(3))
+    key = jax.random.PRNGKey(11)
+    seed = int(jax.random.randint(key, (1, 1), 0, 2**31 - 1,
+                                  dtype=jnp.int32)[0, 0])
+    ref_fn = lambda q, k, v: _dense_dropout_ref(q, k, v, seed, rate, T, H)
+    out_fn = lambda q, k, v: flash_attention(
+        q, k, v, causal=True, dropout=rate, dropout_rng=key)
+    np.testing.assert_allclose(np.asarray(out_fn(q, k, v)),
+                               np.asarray(ref_fn(q, k, v)), atol=2e-5)
+    gref = jax.grad(lambda q, k, v: jnp.sum(ref_fn(q, k, v) ** 2),
+                    (0, 1, 2))(q, k, v)
+    gout = jax.grad(lambda q, k, v: jnp.sum(out_fn(q, k, v) ** 2),
+                    (0, 1, 2))(q, k, v)
+    for a, b in zip(gout, gref):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=2e-4)

@@ -234,15 +234,18 @@ def _train_on_cluster(net, args, it) -> None:
         # while the probe still heartbeats so the claim cannot be stolen
         batches = [ds for i, ds in enumerate(it)
                    if i % args.num_workers == shard_idx]
-    finally:
-        # keep the worker in the alive set through the handoff to the
-        # training client (same worker_id): deregistering here would free
-        # the slot for a sweeping replacement during the gap
+    except BaseException:
+        # the claim survives one heartbeat_timeout for a same-id restart
         probe.close(deregister=False)
+        raise
     print(f"worker {worker_id} shard {shard_idx}: {len(batches)} local batches")
+    # hand the LIVE probe to the worker loop: its heartbeat keeps the
+    # claimed slot protected through net/data setup — closing here would
+    # leave the slot sweepable for one heartbeat_timeout (ADVICE r4)
     run_elastic_worker(args.cluster, worker_id, net, batches,
                        sync_every=args.sync_every,
-                       checkpoint_path=args.checkpoint, epochs=args.epochs)
+                       checkpoint_path=args.checkpoint, epochs=args.epochs,
+                       client=probe)
 
 
 def _cmd_train(args) -> int:

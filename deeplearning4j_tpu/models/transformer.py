@@ -152,3 +152,20 @@ def transformer_flops_per_token(vocab_size, d_model, n_layers, d_ff, seq_len):
     return 3 * fwd  # fwd + bwd(2x)
 
 
+def transformer_moe_flops_per_token(vocab_size, d_model, n_layers,
+                                    n_experts, top_k, d_expert_hidden,
+                                    seq_len):
+    """Analytic fwd+bwd FLOPs per token for the MoE LM: the dense FF term
+    becomes top_k expert FFNs + the router matmul. USEFUL flops only —
+    capacity-buffer zero padding is the implementation's overhead, not
+    model compute, so the MFU derived from this is honest about it."""
+    per_layer = (
+        4 * 2 * d_model * d_model
+        + top_k * 2 * 2 * d_model * d_expert_hidden  # k routed expert FFNs
+        + 2 * d_model * n_experts                    # router logits
+        + 2 * 2 * seq_len * d_model
+    )
+    fwd = n_layers * per_layer + 2 * d_model * vocab_size
+    return 3 * fwd
+
+

@@ -174,15 +174,20 @@ class PosAwareTokenizerFactory:
     """TokenizerFactory-compatible wrapper that attaches POS tags: its
     tokenizers yield `word#pos` strings (the reference PoStagger + SWN3
     keying), so downstream vocab/embedding pipelines can train on
-    sense-separated tokens."""
+    sense-separated tokens. Tagging routes through the pluggable
+    annotation engine (nlp/annotation.py — the UIMA AnalysisEngine slot),
+    so a spaCy engine upgrades this factory without code changes."""
 
-    def __init__(self, base_factory=None):
+    def __init__(self, base_factory=None, engine=None):
         from deeplearning4j_tpu.nlp.text import DefaultTokenizerFactory
 
         self.base = base_factory or DefaultTokenizerFactory()
+        self.engine = engine
 
     def create(self, text: str):
+        from deeplearning4j_tpu.nlp.annotation import get_annotation_engine
         from deeplearning4j_tpu.nlp.text import Tokenizer
 
+        eng = self.engine or get_annotation_engine()
         toks = self.base.create(text).get_tokens()
-        return Tokenizer([f"{w}#{p}" for w, p in pos_tag(toks)])
+        return Tokenizer([f"{w}#{p}" for w, p in eng.pos_tags(toks)])

@@ -71,6 +71,7 @@ class ComputationGraph:
         self._train_step = None
         self._scan_fit = None
         self._output_jit = None
+        self._score_examples_jit = {}
         self._rng = None
         self._mesh = None
         self._zero1 = False
@@ -408,7 +409,9 @@ class ComputationGraph:
         """Jitted donated train step (same contract as MLN._get_train_step)."""
         if self._train_step is None:
             axes_map = getattr(self, "_mesh_axes", None) or {}
-            if "seq" in axes_map:
+            # seq WITH pipe routes through the PP schedule (its shard_map
+            # is manual over the seq axis too); seq alone takes the SP step
+            if "seq" in axes_map and "pipe" not in axes_map:
                 from deeplearning4j_tpu.parallel.sequence_parallel import (
                     make_sp_train_step,
                 )
@@ -671,6 +674,81 @@ class ComputationGraph:
         loss, _ = self._loss(self._canonical_params(), self.state, None,
                              self._batch_dict(mds), train=training)
         return float(loss)
+
+    def score_examples(self, ds, add_regularization: bool = False):
+        """One score PER EXAMPLE [batch] over the DAG — summed across all
+        output layers like score() (reference spark
+        computationgraph/scoring/ScoreExamplesFunction.java). Inference-
+        mode forward; `add_regularization` adds the network L1/L2 penalty
+        to each example. With a mesh set, shards over the 'data' axis."""
+        mds = self._to_mds(ds)
+        batch = self._batch_dict(mds)
+        key = bool(add_regularization)
+        if key not in self._score_examples_jit:
+            def _scores(params, state, batch):
+                input_dict = dict(zip(self.conf.network_inputs,
+                                      batch["features"]))
+                masks = {}
+                if batch.get("features_masks") is not None:
+                    masks = {k: m for k, m in zip(self.conf.network_inputs,
+                                                  batch["features_masks"])
+                             if m is not None}
+                acts, _, _ = self._forward(params, state, input_dict,
+                                           train=False, rng=None,
+                                           masks=masks, collect=True)
+                per = 0.0
+                labels_list = batch["labels"]
+                lmasks = (batch.get("labels_masks")
+                          or [None] * len(labels_list))
+                cdtype = self.compute_dtype
+                for out_name, labels, lmask in zip(
+                        self.conf.network_outputs, labels_list, lmasks):
+                    vconf = self.conf.vertices[out_name]
+                    x = acts[self.conf.vertex_inputs[out_name][0]]
+                    if vconf.preprocessor is not None:
+                        x = vconf.preprocessor.pre_process(x)
+                    p_out = params[out_name]
+                    if cdtype != self.param_dtype:
+                        p_out = tree_cast(p_out, cdtype)
+                    per = per + self.impls[out_name].loss(
+                        vconf.layer, p_out, x, labels, train=False,
+                        rng=None, mask=lmask, per_example=True)
+                if add_regularization:
+                    reg = 0.0
+                    for name, v in self.layer_vertices.items():
+                        reg = reg + l1_l2_penalty(v.layer, params[name])
+                    per = per + reg
+                return per
+
+            axes = getattr(self, "_mesh_axes", None)
+            data_axis = (axes or {}).get("data", "data")
+            if (self._mesh is not None
+                    and data_axis in self._mesh.axis_names):
+                from deeplearning4j_tpu.nn.training import mesh_shardings
+
+                repl, data = mesh_shardings(self._mesh, data_axis)
+                p_in = (None if (getattr(self, "_pp_plan", None) is not None
+                                 or getattr(self, "_param_sh", None)
+                                 is not None) else repl)
+                batch_sh = jax.tree.map(lambda _: data, batch)
+                self._score_examples_jit[key] = jax.jit(
+                    _scores, in_shardings=(p_in, repl, batch_sh),
+                    out_shardings=data)
+            else:
+                self._score_examples_jit[key] = jax.jit(_scores)
+        axes = getattr(self, "_mesh_axes", None)
+        data_axis = (axes or {}).get("data", "data")
+        params = self._canonical_params()
+        if self._mesh is not None and data_axis in self._mesh.axis_names:
+            from deeplearning4j_tpu.nn.training import pad_batch_to_multiple
+
+            B = np.asarray(mds.features[0]).shape[0]
+            batch, pad = pad_batch_to_multiple(
+                batch, self._mesh.shape[data_axis])
+            per = self._score_examples_jit[key](params, self.state, batch)
+            return np.asarray(per)[:B]
+        return np.asarray(
+            self._score_examples_jit[key](params, self.state, batch))
 
     def evaluate(self, it, top_n: int = 1):
         from deeplearning4j_tpu.eval.evaluation import Evaluation

@@ -12,6 +12,8 @@ onto the MXU directly — no im2col materialization); pooling is
 
 from __future__ import annotations
 
+import functools
+
 import jax
 import jax.numpy as jnp
 from jax import lax
@@ -70,6 +72,41 @@ class ConvolutionImpl(LayerImpl):
         return get_activation(conf.activation)(z), state
 
 
+@functools.partial(jax.custom_vjp, nondiff_argnums=(1, 2))
+def _maxpool_tiled(x, kh, kw):
+    """Non-overlapping max pool (stride == kernel, dims divisible).
+
+    XLA differentiates reduce_window-max through select_and_scatter, which
+    ran at ~0.36 ms/step in the VGG-16 trace (r5) — an order of magnitude
+    over the HBM cost of the tensors involved. For the tiled case the
+    backward is an equality mask: dx = (x == y↑) · dy↑/ties, where ↑ is
+    the kh×kw tile upsample and `ties` the per-window count of maxima
+    (gradient mass is split across ties; select_and_scatter credits the
+    first — both are valid subgradients, identical when the max is
+    unique)."""
+    kh, kw = int(kh), int(kw)
+    return lax.reduce_window(x, -jnp.inf, lax.max, (1, kh, kw, 1),
+                             (1, kh, kw, 1), "VALID")
+
+
+def _maxpool_tiled_fwd(x, kh, kw):
+    y = _maxpool_tiled(x, kh, kw)
+    return y, (x, y)
+
+
+def _maxpool_tiled_bwd(kh, kw, res, dy):
+    x, y = res
+    up = jnp.repeat(jnp.repeat(y, kh, axis=1), kw, axis=2)
+    eq = (x == up).astype(dy.dtype)
+    ties = lax.reduce_window(eq, 0.0, lax.add, (1, kh, kw, 1),
+                             (1, kh, kw, 1), "VALID")
+    scaled = jnp.repeat(jnp.repeat(dy / ties, kh, axis=1), kw, axis=2)
+    return (eq * scaled,)
+
+
+_maxpool_tiled.defvjp(_maxpool_tiled_fwd, _maxpool_tiled_bwd)
+
+
 @register_impl(SubsamplingLayer)
 class SubsamplingImpl(LayerImpl):
     def apply(self, conf, params, state, x, *, train=False, rng=None, mask=None):
@@ -84,6 +121,14 @@ class SubsamplingImpl(LayerImpl):
         strides = (1, sh, sw, 1)
         pt = conf.pooling_type
         if pt in (PoolingType.MAX, "max"):
+            zero_pad = (not isinstance(pad, list)
+                        or all(p == (0, 0) for p in pad))
+            if (zero_pad and sh == kh and sw == kw
+                    and x.shape[1] % kh == 0 and x.shape[2] % kw == 0):
+                # tiled (non-overlapping, exactly-dividing) pooling: SAME
+                # and VALID coincide (zero padding), and the custom
+                # equality-mask backward replaces select_and_scatter
+                return _maxpool_tiled(x, kh, kw), state
             return (
                 lax.reduce_window(x, -jnp.inf, lax.max, window, strides, pad4),
                 state,
@@ -126,8 +171,24 @@ class BatchNormImpl(LayerImpl):
             # at least f32 for the stats, but never truncate wider inputs
             # (f64 gradient checks rely on exact mean cancellation)
             stat_dtype = jnp.promote_types(x.dtype, jnp.float32)
-            mean = jnp.mean(x.astype(stat_dtype), axis=axes)
-            var = jnp.var(x.astype(stat_dtype), axis=axes)
+            xf = x.astype(stat_dtype)
+            if x.dtype == jnp.bfloat16:
+                # one-pass stats: sum and sum-of-squares reduce in a single
+                # read of the activation (XLA multi-output fusion) — the
+                # two-pass mean-then-var formulation re-read every BN input
+                # twice and was ~40% of the VGG-16 step (r5 trace). Only
+                # for bf16 compute (the TPU perf path): E[x^2]-mean^2 in
+                # the f32 accumulator is exact enough there (bf16 data has
+                # ~3 significant digits; mean^2/var would need to exceed
+                # 2^24 to cancel), while f32/f64 inputs keep the
+                # cancellation-exact mean-then-var form below.
+                n = x.size // x.shape[-1]
+                mean = jnp.sum(xf, axis=axes) / n
+                var = jnp.maximum(
+                    jnp.sum(xf * xf, axis=axes) / n - mean * mean, 0.0)
+            else:
+                mean = jnp.mean(xf, axis=axes)
+                var = jnp.var(xf, axis=axes)
             decay = conf.decay
             new_state = {
                 "mean": (decay * state["mean"] + (1 - decay) * mean).astype(

@@ -78,21 +78,25 @@ class OutputImpl(LayerImpl):
         _, z = _dense_forward(conf, params, x, train, rng)
         return z
 
-    def loss(self, conf, params, x, labels, *, train=False, rng=None, mask=None):
+    def loss(self, conf, params, x, labels, *, train=False, rng=None,
+             mask=None, per_example=False):
+        """Scalar training loss; ``per_example=True`` returns one score per
+        example [B] instead (reference ScoreExamplesFunction semantics)."""
         act = (conf.activation or "").lower()
         if self._use_fused_head(conf, params, x, labels, act):
             from deeplearning4j_tpu.ops.fused_softmax_xent import (
                 softmax_xent_head,
             )
-            from deeplearning4j_tpu.ops.losses import _masked_mean
+            from deeplearning4j_tpu.ops.losses import _finish
 
             if conf.dropout:
                 x = apply_dropout(x, conf.dropout, rng, train=train)
             per = softmax_xent_head(x, params["W"], params["b"], labels)
-            return _masked_mean(per, mask)
+            return _finish(per, mask, not per_example)
         y, z = _dense_forward(conf, params, x, train, rng)
         logits = z if act in ("softmax", "sigmoid") else None
-        return compute_loss(conf.loss_function, labels, y, mask, logits=logits)
+        return compute_loss(conf.loss_function, labels, y, mask,
+                            logits=logits, reduce=not per_example)
 
     @staticmethod
     def _use_fused_head(conf, params, x, labels, act):

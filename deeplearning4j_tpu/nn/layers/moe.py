@@ -69,8 +69,8 @@ class MixtureOfExpertsLayer(FeedForwardLayer):
         return InputType.feed_forward(self.n_out)
 
 
-def moe_gates_from_logits(logits, top_k):
-    """Top-k renormalized softmax gates [N, E] (zeros outside the top-k).
+def moe_topk_from_logits(logits, top_k):
+    """(gates [N, E], expert ids [N, k], renormalized probs [N, k]).
 
     For the practical regime (small k, modest E) the top-k runs as k
     argmax+mask passes and the gate matrix is built from one-hots —
@@ -83,21 +83,27 @@ def moe_gates_from_logits(logits, top_k):
     N = logits.shape[0]
     if top_k <= 4 and E <= 64:
         x = logits
-        onehots, vals = [], []
+        onehots, vals, ids = [], [], []
         for _ in range(top_k):
             i = jnp.argmax(x, axis=-1)
             oh = jax.nn.one_hot(i, E, dtype=logits.dtype)   # [N, E]
             vals.append(jnp.max(x, axis=-1))
             onehots.append(oh)
+            ids.append(i)
             x = jnp.where(oh > 0, jnp.finfo(x.dtype).min, x)
         probs = jax.nn.softmax(jnp.stack(vals, -1), axis=-1)  # [N, k]
         gates = sum(oh * probs[:, j:j + 1] for j, oh in enumerate(onehots))
-        return gates
+        return gates, jnp.stack(ids, -1), probs
     top_vals, top_idx = jax.lax.top_k(logits, top_k)      # [N, k]
     probs = jax.nn.softmax(top_vals, axis=-1)             # renormalized
     gates = jnp.zeros((N, E), logits.dtype).at[
         jnp.arange(N)[:, None], top_idx].set(probs)
-    return gates
+    return gates, top_idx, probs
+
+
+def moe_gates_from_logits(logits, top_k):
+    """Top-k renormalized softmax gates [N, E] (zeros outside the top-k)."""
+    return moe_topk_from_logits(logits, top_k)[0]
 
 
 def moe_gates(x2d, Wg, top_k):
@@ -143,9 +149,24 @@ def moe_load_balance_loss(logits, gates, top_k):
     return E * jnp.sum(frac * importance)
 
 
+# Routed dispatch implementation: "einsum" (GShard one-hot formulation,
+# r5 default — with MXU-friendly float routing metadata) or "gather"
+# (index-based take_along_axis/scatter). The r5 trace showed BOTH
+# formulations' real cost was the routing METADATA — an s32 cumsum
+# lowered to reduce-window (~1.2 ms/step) plus pred/s32 elementwise and
+# small-axis gathers (several ms) — while the einsum dispatch itself is
+# ~50 us of MXU time; the gather form additionally pays TPU's slow
+# generic gather lowering (take_along_axis at ~50 GB/s effective). The
+# einsum path therefore computes positions via a STRICTLY-LOWER-
+# TRIANGULAR MATMUL (exclusive prefix counts on the MXU; counts <= S
+# are exact in the f32 accumulator) and keeps every mask in the compute
+# dtype — no s32/pred bands at all.
+DISPATCH = "einsum"
+
+
 def moe_apply_routed(params, x2d, *, top_k, capacity_factor, activation,
-                     group_size=0, return_aux=False):
-    """Token-routed MoE forward via capacity-factor einsum dispatch.
+                     group_size=0, return_aux=False, dispatch=None):
+    """Token-routed MoE forward via capacity-factor dispatch.
 
     Returns y [N, O] (and the unweighted load-balance aux loss when
     ``return_aux``). Within each group, slots are claimed in token order;
@@ -154,16 +175,15 @@ def moe_apply_routed(params, x2d, *, top_k, capacity_factor, activation,
     N, D = x2d.shape
     E = params["We1"].shape[0]
     O = params["We2"].shape[-1]
-    # default group 256: the dispatch/combine one-hots are [G, S, E, C]
-    # with C ∝ S, so their FLOPs/HBM scale with the group size — 256 vs
-    # 1024 measured +18% tokens/sec at the bench config (same relative
-    # capacity headroom per group; only the drop WINDOW shrinks)
+    # default group 256: the r4 einsum dispatch cost scaled with group
+    # size (one-hots ∝ S); the gather dispatch is size-insensitive but
+    # the drop WINDOW semantics stay per-group, so the default holds
     S = group_size or min(N, 256)
     G = -(-N // S)
     pad = G * S - N
 
     logits = x2d @ params["Wg"]                            # [N, E]
-    gates = moe_gates_from_logits(logits, top_k)
+    gates, top_idx, top_probs = moe_topk_from_logits(logits, top_k)
     aux = moe_load_balance_loss(logits, gates, top_k) if return_aux else None
 
     xp = jnp.pad(x2d, ((0, pad), (0, 0))) if pad else x2d
@@ -171,22 +191,72 @@ def moe_apply_routed(params, x2d, *, top_k, capacity_factor, activation,
     gg = gg.reshape(G, S, E)
     C = expert_capacity(S, top_k, capacity_factor, E)
 
+    act = get_activation(activation)
+    if (dispatch or DISPATCH) == "einsum":
+        # float routing metadata end to end: exclusive prefix counts via
+        # a strict-lower-triangular matmul (MXU; exact for counts <= S in
+        # the f32 accumulator), masks by arithmetic compare — no s32
+        # cumsum/gather bands (see DISPATCH note)
+        cdt = xp.dtype
+        routed_f = (gg > 0).astype(cdt)                    # [G, S, E]
+        tril = jnp.tril(jnp.ones((S, S), cdt), -1)         # t < s
+        pos = jnp.einsum("st,gte->gse", tril, routed_f,
+                         preferred_element_type=jnp.float32)
+        keep_f = routed_f * (pos < C).astype(cdt)          # [G, S, E]
+        slots = jnp.arange(C, dtype=jnp.float32)
+        disp = (keep_f[..., None]
+                * (pos[..., None] == slots).astype(cdt))   # [G, S, E, C]
+        combine = disp * gg[..., None].astype(cdt)
+        xg = xp.reshape(G, S, D)
+        expert_in = jnp.einsum("gsec,gsd->egcd", disp, xg)  # [E, G, C, D]
+        h = act(jnp.einsum("egcd,edh->egch", expert_in, params["We1"])
+                + params["be1"][:, None, None, :])
+        out = (jnp.einsum("egch,eho->egco", h, params["We2"])
+               + params["be2"][:, None, None, :])
+        y = jnp.einsum("gsec,egco->gso", combine, out).reshape(G * S, O)
+        y = y[:N] if pad else y
+        return (y, aux) if return_aux else y
+
+    # ---- gather dispatch ----
     routed = gg > 0                                        # [G, S, E]
     pos = jnp.cumsum(routed.astype(jnp.int32), axis=1) - 1  # slot per expert
     keep = routed & (pos < C)
-    # one_hot(-1) is the all-zero row: dropped/pad tokens vanish from both
-    # the dispatch gather and the combine scatter.
-    dispatch = jax.nn.one_hot(jnp.where(keep, pos, -1), C, dtype=xp.dtype)
-    combine = dispatch * gg[..., None].astype(xp.dtype)    # [G, S, E, C]
+    # per-(token, k): its expert id, whether it won a slot, and which
+    if pad:
+        top_idx = jnp.pad(top_idx, ((0, pad), (0, 0)))
+        top_probs = jnp.pad(top_probs, ((0, pad), (0, 0)))
+    e_k = top_idx.reshape(G, S, top_k)                     # [G, S, k]
+    kept_k = jnp.take_along_axis(keep, e_k, axis=2)        # [G, S, k]
+    slot_k = jnp.take_along_axis(pos, e_k, axis=2)         # [G, S, k]
+    prob_k = top_probs.reshape(G, S, top_k).astype(xp.dtype)
 
-    xg = xp.reshape(G, S, D)
-    expert_in = jnp.einsum("gsec,gsd->egcd", dispatch, xg)  # [E, G, C, D]
-    act = get_activation(activation)
+    # inverse map (e, c) -> source token s, built by scatter; slot C-or-
+    # greater (capacity overflow) and sentinel writes drop out of range
+    g_idx = jax.lax.broadcasted_iota(jnp.int32, (G, S, top_k), 0)
+    s_idx = jax.lax.broadcasted_iota(jnp.int32, (G, S, top_k), 1)
+    slot_w = jnp.where(kept_k, slot_k, C)                  # C -> dropped
+    idx_buf = jnp.full((G, E, C), S, jnp.int32)            # S -> zero row
+    idx_buf = idx_buf.at[g_idx, e_k, slot_w].set(s_idx, mode="drop")
+
+    xg_pad = jnp.pad(xp.reshape(G, S, D), ((0, 0), (0, 1), (0, 0)))
+    expert_in = jnp.take_along_axis(
+        xg_pad, idx_buf.reshape(G, E * C, 1), axis=1)      # [G, E*C, D]
+    expert_in = jnp.moveaxis(
+        expert_in.reshape(G, E, C, D), 1, 0)               # [E, G, C, D]
     h = act(jnp.einsum("egcd,edh->egch", expert_in, params["We1"])
             + params["be1"][:, None, None, :])
     out = (jnp.einsum("egch,eho->egco", h, params["We2"])
-           + params["be2"][:, None, None, :])
-    y = jnp.einsum("gsec,egco->gso", combine, out).reshape(G * S, O)
+           + params["be2"][:, None, None, :])              # [E, G, C, O]
+
+    # combine: each token gathers its k slot outputs; dropped (e, slot)
+    # pairs point at the padded zero row E*C
+    out_pad = jnp.pad(jnp.moveaxis(out, 0, 1).reshape(G, E * C, O),
+                      ((0, 0), (0, 1), (0, 0)))            # [G, E*C+1, O]
+    flat = jnp.where(kept_k, e_k * C + slot_k, E * C)      # [G, S, k]
+    picked = jnp.take_along_axis(
+        out_pad, flat.reshape(G, S * top_k, 1), axis=1
+    ).reshape(G, S, top_k, O)
+    y = jnp.einsum("gsk,gsko->gso", prob_k, picked).reshape(G * S, O)
     y = y[:N] if pad else y
     return (y, aux) if return_aux else y
 

@@ -67,6 +67,7 @@ class MultiLayerNetwork:
         self._train_step = None
         self._scan_fit = None
         self._output_jit = None
+        self._score_examples_jit = {}
         self._rng = None
         self._rnn_carries = None  # streaming inference state
         self._rnn_jit = None
@@ -110,6 +111,7 @@ class MultiLayerNetwork:
         self._train_step = None
         self._scan_fit = None
         self._output_jit = None
+        self._score_examples_jit = {}
 
     def set_listeners(self, *listeners):
         self.listeners = list(listeners)
@@ -509,6 +511,75 @@ class MultiLayerNetwork:
         batch = self._batch_dict(dataset)
         loss, _ = self._loss(self.params, self.state, None, batch, train=training)
         return float(loss)
+
+    def score_examples(self, dataset, add_regularization: bool = False):
+        """One score PER EXAMPLE [batch] — the ranking/anomaly-scoring API
+        (reference spark ScoreExamplesFunction / scoreExamples:1969).
+        Inference-mode forward; `add_regularization` adds the network's
+        L1/L2 penalty to every example's score like the reference's
+        addRegularizationTerms. With a mesh set, the batch shards over the
+        'data' axis like output()."""
+        batch = self._batch_dict(dataset)
+        key = bool(add_regularization)
+        if key not in self._score_examples_jit:
+            def _scores(params, state, batch):
+                x = batch["features"]
+                fmask = batch.get("features_mask")
+                lmask = batch.get("labels_mask")
+                out_conf = self.layer_confs[-1]
+                if not isinstance(out_conf, BaseOutputLayer):
+                    raise ValueError(
+                        "Last layer must be an OutputLayer to score")
+                n = len(self.layer_confs)
+                h, _, _ = self._forward(params, state, x, train=False,
+                                        rng=None, mask=fmask,
+                                        to_layer=n - 1)
+                proc = self.conf.get_preprocessor(n - 1)
+                if proc is not None:
+                    h = proc.pre_process(h)
+                mask = lmask if lmask is not None else (
+                    fmask if isinstance(out_conf, RnnOutputLayer) else None)
+                p_out = params[self.layer_names[-1]]
+                if self.compute_dtype != self.param_dtype:
+                    p_out = tree_cast(p_out, self.compute_dtype)
+                per = self.impls[-1].loss(
+                    out_conf, p_out, h, batch["labels"], train=False,
+                    rng=None, mask=mask, per_example=True)
+                if add_regularization:
+                    reg = 0.0
+                    for name, lc in zip(self.layer_names, self.layer_confs):
+                        reg = reg + l1_l2_penalty(lc, params[name])
+                    per = per + reg
+                return per
+
+            axes = getattr(self, "_mesh_axes", None)
+            data_axis = (axes or {}).get("data", "data")
+            if (self._mesh is not None
+                    and data_axis in self._mesh.axis_names):
+                from deeplearning4j_tpu.nn.training import mesh_shardings
+
+                repl, data = mesh_shardings(self._mesh, data_axis)
+                p_in = (None if getattr(self, "_param_sh", None) is not None
+                        else repl)
+                batch_sh = jax.tree.map(lambda _: data, batch)
+                self._score_examples_jit[key] = jax.jit(
+                    _scores, in_shardings=(p_in, repl, batch_sh),
+                    out_shardings=data)
+            else:
+                self._score_examples_jit[key] = jax.jit(_scores)
+        axes = getattr(self, "_mesh_axes", None)
+        data_axis = (axes or {}).get("data", "data")
+        if self._mesh is not None and data_axis in self._mesh.axis_names:
+            from deeplearning4j_tpu.nn.training import pad_batch_to_multiple
+
+            B = np.asarray(dataset.features).shape[0]
+            batch, pad = pad_batch_to_multiple(
+                batch, self._mesh.shape[data_axis])
+            per = self._score_examples_jit[key](self.params, self.state,
+                                                batch)
+            return np.asarray(per)[:B]
+        return np.asarray(
+            self._score_examples_jit[key](self.params, self.state, batch))
 
     def evaluate(self, it, top_n: int = 1):
         """Classification evaluation (reference evaluate:2311); top_n > 1

@@ -60,19 +60,44 @@ def validate_loss(name) -> str:
     return low
 
 
+def _align_mask(per, mask):
+    """Broadcast a loss mask to the per-position loss array's shape
+    (rank-pad trailing dims, then broadcast), in the loss dtype."""
+    mask = jnp.broadcast_to(mask, per.shape) if mask.ndim == per.ndim else mask
+    while mask.ndim < per.ndim:
+        mask = mask[..., None]
+    return jnp.broadcast_to(mask, per.shape).astype(per.dtype)
+
+
 def _masked_mean(per_example, mask):
     """Mean over examples; if mask given, weight rows and renormalize."""
     if mask is None:
         return jnp.mean(per_example)
-    mask = jnp.broadcast_to(mask, per_example.shape) if mask.ndim == per_example.ndim else mask
-    while mask.ndim < per_example.ndim:
-        mask = mask[..., None]
-    m = jnp.broadcast_to(mask, per_example.shape).astype(per_example.dtype)
+    m = _align_mask(per_example, mask)
     return jnp.sum(per_example * m) / jnp.maximum(jnp.sum(m), 1.0)
 
 
-def compute_loss(name, labels, output, mask=None, *, logits=None):
-    """Compute a scalar loss.
+def _masked_per_example(per, mask):
+    """Collapse per-position losses to one score PER EXAMPLE [B] (mask-
+    weighted mean over any time/position dims) — the scoreExamples
+    reduction (reference ScoreExamplesFunction, ScoreFlatMapFunction)."""
+    if mask is None:
+        if per.ndim <= 1:
+            return per
+        return jnp.mean(per.reshape(per.shape[0], -1), axis=-1)
+    m = _align_mask(per, mask)
+    num = jnp.sum((per * m).reshape(per.shape[0], -1), axis=-1)
+    den = jnp.sum(m.reshape(per.shape[0], -1), axis=-1)
+    return num / jnp.maximum(den, 1.0)
+
+
+def _finish(per, mask, reduce):
+    return _masked_mean(per, mask) if reduce else _masked_per_example(per, mask)
+
+
+def compute_loss(name, labels, output, mask=None, *, logits=None,
+                 reduce=True):
+    """Compute a scalar loss (or per-example losses when ``reduce=False``).
 
     `output` is the activated output; for softmax/sigmoid output layers pass
     `logits` (the preactivation) as well so the fused stable path is used.
@@ -81,7 +106,7 @@ def compute_loss(name, labels, output, mask=None, *, logits=None):
     fn(labels, output) -> per-example loss, masked-meaned here.
     """
     if callable(name):
-        return _masked_mean(name(labels, output), mask)
+        return _finish(name(labels, output), mask, reduce)
     name = name.lower()
     if name in (LossFunction.MCXENT, LossFunction.NEGATIVELOGLIKELIHOOD):
         if logits is not None:
@@ -99,7 +124,7 @@ def compute_loss(name, labels, output, mask=None, *, logits=None):
                                        axis=-1)[..., 0]
         else:
             per = -jnp.sum(labels * logp, axis=-1)
-        return _masked_mean(per, mask)
+        return _finish(per, mask, reduce)
     if name == LossFunction.XENT:
         if logits is not None:
             # stable sigmoid BCE on logits
@@ -110,46 +135,46 @@ def compute_loss(name, labels, output, mask=None, *, logits=None):
         else:
             o = jnp.clip(output, _EPS, 1.0 - _EPS)
             per = -jnp.sum(labels * jnp.log(o) + (1 - labels) * jnp.log1p(-o), axis=-1)
-        return _masked_mean(per, mask)
+        return _finish(per, mask, reduce)
     if name in (LossFunction.MSE, LossFunction.SQUARED_LOSS):
         per = jnp.sum((labels - output) ** 2, axis=-1)
         if name == LossFunction.MSE:
             per = per / output.shape[-1]
-        return _masked_mean(per, mask)
+        return _finish(per, mask, reduce)
     if name in (LossFunction.L1, LossFunction.MEAN_ABSOLUTE_ERROR):
         per = jnp.sum(jnp.abs(labels - output), axis=-1)
         if name == LossFunction.MEAN_ABSOLUTE_ERROR:
             per = per / output.shape[-1]
-        return _masked_mean(per, mask)
+        return _finish(per, mask, reduce)
     if name == LossFunction.RMSE_XENT:
         o = jnp.clip(output, _EPS, 1.0 - _EPS)
         xent = -(labels * jnp.log(o) + (1 - labels) * jnp.log1p(-o))
         per = jnp.sqrt(jnp.sum(xent**2, axis=-1) + _EPS)
-        return _masked_mean(per, mask)
+        return _finish(per, mask, reduce)
     if name in (LossFunction.RECONSTRUCTION_CROSSENTROPY,):
         o = jnp.clip(output, _EPS, 1.0 - _EPS)
         per = -jnp.sum(labels * jnp.log(o) + (1 - labels) * jnp.log1p(-o), axis=-1)
-        return _masked_mean(per, mask)
+        return _finish(per, mask, reduce)
     if name in (LossFunction.EXPLL, LossFunction.POISSON):
         o = jnp.clip(output, _EPS, None)
         per = jnp.sum(o - labels * jnp.log(o), axis=-1)
-        return _masked_mean(per, mask)
+        return _finish(per, mask, reduce)
     if name == LossFunction.HINGE:
         per = jnp.sum(jnp.maximum(0.0, 1.0 - labels * output), axis=-1)
-        return _masked_mean(per, mask)
+        return _finish(per, mask, reduce)
     if name == LossFunction.SQUARED_HINGE:
         per = jnp.sum(jnp.maximum(0.0, 1.0 - labels * output) ** 2, axis=-1)
-        return _masked_mean(per, mask)
+        return _finish(per, mask, reduce)
     if name == LossFunction.KL_DIVERGENCE:
         o = jnp.clip(output, _EPS, 1.0)
         t = jnp.clip(labels, _EPS, 1.0)
         per = jnp.sum(t * (jnp.log(t) - jnp.log(o)), axis=-1)
-        return _masked_mean(per, mask)
+        return _finish(per, mask, reduce)
     if name == LossFunction.COSINE_PROXIMITY:
         ln = labels / (jnp.linalg.norm(labels, axis=-1, keepdims=True) + _EPS)
         on = output / (jnp.linalg.norm(output, axis=-1, keepdims=True) + _EPS)
         per = -jnp.sum(ln * on, axis=-1)
-        return _masked_mean(per, mask)
+        return _finish(per, mask, reduce)
     raise ValueError(f"Unknown loss function '{name}'")
 
 

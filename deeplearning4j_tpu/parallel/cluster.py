@@ -402,7 +402,7 @@ class ClusterClient:
 
 def run_elastic_worker(address: str, worker_id: str, net, batches, *,
                        sync_every: int = 1, checkpoint_path: Optional[str] = None,
-                       epochs: int = 1):
+                       epochs: int = 1, client: Optional["ClusterClient"] = None):
     """Elastic data-parallel worker loop (multi-PROCESS param averaging).
 
     net: an initialized MultiLayerNetwork/ComputationGraph; batches: this
@@ -440,7 +440,10 @@ def run_elastic_worker(address: str, worker_id: str, net, batches, *,
         net.state = restored.state
         net.iteration_count = restored.iteration_count
         start_step = restored.iteration_count
-    client = ClusterClient(address, worker_id)
+    # accepting a live client keeps a claimed shard slot heartbeating
+    # through the caller's setup gap — a fresh registration here would
+    # leave the slot sweepable for one heartbeat_timeout (ADVICE r4)
+    client = client or ClusterClient(address, worker_id)
     try:
         if net.params is None:
             net.init()

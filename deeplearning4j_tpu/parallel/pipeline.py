@@ -478,6 +478,7 @@ def make_pp_train_step(net, plan: PipelinePlan, mesh: Mesh, axes: dict,
 
     pipe = axes["pipe"]
     data = axes.get("data")
+    seq = axes.get("seq")
     S, M = plan.S, n_microbatches
     if M % S:
         raise ValueError(f"{M} microbatches do not divide over {S} stages")
@@ -490,9 +491,18 @@ def make_pp_train_step(net, plan: PipelinePlan, mesh: Mesh, axes: dict,
     # (spmd_partitioner_util.cc:495 on a data x pipe x expert mesh), and
     # manual data costs nothing — the batch is embarrassingly parallel
     # and the loss/state combines below psum/pmean over both axes.
-    manual = {pipe} | ({data} if data is not None else set())
-    dax = (pipe,) if data is None else (pipe, data)
-    d_only = () if data is None else (data,)
+    # 'seq' rides the same mechanism as 'data': an embarrassingly-
+    # parallel content axis run manual alongside pipe. Its shards hold
+    # time blocks instead of batch rows — the SP-configured layers'
+    # ring collectives (ring attention, offset posenc) bind against it
+    # inside the stage bodies, and the loss/state combines below treat
+    # it exactly like a second data axis (equal shards; the masked-mean
+    # weights already make the combine exact for unequal valid counts).
+    manual = ({pipe} | ({data} if data is not None else set())
+              | ({seq} if seq is not None else set()))
+    extra = tuple(a for a in (data, seq) if a is not None)
+    dax = (pipe,) + extra
+    d_only = extra
 
     def _pmean_floats(tree, ax):
         if not ax:
@@ -513,6 +523,11 @@ def make_pp_train_step(net, plan: PipelinePlan, mesh: Mesh, axes: dict,
     def make_program(has_f, has_l):
         def program(pre_p, stages_p, post_p, stages_s, pre_s, post_s,
                     toks, labs, fm, lm, key):
+            if seq is not None:
+                # decorrelate dropout streams across time shards (the SP
+                # step does the same): one key would mask identical
+                # positions in every shard's local block
+                key = jax.random.fold_in(key, lax.axis_index(seq))
             # local stage slice: shard_map strips the leading [S] axis to 1
             stage_p = plan.stage_local(tuple(a[0] for a in stages_p))
             stage_s0 = plan.stage_local_state(
@@ -639,7 +654,9 @@ def make_pp_train_step(net, plan: PipelinePlan, mesh: Mesh, axes: dict,
                     pp_state["stages"], pp_state["pre"], pp_state["post"],
                     toks_m, labs_m,
                     fm_m if has_f else (), lm_m if has_l else (), rng)
-        stream = P(None, data) if data is not None else P()
+        # stream leaves are [M, mb, T, ...]: microbatch x batch x time
+        stream = P(None, data, seq) if seq is not None else (
+            P(None, data) if data is not None else P())
         sm = jax.shard_map(
             program, mesh=mesh,
             in_specs=(P(), P(pipe), P(), P(pipe), P(), P(),
@@ -687,6 +704,10 @@ def make_pp_train_step(net, plan: PipelinePlan, mesh: Mesh, axes: dict,
             raise ValueError(
                 f"microbatch size {mb} not divisible over the "
                 f"{mesh.shape[data]}-way data axis")
+        if seq is not None and toks.shape[1] % mesh.shape[seq]:
+            raise ValueError(
+                f"sequence length {toks.shape[1]} not divisible over the "
+                f"{mesh.shape[seq]}-way seq axis")
 
         def to_stream(a):
             if a is None:

@@ -128,6 +128,7 @@ def configure_mesh(net, mesh, *, zero1=False, axes=None, n_microbatches=None,
     net._train_step = None
     net._scan_fit = None
     net._output_jit = None
+    net._score_examples_jit = {}
     if mesh is not None:
         _ensure_tree_optimizer(net, axes, zero1)
     if mesh is None or axes is None:
@@ -152,11 +153,11 @@ def configure_mesh(net, mesh, *, zero1=False, axes=None, n_microbatches=None,
         # seq/data only, so Megatron TP placements on a 'model' axis
         # propagate GSPMD-auto through the per-shard compute (r3 #4
         # lifted the seq-with-data-only restriction).
-        if set(axes) - {"seq", "data", "model"}:
+        if set(axes) - {"seq", "data", "model", "pipe"}:
             raise ValueError(
-                "the 'seq' axis composes with 'data' and 'model' only "
-                "(time-sharded ring attention runs manual inside "
-                "shard_map; pipe/expert need a different schedule)")
+                "the 'seq' axis composes with 'data', 'model' and 'pipe' "
+                "(time-sharded ring attention runs manual inside the SP "
+                "or PP shard_map; 'expert' needs a different schedule)")
         if not hasattr(net, "layer_vertices"):
             raise ValueError(
                 "the 'seq' axis requires the ComputationGraph container "
@@ -183,7 +184,13 @@ def configure_mesh(net, mesh, *, zero1=False, axes=None, n_microbatches=None,
                     f"conf layer '{getattr(lc, 'name', '?')}' is built for "
                     f"seq axis {lc.seq_parallel_axis!r} but axes['seq'] is "
                     f"{axes['seq']!r}")
-        if "model" in axes:
+        if "pipe" in axes:
+            # seq x pipe: fall through to the pipeline block below — the
+            # PP schedule runs manual over {pipe, data, seq} and the
+            # SP-configured layers' ring collectives resolve against the
+            # bound seq axis inside the stage bodies (r5, VERDICT r4 #9)
+            pass
+        elif "model" in axes:
             from deeplearning4j_tpu.parallel.tensor_parallel import (
                 param_shardings,
                 resolve_rules as _resolve,
@@ -200,7 +207,8 @@ def configure_mesh(net, mesh, *, zero1=False, axes=None, n_microbatches=None,
                 net.opt_state = _map_param_shaped(
                     net.opt_state, net.params,
                     lambda t: jax.tree.map(jax.device_put, t, net._param_sh))
-        return net
+        if "pipe" not in axes:
+            return net
 
     rules = resolve_rules(axes, tp_rules)
     net._resolved_rules = rules

@@ -114,11 +114,14 @@ class ModelSerializer:
                 leaves = _load_leaves(zf, "updater.npz")
                 try:
                     net.opt_state = _restore_tree(net.opt_state, leaves)
-                except ValueError:
+                except (ValueError, TypeError, KeyError):
                     # layout bridge: the checkpoint's updater state may be
                     # in the other optimizer layout (per-leaf tree vs the
                     # flat-view fused state) — rebuild and retry (`net` is
-                    # local to this restore, so mutating is safe)
+                    # local to this restore, so mutating is safe). A
+                    # mismatch can surface as TypeError/KeyError too
+                    # (pytree structure vs leaf-count differences raise
+                    # different types)
                     from deeplearning4j_tpu.nn.updater import (
                         rebuild_other_layout,
                     )

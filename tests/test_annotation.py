@@ -1,0 +1,94 @@
+"""Pluggable annotation engine (the UIMA AnalysisEngine slot — reference
+text/uima/UimaResource.java, PosUimaTokenizer.java,
+UimaSentenceIterator.java)."""
+
+from deeplearning4j_tpu.nlp.annotation import (
+    AnnotationEngine,
+    AnnotationTokenizerFactory,
+    LexiconAnnotationEngine,
+    SentenceDetector,
+    SpacyAnnotationEngine,
+    get_annotation_engine,
+    set_annotation_engine,
+)
+from deeplearning4j_tpu.nlp.sentiment import PosAwareTokenizerFactory
+
+
+def test_default_engine_is_lexicon():
+    assert isinstance(get_annotation_engine(), LexiconAnnotationEngine)
+
+
+def test_sentence_segmentation():
+    eng = LexiconAnnotationEngine()
+    text = ("Deep learning works. Does it scale? It does! "
+            "Dr. No was here.")
+    sents = eng.sentences(text)
+    assert sents[0] == "Deep learning works."
+    assert sents[1] == "Does it scale?"
+    assert sents[2] == "It does!"
+    assert len(sents) >= 3
+
+
+def test_tokenize_and_pos():
+    eng = LexiconAnnotationEngine()
+    toks = eng.tokenize("The quick dog runs quickly.")
+    assert toks[:2] == ["The", "quick"]
+    assert "." in toks
+    tags = dict(eng.pos_tags(["the", "quickly", "running", "goodness"]))
+    assert tags["the"] == "d"
+    assert tags["quickly"] == "r"
+    assert tags["running"] == "v"
+    assert tags["goodness"] == "n"
+
+
+def test_annotate_document_shape():
+    out = LexiconAnnotationEngine().annotate("Cats sleep. Dogs bark.")
+    assert len(out) == 2
+    assert all(isinstance(t, tuple) and len(t) == 2
+               for sent in out for t in sent)
+
+
+def test_sentence_detector_and_factory_route_through_engine():
+    class UpperEngine(LexiconAnnotationEngine):
+        def pos_tags(self, tokens):
+            return [(t, "x") for t in tokens]
+
+    set_annotation_engine(UpperEngine())
+    try:
+        toks = PosAwareTokenizerFactory().create("good dog").get_tokens()
+        assert toks == ["good#x", "dog#x"]
+        toks2 = AnnotationTokenizerFactory().create("good dog").get_tokens()
+        assert toks2 == ["good#x", "dog#x"]
+        assert SentenceDetector().detect("A b. C d.") == ["A b.", "C d."]
+    finally:
+        set_annotation_engine(None)
+    # restored default
+    toks = PosAwareTokenizerFactory().create("good dog").get_tokens()
+    assert toks == ["good#a", "dog#n"]
+
+
+def test_spacy_engine_gated():
+    # spaCy is not in this image: available() must say so and construction
+    # must raise ImportError (never a crash elsewhere)
+    if SpacyAnnotationEngine.available():
+        eng = SpacyAnnotationEngine()
+        assert eng.sentences("A b. C d.")
+    else:
+        try:
+            SpacyAnnotationEngine()
+            raised = False
+        except ImportError:
+            raised = True
+        assert raised
+
+
+def test_engine_protocol_abstract():
+    base = AnnotationEngine()
+    for call in (lambda: base.sentences("x"), lambda: base.tokenize("x"),
+                 lambda: base.pos_tags(["x"])):
+        try:
+            call()
+            raised = False
+        except NotImplementedError:
+            raised = True
+        assert raised

@@ -168,6 +168,43 @@ def test_routed_matches_dense_at_ample_capacity():
                                    atol=1e-4)
 
 
+def test_gather_dispatch_matches_einsum_dispatch():
+    """The r5 gather dispatch (index-based; no [G,S,E,C] one-hot
+    contractions) is the same function as the GShard einsum formulation —
+    values AND gradients, including dropped tokens at tight capacity."""
+    from deeplearning4j_tpu.nn.layers.moe import (
+        MixtureOfExpertsImpl,
+        MixtureOfExpertsLayer,
+        moe_apply_routed,
+    )
+
+    lc = MixtureOfExpertsLayer(n_in=8, n_out=8, n_experts=4, top_k=2,
+                               d_hidden=16, activation="gelu",
+                               weight_init="xavier")
+    params, _ = MixtureOfExpertsImpl().init(lc, jax.random.PRNGKey(1),
+                                            jnp.float32)
+    x = jnp.asarray(np.random.default_rng(0).standard_normal((50, 8)),
+                    jnp.float32)
+    for cf in (2.0, 0.5):  # ample AND over-capacity (drops) regimes
+        ein = moe_apply_routed(params, x, top_k=2, capacity_factor=cf,
+                               activation="gelu", group_size=16,
+                               dispatch="einsum")
+        gat = moe_apply_routed(params, x, top_k=2, capacity_factor=cf,
+                               activation="gelu", group_size=16,
+                               dispatch="gather")
+        np.testing.assert_allclose(np.asarray(gat), np.asarray(ein),
+                                   atol=1e-5)
+        ge = jax.grad(lambda p: jnp.sum(moe_apply_routed(
+            p, x, top_k=2, capacity_factor=cf, activation="gelu",
+            group_size=16, dispatch="einsum") ** 2))(params)
+        gg = jax.grad(lambda p: jnp.sum(moe_apply_routed(
+            p, x, top_k=2, capacity_factor=cf, activation="gelu",
+            group_size=16, dispatch="gather") ** 2))(params)
+        for k in ge:
+            np.testing.assert_allclose(np.asarray(gg[k]), np.asarray(ge[k]),
+                                       atol=1e-4)
+
+
 def test_routed_drops_over_capacity_and_balances():
     """At a tight capacity factor, over-capacity tokens produce exactly-zero
     output rows (the residual carries them), and the Switch aux loss is >= 1

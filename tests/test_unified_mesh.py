@@ -204,6 +204,37 @@ def test_sp_via_set_mesh_matches_dense(lm_data):
     assert abs(net.score_value - dense_net.score_value) < ATOL
 
 
+def test_seq_pipe_via_set_mesh_matches_dense(lm_data):
+    """seq x pipe (VERDICT r4 #9): the PP schedule runs manual over the
+    seq axis too — time-sharded ring attention inside the pipeline stage
+    bodies — so long-context pipelined models have a path. Composed with
+    data for the full pipe x seq x data step."""
+    toks = np.asarray(lm_data.features)
+    labs_int = np.roll(toks, -1, axis=1).astype(np.int32)
+    from deeplearning4j_tpu.datasets.api import DataSet as DS
+
+    data_int = DS(toks, labs_int)
+    dense_net = transformer_lm(vocab_size=V, d_model=D, n_heads=H,
+                               n_layers=L, d_ff=FF, max_length=T)
+    dense_net.init()
+    dense_net.fit(data_int, epochs=3)
+    net = transformer_lm(vocab_size=V, d_model=D, n_heads=H, n_layers=L,
+                         d_ff=FF, max_length=T, seq_parallel_axis="seq")
+    net.init()
+    net.set_mesh(make_mesh({"pipe": 2, "seq": 2, "data": 2}),
+                 axes={"pipe": "pipe", "seq": "seq", "data": "data"},
+                 n_microbatches=2)
+    net.fit(data_int, epochs=3)
+    assert abs(net.score_value - dense_net.score_value) < ATOL
+    # params trained identically through the composed schedule
+    cp = net._canonical_params()
+    for k in dense_net.params:
+        for a, b in zip(jax.tree.leaves(dense_net.params[k]),
+                        jax.tree.leaves(cp[k])):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       atol=5e-4)
+
+
 def test_seq_axis_requires_sp_conf():
     net = _fresh_lm()  # built WITHOUT seq_parallel_axis
     with pytest.raises(ValueError, match="seq_parallel_axis"):

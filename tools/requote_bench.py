@@ -86,9 +86,21 @@ def render(lines, artifact_name):
            f"| benchmark (BASELINE.md config) | {artifact_name} |",
            "|---|---|"]
     for prefix, label, fmt in ROWS:
-        match = [l for m, l in lines.items() if m.startswith(prefix)]
+        # exact-name match: prefix rows are `metric_<backend>` lines — a
+        # bare startswith could quote a cpu smoke line or a stale
+        # duplicate into the docs (ADVICE r4). Prefer the tpu backend,
+        # else the exact bare name; warn when several candidates match.
+        match = [l for m, l in lines.items()
+                 if m == prefix or m == f"{prefix}_tpu"]
+        if not match:
+            match = [l for m, l in lines.items()
+                     if m.startswith(prefix + "_")]
+            if len(match) > 1:
+                print(f"warning: {len(match)} metrics match prefix "
+                      f"{prefix!r}; quoting the last", file=sys.stderr)
+                match = match[-1:]
         if match:
-            line = match[0]
+            line = match[-1]
             flag = " ⚠regression" if line.get("regression") else ""
             out.append(f"| {label} | {fmt(line)}{flag} |")
     return "\n".join(out)

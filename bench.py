@@ -175,9 +175,14 @@ def _time_net_steps(net, ds, steps: int) -> float:
     return t3 / (3 * steps)
 
 
+_PROBE_CACHE = {}
+
+
 def _measure_matmul_tflops():
     """Achievable dense bf16 matmul FLOP/s right now (slope over fori_loop
-    lengths; cancels fixed latency). Returns None off-TPU."""
+    lengths; cancels fixed latency). Returns None off-TPU. The jitted
+    probe fns are cached — _defended_measure probes up to 6x per mode and
+    re-jitting would burn chip time inside the window being probed."""
     import functools
 
     import jax
@@ -193,7 +198,10 @@ def _measure_matmul_tflops():
             return (a @ c) * jnp.bfloat16(1e-3)
         return jax.lax.fori_loop(0, K, body, a)
 
-    fns = {K: jax.jit(functools.partial(many, K=K)) for K in (10, 40)}
+    if "matmul" not in _PROBE_CACHE:
+        _PROBE_CACHE["matmul"] = {
+            K: jax.jit(functools.partial(many, K=K)) for K in (10, 40)}
+    fns = _PROBE_CACHE["matmul"]
 
     def timed(K):
         f = fns[K]
@@ -236,7 +244,10 @@ def _measure_conv_tflops():
     # ~0.5 ms/iter: the slope needs hundreds of iters to dominate the
     # tunnel jitter (a 30-iter slope returned 406 TF/s — 2x the chip's
     # physical peak — and defeated the gate scaling it feeds)
-    fns = {K: jax.jit(functools.partial(many, K=K)) for K in (60, 240)}
+    if "conv" not in _PROBE_CACHE:
+        _PROBE_CACHE["conv"] = {
+            K: jax.jit(functools.partial(many, K=K)) for K in (60, 240)}
+    fns = _PROBE_CACHE["conv"]
     for f in fns.values():
         _sync(f(x))
 

@@ -81,11 +81,16 @@ class ModelSerializer:
             _save_tree(zf, "state.npz", net.state)
             if save_updater and opt_state is not None:
                 _save_tree(zf, "updater.npz", opt_state)
+            from deeplearning4j_tpu.nn.updater import FLAT_LAYOUT_VERSION
+
             zf.writestr("meta.json", json.dumps({
                 "format_version": _FORMAT_VERSION,
                 "kind": kind,
                 "iteration": net.iteration_count,
                 "epoch": getattr(net, "epoch_count", 0),
+                # layout of any flat-view optimizer vectors in
+                # updater.npz (see nn/updater.upgrade_flat_layout)
+                "flat_layout": FLAT_LAYOUT_VERSION,
             }))
 
     @staticmethod
@@ -112,6 +117,24 @@ class ModelSerializer:
             net.state = _restore_tree(net.state, _load_leaves(zf, "state.npz"))
             if "updater.npz" in zf.namelist():
                 leaves = _load_leaves(zf, "updater.npz")
+                if meta.get("flat_layout", 1) < 2:
+                    # pre-r5 checkpoints flattened every leaf row-major;
+                    # v2 stores lane-hostile leaves axis-rotated — reorder
+                    # any full-length flat vectors (adam m/v, momentum)
+                    # so resumed moments line up with today's layout
+                    from deeplearning4j_tpu.nn.updater import (
+                        FlatViewTransform,
+                        flat_state_size,
+                        upgrade_flat_layout,
+                    )
+
+                    if isinstance(net.tx, FlatViewTransform):
+                        total = flat_state_size(net.params)
+                        leaves = [
+                            np.asarray(upgrade_flat_layout(
+                                jnp.asarray(l), net.params))
+                            if l.ndim == 1 and l.size == total else l
+                            for l in leaves]
                 try:
                     net.opt_state = _restore_tree(net.opt_state, leaves)
                 except (ValueError, TypeError, KeyError):

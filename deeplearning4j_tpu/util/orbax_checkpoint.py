@@ -77,10 +77,15 @@ class ShardedCheckpointer:
         # meta/config go to a staging name and rename AFTER the orbax
         # commit: restore() only selects steps whose meta.json exists, so
         # a crash mid-save can never surface a partial step as "latest"
+        from deeplearning4j_tpu.nn.updater import FLAT_LAYOUT_VERSION
+
         self._pending = (d, {
             "iteration": net.iteration_count,
             "epoch": getattr(net, "epoch_count", 0),
             "kind": type(net).__name__,
+            # layout of flat-view optimizer vectors (see
+            # nn/updater.upgrade_flat_layout)
+            "flat_layout": FLAT_LAYOUT_VERSION,
         }, serde.to_json(net.conf))
         self._ckptr.save(os.path.join(d, "model"), _tree(net), force=True)
         if not self.use_async:
@@ -161,6 +166,22 @@ class ShardedCheckpointer:
         net.state = restored["state"]
         with open(os.path.join(d, "meta.json")) as f:
             meta = json.load(f)
+        if meta.get("flat_layout", 1) < 2:
+            # pre-r5 flat vectors were all-row-major; reorder to the v2
+            # (lane-rotated) layout so resumed moments stay aligned
+            from deeplearning4j_tpu.nn.updater import (
+                FlatViewTransform,
+                flat_state_size,
+                upgrade_flat_layout,
+            )
+
+            if isinstance(net.tx, FlatViewTransform):
+                total = flat_state_size(net.params)
+                net.opt_state = jax.tree.map(
+                    lambda l: (upgrade_flat_layout(l, net.params)
+                               if getattr(l, "ndim", None) == 1
+                               and l.size == total else l),
+                    net.opt_state)
         net.iteration_count = meta.get("iteration", 0)
         if hasattr(net, "epoch_count"):
             net.epoch_count = meta.get("epoch", 0)

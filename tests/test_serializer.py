@@ -123,3 +123,82 @@ def test_kind_specific_restores_reject_wrong_kind(tmp_path):
         ModelSerializer.restore_computation_graph(path)
     assert isinstance(ModelSerializer.restore_multi_layer_network(path),
                       MultiLayerNetwork)
+
+
+def test_flat_layout_v1_checkpoint_upgrades(tmp_path):
+    """Pre-r5 (flat_layout v1) checkpoints stored every leaf row-major in
+    the flat optimizer vector; v2 axis-rotates lane-hostile leaves (2D+
+    with minor dim < 128). Restoring a v1 zip must reorder the moments so
+    resumed training matches — not silently scramble them."""
+    import io
+    import json
+    import zipfile
+
+    import jax
+    import jax.numpy as jnp
+
+    from deeplearning4j_tpu.models.transformer import transformer_moe_lm
+    from deeplearning4j_tpu.nn import updater as upd
+    from deeplearning4j_tpu.nn.updater import (
+        FlatViewTransform,
+        _lane_hostile,
+    )
+
+    # a model with lane-hostile leaves ([d_model, n_experts] routers) and
+    # enough params that the flat view is active
+    def _net():
+        net = transformer_moe_lm(vocab_size=512, d_model=64, n_heads=2,
+                                 n_layers=1, n_experts=4, top_k=2,
+                                 d_expert_hidden=2048, max_length=8)
+        net.init()
+        return net
+
+    net = _net()
+    assert isinstance(net.tx, FlatViewTransform)
+    assert any(_lane_hostile(l) for l in jax.tree.leaves(net.params))
+    rng = np.random.default_rng(0)
+    toks = np.asarray(rng.integers(0, 512, (4, 8)), np.int32)
+    ds = DataSet(toks, np.roll(toks, -1, axis=1).astype(np.int32))
+    net.fit(ds)
+    path = str(tmp_path / "v2.zip")
+    ModelSerializer.write_model(net, path)
+
+    # rewrite the zip as a v1 checkpoint: flat vectors de-rotated to the
+    # old all-row-major order + flat_layout stripped from meta
+    def _derotate(vec):
+        outs, off = [], 0
+        for l in jax.tree.leaves(net.params):
+            seg = vec[off:off + l.size]
+            if _lane_hostile(l):
+                rot = (l.shape[-1],) + l.shape[:-1]
+                seg = np.moveaxis(seg.reshape(rot), 0, -1).ravel()
+            outs.append(seg)
+            off += l.size
+        return np.concatenate(outs)
+
+    total = upd.flat_state_size(net.params)
+    v1path = str(tmp_path / "v1.zip")
+    with zipfile.ZipFile(path) as zin, \
+            zipfile.ZipFile(v1path, "w") as zout:
+        for item in zin.namelist():
+            data = zin.read(item)
+            if item == "meta.json":
+                meta = json.loads(data)
+                meta.pop("flat_layout")
+                data = json.dumps(meta).encode()
+            elif item == "updater.npz":
+                npz = np.load(io.BytesIO(data), allow_pickle=False)
+                leaves = [npz[k] for k in npz.files]
+                leaves = [_derotate(l) if l.ndim == 1 and l.size == total
+                          else l for l in leaves]
+                buf = io.BytesIO()
+                np.savez(buf, *leaves)
+                data = buf.getvalue()
+            zout.writestr(item, data)
+
+    for p in (path, v1path):
+        restored = ModelSerializer.restore(p)
+        for a, b in zip(jax.tree.leaves(restored.opt_state),
+                        jax.tree.leaves(net.opt_state)):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       atol=0)

@@ -36,33 +36,40 @@ TARGETS = {
     "lenet": 1700000.0,      # images/sec/chip (r2 measured: 1.78M, scanned
                              # steady-state; per-step Python dispatch caps a
                              # naive loop far lower)
-    "vgg16": 80000.0,        # images/sec/chip — ~0.75x the r4 healthy-
-                             # window rate (107k at a 191 TF/s ceiling;
-                             # 44-85k across earlier rounds was chip-state
-                             # spread). Catches a real slide to r3 levels
-                             # while moderate throttle windows self-
-                             # explain via chip_matmul_tflops.
-    "word2vec": 800000.0,    # words/sec — ~0.9x the sustained shared-
-                             # negatives rate (r2-r4 healthy windows:
-                             # 875k-1.04M; r4 re-measured 944k at a 163
-                             # TF/s ceiling). The old 600k floor let the
-                             # r3 driver window's 699k (-33% vs r2) pass
-                             # silently (VERDICT r3 #3); now it flags,
-                             # and the line carries chip_matmul_tflops
-                             # so throttle windows are distinguishable.
+    "vgg16": 80000.0,        # images/sec/chip — ~0.7x the r5 healthy-
+                             # window rate (116k after the one-pass BN
+                             # stats + tiled maxpool backward; 40.7-116k
+                             # across r5 windows was chip-state spread).
+                             # Throttled windows scale the gate via the
+                             # conv probe (gate_scale) instead of false-
+                             # flagging.
+    "word2vec": 800000.0,    # words/sec — ~0.9x the r5 oversample-2
+                             # shared-negatives rate (831k measured at a
+                             # 175 TF/s window; the oversample costs
+                             # ~12% of the r4 os=1 rate and buys the
+                             # 0.98x-host quality ratio). The old 600k
+                             # floor let the r3 driver window's 699k
+                             # pass silently (VERDICT r3 #3); throttled
+                             # windows now scale the gate via the matmul
+                             # probe instead of false-flagging.
     "resnet_dp": 1.0,        # allreduce/param-avg speedup (>=1 expected)
-    "moe": 900000.0,         # routed-MoE tokens/sec (r4 measured: 1.07M
-                             # at the matched 2-head flagship config =
-                             # 0.57x the r4 dense line / 1.2x the 0.6x-
-                             # of-r3-dense bar VERDICT r3 set (890k).
-                             # Gains: argmax top-k gating over lax.top_k
-                             # sort + scatter, then group-256 routing
-                             # (dispatch one-hots scale with group size))
-    "transformer": 0.30,     # MFU fraction (north star >=30%; r2 measured
-                             # 0.37 at seq 512 with the fused softmax-xent
-                             # head + tuned flash kernels incl. the fused
-                             # single-pass backward, and 0.40 at seq 4096
-                             # via the longcontext mode)
+    "moe": 1250000.0,        # routed-MoE tokens/sec (r5 measured: 1.52M
+                             # best / 1.46M typical interleaved at the
+                             # matched 2-head flagship config = 0.765x
+                             # the same-window dense line. r5 gains:
+                             # MXU-friendly float routing metadata
+                             # (tri-matmul prefix counts; no s32
+                             # cumsum/pred bands) and the lane-rotated
+                             # flat-optimizer layout (the [256,8] router
+                             # leaves made XLA relayout the whole 19M-
+                             # param flat vector, 2.8 ms/step))
+    "transformer": 0.30,     # MFU fraction (north star >=30%; r5 session
+                             # measured 0.530 clean / 0.530 masked /
+                             # 0.481 masked+dropout at seq 512, 0.457 at
+                             # the 4-head/D=64 config, ~0.59+ at seq
+                             # 4096 — the anchor stays at the north star
+                             # so the gate flags a fall below it, with
+                             # gate_scale absorbing chip throttle)
 }
 
 # Peak dense bf16 FLOP/s per chip by TPU generation (public spec sheets);
@@ -103,10 +110,19 @@ HEALTHY_MATMUL_TFLOPS = 191.0
 HEALTHY_CONV_TFLOPS = 190.0
 
 # word2vec device path must keep >= this fraction of the host (reference-
-# semantics) path's embedding quality on the shared sub-corpus (r4
-# measured ~0.87; shared negatives + trust-region clipping account for
-# the gap — `share_negatives=False` reaches ~0.95+ at 2.7x the runtime)
-W2V_QUALITY_RATIO = 0.8
+# semantics) path's embedding quality on the shared sub-corpus. r5 closed
+# the r4 gap (0.87): the residual came from (a) shared-negative VARIANCE
+# — fixed by drawing oversample*K shared negatives weighted K/M, which
+# keeps the per-pair SGNS objective expectation exactly — and (b) update
+# GRANULARITY (8192-token batched updates vs the host's per-window) —
+# the default pipeline config now updates every 1024 tokens. Measured
+# ratio at the defaults: 0.977 (deterministic seed); the unshared and
+# fine-granularity variants reach >= 1.0x host.
+W2V_QUALITY_RATIO = 0.95
+
+# routed MoE must hold >= this fraction of the SAME-WINDOW dense line
+# (top-2/8 at capacity 1.25; r5 measured 0.737-0.765)
+MOE_RATIO_FLOOR = 0.65
 
 
 def _emit(mode: str, value: float, unit: str, **extra) -> None:
@@ -445,7 +461,16 @@ def bench_word2vec() -> None:
     cosine separation, compared against the unshared-negatives variant and
     the host (reference-semantics) path on the same sub-corpus/seed — so
     trust-region clipping + shared negatives cannot silently trade quality
-    for speed."""
+    for speed.
+
+    Config pairing (r5): the sub-corpus gate probes the PIPELINE DEFAULTS
+    (512x2 chunks = 1024-token updates) — the coarse timed config
+    (2048x4 = 8192) cannot be probed on a 200k-word sub-corpus because
+    its update COUNT collapses (~24 updates trains nothing: measured
+    0.24 separation, a corpus-size artifact, not a quality signal). The
+    timed config's own quality on the full corpus is the `quality`
+    field, which must also clear the host sub-corpus separation — a
+    slide in the coarse path flags there."""
     from deeplearning4j_tpu.nlp.word2vec import Word2Vec
 
     rng = np.random.default_rng(0)
@@ -797,7 +822,16 @@ def bench_moe() -> None:
         if peak:
             extra["mfu"] = round(flops_tok * value / peak, 4)
         extra["dense_same_window_tokens_per_sec"] = round(dense_tps, 1)
-        extra["vs_dense_ratio"] = round(value / dense_tps, 4)
+        ratio = value / dense_tps
+        extra["vs_dense_ratio"] = round(ratio, 4)
+        # ratio gate (VERDICT r4 #3): a top-2/8 capacity-1.25 MoE should
+        # hold >= 0.65x dense; the ratio is chip-state-immune (same
+        # window), so no gate_scale — r5 measured 0.765
+        extra["ratio_floor"] = MOE_RATIO_FLOOR
+        if ratio < MOE_RATIO_FLOOR:
+            extra["regression"] = True
+            sys.stderr.write(f"REGRESSION: moe vs_dense_ratio "
+                             f"{ratio:.3f} < {MOE_RATIO_FLOOR}\n")
         _emit("moe", value, "tokens/sec",
               metric=f"transformer_moe_lm_tokens_per_sec_{backend}",
               n_experts=8, top_k=2, routing="routed",

@@ -79,8 +79,9 @@ class SequenceVectors:
                  elements_learning_algorithm: str = "skipgram",
                  vocab_limit: Optional[int] = None,
                  use_device_pipeline: bool = False, device_mesh=None,
-                 pipeline_chunk: int = 512, pipeline_group: int = 4,
+                 pipeline_chunk: int = 512, pipeline_group: int = 2,
                  pipeline_share_negatives: bool = True,
+                 pipeline_neg_oversample: float = 2.0,
                  n_workers: int = 1):
         self.layer_size = layer_size
         self.window_size = window_size
@@ -100,6 +101,10 @@ class SequenceVectors:
         self.pipeline_chunk = pipeline_chunk
         self.pipeline_group = pipeline_group
         self.pipeline_share_negatives = pipeline_share_negatives
+        # shared-negative variance reduction: draw oversample*K negatives
+        # per center, each weighted K/M — expectation-identical to
+        # per-pair SGNS, most of the unshared quality at shared speed (r5)
+        self.pipeline_neg_oversample = pipeline_neg_oversample
         self.n_workers = n_workers  # host-parallel vocab counting
         self._epoch_fn = None
 
@@ -339,28 +344,39 @@ class SequenceVectors:
         if self._extra_rows():
             raise ValueError("device pipeline does not support extra label "
                              "rows (ParagraphVectors) — use the host path")
+        group = self.pipeline_group
+        if self.device_mesh is not None:
+            n_dev = self.device_mesh.shape["data"]
+            if group % n_dev:
+                # the group dim shards over the mesh: round UP to a
+                # multiple so the finer r5 default (group=2, 1024-token
+                # updates) still runs on any device count — mesh users
+                # get the nearest >= granularity, same SGD semantics
+                group = -(-group // n_dev) * n_dev
         cfg = (self.algorithm, self.window_size, self.negative,
-               self.pipeline_chunk, self.pipeline_group,
-               self.pipeline_share_negatives, id(self.device_mesh))
+               self.pipeline_chunk, group,
+               self.pipeline_share_negatives,
+               self.pipeline_neg_oversample, id(self.device_mesh))
         if self._epoch_fn is None or getattr(self, "_epoch_cfg", None) != cfg:
             if self.algorithm == "cbow":
                 self._epoch_fn = make_cbow_epoch(
                     window=self.window_size, negative=self.negative,
-                    chunk=self.pipeline_chunk, group=self.pipeline_group,
+                    chunk=self.pipeline_chunk, group=group,
                     mesh=self.device_mesh)
             else:
                 self._epoch_fn = make_sgns_epoch(
                     window=self.window_size, negative=self.negative,
-                    chunk=self.pipeline_chunk, group=self.pipeline_group,
+                    chunk=self.pipeline_chunk, group=group,
                     mesh=self.device_mesh,
-                    share_negatives=self.pipeline_share_negatives)
+                    share_negatives=self.pipeline_share_negatives,
+                    neg_oversample=self.pipeline_neg_oversample)
             self._epoch_cfg = cfg
         t = self.lookup_table
         probs = np.diff(self._cum_table, prepend=0.0)
         aJ, aq = build_alias_table(probs)
         aJ, aq = jnp.asarray(aJ), jnp.asarray(aq)
         total = self.vocab.total_word_occurrences * self.epochs
-        per_update = self.pipeline_chunk * self.pipeline_group
+        per_update = self.pipeline_chunk * group
         done = 0.0
         packed = None
         losses = []

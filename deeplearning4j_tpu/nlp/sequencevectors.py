@@ -79,7 +79,7 @@ class SequenceVectors:
                  elements_learning_algorithm: str = "skipgram",
                  vocab_limit: Optional[int] = None,
                  use_device_pipeline: bool = False, device_mesh=None,
-                 pipeline_chunk: int = 512, pipeline_group: int = 2,
+                 pipeline_chunk: int = 512, pipeline_group=None,
                  pipeline_share_negatives: bool = True,
                  pipeline_neg_oversample: float = 2.0,
                  n_workers: int = 1):
@@ -99,6 +99,11 @@ class SequenceVectors:
         self.use_device_pipeline = use_device_pipeline
         self.device_mesh = device_mesh
         self.pipeline_chunk = pipeline_chunk
+        # None = auto: 2 (1024-token updates, the r5 quality default), or
+        # the smallest mesh-data-axis multiple >= 2 when a device_mesh is
+        # set. PIN an explicit group for strict device-count invariance —
+        # auto adapts the update granularity to the mesh, a pinned group
+        # gives bit-identical results on any device count (DP-5).
         self.pipeline_group = pipeline_group
         self.pipeline_share_negatives = pipeline_share_negatives
         # shared-negative variance reduction: draw oversample*K negatives
@@ -345,14 +350,18 @@ class SequenceVectors:
             raise ValueError("device pipeline does not support extra label "
                              "rows (ParagraphVectors) — use the host path")
         group = self.pipeline_group
-        if self.device_mesh is not None:
-            n_dev = self.device_mesh.shape["data"]
-            if group % n_dev:
-                # the group dim shards over the mesh: round UP to a
-                # multiple so the finer r5 default (group=2, 1024-token
-                # updates) still runs on any device count — mesh users
-                # get the nearest >= granularity, same SGD semantics
+        if group is None:
+            group = 2
+            if self.device_mesh is not None:
+                n_dev = self.device_mesh.shape["data"]
                 group = -(-group // n_dev) * n_dev
+        elif (self.device_mesh is not None
+              and group % self.device_mesh.shape["data"]):
+            n_dev = self.device_mesh.shape["data"]
+            raise ValueError(
+                f"pipeline_group={group} does not divide over the "
+                f"{n_dev}-way mesh data axis — set pipeline_group to a "
+                f"multiple of {n_dev} (or leave it None for auto)")
         cfg = (self.algorithm, self.window_size, self.negative,
                self.pipeline_chunk, group,
                self.pipeline_share_negatives,

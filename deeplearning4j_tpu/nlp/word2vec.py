@@ -108,13 +108,22 @@ class Word2Vec(SequenceVectors):
             self._kw["pipeline_share_negatives"] = flag
             return self
 
-        def device_mesh(self, mesh, chunk: int = 512, group: int = 4):
+        def device_mesh(self, mesh, chunk: int = 512, group=None):
             """Shard the chunk stream over mesh's 'data' axis (DP-5).
-            Implies use_device_pipeline."""
+            Implies use_device_pipeline. group None = auto (smallest
+            mesh multiple of the r5 default 2); pin an explicit
+            mesh-multiple for device-count-invariant results."""
             self._kw["use_device_pipeline"] = True
             self._kw["device_mesh"] = mesh
             self._kw["pipeline_chunk"] = chunk
             self._kw["pipeline_group"] = group
+            return self
+
+        def negative_oversample(self, factor: float):
+            """Shared-negative variance reduction: draw factor*K shared
+            negatives each weighted K/M (expectation-identical to
+            per-pair SGNS; default 2.0 — see nlp/device_pipeline.py)."""
+            self._kw["pipeline_neg_oversample"] = float(factor)
             return self
 
         def elements_learning_algorithm(self, name: str):

@@ -37,18 +37,22 @@ from jax.experimental.pallas import tpu as pltpu
 LANES = 128
 NEG_INF = -1e30
 
-# Swept on v5e (N=16384, d=256, V=10000, fwd+bwd): 512/2048 → 0.67ms vs
-# 3.2ms at 256/1024; 512/4096 exceeds the 16MB VMEM scoped limit (the
-# [bn, bv] f32 logits tile plus the [d, bv] f32 dW scratch dominate).
-BLOCK_N = 512    # token-block rows per program
+# Swept on v5e (N=16384, d=256, V=10240, fwd+dx+dwdb interleaved):
+# r2 found 512/2048 >> 256/1024; the r5 re-sweep at the 32MB scoped
+# limit found 1024-row blocks another ~4% faster (fewer weight-block
+# re-streams per row), while 4096-wide vocab chunks and 256-row blocks
+# both LOSE even with the headroom.
+BLOCK_N = 1024   # token-block rows per program
 BLOCK_V = 2048   # vocab-chunk columns streamed through VMEM at d=256
 
 
 def _block_v(d: int, v: int) -> int:
     """Vocab chunk width: the VMEM working set ([bn, bv] f32 logits tile,
     [d, bv] f32 dW scratch, double-buffered [d, bv] weight blocks) scales
-    with d·bv, so shrink the chunk as the feature dim grows to stay under
-    the 16MB scoped limit the d=256 sweep was tuned against. The width is
+    with d·bv, so shrink the chunk as the feature dim grows to stay
+    inside the swept VMEM envelope (bn=1024 x bv=2048 at d=256 under the
+    32MB scoped limit every kernel in this file now requests — wider
+    chunks fit but LOSE, see the BLOCK_N/BLOCK_V note). The width is
     floored to a lane multiple (128); when the whole vocab fits one chunk
     the block equals the array dim, which Mosaic also accepts. The chunk
     is also capped at the swept BLOCK_V so a small d (e.g. 128) cannot
@@ -158,6 +162,8 @@ def _fused_fwd(x, w, b, labels):
             pltpu.VMEM((bn, LANES), jnp.float32),
             pltpu.VMEM((bn, LANES), jnp.float32),
         ],
+        compiler_params=pltpu.CompilerParams(
+            vmem_limit_bytes=32 * 1024 * 1024),
         interpret=_use_interpret(),
     )(x, w, b2, lab2)
     return loss[:, 0], lse[:, 0]
@@ -251,6 +257,8 @@ def _fused_bwd(res, dloss):
         out_specs=pl.BlockSpec((bn, d), lambda i, j: (i, 0)),
         out_shape=jax.ShapeDtypeStruct((N, d), x.dtype),
         scratch_shapes=[pltpu.VMEM((bn, d), jnp.float32)],
+        compiler_params=pltpu.CompilerParams(
+            vmem_limit_bytes=32 * 1024 * 1024),
         interpret=_use_interpret(),
     )(x, w, b2, lab2, lse2, g2)
 

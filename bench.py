@@ -565,21 +565,26 @@ def bench_resnet_dp() -> None:
     y = np.eye(10, dtype=np.float32)[rng.integers(0, 10, batch)]
     ds = DataSet(x, y)
 
-    def timed_fit(trainer, n_batches):
+    def timed_fit(trainer, n_batches, rounds=3):
+        # virtual-CPU-mesh timing is host-contention sensitive (r5 saw
+        # the ratio swing 0.98-1.21x between sweeps): best-of-3 rounds
         trainer.fit(ListDataSetIterator([ds] * 2))  # warmup/compile
-        t0 = time.perf_counter()
-        trainer.fit(ListDataSetIterator([ds] * n_batches))
-        return n_batches / (time.perf_counter() - t0)
+        best = 0.0
+        for _ in range(rounds):
+            t0 = time.perf_counter()
+            trainer.fit(ListDataSetIterator([ds] * n_batches))
+            best = max(best, n_batches / (time.perf_counter() - t0))
+        return best
 
     mesh = make_mesh({"data": n_dev})
     net_ar = resnet20()
     net_ar.init()
-    sps_allreduce = timed_fit(DataParallelTrainer(net_ar, mesh), 6)
+    sps_allreduce = timed_fit(DataParallelTrainer(net_ar, mesh), 8)
 
     net_pa = resnet20()
     net_pa.init()
     sps_paramavg = timed_fit(
-        ParameterAveragingTrainer(net_pa, mesh, averaging_frequency=1), 6)
+        ParameterAveragingTrainer(net_pa, mesh, averaging_frequency=1), 8)
 
     _emit("resnet_dp", sps_allreduce / sps_paramavg, "x",
           metric="resnet20_dp_allreduce_vs_paramavg_speedup",

@@ -285,12 +285,12 @@ def _fused_bwd(res, dloss):
             pltpu.VMEM((d, bv), jnp.float32),
             pltpu.VMEM((1, bv), jnp.float32),
         ],
-        # the [bn,bv] logits recompute + [d,bv] scratch + double-buffered
-        # [d,bv] weight blocks sit ~0.1-1MB over the conservative 16MB
-        # scoped default under some XLA schedules (observed: 16.13MB on a
-        # direct-step compile while the scanned path fit); v5e has 128MB
-        # of VMEM — raise this kernel's scoped budget instead of shrinking
-        # the swept block sizes
+        # the [bn,bv] f32 logits recompute is 8MB alone at the r5 block
+        # sizes (bn=1024 x bv=2048), plus the [d,bv] dW scratch and
+        # double-buffered weight blocks — well past the conservative
+        # 16MB scoped default; v5e has 128MB of VMEM, so all three
+        # kernels in this file request 32MB rather than shrinking the
+        # swept (faster) block sizes
         compiler_params=pltpu.CompilerParams(
             vmem_limit_bytes=32 * 1024 * 1024),
         interpret=_use_interpret(),

@@ -235,6 +235,33 @@ def test_seq_pipe_via_set_mesh_matches_dense(lm_data):
                                        atol=5e-4)
 
 
+def test_seq_pipe_masked_loss_matches_dense(lm_data):
+    """seq x pipe with a LABELS mask: each seq shard holds a different
+    number of valid positions, so the exact global combine is the
+    valid-count-weighted psum over {pipe, data, seq} — must equal the
+    dense masked loss."""
+    rng = np.random.default_rng(3)
+    toks = np.asarray(lm_data.features)
+    labs_int = np.roll(toks, -1, axis=1).astype(np.int32)
+    lmask = (rng.random(toks.shape) < 0.7).astype(np.float32)
+    lmask[:, 0] = 1.0
+    from deeplearning4j_tpu.datasets.api import DataSet as DS
+
+    ds = DS(toks, labs_int, labels_mask=lmask)
+    dense_net = transformer_lm(vocab_size=V, d_model=D, n_heads=H,
+                               n_layers=L, d_ff=FF, max_length=T)
+    dense_net.init()
+    dense_net.fit(ds, epochs=2)
+    net = transformer_lm(vocab_size=V, d_model=D, n_heads=H, n_layers=L,
+                         d_ff=FF, max_length=T, seq_parallel_axis="seq")
+    net.init()
+    net.set_mesh(make_mesh({"pipe": 2, "seq": 2, "data": 2}),
+                 axes={"pipe": "pipe", "seq": "seq", "data": "data"},
+                 n_microbatches=2)
+    net.fit(ds, epochs=2)
+    assert abs(net.score_value - dense_net.score_value) < ATOL
+
+
 def test_seq_axis_requires_sp_conf():
     net = _fresh_lm()  # built WITHOUT seq_parallel_axis
     with pytest.raises(ValueError, match="seq_parallel_axis"):

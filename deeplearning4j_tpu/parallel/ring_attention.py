@@ -31,9 +31,14 @@ def _ring_flash(q, k, v, *, axis_name: str, causal: bool):
     kernel exists to replace). Each hop runs the fused kernel on local Q
     against the visiting K/V block at the single-chip flash rate; the
     (o, lse) results merge across hops with the standard logsumexp
-    combine, whose weights differentiate through the kernel's lse output
-    (flash_attention_lse). ppermute overlap is unchanged."""
-    from deeplearning4j_tpu.ops.flash_attention import flash_attention_lse
+    combine (lse_combine — shared with the serial chunk loop in
+    ops/flash_attention.py), whose weights differentiate through the
+    kernel's lse output (flash_attention_lse). ppermute overlap is
+    unchanged."""
+    from deeplearning4j_tpu.ops.flash_attention import (
+        flash_attention_lse,
+        lse_combine,
+    )
 
     n = lax.psum(1, axis_name)
     idx = lax.axis_index(axis_name)
@@ -70,13 +75,7 @@ def _ring_flash(q, k, v, *, axis_name: str, causal: bool):
         o, lse, k_cur, v_cur = carry
         src = (idx - i) % n
         o_hop, lse_hop = hop(k_cur, v_cur, src)
-        m = jnp.maximum(lse, lse_hop)
-        a = jnp.exp(lse - m)
-        b = jnp.exp(lse_hop - m)
-        denom = jnp.maximum(a + b, 1e-30)
-        o = (o * a[..., None]
-             + o_hop.astype(jnp.float32) * b[..., None]) / denom[..., None]
-        lse = m + jnp.log(denom)
+        o, lse = lse_combine(o, lse, o_hop, lse_hop)
         k_nxt = lax.ppermute(k_cur, axis_name, perm)
         v_nxt = lax.ppermute(v_cur, axis_name, perm)
         return (o, lse, k_nxt, v_nxt), None

@@ -1,0 +1,32 @@
+"""Long-context training with rematerialization: conf.remat wraps every
+layer vertex in jax.checkpoint, so per-layer activations are recomputed
+during the backward pass instead of living in HBM for the whole step —
+the HBM-for-FLOPs trade that lets sequence lengths train on one chip
+that would otherwise OOM (reference memory knobs: the workspace system;
+here the XLA-native equivalent).
+
+Composes with the flash-attention kernels (attention never materializes
+[T, T] scores either way) and with every set_mesh axis. For sequences
+too long for ONE chip even with remat, see sequence_parallel_lm.py —
+the two compose: remat shrinks per-shard activation memory under the
+seq axis too.
+"""
+import numpy as np
+
+from deeplearning4j_tpu.datasets.api import DataSet
+from deeplearning4j_tpu.datasets.iterators import ListDataSetIterator
+from deeplearning4j_tpu.models.transformer import transformer_lm
+
+VOCAB, SEQ, BATCH = 512, 1024, 2
+
+rng = np.random.default_rng(0)
+toks = np.asarray(rng.integers(0, VOCAB, (BATCH, SEQ)), np.int32)
+ds = DataSet(toks, np.roll(toks, -1, axis=1))
+
+net = transformer_lm(vocab_size=VOCAB, d_model=64, n_heads=2, n_layers=4,
+                     d_ff=128, max_length=SEQ, remat=True)
+net.init()
+
+for epoch in range(5):
+    net.fit(ListDataSetIterator([ds]), epochs=1)
+    print(f"epoch {epoch}: loss {net.score_value:.4f}")

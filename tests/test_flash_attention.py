@@ -409,3 +409,97 @@ def test_dropout_streaming_kernels_match_dense():
                     (0, 1, 2))(q, k, v)
     for a, b in zip(gout, gref):
         np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=2e-4)
+
+
+class TestChunkedFlash:
+    """Blockwise long-context attention (chunked_flash_attention): the
+    ring-attention hop primitive + lse merge serialized on one chip, for
+    T beyond the monolithic kernels' VMEM envelope (MAX_FLASH_T). Tested
+    at small T with an explicit chunk so CPU interpret mode stays fast."""
+
+    @pytest.mark.parametrize("causal", [True, False])
+    def test_forward_matches_dense(self, causal):
+        from deeplearning4j_tpu.ops.flash_attention import (
+            chunked_flash_attention,
+        )
+
+        q, k, v = _qkv(T=512)
+        o_c = chunked_flash_attention(q, k, v, causal=causal, chunk=128)
+        o_d = dot_product_attention(q, k, v, causal=causal)
+        np.testing.assert_allclose(np.asarray(o_c), np.asarray(o_d),
+                                   atol=2e-5)
+
+    def test_backward_matches_monolithic(self, rng):
+        from deeplearning4j_tpu.ops.flash_attention import (
+            chunked_flash_attention,
+        )
+
+        q, k, v = _qkv(T=512, seed=3)
+
+        def f_chunked(q, k, v):
+            return jnp.sum(jnp.sin(
+                chunked_flash_attention(q, k, v, causal=True, chunk=128)))
+
+        def f_mono(q, k, v):
+            return jnp.sum(jnp.sin(flash_attention(q, k, v, causal=True)))
+
+        g_c = jax.grad(f_chunked, argnums=(0, 1, 2))(q, k, v)
+        g_m = jax.grad(f_mono, argnums=(0, 1, 2))(q, k, v)
+        for a, b in zip(g_c, g_m):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       atol=2e-4)
+
+    def test_supports_envelope(self):
+        from deeplearning4j_tpu.ops.flash_attention import (
+            MAX_CHUNKS,
+            MAX_FLASH_T,
+            pick_chunk,
+            supports_chunked,
+        )
+
+        big = (2, 2, 2 * MAX_FLASH_T, 64)
+        assert supports_chunked(big, causal=True, dropout=0.0, mask=None)
+        # monolithic envelope excludes what chunked picks up
+        assert not supports(big, causal=True, dropout=0.0, mask=None)
+        # masks/dropout are not plumbed through the chunk loop
+        assert not supports_chunked(big, causal=True, dropout=0.1, mask=None)
+        assert not supports_chunked(big, causal=True, dropout=0.0,
+                                    mask=np.ones((2, big[2])))
+        # T inside the monolithic envelope stays monolithic
+        small = (2, 2, MAX_FLASH_T, 64)
+        assert not supports_chunked(small, causal=True, dropout=0.0,
+                                    mask=None)
+        assert pick_chunk(2 * MAX_FLASH_T) == MAX_FLASH_T
+        assert pick_chunk(8192 + 128) == 0  # not tile-divisible
+        # the unroll guard: an awkward T whose only tiles would exceed
+        # MAX_CHUNKS (49 x 512) is rejected, not compiled for minutes
+        assert pick_chunk(25088) == 0
+        # the measured ceiling: MAX_CHUNKS tiles of MAX_FLASH_T
+        assert pick_chunk(MAX_CHUNKS * MAX_FLASH_T) == MAX_FLASH_T
+
+    def test_long_t_misconfig_raises_not_ooms(self):
+        """mask/dropout (or an untileable T) at long T must raise with
+        instructions — the dense fallback would be a device OOM."""
+        from deeplearning4j_tpu.nn.conf.layers import SelfAttentionLayer
+        from deeplearning4j_tpu.nn.layers.attention import (
+            SelfAttentionImpl,
+        )
+        from deeplearning4j_tpu.ops.flash_attention import MAX_FLASH_T
+
+        T = 2 * MAX_FLASH_T
+        conf = SelfAttentionLayer(n_in=16, n_out=16, n_heads=2, causal=True,
+                                  weight_init="xavier",
+                                  attention_dropout=0.5)
+        impl = SelfAttentionImpl()
+        params, state = impl.init(conf, jax.random.PRNGKey(0), jnp.float32)
+        x = jnp.zeros((1, T, 16), jnp.float32)
+        with pytest.raises(ValueError, match="chunked flash path"):
+            jax.eval_shape(lambda p, s, x: impl.apply(
+                conf, p, s, x, train=True, rng=jax.random.PRNGKey(1)),
+                params, state, x)
+        conf2 = SelfAttentionLayer(n_in=16, n_out=16, n_heads=2, causal=True,
+                                   weight_init="xavier")
+        with pytest.raises(ValueError, match="cannot be tiled"):
+            jax.eval_shape(lambda p, s, x: impl.apply(
+                conf2, p, s, x, train=False, rng=None),
+                params, state, jnp.zeros((1, 25088, 16), jnp.float32))

@@ -210,3 +210,40 @@ def test_fit_scanned_rejects_ragged_batches():
                            np.eye(2, dtype=np.float32)[rng.integers(0, 2, n)])
     with pytest.raises(ValueError):
         net.fit_scanned(ListDataSetIterator([mk(16), mk(7)]))
+
+
+def test_mln_remat_matches_plain_gradients():
+    """conf.remat (jax.checkpoint per layer, multilayer.py:169) is a pure
+    HBM-for-FLOPs trade: loss and every gradient leaf must agree with the
+    un-rematted network to float tolerance."""
+    import jax
+
+    from deeplearning4j_tpu.nn.conf import DenseLayer, OutputLayer
+
+    rng = np.random.default_rng(5)
+    x = rng.standard_normal((8, 12)).astype(np.float32)
+    y = np.eye(3, dtype=np.float32)[rng.integers(0, 3, 8)]
+    nets = {}
+    for remat in (False, True):
+        b = (NeuralNetConfiguration.builder()
+             .seed(9).learning_rate(0.05).updater(Updater.ADAM)
+             .remat(remat)
+             .list()
+             .layer(DenseLayer(n_in=12, n_out=16, activation="tanh"))
+             .layer(DenseLayer(n_in=16, n_out=8, activation="relu"))
+             .layer(OutputLayer(n_in=8, n_out=3, activation="softmax",
+                                loss_function="mcxent"))
+             .build())
+        nets[remat] = MultiLayerNetwork(b).init()
+
+    def loss_and_grads(net):
+        batch = {"features": x, "labels": y}
+        def f(p):
+            loss, _ = net._loss(p, net.state, jax.random.PRNGKey(0), batch)
+            return loss
+        return jax.value_and_grad(f)(net.params)
+
+    (l0, g0), (l1, g1) = loss_and_grads(nets[False]), loss_and_grads(nets[True])
+    np.testing.assert_allclose(float(l0), float(l1), rtol=1e-6)
+    jax.tree.map(lambda a, b: np.testing.assert_allclose(
+        np.asarray(a), np.asarray(b), rtol=1e-5, atol=1e-6), g0, g1)

@@ -20,6 +20,7 @@ from deeplearning4j_tpu.ops.flash_attention import (
     flash_attention_qkv,
     supports as flash_supports,
     supports_chunked as flash_supports_chunked,
+    supports_monolithic_fallback as flash_supports_monolithic_fallback,
     supports_qkv as flash_supports_qkv,
 )
 from deeplearning4j_tpu.nn.conf.layers import (
@@ -159,7 +160,8 @@ class SelfAttentionImpl(LayerImpl):
         D = n // H
         qkv = x @ params["Wqkv"] + params["bqkv"]  # [B, T, 3n]
         drop_attn = conf.attention_dropout if train else 0.0
-        if (getattr(conf, "use_flash", True)
+        use_flash = getattr(conf, "use_flash", True)
+        if (use_flash
                 and not _sp_axis_in_scope(getattr(conf, "seq_parallel_axis",
                                                   ""))
                 and flash_supports_qkv(B, T, n, H, dropout=drop_attn)):
@@ -195,17 +197,25 @@ class SelfAttentionImpl(LayerImpl):
             out = ring_attention(qh, kh, vh,
                                  axis_name=conf.seq_parallel_axis,
                                  causal=conf.causal)
-        elif getattr(conf, "use_flash", True) and flash_supports(
+        elif use_flash and flash_supports(
                 qh.shape, causal=conf.causal, dropout=drop_attn, mask=mask):
             out = flash_attention(qh, kh, vh, causal=conf.causal, mask=mask,
                                   dropout=drop_attn, dropout_rng=rng)
-        elif getattr(conf, "use_flash", True) and flash_supports_chunked(
+        elif use_flash and flash_supports_chunked(
                 qh.shape, causal=conf.causal, dropout=drop_attn, mask=mask):
-            # T beyond the monolithic kernels' VMEM envelope: blockwise
+            # T beyond the monolithic kernels' envelope: blockwise
             # tiles + lse merge (single-chip ring). Past this, the seq
             # mesh axis shards T across chips (sequence_parallel.py)
             out = chunked_flash_attention(qh, kh, vh, causal=conf.causal)
-        elif getattr(conf, "use_flash", True) and T > MAX_FLASH_T:
+        elif (use_flash and T > MAX_FLASH_T
+              and flash_supports_monolithic_fallback(
+                  qh.shape, causal=conf.causal, dropout=drop_attn,
+                  mask=mask)):
+            # what the tile loop can't take (masks/dropout, non-tileable
+            # T) still compiles monolithically to MONOLITHIC_COMPILE_MAX
+            out = flash_attention(qh, kh, vh, causal=conf.causal, mask=mask,
+                                  dropout=drop_attn, dropout_rng=rng)
+        elif use_flash and T > MAX_FLASH_T:
             # dense [T, T] scores at these lengths are a guaranteed
             # device OOM — fail with instructions, not an opaque OOM
             raise ValueError(chunked_unsupported_reason(

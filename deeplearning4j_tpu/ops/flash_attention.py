@@ -1143,12 +1143,21 @@ def flash_attention_qkv(qkv, n_heads, *, causal=True, sm_scale=None,
 # T=4096, so the crossover sits at or below 512.
 MIN_FLASH_SEQ = 512
 
-# Largest T the monolithic long-T kernels compile at: the dq/dkv backward
-# streams full-T K/V (resp. Q/dO) blocks through VMEM (double-buffered
-# bf16 [T, D] pairs), which fits at 8192 and busts VMEM at 16384 (the
-# forward still compiles there). Beyond this, attention goes through
-# chunked_flash_attention — same kernels over chunk-length tiles.
+# Largest T the monolithic long-T kernels are performance-proven at: the
+# dq/dkv backward streams full-T K/V (resp. Q/dO) blocks through VMEM
+# (double-buffered bf16 [T, D] pairs), which fits at 8192 (0.69 MFU
+# in-model) and busts VMEM at 15360+ with 512-blocks. Beyond this,
+# attention prefers chunked_flash_attention — same kernels over
+# chunk-length tiles.
 MAX_FLASH_T = 8192
+
+# Hard compile ceiling of the monolithic backward (measured at D=128,
+# 512-blocks: 14336 compiles, 15360 fails). T in (MAX_FLASH_T,
+# MONOLITHIC_COMPILE_MAX] that the tile loop cannot take — padding
+# masks, attention dropout, or a non-tileable length — falls back to the
+# monolithic kernels (the pre-r5 behavior for every such config) instead
+# of raising.
+MONOLITHIC_COMPILE_MAX = 14336
 
 
 def supports(q_shape, *, causal, dropout, mask) -> bool:
@@ -1168,14 +1177,23 @@ def supports(q_shape, *, causal, dropout, mask) -> bool:
 # 1200+ pallas calls and compile for minutes.
 MAX_CHUNKS = 16
 
+# Kernel-proven tile lengths, largest first — the single home for the
+# tiling envelope quoted in error messages (chunked_unsupported_reason,
+# the ring hop dispatch).
+CHUNK_TILES = (8192, 4096, 2048, 1024, 512)
+
 
 def pick_chunk(T: int) -> int:
     """Largest kernel-proven tile length that divides T into 2 to
     MAX_CHUNKS chunks (0: T not chunkable)."""
-    for c in (8192, 4096, 2048, 1024, 512):
+    for c in CHUNK_TILES:
         if T % c == 0 and 2 <= T // c <= MAX_CHUNKS:
             return c
     return 0
+
+
+def _tiles_str() -> str:
+    return "/".join(str(c) for c in reversed(CHUNK_TILES))
 
 
 def supports_chunked(q_shape, *, causal, dropout, mask) -> bool:
@@ -1191,19 +1209,29 @@ def supports_chunked(q_shape, *, causal, dropout, mask) -> bool:
             and pick_chunk(T) > 0)
 
 
+def supports_monolithic_fallback(q_shape, *, causal, dropout, mask) -> bool:
+    """T in (MAX_FLASH_T, MONOLITHIC_COMPILE_MAX] the tile loop cannot
+    take (mask/dropout configs, non-tileable lengths) still compiles on
+    the monolithic kernels with every in-kernel feature — the pre-r5
+    dispatch for those shapes, kept so they don't regress to an error."""
+    T = q_shape[2]
+    return MAX_FLASH_T < T <= MONOLITHIC_COMPILE_MAX and T % BLOCK == 0
+
+
 def chunked_unsupported_reason(T, *, dropout, mask) -> str:
-    """Why supports_chunked rejected a T > MAX_FLASH_T shape — raised by
-    the attention layer so long-context misconfigurations fail with
+    """Why a T > MONOLITHIC_COMPILE_MAX shape has no fused path — raised
+    by the attention layer so long-context misconfigurations fail with
     instructions instead of a dense-path device OOM."""
     if mask is not None or dropout:
         return (f"attention at T={T} runs the chunked flash path, which "
-                "supports neither padding masks nor attention dropout — "
-                "train long-context batches unpadded with "
+                "supports neither padding masks nor attention dropout "
+                f"(in-kernel masks/dropout reach T={MONOLITHIC_COMPILE_MAX}"
+                ") — train long-context batches unpadded with "
                 "attention_dropout=0, or shard T over a 'seq' mesh axis "
                 "(ring attention)")
     return (f"attention at T={T} cannot be tiled: the chunked flash path "
             f"needs T divisible into 2-{MAX_CHUNKS} tiles of "
-            "512/1024/2048/4096/8192 (max single-chip "
+            f"{_tiles_str()} (max single-chip "
             f"T = {MAX_CHUNKS * MAX_FLASH_T}) — pad T to a tile-divisible "
             "length or shard T over a 'seq' mesh axis")
 
@@ -1236,30 +1264,49 @@ def chunked_flash_attention(q, k, v, *, causal=True, sm_scale=None,
     weights flow through flash_attention_lse's custom VJP). `chunk`
     defaults to pick_chunk(T)."""
     B, H, T, D = q.shape
-    c = chunk or pick_chunk(T)
-    if c <= 0 or T % c:
-        raise ValueError(f"T={T} not divisible into chunks")
-    n = T // c
     if sm_scale is None:
         sm_scale = 1.0 / float(np.sqrt(D))
-    qf = q.reshape(B * H, T, D)
-    kf = k.reshape(B * H, T, D)
-    vf = v.reshape(B * H, T, D)
-    outs = []
+    o, _ = chunked_flash_attention_lse(
+        q.reshape(B * H, T, D), k.reshape(B * H, T, D),
+        v.reshape(B * H, T, D), sm_scale, causal, chunk=chunk)
+    return o.reshape(B, H, T, D)
+
+
+def chunked_flash_attention_lse(q, k, v, sm_scale, causal, chunk=None):
+    """Flat-layout chunked attention returning (o [BH, T, D], lse
+    [BH, T]) — the long-local-block form of flash_attention_lse: ring
+    hops whose PER-SHARD block exceeds MAX_FLASH_T route here
+    (parallel/ring_attention.py), so the seq mesh axis composes with
+    single-chip chunking to sequences of n_shards * 128k tokens.
+    Differentiable the same way (per-tile custom VJPs + lse_combine)."""
+    BH, T, D = q.shape
+    c = chunk or pick_chunk(T)
+    # explicit chunks obey the same guards as pick_chunk: lane-legal
+    # tiles no longer than the kernels' proven envelope, 2 to MAX_CHUNKS
+    # of them (n*(n+1)/2 pallas calls unroll in one jaxpr — an uncapped
+    # hop_chunk would compile for minutes; an oversized one would hand
+    # the monolithic kernel the VMEM-busting length this path avoids)
+    if (c <= 0 or T % c or c % BLOCK or c > MAX_FLASH_T
+            or not 2 <= T // c <= MAX_CHUNKS):
+        raise ValueError(
+            f"T={T} not divisible into 2-{MAX_CHUNKS} kernel tiles"
+            + (f" of {chunk}" if chunk else ""))
+    n = T // c
+    outs, lses = [], []
     for i in range(n):
-        qi = qf[:, i * c:(i + 1) * c]
+        qi = q[:, i * c:(i + 1) * c]
         o = lse = None
         for j in range(i + 1 if causal else n):
-            kj = kf[:, j * c:(j + 1) * c]
-            vj = vf[:, j * c:(j + 1) * c]
-            o_hop, lse_hop = flash_attention_lse(qi, kj, vj, sm_scale,
-                                                 causal and j == i)
+            o_hop, lse_hop = flash_attention_lse(
+                qi, k[:, j * c:(j + 1) * c], v[:, j * c:(j + 1) * c],
+                sm_scale, causal and j == i)
             if o is None:
                 o, lse = o_hop.astype(jnp.float32), lse_hop
             else:
                 o, lse = lse_combine(o, lse, o_hop, lse_hop)
         outs.append(o.astype(q.dtype))
-    return jnp.concatenate(outs, axis=1).reshape(B, H, T, D)
+        lses.append(lse)
+    return jnp.concatenate(outs, axis=1), jnp.concatenate(lses, axis=1)
 
 
 def flash_attention(q, k, v, *, causal=True, sm_scale=None, mask=None,

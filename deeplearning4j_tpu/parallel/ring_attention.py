@@ -25,7 +25,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 _NEG = -1e30
 
 
-def _ring_flash(q, k, v, *, axis_name: str, causal: bool):
+def _ring_flash(q, k, v, *, axis_name: str, causal: bool, hop_chunk=None):
     """Per-hop Pallas flash kernel + two-way lse merge (VERDICT r3 #4: the
     ring previously ran f32 einsum blockwise softmax — the dense math the
     kernel exists to replace). Each hop runs the fused kernel on local Q
@@ -34,10 +34,19 @@ def _ring_flash(q, k, v, *, axis_name: str, causal: bool):
     combine (lse_combine — shared with the serial chunk loop in
     ops/flash_attention.py), whose weights differentiate through the
     kernel's lse output (flash_attention_lse). ppermute overlap is
-    unchanged."""
+    unchanged. Local blocks past MAX_FLASH_T (the monolithic kernels'
+    VMEM envelope) run each hop through chunked_flash_attention_lse, so
+    the ring scales to n_shards x 128k-token sequences; `hop_chunk`
+    forces that tile length (tests use it at small Tl)."""
     from deeplearning4j_tpu.ops.flash_attention import (
+        MAX_CHUNKS,
+        MAX_FLASH_T,
+        MONOLITHIC_COMPILE_MAX,
+        _tiles_str,
+        chunked_flash_attention_lse,
         flash_attention_lse,
         lse_combine,
+        pick_chunk,
     )
 
     n = lax.psum(1, axis_name)
@@ -46,16 +55,31 @@ def _ring_flash(q, k, v, *, axis_name: str, causal: bool):
     scale = 1.0 / float(np.sqrt(D))
     qf = q.reshape(B * H, Tl, D)
     perm = [(j, (j + 1) % n) for j in range(n)]
+    if hop_chunk or (Tl > MAX_FLASH_T and pick_chunk(Tl) > 0):
+        def hop_lse(qf, kf, vf, scale, causal_hop):
+            return chunked_flash_attention_lse(qf, kf, vf, scale,
+                                               causal_hop, chunk=hop_chunk)
+    elif Tl <= MONOLITHIC_COMPILE_MAX:
+        # non-tileable local blocks up to the measured compile ceiling
+        # keep the monolithic per-hop kernel (pre-r5 behavior)
+        hop_lse = flash_attention_lse
+    else:
+        raise ValueError(
+            f"ring attention local block Tl={Tl} is neither tileable "
+            f"(2-{MAX_CHUNKS} tiles of {_tiles_str()}) nor within the "
+            f"monolithic kernels' compile ceiling "
+            f"({MONOLITHIC_COMPILE_MAX}) — use more 'seq' shards or pad "
+            "T so the per-shard block is tileable")
 
     def hop(k_cur, v_cur, src):
         kf = k_cur.reshape(B * H, Tl, D)
         vf = v_cur.reshape(B * H, Tl, D)
 
         def full(_):
-            return flash_attention_lse(qf, kf, vf, scale, False)
+            return hop_lse(qf, kf, vf, scale, False)
 
         def diag(_):
-            return flash_attention_lse(qf, kf, vf, scale, True)
+            return hop_lse(qf, kf, vf, scale, True)
 
         def skip(_):
             return (jnp.zeros_like(qf),
@@ -84,18 +108,21 @@ def _ring_flash(q, k, v, *, axis_name: str, causal: bool):
     return o.reshape(B, H, Tl, D).astype(q.dtype)
 
 
-def ring_attention(q, k, v, *, axis_name: str = "seq", causal: bool = True):
+def ring_attention(q, k, v, *, axis_name: str = "seq", causal: bool = True,
+                   hop_chunk=None):
     """Per-shard blockwise attention. q,k,v: [B, H, Tl, D] local blocks of a
     sequence sharded over `axis_name`. Returns [B, H, Tl, D].
 
     Runs n_shards steps; at each step attends local q against the visiting
     k/v block, then rotates k/v one hop around the ring. When the local
     block length is kernel-legal (Tl % 128 == 0) each hop runs the Pallas
-    flash kernel; otherwise the f32 einsum blockwise softmax (tiny-shape
+    flash kernel (chunk-tiled when Tl exceeds the monolithic VMEM
+    envelope); otherwise the f32 einsum blockwise softmax (tiny-shape
     tests, odd lengths)."""
     B, H, Tl, D = q.shape
     if Tl % 128 == 0:
-        return _ring_flash(q, k, v, axis_name=axis_name, causal=causal)
+        return _ring_flash(q, k, v, axis_name=axis_name, causal=causal,
+                           hop_chunk=hop_chunk)
     n = lax.psum(1, axis_name)
     idx = lax.axis_index(axis_name)
     scale = 1.0 / jnp.sqrt(jnp.asarray(D, jnp.float32))

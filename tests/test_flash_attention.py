@@ -477,6 +477,47 @@ class TestChunkedFlash:
         # the measured ceiling: MAX_CHUNKS tiles of MAX_FLASH_T
         assert pick_chunk(MAX_CHUNKS * MAX_FLASH_T) == MAX_FLASH_T
 
+    def test_monolithic_fallback_tier(self):
+        """T in (MAX_FLASH_T, MONOLITHIC_COMPILE_MAX] that the tile loop
+        cannot take (mask/dropout, non-tileable length) keeps the
+        monolithic kernels — the pre-r5 dispatch for those shapes must
+        not regress to an error (measured: the backward compiles to
+        14336 with 512-blocks; 15360 busts VMEM)."""
+        from deeplearning4j_tpu.ops.flash_attention import (
+            MONOLITHIC_COMPILE_MAX,
+            pick_chunk,
+            supports_chunked,
+            supports_monolithic_fallback,
+        )
+
+        awkward = (2, 2, 8320, 64)  # 128-divisible, no 512+ tile divisor
+        assert pick_chunk(8320) == 0
+        assert not supports_chunked(awkward, causal=True, dropout=0.0,
+                                    mask=None)
+        assert supports_monolithic_fallback(awkward, causal=True,
+                                            dropout=0.0, mask=None)
+        # masked/dropout tileable T inside the ceiling also falls back
+        masked = (2, 2, 12288, 64)
+        assert supports_monolithic_fallback(masked, causal=True, dropout=0.1,
+                                            mask=None)
+        # beyond the ceiling nothing monolithic is claimed
+        over = (2, 2, MONOLITHIC_COMPILE_MAX + 1024, 64)
+        assert not supports_monolithic_fallback(over, causal=True,
+                                                dropout=0.0, mask=None)
+
+    def test_explicit_chunk_obeys_guards(self):
+        from deeplearning4j_tpu.ops.flash_attention import (
+            chunked_flash_attention,
+        )
+
+        q, k, v = _qkv(T=512)
+        # an explicit chunk that would unroll past MAX_CHUNKS is rejected
+        with pytest.raises(ValueError, match="kernel tiles"):
+            chunked_flash_attention(q, k, v, causal=True, chunk=16)
+        # non-lane-multiple tiles are rejected even when count-legal
+        with pytest.raises(ValueError, match="kernel tiles"):
+            chunked_flash_attention(q, k, v, causal=True, chunk=64)
+
     def test_long_t_misconfig_raises_not_ooms(self):
         """mask/dropout (or an untileable T) at long T must raise with
         instructions — the dense fallback would be a device OOM."""

@@ -204,9 +204,11 @@ class SelfAttentionImpl(LayerImpl):
         elif use_flash and flash_supports_chunked(
                 qh.shape, causal=conf.causal, dropout=drop_attn, mask=mask):
             # T beyond the monolithic kernels' envelope: blockwise
-            # tiles + lse merge (single-chip ring). Past this, the seq
-            # mesh axis shards T across chips (sequence_parallel.py)
-            out = chunked_flash_attention(qh, kh, vh, causal=conf.causal)
+            # tiles + lse merge (single-chip ring); padding masks slice
+            # per kv tile. Past this, the seq mesh axis shards T across
+            # chips (sequence_parallel.py)
+            out = chunked_flash_attention(qh, kh, vh, causal=conf.causal,
+                                          mask=mask)
         elif (use_flash and T > MAX_FLASH_T
               and flash_supports_monolithic_fallback(
                   qh.shape, causal=conf.causal, dropout=drop_attn,

@@ -776,6 +776,34 @@ def _fal_bwd(sm_scale, causal, res, cts):
 flash_attention_lse.defvjp(_fal_fwd, _fal_bwd)
 
 
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5))
+def flash_attention_lse_masked(q, k, v, kmask, sm_scale, causal):
+    """flash_attention_lse with a [BH, 1, T] key padding mask operand —
+    the per-tile primitive of the MASKED chunk loop
+    (chunked_flash_attention_lse): each kv tile sees its slice of the
+    mask, so variable-length batches keep the fused path at chunked
+    lengths. A fully-masked tile emits lse ~ -1e20 and a zero block,
+    which the lse merge weights away."""
+    o, lse = _flash_fwd(q, k, v, kmask, sm_scale, causal)
+    return o, lse
+
+
+def _falm_fwd(q, k, v, kmask, sm_scale, causal):
+    o, lse = _flash_fwd(q, k, v, kmask, sm_scale, causal)
+    return (o, lse), (q, k, v, kmask, o, lse)
+
+
+def _falm_bwd(sm_scale, causal, res, cts):
+    do, dlse = cts
+    q, k, v, kmask, o, lse = res
+    dq, dk, dv = _flash_bwd_impl(q, k, v, o, lse, do, kmask, sm_scale,
+                                 causal, dlse=dlse)
+    return dq, dk, dv, jnp.zeros_like(kmask)
+
+
+flash_attention_lse_masked.defvjp(_falm_fwd, _falm_bwd)
+
+
 # ------------------------------------------------- packed-qkv (no relayout)
 #
 # When head_dim is a multiple of the 128-lane tile, the kernels can read
@@ -1198,37 +1226,42 @@ def _tiles_str() -> str:
 
 def supports_chunked(q_shape, *, causal, dropout, mask) -> bool:
     """Envelope of the blockwise long-context path: T beyond the
-    monolithic kernels, divisible into kernel-proven tiles. Padding masks
-    and attention dropout are not plumbed through the chunk loop (the
-    dropout counter-hash keys on chunk-relative coordinates; a mask would
-    need per-tile slicing) — the attention layer raises for those configs
-    at this length instead of entering the dense path, which OOMs there
-    (chunked_unsupported_reason builds the message)."""
+    monolithic kernels, divisible into kernel-proven tiles. Padding
+    masks ride the loop (each kv tile sees its mask slice —
+    flash_attention_lse_masked); attention dropout does not (the
+    counter-hash keys on chunk-relative coordinates) — the attention
+    layer raises for dropout at this length instead of entering the
+    dense path, which OOMs there (chunked_unsupported_reason builds the
+    message)."""
     T = q_shape[2]
-    return (mask is None and not dropout and T > MAX_FLASH_T
-            and pick_chunk(T) > 0)
+    return not dropout and T > MAX_FLASH_T and pick_chunk(T) > 0
 
 
 def supports_monolithic_fallback(q_shape, *, causal, dropout, mask) -> bool:
     """T in (MAX_FLASH_T, MONOLITHIC_COMPILE_MAX] the tile loop cannot
     take (mask/dropout configs, non-tileable lengths) still compiles on
     the monolithic kernels with every in-kernel feature — the pre-r5
-    dispatch for those shapes, kept so they don't regress to an error."""
-    T = q_shape[2]
-    return MAX_FLASH_T < T <= MONOLITHIC_COMPILE_MAX and T % BLOCK == 0
+    dispatch for those shapes, kept so they don't regress to an error.
+    Gated at D <= 128: the compile ceiling was measured there, and the
+    backward's VMEM working set scales with D."""
+    T, D = q_shape[2], q_shape[3]
+    return (MAX_FLASH_T < T <= MONOLITHIC_COMPILE_MAX and T % BLOCK == 0
+            and D <= 128)
 
 
 def chunked_unsupported_reason(T, *, dropout, mask) -> str:
     """Why a T > MONOLITHIC_COMPILE_MAX shape has no fused path — raised
     by the attention layer so long-context misconfigurations fail with
     instructions instead of a dense-path device OOM."""
-    if mask is not None or dropout:
+    if dropout:
+        pad_note = ("" if pick_chunk(T) > 0
+                    else " AND pad T to a tile-divisible length")
         return (f"attention at T={T} runs the chunked flash path, which "
-                "supports neither padding masks nor attention dropout "
-                f"(in-kernel masks/dropout reach T={MONOLITHIC_COMPILE_MAX}"
-                ") — train long-context batches unpadded with "
-                "attention_dropout=0, or shard T over a 'seq' mesh axis "
-                "(ring attention)")
+                "does not support attention dropout (in-kernel dropout "
+                f"reaches T={MONOLITHIC_COMPILE_MAX}) — set "
+                "attention_dropout=0 for long-context training (input/FF "
+                f"dropout still applies){pad_note}, or shard T over a "
+                "'seq' mesh axis (ring attention)")
     return (f"attention at T={T} cannot be tiled: the chunked flash path "
             f"needs T divisible into 2-{MAX_CHUNKS} tiles of "
             f"{_tiles_str()} (max single-chip "
@@ -1251,7 +1284,7 @@ def lse_combine(o, lse, o_hop, lse_hop):
 
 
 def chunked_flash_attention(q, k, v, *, causal=True, sm_scale=None,
-                            chunk=None):
+                            mask=None, chunk=None):
     """Single-chip long-context attention: Q/KV cut into chunk-length
     tiles, each (q_i, kv_j) pair running the monolithic Pallas kernel
     (j < i full, j == i causal diagonal, j > i skipped), results merged
@@ -1261,24 +1294,28 @@ def chunked_flash_attention(q, k, v, *, causal=True, sm_scale=None,
     chunk-divisible T compiles; HBM never holds [T, T] anything.
 
     q, k, v: [B, H, T, D] -> [B, H, T, D]; differentiable (the lse-merge
-    weights flow through flash_attention_lse's custom VJP). `chunk`
-    defaults to pick_chunk(T)."""
+    weights flow through flash_attention_lse's custom VJP). mask:
+    optional [B, T] key padding mask (1 = valid), sliced per kv tile.
+    `chunk` defaults to pick_chunk(T)."""
     B, H, T, D = q.shape
     if sm_scale is None:
         sm_scale = 1.0 / float(np.sqrt(D))
+    kmask = None if mask is None else _broadcast_kmask(mask, B, H, T)
     o, _ = chunked_flash_attention_lse(
         q.reshape(B * H, T, D), k.reshape(B * H, T, D),
-        v.reshape(B * H, T, D), sm_scale, causal, chunk=chunk)
+        v.reshape(B * H, T, D), sm_scale, causal, kmask=kmask, chunk=chunk)
     return o.reshape(B, H, T, D)
 
 
-def chunked_flash_attention_lse(q, k, v, sm_scale, causal, chunk=None):
+def chunked_flash_attention_lse(q, k, v, sm_scale, causal, kmask=None,
+                                chunk=None):
     """Flat-layout chunked attention returning (o [BH, T, D], lse
     [BH, T]) — the long-local-block form of flash_attention_lse: ring
     hops whose PER-SHARD block exceeds MAX_FLASH_T route here
     (parallel/ring_attention.py), so the seq mesh axis composes with
     single-chip chunking to sequences of n_shards * 128k tokens.
-    Differentiable the same way (per-tile custom VJPs + lse_combine)."""
+    Differentiable the same way (per-tile custom VJPs + lse_combine).
+    kmask: optional [BH, 1, T] key padding mask, sliced per kv tile."""
     BH, T, D = q.shape
     c = chunk or pick_chunk(T)
     # explicit chunks obey the same guards as pick_chunk: lane-legal
@@ -1297,9 +1334,15 @@ def chunked_flash_attention_lse(q, k, v, sm_scale, causal, chunk=None):
         qi = q[:, i * c:(i + 1) * c]
         o = lse = None
         for j in range(i + 1 if causal else n):
-            o_hop, lse_hop = flash_attention_lse(
-                qi, k[:, j * c:(j + 1) * c], v[:, j * c:(j + 1) * c],
-                sm_scale, causal and j == i)
+            kj = k[:, j * c:(j + 1) * c]
+            vj = v[:, j * c:(j + 1) * c]
+            if kmask is None:
+                o_hop, lse_hop = flash_attention_lse(
+                    qi, kj, vj, sm_scale, causal and j == i)
+            else:
+                o_hop, lse_hop = flash_attention_lse_masked(
+                    qi, kj, vj, kmask[:, :, j * c:(j + 1) * c],
+                    sm_scale, causal and j == i)
             if o is None:
                 o, lse = o_hop.astype(jnp.float32), lse_hop
             else:
@@ -1307,6 +1350,16 @@ def chunked_flash_attention_lse(q, k, v, sm_scale, causal, chunk=None):
         outs.append(o.astype(q.dtype))
         lses.append(lse)
     return jnp.concatenate(outs, axis=1), jnp.concatenate(lses, axis=1)
+
+
+def _broadcast_kmask(mask, B, H, T):
+    """[B, T] key padding mask -> the kernels' [B*H, 1, T] operand (the
+    singleton row dim satisfies Mosaic's (8,128)-divisible-or-equal block
+    rule). The single home for this layout — flash_attention's masked and
+    dropout branches and the chunk loop all build it here."""
+    return jnp.broadcast_to(
+        jnp.asarray(mask, jnp.float32)[:, None, :], (B, H, T)
+    ).reshape(B * H, 1, T)
 
 
 def flash_attention(q, k, v, *, causal=True, sm_scale=None, mask=None,
@@ -1331,18 +1384,12 @@ def flash_attention(q, k, v, *, causal=True, sm_scale=None, mask=None,
         seed = jax.random.randint(dropout_rng, (1, 1), 0, 2**31 - 1,
                                   dtype=jnp.int32)
         kmask = (jnp.ones((B * H, 1, T), jnp.float32) if mask is None
-                 else jnp.broadcast_to(
-                     jnp.asarray(mask, jnp.float32)[:, None, :],
-                     (B, H, T)).reshape(B * H, 1, T))
+                 else _broadcast_kmask(mask, B, H, T))
         o = _flash_core_drop(qf, kf, vf, kmask, seed, sm_scale,
                              bool(causal), float(dropout))
     elif mask is None:
         o = _flash_core(qf, kf, vf, sm_scale, bool(causal))
     else:
-        # [BH, 1, T]: Mosaic block shapes must be (8,128)-divisible or
-        # equal to the array dims — the singleton row dim satisfies that
-        kmask = jnp.broadcast_to(
-            jnp.asarray(mask, jnp.float32)[:, None, :], (B, H, T)
-        ).reshape(B * H, 1, T)
+        kmask = _broadcast_kmask(mask, B, H, T)
         o = _flash_core_masked(qf, kf, vf, kmask, sm_scale, bool(causal))
     return o.reshape(B, H, T, D)

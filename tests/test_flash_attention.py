@@ -461,10 +461,11 @@ class TestChunkedFlash:
         assert supports_chunked(big, causal=True, dropout=0.0, mask=None)
         # monolithic envelope excludes what chunked picks up
         assert not supports(big, causal=True, dropout=0.0, mask=None)
-        # masks/dropout are not plumbed through the chunk loop
+        # dropout is not plumbed through the chunk loop; masks are (r5:
+        # each kv tile sees its mask slice)
         assert not supports_chunked(big, causal=True, dropout=0.1, mask=None)
-        assert not supports_chunked(big, causal=True, dropout=0.0,
-                                    mask=np.ones((2, big[2])))
+        assert supports_chunked(big, causal=True, dropout=0.0,
+                                mask=np.ones((2, big[2])))
         # T inside the monolithic envelope stays monolithic
         small = (2, 2, MAX_FLASH_T, 64)
         assert not supports_chunked(small, causal=True, dropout=0.0,
@@ -476,6 +477,53 @@ class TestChunkedFlash:
         assert pick_chunk(25088) == 0
         # the measured ceiling: MAX_CHUNKS tiles of MAX_FLASH_T
         assert pick_chunk(MAX_CHUNKS * MAX_FLASH_T) == MAX_FLASH_T
+
+    @pytest.mark.parametrize("causal", [True, False])
+    def test_masked_forward_matches_dense(self, causal):
+        """Variable-length batches through the chunk loop: each kv tile
+        sees its slice of the [B, T] key mask; valid rows match the
+        dense masked path, fully-padded kv tiles are weighted away by
+        the lse merge."""
+        from deeplearning4j_tpu.ops.flash_attention import (
+            chunked_flash_attention,
+        )
+
+        B, T = 3, 512
+        q, k, v = _qkv(B=B, T=T)
+        # lengths straddle tile boundaries: full, mid-tile, one tile
+        mask = _varlen_mask(B, T, [512, 300, 128])
+        o_c = chunked_flash_attention(q, k, v, causal=causal, mask=mask,
+                                      chunk=128)
+        o_d = dot_product_attention(q, k, v, causal=causal, mask=mask)
+        valid = np.asarray(mask, bool)
+        for b in range(B):
+            np.testing.assert_allclose(
+                np.asarray(o_c)[b, :, valid[b]],
+                np.asarray(o_d)[b, :, valid[b]], atol=2e-5)
+
+    def test_masked_backward_matches_monolithic(self):
+        from deeplearning4j_tpu.ops.flash_attention import (
+            chunked_flash_attention,
+        )
+
+        B, T = 2, 512
+        q, k, v = _qkv(B=B, T=T, seed=7)
+        mask = _varlen_mask(B, T, [512, 384])
+        w = mask[:, None, :, None]  # loss reads valid rows only
+
+        def f_chunked(q, k, v):
+            return jnp.sum(jnp.sin(chunked_flash_attention(
+                q, k, v, causal=True, mask=mask, chunk=128)) * w)
+
+        def f_mono(q, k, v):
+            return jnp.sum(jnp.sin(flash_attention(
+                q, k, v, causal=True, mask=mask)) * w)
+
+        g_c = jax.grad(f_chunked, (0, 1, 2))(q, k, v)
+        g_m = jax.grad(f_mono, (0, 1, 2))(q, k, v)
+        for a, b in zip(g_c, g_m):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       atol=2e-4)
 
     def test_monolithic_fallback_tier(self):
         """T in (MAX_FLASH_T, MONOLITHIC_COMPILE_MAX] that the tile loop

@@ -285,7 +285,7 @@ def _build_epoch(local_grads, *, chunk, group, mesh):
     """Scan/update/mesh scaffolding shared by the SGNS and CBOW epochs."""
     if mesh is not None:
         from jax.sharding import PartitionSpec as P
-        from jax import shard_map
+        from deeplearning4j_tpu.util.compat import shard_map
 
         n_dev = mesh.shape["data"]
         if group % n_dev:

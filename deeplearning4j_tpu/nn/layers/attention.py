@@ -184,19 +184,22 @@ class SelfAttentionImpl(LayerImpl):
         if _sp_axis_in_scope(getattr(conf, "seq_parallel_axis", "")):
             # inside the sequence-parallel shard_map: local q block attends
             # the K/V blocks rotating around the ICI ring; the full [T, T]
-            # scores never exist on any one shard
-            if mask is not None or drop_attn:
+            # scores never exist on any one shard. Attention dropout rides
+            # the ring since r6 (global-coordinate keep mask; the step rng
+            # is replicated across seq shards, which is exactly what the
+            # mask needs)
+            if mask is not None:
                 raise ValueError(
-                    "sequence-parallel attention supports neither padding "
-                    "masks nor attention dropout — pad to full length and "
-                    "disable attention_dropout")
+                    "sequence-parallel attention does not support padding "
+                    "masks — pad to full length")
             from deeplearning4j_tpu.parallel.ring_attention import (
                 ring_attention,
             )
 
             out = ring_attention(qh, kh, vh,
                                  axis_name=conf.seq_parallel_axis,
-                                 causal=conf.causal)
+                                 causal=conf.causal,
+                                 dropout=drop_attn, dropout_rng=rng)
         elif use_flash and flash_supports(
                 qh.shape, causal=conf.causal, dropout=drop_attn, mask=mask):
             out = flash_attention(qh, kh, vh, causal=conf.causal, mask=mask,
@@ -205,10 +208,13 @@ class SelfAttentionImpl(LayerImpl):
                 qh.shape, causal=conf.causal, dropout=drop_attn, mask=mask):
             # T beyond the monolithic kernels' envelope: blockwise
             # tiles + lse merge (single-chip ring); padding masks slice
-            # per kv tile. Past this, the seq mesh axis shards T across
-            # chips (sequence_parallel.py)
+            # per kv tile and dropout hashes global coordinates (r6), so
+            # the full training feature set rides this path. Past this,
+            # the seq mesh axis shards T across chips
+            # (sequence_parallel.py)
             out = chunked_flash_attention(qh, kh, vh, causal=conf.causal,
-                                          mask=mask)
+                                          mask=mask, dropout=drop_attn,
+                                          dropout_rng=rng)
         elif (use_flash and T > MAX_FLASH_T
               and flash_supports_monolithic_fallback(
                   qh.shape, causal=conf.causal, dropout=drop_attn,
@@ -221,7 +227,8 @@ class SelfAttentionImpl(LayerImpl):
             # dense [T, T] scores at these lengths are a guaranteed
             # device OOM — fail with instructions, not an opaque OOM
             raise ValueError(chunked_unsupported_reason(
-                T, dropout=drop_attn, mask=mask))
+                T, dropout=drop_attn, mask=mask, causal=conf.causal,
+                head_dim=D))
         else:
             out = dot_product_attention(
                 qh, kh, vh, causal=conf.causal, mask=mask,

@@ -24,9 +24,11 @@ budget and drops to 1 when key/value blocks stream (T > block cap).
 Constraints: T divisible by the block size (128); [B, T] key padding
 masks fold into the block predicates, so variable-length batches keep the
 fused path; attention dropout runs IN-KERNEL via a counter-hash keep mask
-(r4); head_dim is padded to the 128-lane tile internally by Mosaic when
-smaller, and head_dim % 128 == 0 unlocks the packed-qkv no-relayout entry
-point (flash_attention_qkv).
+keyed on GLOBAL (q, k) coordinates (r4, chunk-invariant since r6 — it
+composes with the chunked long-context loop and ring hops); head_dim is
+padded to the 128-lane tile internally by Mosaic when smaller, and
+head_dim % 128 == 0 unlocks the packed-qkv no-relayout entry point
+(flash_attention_qkv).
 
 Falls back to interpret mode off-TPU so the unit tests exercise the same
 kernel code on CPU.
@@ -40,7 +42,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 from jax.experimental import pallas as pl
-from jax.experimental.pallas import tpu as pltpu
+from deeplearning4j_tpu.util.compat import tpu_compiler_params
 
 BLOCK = 128
 LANES = 128  # lane width (used by fused_softmax_xent block sizing)
@@ -121,10 +123,17 @@ def _fmix32(x):
     return x
 
 
-def _keep_mask(seed, bh0, stride, G, q0, k0, bq, bk, seq_len, rate):
+def _keep_mask(seed, bh0, stride, G, q0, k0, bq, bk, hash_t, rate):
     """[G, bq, bk] bool keep mask. seed: traced scalar; bh0: this
     program's first absolute batch*head row; stride: bh step between the
-    G slices; q0/k0: absolute row/col offsets of the block.
+    G slices; q0/k0: GLOBAL row/col offsets of the block in the full
+    sequence (may be traced); hash_t: the GLOBAL sequence length used as
+    the row stride of the linearized hash coordinate. Keying on global
+    (q0, k0, hash_t) makes the keep decision for logical element
+    (bh, i, j) CHUNK-INVARIANT: a tile computed at origin (q0, k0) of a
+    length-hash_t sequence drops exactly what the monolithic kernel at
+    T=hash_t would — the chunked flash loop and the ring's per-hop
+    kernels regenerate identical masks (r6).
 
     The per-ROW key gets the full murmur finalizer (cheap: G values);
     the per-ELEMENT mix is the shorter mul/xorshift/mul/xorshift tail —
@@ -141,7 +150,7 @@ def _keep_mask(seed, bh0, stride, G, q0, k0, bq, bk, seq_len, rate):
           + jax.lax.broadcasted_iota(jnp.uint32, (bq, bk), 0))
     gk = (jnp.asarray(k0).astype(jnp.uint32)
           + jax.lax.broadcasted_iota(jnp.uint32, (bq, bk), 1))
-    h = key + (gq * u(seq_len) + gk)[None]
+    h = key + (gq * u(hash_t) + gk)[None]
     h = h * u(0xCC9E2D51)
     h = h ^ (h >> u(15))
     h = h * u(0x1B873593)
@@ -173,6 +182,23 @@ def dropout_keep_mask_host(seed, bh, T, rate):
         h ^= h >> np.uint32(13)
         thr = np.uint32(min(int((1.0 - rate) * 4294967296.0), 4294967295))
     return h < thr
+
+
+def _step_seed(dropout_rng):
+    """[1, 1] int32 per-step dropout key derived from a jax PRNG key."""
+    return jax.random.randint(dropout_rng, (1, 1), 0, 2**31 - 1,
+                              dtype=jnp.int32)
+
+
+def _drop_ctx(seed, q_origin=0, k_origin=0):
+    """[1, 3] int32 dropout-context operand the kernels read: (step seed,
+    global q origin, global k origin) — the absolute sequence offsets of
+    this kernel call's window. `seed` is the [1, 1] int32 step key;
+    origins may be Python ints (the unrolled chunk loop) or traced
+    scalars (ring hops, whose k origin depends on the hop index)."""
+    orig = jnp.stack([jnp.asarray(q_origin, jnp.int32).reshape(()),
+                      jnp.asarray(k_origin, jnp.int32).reshape(())])
+    return jnp.concatenate([jnp.reshape(seed, (1, 1)), orig[None]], axis=1)
 
 
 # ------------------------------------------------------------------ forward
@@ -213,7 +239,7 @@ def _attn_single_block(q, kb, vb, km, keep_scale_vals, sm_scale, causal,
 
 def _fwd_kernel(q_ref, k_ref, v_ref, *rest, sm_scale, causal, masked,
                 block_q, block_k, seq_len, dropout=0.0, bh_stride=1,
-                packed_heads=False):
+                packed_heads=False, hash_t=None):
     rest = list(rest)
     kmask_ref = rest.pop(0) if masked else None
     seed_ref = rest.pop(0) if dropout else None
@@ -229,10 +255,16 @@ def _fwd_kernel(q_ref, k_ref, v_ref, *rest, sm_scale, causal, masked,
         bh0 = pl.program_id(0) * G_ * bh_stride
         if packed_heads:
             bh0 = bh0 + pl.program_id(1)
+        # chunk-invariance (r6): the ctx operand carries the window's
+        # global (q, k) origin; hash_t is the GLOBAL sequence length —
+        # per-chunk/per-hop calls hash the same coordinates the
+        # monolithic kernel would
+        qo, ko = seed_ref[0, 1], seed_ref[0, 2]
 
         def keep_scale(q0, k0, bq, bk):
-            keep = _keep_mask(seed_ref[0, 0], bh0, bh_stride, G_, q0, k0,
-                              bq, bk, seq_len, dropout)
+            keep = _keep_mask(seed_ref[0, 0], bh0, bh_stride, G_,
+                              qo + q0, ko + k0, bq, bk,
+                              hash_t or seq_len, dropout)
             return keep.astype(jnp.float32) * (1.0 / (1.0 - dropout))
     # keep the MXU operands in the input dtype (bf16 on TPU runs the MXU at
     # full rate; f32 operands decompose into multiple passes) and accumulate
@@ -310,7 +342,8 @@ def _fwd_kernel(q_ref, k_ref, v_ref, *rest, sm_scale, causal, masked,
     lse_ref[:, 0] = m + jnp.log(l)
 
 
-def _flash_fwd(q, k, v, kmask, sm_scale, causal, dropout=0.0, seed=None):
+def _flash_fwd(q, k, v, kmask, sm_scale, causal, dropout=0.0, seed=None,
+               hash_t=None):
     BH, T, D = q.shape
     block_q, block_k = _block_sizes(T)
     masked = kmask is not None
@@ -320,7 +353,8 @@ def _flash_fwd(q, k, v, kmask, sm_scale, causal, dropout=0.0, seed=None):
     grid = (BH // G, T // block_q)
     kern = functools.partial(_fwd_kernel, sm_scale=sm_scale, causal=causal,
                              masked=masked, block_q=block_q,
-                             block_k=block_k, seq_len=T, dropout=dropout)
+                             block_k=block_k, seq_len=T, dropout=dropout,
+                             hash_t=hash_t)
     in_specs = [
         pl.BlockSpec((G, block_q, D), lambda bh, qi: (bh, qi, 0)),
         pl.BlockSpec((G, T, D), lambda bh, qi: (bh, 0, 0)),
@@ -331,7 +365,7 @@ def _flash_fwd(q, k, v, kmask, sm_scale, causal, dropout=0.0, seed=None):
         in_specs.append(pl.BlockSpec((G, 1, T), lambda bh, qi: (bh, 0, 0)))
         args.append(kmask)
     if dropout:
-        in_specs.append(pl.BlockSpec((1, 1), lambda bh, qi: (0, 0)))
+        in_specs.append(pl.BlockSpec((1, 3), lambda bh, qi: (0, 0)))
         args.append(seed)
     o, lse = pl.pallas_call(
         kern,
@@ -345,7 +379,7 @@ def _flash_fwd(q, k, v, kmask, sm_scale, causal, dropout=0.0, seed=None):
             jax.ShapeDtypeStruct((BH, T, D), q.dtype),
             jax.ShapeDtypeStruct((BH, 1, T), jnp.float32),
         ],
-        compiler_params=pltpu.CompilerParams(vmem_limit_bytes=_VMEM_LIMIT),
+        compiler_params=tpu_compiler_params(vmem_limit_bytes=_VMEM_LIMIT),
         interpret=_use_interpret(),
     )(*args)
     return o, lse[:, 0, :]
@@ -355,7 +389,7 @@ def _flash_fwd(q, k, v, kmask, sm_scale, causal, dropout=0.0, seed=None):
 
 def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, *rest,
                sm_scale, causal, masked, block_q, block_k, seq_len,
-               dropout=0.0, bh_stride=1):
+               dropout=0.0, bh_stride=1, hash_t=None):
     rest = list(rest)
     kmask_ref = rest.pop(0) if masked else None
     seed_ref = rest.pop(0) if dropout else None
@@ -364,6 +398,8 @@ def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, *rest,
     # program_id must be read OUTSIDE the fori_loop body (interpret mode
     # cannot lower it from inside the loop's closed jaxpr)
     bh0 = pl.program_id(0) if dropout else None
+    qo = seed_ref[0, 1] if dropout else None  # global window origin (r6)
+    ko = seed_ref[0, 2] if dropout else None
     q = q_ref[...]                                          # [G, bq, D]
     do = do_ref[...]
     lse = lse_ref[:, 0]                                     # [G, bq]
@@ -392,9 +428,9 @@ def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, *rest,
                                  preferred_element_type=jnp.float32)
         if dropout:
             ks = _keep_mask(seed_ref[0, 0], bh0 * G * bh_stride,
-                            bh_stride, G, qi * block_q, j * block_k,
-                            block_q, block_k, seq_len,
-                            dropout).astype(jnp.float32)
+                            bh_stride, G, qo + qi * block_q,
+                            ko + j * block_k, block_q, block_k,
+                            hash_t or seq_len, dropout).astype(jnp.float32)
             dp = dp * (ks * (1.0 / (1.0 - dropout)))
         ds = (p * (dp - delta[..., None]) * sm_scale).astype(kb.dtype)
         return dq + jax.lax.dot_general(
@@ -408,13 +444,15 @@ def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, *rest,
 
 def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, *rest,
                 sm_scale, causal, masked, block_q, block_k, seq_len,
-                dropout=0.0, bh_stride=1):
+                dropout=0.0, bh_stride=1, hash_t=None):
     rest = list(rest)
     kmask_ref = rest.pop(0) if masked else None
     seed_ref = rest.pop(0) if dropout else None
     dk_ref, dv_ref = rest
     ki = pl.program_id(1)
     bh0 = pl.program_id(0) if dropout else None  # see _dq_kernel note
+    qo = seed_ref[0, 1] if dropout else None
+    ko = seed_ref[0, 2] if dropout else None
     kb = k_ref[...]                                         # [G, bk, D]
     vb = v_ref[...]
     G = kb.shape[0]
@@ -445,9 +483,9 @@ def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, *rest,
                                  preferred_element_type=jnp.float32)
         if dropout:
             ks = _keep_mask(seed_ref[0, 0], bh0 * G * bh_stride,
-                            bh_stride, G, j * block_q, ki * block_k,
-                            block_q, block_k, seq_len,
-                            dropout).astype(jnp.float32)
+                            bh_stride, G, qo + j * block_q,
+                            ko + ki * block_k, block_q, block_k,
+                            hash_t or seq_len, dropout).astype(jnp.float32)
             ks = ks * (1.0 / (1.0 - dropout))
             pd = p * ks
             dp = dp * ks
@@ -516,7 +554,7 @@ def _attn_single_block_bwd(qb, kb, vb, dob, ob, lse, km, ks, dlse,
 def _bwd_fused_kernel(q_ref, k_ref, v_ref, do_ref, o_ref, lse_ref,
                       *rest, sm_scale, causal, masked, seq_len,
                       dropout=0.0, bh_stride=1, has_dlse=False,
-                      packed_heads=False):
+                      packed_heads=False, hash_t=None):
     """Single-pass backward for the block == T case (T <= BLOCK_K_MAX,
     i.e. _block_sizes gave both blocks the whole sequence): with Q, K and
     V all resident, one recompute of the probabilities feeds dq, dk AND
@@ -541,8 +579,10 @@ def _bwd_fused_kernel(q_ref, k_ref, v_ref, do_ref, o_ref, lse_ref,
         bh0 = pl.program_id(0) * G * bh_stride
         if packed_heads:
             bh0 = bh0 + pl.program_id(1)  # see _fwd_kernel's numbering
-        ks = _keep_mask(seed_ref[0, 0], bh0, bh_stride, G, 0, 0, seq_len,
-                        seq_len, seq_len, dropout).astype(jnp.float32)
+        ks = _keep_mask(seed_ref[0, 0], bh0, bh_stride, G,
+                        seed_ref[0, 1], seed_ref[0, 2], seq_len,
+                        seq_len, hash_t or seq_len,
+                        dropout).astype(jnp.float32)
         ks = ks * (1.0 / (1.0 - dropout))
     dq, dk, dv = _attn_single_block_bwd(
         qb, kb, vb, dob, o_ref[...], lse,
@@ -555,7 +595,7 @@ def _bwd_fused_kernel(q_ref, k_ref, v_ref, do_ref, o_ref, lse_ref,
 
 
 def _flash_bwd_fused(q, k, v, do, o, lse, kmask, sm_scale, causal,
-                     dropout=0.0, seed=None, dlse=None):
+                     dropout=0.0, seed=None, dlse=None, hash_t=None):
     BH, T, D = q.shape
     masked = kmask is not None
     extra = int(T * T * 4) if dropout else 0
@@ -569,7 +609,7 @@ def _flash_bwd_fused(q, k, v, do, o, lse, kmask, sm_scale, causal,
         in_specs.append(pl.BlockSpec((G, 1, T), lambda bh: (bh, 0, 0)))
         args.append(kmask)
     if dropout:
-        in_specs.append(pl.BlockSpec((1, 1), lambda bh: (0, 0)))
+        in_specs.append(pl.BlockSpec((1, 3), lambda bh: (0, 0)))
         args.append(seed)
     if dlse is not None:
         in_specs.append(lblock)
@@ -577,7 +617,8 @@ def _flash_bwd_fused(q, k, v, do, o, lse, kmask, sm_scale, causal,
     return pl.pallas_call(
         functools.partial(_bwd_fused_kernel, sm_scale=sm_scale,
                           causal=causal, masked=masked, seq_len=T,
-                          dropout=dropout, has_dlse=dlse is not None),
+                          dropout=dropout, has_dlse=dlse is not None,
+                          hash_t=hash_t),
         grid=(BH // G,),
         in_specs=in_specs,
         out_specs=[fullblock, fullblock, fullblock],
@@ -586,13 +627,13 @@ def _flash_bwd_fused(q, k, v, do, o, lse, kmask, sm_scale, causal,
             jax.ShapeDtypeStruct((BH, T, D), k.dtype),
             jax.ShapeDtypeStruct((BH, T, D), v.dtype),
         ],
-        compiler_params=pltpu.CompilerParams(vmem_limit_bytes=_VMEM_LIMIT),
+        compiler_params=tpu_compiler_params(vmem_limit_bytes=_VMEM_LIMIT),
         interpret=_use_interpret(),
     )(*args)
 
 
 def _flash_bwd_impl(q, k, v, o, lse, do, kmask, sm_scale, causal,
-                    dlse=None, dropout=0.0, seed=None):
+                    dlse=None, dropout=0.0, seed=None, hash_t=None):
     BH, T, D = q.shape
     block_q, block_k = _block_sizes(T)
     masked = kmask is not None
@@ -603,7 +644,7 @@ def _flash_bwd_impl(q, k, v, o, lse, do, kmask, sm_scale, causal,
         # the optional ring dlse fold) happens in-kernel
         return _flash_bwd_fused(
             q, k, v, do, o, lse[:, None, :], kmask, sm_scale, causal,
-            dropout=dropout, seed=seed,
+            dropout=dropout, seed=seed, hash_t=hash_t,
             dlse=None if dlse is None else
             dlse.astype(jnp.float32)[:, None, :])
 
@@ -631,12 +672,12 @@ def _flash_bwd_impl(q, k, v, o, lse, do, kmask, sm_scale, causal,
         dq_specs.append(pl.BlockSpec((1, 1, T), lambda bh, qi: (bh, 0, 0)))
         dq_args.append(kmask)
     if dropout:
-        dq_specs.append(pl.BlockSpec((1, 1), lambda bh, qi: (0, 0)))
+        dq_specs.append(pl.BlockSpec((1, 3), lambda bh, qi: (0, 0)))
         dq_args.append(seed)
     dq = pl.pallas_call(
         functools.partial(_dq_kernel, sm_scale=sm_scale, causal=causal,
                           masked=masked, block_q=block_q, block_k=block_k,
-                          seq_len=T, dropout=dropout),
+                          seq_len=T, dropout=dropout, hash_t=hash_t),
         grid=(BH, T // block_q),
         in_specs=dq_specs,
         out_specs=pl.BlockSpec((1, block_q, D), lambda bh, qi: (bh, qi, 0)),
@@ -658,12 +699,12 @@ def _flash_bwd_impl(q, k, v, o, lse, do, kmask, sm_scale, causal,
                                       lambda bh, ki: (bh, 0, ki)))
         dkv_args.append(kmask)
     if dropout:
-        dkv_specs.append(pl.BlockSpec((1, 1), lambda bh, ki: (0, 0)))
+        dkv_specs.append(pl.BlockSpec((1, 3), lambda bh, ki: (0, 0)))
         dkv_args.append(seed)
     dk, dv = pl.pallas_call(
         functools.partial(_dkv_kernel, sm_scale=sm_scale, causal=causal,
                           masked=masked, block_q=block_q, block_k=block_k,
-                          seq_len=T, dropout=dropout),
+                          seq_len=T, dropout=dropout, hash_t=hash_t),
         grid=(BH, T // block_k),
         in_specs=dkv_specs,
         out_specs=[
@@ -724,7 +765,8 @@ _flash_core_masked.defvjp(_flash_core_masked_fwd, _flash_core_masked_bwd)
 @functools.partial(jax.custom_vjp, nondiff_argnums=(5, 6, 7))
 def _flash_core_drop(q, k, v, kmask, seed, sm_scale, causal, dropout):
     """Dropout-enabled core (kmask always an operand — pass ones when
-    there is no padding mask; seed: [1,1] int32 step key)."""
+    there is no padding mask; seed: [1,3] int32 dropout ctx from
+    _drop_ctx)."""
     o, _ = _flash_fwd(q, k, v, kmask, sm_scale, causal, dropout=dropout,
                       seed=seed)
     return o
@@ -802,6 +844,41 @@ def _falm_bwd(sm_scale, causal, res, cts):
 
 
 flash_attention_lse_masked.defvjp(_falm_fwd, _falm_bwd)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(5, 6, 7, 8))
+def flash_attention_lse_drop(q, k, v, kmask, ctx, sm_scale, causal,
+                             dropout, hash_t):
+    """flash_attention_lse_masked + in-kernel dropout whose keep mask is
+    keyed on GLOBAL coordinates (r6): ctx is the [1, 3] int32 dropout
+    context from `_drop_ctx` (step seed, q origin, k origin) and hash_t
+    the GLOBAL sequence length, so a tile at origin (q0, k0) drops
+    exactly the elements the monolithic kernel at T=hash_t would. This
+    is the per-tile primitive of the dropout-enabled chunk loop
+    (chunked_flash_attention_lse) and the ring's dropout hops
+    (parallel/ring_attention.py). kmask is always an operand — pass ones
+    when unpadded."""
+    return _flash_fwd(q, k, v, kmask, sm_scale, causal, dropout=dropout,
+                      seed=ctx, hash_t=hash_t)
+
+
+def _fald_fwd(q, k, v, kmask, ctx, sm_scale, causal, dropout, hash_t):
+    o, lse = _flash_fwd(q, k, v, kmask, sm_scale, causal, dropout=dropout,
+                        seed=ctx, hash_t=hash_t)
+    return (o, lse), (q, k, v, kmask, ctx, o, lse)
+
+
+def _fald_bwd(sm_scale, causal, dropout, hash_t, res, cts):
+    do, dlse = cts
+    q, k, v, kmask, ctx, o, lse = res
+    dq, dk, dv = _flash_bwd_impl(q, k, v, o, lse, do, kmask, sm_scale,
+                                 causal, dlse=dlse, dropout=dropout,
+                                 seed=ctx, hash_t=hash_t)
+    return (dq, dk, dv, jnp.zeros_like(kmask),
+            jax.custom_derivatives.zero_from_primal(ctx))
+
+
+flash_attention_lse_drop.defvjp(_fald_fwd, _fald_bwd)
 
 
 # ------------------------------------------------- packed-qkv (no relayout)
@@ -906,7 +983,7 @@ def _flash_fwd_qkv_pair(qkv, H, kmask, sm_scale, causal, dropout=0.0,
         in_specs.append(pl.BlockSpec((G, 1, T), lambda b, hp: (b, 0, 0)))
         args.append(kmask)
     if dropout:
-        in_specs.append(pl.BlockSpec((1, 1), lambda b, hp: (0, 0)))
+        in_specs.append(pl.BlockSpec((1, 3), lambda b, hp: (0, 0)))
         args.append(seed)
     o, lse = pl.pallas_call(
         kern,
@@ -920,7 +997,7 @@ def _flash_fwd_qkv_pair(qkv, H, kmask, sm_scale, causal, dropout=0.0,
             jax.ShapeDtypeStruct((B, T, n), qkv.dtype),
             jax.ShapeDtypeStruct((B, H, 1, T), jnp.float32),
         ],
-        compiler_params=pltpu.CompilerParams(vmem_limit_bytes=_VMEM_LIMIT),
+        compiler_params=tpu_compiler_params(vmem_limit_bytes=_VMEM_LIMIT),
         interpret=_use_interpret(),
     )(*args)
     return o, lse
@@ -948,7 +1025,7 @@ def _flash_bwd_qkv_pair(qkv, o, lse, do, H, kmask, sm_scale, causal,
         in_specs.append(pl.BlockSpec((G, 1, T), lambda b, hp: (b, 0, 0)))
         args.append(kmask)
     if dropout:
-        in_specs.append(pl.BlockSpec((1, 1), lambda b, hp: (0, 0)))
+        in_specs.append(pl.BlockSpec((1, 3), lambda b, hp: (0, 0)))
         args.append(seed)
     dq, dk, dv = pl.pallas_call(
         functools.partial(_bwd_kernel_pair, sm_scale=sm_scale,
@@ -958,7 +1035,7 @@ def _flash_bwd_qkv_pair(qkv, o, lse, do, H, kmask, sm_scale, causal,
         in_specs=in_specs,
         out_specs=[col, col, col],
         out_shape=[jax.ShapeDtypeStruct((B, T, n), qkv.dtype)] * 3,
-        compiler_params=pltpu.CompilerParams(vmem_limit_bytes=_VMEM_LIMIT),
+        compiler_params=tpu_compiler_params(vmem_limit_bytes=_VMEM_LIMIT),
         interpret=_use_interpret(),
     )(*args)
     return jnp.concatenate([dq, dk, dv], axis=-1)
@@ -988,7 +1065,7 @@ def _flash_fwd_qkv(qkv, H, kmask, sm_scale, causal, dropout=0.0, seed=None):
         in_specs.append(pl.BlockSpec((G, 1, T), lambda b, h: (b, 0, 0)))
         args.append(kmask)
     if dropout:
-        in_specs.append(pl.BlockSpec((1, 1), lambda b, h: (0, 0)))
+        in_specs.append(pl.BlockSpec((1, 3), lambda b, h: (0, 0)))
         args.append(seed)
     o, lse = pl.pallas_call(
         kern,
@@ -1002,7 +1079,7 @@ def _flash_fwd_qkv(qkv, H, kmask, sm_scale, causal, dropout=0.0, seed=None):
             jax.ShapeDtypeStruct((B, T, n), qkv.dtype),
             jax.ShapeDtypeStruct((B, H, 1, T), jnp.float32),
         ],
-        compiler_params=pltpu.CompilerParams(vmem_limit_bytes=_VMEM_LIMIT),
+        compiler_params=tpu_compiler_params(vmem_limit_bytes=_VMEM_LIMIT),
         interpret=_use_interpret(),
     )(*args)
     return o, lse
@@ -1037,7 +1114,7 @@ def _flash_bwd_qkv(qkv, o, lse, do, H, kmask, sm_scale, causal,
         in_specs.append(pl.BlockSpec((G, 1, T), lambda b, h: (b, 0, 0)))
         args.append(kmask)
     if dropout:
-        in_specs.append(pl.BlockSpec((1, 1), lambda b, h: (0, 0)))
+        in_specs.append(pl.BlockSpec((1, 3), lambda b, h: (0, 0)))
         args.append(seed)
     dq, dk, dv = pl.pallas_call(
         functools.partial(_bwd_fused_kernel, sm_scale=sm_scale,
@@ -1047,7 +1124,7 @@ def _flash_bwd_qkv(qkv, o, lse, do, H, kmask, sm_scale, causal,
         in_specs=in_specs,
         out_specs=[col, col, col],
         out_shape=[jax.ShapeDtypeStruct((B, T, n), qkv.dtype)] * 3,
-        compiler_params=pltpu.CompilerParams(vmem_limit_bytes=_VMEM_LIMIT),
+        compiler_params=tpu_compiler_params(vmem_limit_bytes=_VMEM_LIMIT),
         interpret=_use_interpret(),
     )(*args)
     return jnp.concatenate([dq, dk, dv], axis=-1)
@@ -1097,7 +1174,7 @@ _flash_qkv_core_masked.defvjp(_flash_qkv_core_masked_fwd,
 def _flash_qkv_core_drop(qkv, kmask, seed, H, sm_scale, causal, dropout):
     """Dropout-enabled packed core (r5 — VERDICT r4 #2: the dropout
     config no longer falls off the no-relayout path). kmask is always an
-    operand (ones when unpadded); seed: [1,1] int32 step key."""
+    operand (ones when unpadded); seed: [1,3] int32 dropout ctx."""
     o, _ = _flash_fwd_qkv(qkv, H, kmask, sm_scale, causal,
                           dropout=dropout, seed=seed)
     return o
@@ -1151,11 +1228,10 @@ def flash_attention_qkv(qkv, n_heads, *, causal=True, sm_scale=None,
     if dropout:
         if dropout_rng is None:
             raise ValueError("dropout > 0 requires dropout_rng")
-        seed = jax.random.randint(dropout_rng, (1, 1), 0, 2**31 - 1,
-                                  dtype=jnp.int32)
+        ctx = _drop_ctx(_step_seed(dropout_rng))
         kmask = (jnp.ones((B, 1, T), jnp.float32) if mask is None
                  else jnp.asarray(mask, jnp.float32)[:, None, :])
-        return _flash_qkv_core_drop(qkv, kmask, seed, n_heads, sm_scale,
+        return _flash_qkv_core_drop(qkv, kmask, ctx, n_heads, sm_scale,
                                     bool(causal), float(dropout))
     if mask is None:
         return _flash_qkv_core(qkv, n_heads, sm_scale, bool(causal))
@@ -1198,12 +1274,34 @@ def supports(q_shape, *, causal, dropout, mask) -> bool:
     return MIN_FLASH_SEQ <= T <= MAX_FLASH_T and T % BLOCK == 0
 
 
-# The chunk-pair loop is Python-unrolled (n*(n+1)/2 kernel calls in one
-# jaxpr), so the chunk count is capped: 16 chunks = 136 causal pairs,
-# the seq-131072 config measured at 0.70 MFU with tolerable compile time.
-# An uncapped awkward T (e.g. 25088 -> 49 chunks of 512) would unroll
-# 1200+ pallas calls and compile for minutes.
+# The chunk-pair loop is Python-unrolled (one kernel call per (q_i, kv_j)
+# tile pair in one jaxpr), so the UNROLL SIZE is what must be bounded —
+# and it depends on causality: n chunks unroll n*(n+1)/2 causal pairs but
+# n*n non-causal ones (ADVICE r5 #1: the raw MAX_CHUNKS=16 cap let
+# non-causal long-T unroll 256 forward calls plus their VJPs, ~2x the
+# budgeted jaxpr/compile size). The bound is therefore the PAIR count:
+# 136 = the causal 16-chunk budget the seq-131072 config measured at
+# 0.70 MFU with tolerable compile time; non-causal T gets at most 11
+# chunks (121 pairs) under the same budget. An uncapped awkward T (e.g.
+# 25088 -> 49 chunks of 512) would unroll 1200+ pallas calls and compile
+# for minutes.
 MAX_CHUNKS = 16
+MAX_CHUNK_PAIRS = MAX_CHUNKS * (MAX_CHUNKS + 1) // 2  # 136
+
+
+def chunk_pairs(n: int, causal: bool) -> int:
+    """Unrolled kernel calls of an n-chunk loop (the compile-size unit)."""
+    return n * (n + 1) // 2 if causal else n * n
+
+
+def max_chunks(causal: bool) -> int:
+    """Largest chunk count whose unroll fits MAX_CHUNK_PAIRS: 16 causal,
+    11 non-causal."""
+    n = MAX_CHUNKS
+    while n > 1 and chunk_pairs(n, causal) > MAX_CHUNK_PAIRS:
+        n -= 1
+    return n
+
 
 # Kernel-proven tile lengths, largest first — the single home for the
 # tiling envelope quoted in error messages (chunked_unsupported_reason,
@@ -1211,11 +1309,15 @@ MAX_CHUNKS = 16
 CHUNK_TILES = (8192, 4096, 2048, 1024, 512)
 
 
-def pick_chunk(T: int) -> int:
-    """Largest kernel-proven tile length that divides T into 2 to
-    MAX_CHUNKS chunks (0: T not chunkable)."""
+def pick_chunk(T: int, causal: bool = True) -> int:
+    """Largest kernel-proven tile length that divides T into 2+ chunks
+    whose pair count fits the unroll budget (0: T not chunkable). Tiles
+    are tried largest-first, so the dispatch prefers FEWER, larger
+    chunks — a non-causal T that divides into 16 small tiles picks a
+    larger tile instead of unrolling n^2 = 256 calls."""
     for c in CHUNK_TILES:
-        if T % c == 0 and 2 <= T // c <= MAX_CHUNKS:
+        if (T % c == 0 and 2 <= T // c
+                and chunk_pairs(T // c, causal) <= MAX_CHUNK_PAIRS):
             return c
     return 0
 
@@ -1226,15 +1328,16 @@ def _tiles_str() -> str:
 
 def supports_chunked(q_shape, *, causal, dropout, mask) -> bool:
     """Envelope of the blockwise long-context path: T beyond the
-    monolithic kernels, divisible into kernel-proven tiles. Padding
-    masks ride the loop (each kv tile sees its mask slice —
-    flash_attention_lse_masked); attention dropout does not (the
-    counter-hash keys on chunk-relative coordinates) — the attention
-    layer raises for dropout at this length instead of entering the
-    dense path, which OOMs there (chunked_unsupported_reason builds the
-    message)."""
+    monolithic kernels, divisible into kernel-proven tiles whose pair
+    count fits the unroll budget (causality-aware — see chunk_pairs).
+    Padding masks ride the loop (each kv tile sees its mask slice —
+    flash_attention_lse_masked); attention dropout rides it too (r6: the
+    keep mask hashes GLOBAL (q, k) coordinates through
+    flash_attention_lse_drop, so every tile regenerates exactly the
+    monolithic kernel's mask — the last feature exclusion on this path
+    is gone)."""
     T = q_shape[2]
-    return not dropout and T > MAX_FLASH_T and pick_chunk(T) > 0
+    return T > MAX_FLASH_T and pick_chunk(T, causal) > 0
 
 
 def supports_monolithic_fallback(q_shape, *, causal, dropout, mask) -> bool:
@@ -1249,24 +1352,27 @@ def supports_monolithic_fallback(q_shape, *, causal, dropout, mask) -> bool:
             and D <= 128)
 
 
-def chunked_unsupported_reason(T, *, dropout, mask) -> str:
-    """Why a T > MONOLITHIC_COMPILE_MAX shape has no fused path — raised
-    by the attention layer so long-context misconfigurations fail with
-    instructions instead of a dense-path device OOM."""
-    if dropout:
-        pad_note = ("" if pick_chunk(T) > 0
-                    else " AND pad T to a tile-divisible length")
-        return (f"attention at T={T} runs the chunked flash path, which "
-                "does not support attention dropout (in-kernel dropout "
-                f"reaches T={MONOLITHIC_COMPILE_MAX}) — set "
-                "attention_dropout=0 for long-context training (input/FF "
-                f"dropout still applies){pad_note}, or shard T over a "
-                "'seq' mesh axis (ring attention)")
-    return (f"attention at T={T} cannot be tiled: the chunked flash path "
-            f"needs T divisible into 2-{MAX_CHUNKS} tiles of "
-            f"{_tiles_str()} (max single-chip "
-            f"T = {MAX_CHUNKS * MAX_FLASH_T}) — pad T to a tile-divisible "
-            "length or shard T over a 'seq' mesh axis")
+def chunked_unsupported_reason(T, *, dropout, mask, causal=True,
+                               head_dim=None) -> str:
+    """Why a long-T shape has no fused path — raised by the attention
+    layer so long-context misconfigurations fail with instructions
+    instead of a dense-path device OOM. Dropout is NOT an exclusion
+    anymore (r6: chunk-invariant in-kernel dropout); what remains is
+    tileability (pair-count bound) and, for the monolithic fallback
+    tier, the D <= 128 gate (ADVICE r5 #2 — a head_dim-256 user must be
+    told the actual blocker)."""
+    nmax = max_chunks(causal)
+    msg = (f"attention at T={T} cannot be tiled: the chunked flash path "
+           f"needs T divisible into 2-{nmax} "
+           f"{'causal' if causal else 'non-causal'} tiles of "
+           f"{_tiles_str()} (unroll budget {MAX_CHUNK_PAIRS} tile pairs; "
+           f"max single-chip T = {nmax * MAX_FLASH_T})")
+    if T <= MONOLITHIC_COMPILE_MAX:
+        msg += (f", and the monolithic fallback (T <= "
+                f"{MONOLITHIC_COMPILE_MAX}) requires head_dim <= 128"
+                + (f" — got head_dim={head_dim}" if head_dim else ""))
+    return msg + (" — pad T to a tile-divisible length or shard T over a "
+                  "'seq' mesh axis (ring attention)")
 
 
 def lse_combine(o, lse, o_hop, lse_hop):
@@ -1284,7 +1390,8 @@ def lse_combine(o, lse, o_hop, lse_hop):
 
 
 def chunked_flash_attention(q, k, v, *, causal=True, sm_scale=None,
-                            mask=None, chunk=None):
+                            mask=None, chunk=None, dropout=0.0,
+                            dropout_rng=None):
     """Single-chip long-context attention: Q/KV cut into chunk-length
     tiles, each (q_i, kv_j) pair running the monolithic Pallas kernel
     (j < i full, j == i causal diagonal, j > i skipped), results merged
@@ -1296,39 +1403,66 @@ def chunked_flash_attention(q, k, v, *, causal=True, sm_scale=None,
     q, k, v: [B, H, T, D] -> [B, H, T, D]; differentiable (the lse-merge
     weights flow through flash_attention_lse's custom VJP). mask:
     optional [B, T] key padding mask (1 = valid), sliced per kv tile.
-    `chunk` defaults to pick_chunk(T)."""
+    dropout: attention-weight dropout generated in-kernel from
+    `dropout_rng` — chunk-invariant (r6): each tile hashes its GLOBAL
+    (q, k) coordinates, so the keep mask equals the monolithic kernel's
+    at this T bit-for-bit. `chunk` defaults to pick_chunk(T, causal)."""
     B, H, T, D = q.shape
     if sm_scale is None:
         sm_scale = 1.0 / float(np.sqrt(D))
     kmask = None if mask is None else _broadcast_kmask(mask, B, H, T)
+    seed = None
+    if dropout:
+        if dropout_rng is None:
+            raise ValueError("dropout > 0 requires dropout_rng")
+        seed = _step_seed(dropout_rng)
     o, _ = chunked_flash_attention_lse(
         q.reshape(B * H, T, D), k.reshape(B * H, T, D),
-        v.reshape(B * H, T, D), sm_scale, causal, kmask=kmask, chunk=chunk)
+        v.reshape(B * H, T, D), sm_scale, causal, kmask=kmask, chunk=chunk,
+        dropout=dropout, seed=seed)
     return o.reshape(B, H, T, D)
 
 
 def chunked_flash_attention_lse(q, k, v, sm_scale, causal, kmask=None,
-                                chunk=None):
+                                chunk=None, dropout=0.0, seed=None,
+                                q_origin=0, k_origin=0, hash_t=None):
     """Flat-layout chunked attention returning (o [BH, T, D], lse
     [BH, T]) — the long-local-block form of flash_attention_lse: ring
     hops whose PER-SHARD block exceeds MAX_FLASH_T route here
     (parallel/ring_attention.py), so the seq mesh axis composes with
     single-chip chunking to sequences of n_shards * 128k tokens.
     Differentiable the same way (per-tile custom VJPs + lse_combine).
-    kmask: optional [BH, 1, T] key padding mask, sliced per kv tile."""
+    kmask: optional [BH, 1, T] key padding mask, sliced per kv tile.
+
+    dropout/seed: in-kernel dropout (seed from _step_seed) whose keep
+    mask hashes GLOBAL coordinates — q_origin/k_origin are this call's
+    window offsets in the full sequence (nonzero for ring hops; may be
+    traced) and hash_t the GLOBAL sequence length (defaults to T), so
+    the mask is invariant to the chunk count AND to how the sequence is
+    sharded across ring hops."""
     BH, T, D = q.shape
-    c = chunk or pick_chunk(T)
+    c = chunk or pick_chunk(T, causal)
+    n = T // c if c else 0
     # explicit chunks obey the same guards as pick_chunk: lane-legal
-    # tiles no longer than the kernels' proven envelope, 2 to MAX_CHUNKS
-    # of them (n*(n+1)/2 pallas calls unroll in one jaxpr — an uncapped
-    # hop_chunk would compile for minutes; an oversized one would hand
-    # the monolithic kernel the VMEM-busting length this path avoids)
-    if (c <= 0 or T % c or c % BLOCK or c > MAX_FLASH_T
-            or not 2 <= T // c <= MAX_CHUNKS):
+    # tiles no longer than the kernels' proven envelope, with a pair
+    # count inside the unroll budget (one pallas call per tile pair
+    # unrolls in one jaxpr — an uncapped hop_chunk would compile for
+    # minutes; an oversized one would hand the monolithic kernel the
+    # VMEM-busting length this path avoids)
+    if (c <= 0 or T % c or c % BLOCK or c > MAX_FLASH_T or n < 2
+            or chunk_pairs(n, causal) > MAX_CHUNK_PAIRS):
         raise ValueError(
-            f"T={T} not divisible into 2-{MAX_CHUNKS} kernel tiles"
-            + (f" of {chunk}" if chunk else ""))
-    n = T // c
+            f"T={T} not divisible into 2-{max_chunks(causal)} kernel tiles"
+            + (f" of {chunk}" if chunk else "")
+            + (f" ({chunk_pairs(n, causal)} unrolled tile pairs exceed "
+               f"the {MAX_CHUNK_PAIRS} budget)"
+               if n >= 2 and chunk_pairs(n, causal) > MAX_CHUNK_PAIRS
+               else ""))
+    ht = hash_t if hash_t is not None else T
+    km = kmask
+    if dropout and km is None:
+        # the dropout cores take kmask unconditionally (ones = unpadded)
+        km = jnp.ones((BH, 1, T), jnp.float32)
     outs, lses = [], []
     for i in range(n):
         qi = q[:, i * c:(i + 1) * c]
@@ -1336,12 +1470,17 @@ def chunked_flash_attention_lse(q, k, v, sm_scale, causal, kmask=None,
         for j in range(i + 1 if causal else n):
             kj = k[:, j * c:(j + 1) * c]
             vj = v[:, j * c:(j + 1) * c]
-            if kmask is None:
+            if dropout:
+                ctx = _drop_ctx(seed, q_origin + i * c, k_origin + j * c)
+                o_hop, lse_hop = flash_attention_lse_drop(
+                    qi, kj, vj, km[:, :, j * c:(j + 1) * c], ctx,
+                    sm_scale, causal and j == i, float(dropout), ht)
+            elif km is None:
                 o_hop, lse_hop = flash_attention_lse(
                     qi, kj, vj, sm_scale, causal and j == i)
             else:
                 o_hop, lse_hop = flash_attention_lse_masked(
-                    qi, kj, vj, kmask[:, :, j * c:(j + 1) * c],
+                    qi, kj, vj, km[:, :, j * c:(j + 1) * c],
                     sm_scale, causal and j == i)
             if o is None:
                 o, lse = o_hop.astype(jnp.float32), lse_hop
@@ -1381,11 +1520,10 @@ def flash_attention(q, k, v, *, causal=True, sm_scale=None, mask=None,
     if dropout:
         if dropout_rng is None:
             raise ValueError("dropout > 0 requires dropout_rng")
-        seed = jax.random.randint(dropout_rng, (1, 1), 0, 2**31 - 1,
-                                  dtype=jnp.int32)
+        ctx = _drop_ctx(_step_seed(dropout_rng))
         kmask = (jnp.ones((B * H, 1, T), jnp.float32) if mask is None
                  else _broadcast_kmask(mask, B, H, T))
-        o = _flash_core_drop(qf, kf, vf, kmask, seed, sm_scale,
+        o = _flash_core_drop(qf, kf, vf, kmask, ctx, sm_scale,
                              bool(causal), float(dropout))
     elif mask is None:
         o = _flash_core(qf, kf, vf, sm_scale, bool(causal))

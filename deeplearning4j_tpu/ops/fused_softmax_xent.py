@@ -34,6 +34,8 @@ import numpy as np
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+from deeplearning4j_tpu.util.compat import tpu_compiler_params
+
 LANES = 128
 NEG_INF = -1e30
 
@@ -162,7 +164,7 @@ def _fused_fwd(x, w, b, labels):
             pltpu.VMEM((bn, LANES), jnp.float32),
             pltpu.VMEM((bn, LANES), jnp.float32),
         ],
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=tpu_compiler_params(
             vmem_limit_bytes=32 * 1024 * 1024),
         interpret=_use_interpret(),
     )(x, w, b2, lab2)
@@ -257,7 +259,7 @@ def _fused_bwd(res, dloss):
         out_specs=pl.BlockSpec((bn, d), lambda i, j: (i, 0)),
         out_shape=jax.ShapeDtypeStruct((N, d), x.dtype),
         scratch_shapes=[pltpu.VMEM((bn, d), jnp.float32)],
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=tpu_compiler_params(
             vmem_limit_bytes=32 * 1024 * 1024),
         interpret=_use_interpret(),
     )(x, w, b2, lab2, lse2, g2)
@@ -291,7 +293,7 @@ def _fused_bwd(res, dloss):
         # 16MB scoped default; v5e has 128MB of VMEM, so all three
         # kernels in this file request 32MB rather than shrinking the
         # swept (faster) block sizes
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=tpu_compiler_params(
             vmem_limit_bytes=32 * 1024 * 1024),
         interpret=_use_interpret(),
     )(x, w, b2, lab2, lse2, g2)

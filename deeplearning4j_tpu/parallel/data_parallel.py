@@ -84,7 +84,7 @@ class ParameterAveragingTrainer:
     def _build_steps(self):
         net, mesh = self.net, self.mesh
         tx = net.tx
-        from jax import shard_map
+        from deeplearning4j_tpu.util.compat import shard_map
 
         @partial(shard_map, mesh=mesh,
                  in_specs=(P("data"), P("data"), P("data"), P("data"), P()),

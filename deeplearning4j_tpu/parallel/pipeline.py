@@ -48,6 +48,7 @@ import jax.numpy as jnp
 from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from deeplearning4j_tpu.util.compat import pcast_varying, shard_map
 from deeplearning4j_tpu.nn.conf.graph_conf import LayerVertexConf
 from deeplearning4j_tpu.nn.conf.layers import BaseOutputLayer
 from deeplearning4j_tpu.nn.layers import l1_l2_penalty
@@ -590,7 +591,7 @@ def make_pp_train_step(net, plan: PipelinePlan, mesh: Mesh, axes: dict,
 
             store0 = jnp.zeros((k_slots,) + probe.shape, probe.dtype)
             carry0 = jax.tree.map(
-                lambda a: lax.pcast(a, (pipe,), to="varying"),
+                lambda a: pcast_varying(a, (pipe,)),
                 (zero, zero, store0, stage_s0, pre_s,
                  jnp.zeros(()), jnp.zeros(())))
             (_, _, store, st_stage, st_pre, aux_stage, aux_pre), _ = (
@@ -657,7 +658,7 @@ def make_pp_train_step(net, plan: PipelinePlan, mesh: Mesh, axes: dict,
         # stream leaves are [M, mb, T, ...]: microbatch x batch x time
         stream = P(None, data, seq) if seq is not None else (
             P(None, data) if data is not None else P())
-        sm = jax.shard_map(
+        sm = shard_map(
             program, mesh=mesh,
             in_specs=(P(), P(pipe), P(), P(pipe), P(), P(),
                       stream, stream, stream if has_f else P(),

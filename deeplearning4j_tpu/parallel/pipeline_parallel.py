@@ -29,7 +29,7 @@ from functools import partial
 
 import jax
 import jax.numpy as jnp
-from jax import shard_map
+from deeplearning4j_tpu.util.compat import pcast_varying, shard_map
 from jax.sharding import PartitionSpec as P
 
 
@@ -82,8 +82,8 @@ def pipeline_apply(stage_fn, stacked_params, x, *, mesh, n_microbatches,
         # the body's carries are device-varying (they depend on axis_index
         # and ppermute); mark the initial values accordingly for scan's
         # type agreement under shard_map
-        zero_v = jax.lax.pcast(zero, (axis,), to="varying")
-        outputs0_v = jax.lax.pcast(outputs0, (axis,), to="varying")
+        zero_v = pcast_varying(zero, (axis,))
+        outputs0_v = pcast_varying(outputs0, (axis,))
         (_, outputs), _ = jax.lax.scan(
             tick, (zero_v, outputs0_v), jnp.arange(T))
         return outputs

@@ -25,7 +25,31 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 _NEG = -1e30
 
 
-def _ring_flash(q, k, v, *, axis_name: str, causal: bool, hop_chunk=None):
+def _keep_scale_jnp(seed, B, H, q0, k0, Tl, hash_t, rate):
+    """[B, H, Tl, Tl] dropout keep*1/(1-rate) — the plain-jnp twin of the
+    flash kernels' `_keep_mask` (ops/flash_attention.py), bit-for-bit:
+    same murmur key per (b*H + h) row, same global-coordinate element
+    mix. Used by the einsum fallback so odd-length local blocks drop the
+    SAME elements the kernel path (and the single-chip monolithic
+    kernel) would. q0/k0 may be traced (hop origins)."""
+    from deeplearning4j_tpu.ops.flash_attention import _fmix32
+
+    u = jnp.uint32
+    bh = jnp.arange(B * H, dtype=jnp.uint32).reshape(B, H, 1, 1)
+    key = _fmix32(jnp.reshape(seed, ()).astype(u) + bh * u(0x9E3779B9))
+    gq = jnp.asarray(q0, jnp.int32).astype(u) + jnp.arange(Tl, dtype=u)
+    gk = jnp.asarray(k0, jnp.int32).astype(u) + jnp.arange(Tl, dtype=u)
+    h = key + (gq[:, None] * u(hash_t) + gk[None, :])
+    h = h * u(0xCC9E2D51)
+    h = h ^ (h >> u(15))
+    h = h * u(0x1B873593)
+    h = h ^ (h >> u(13))
+    thr = u(min(int((1.0 - rate) * 4294967296.0), 4294967295))
+    return (h < thr).astype(jnp.float32) * (1.0 / (1.0 - rate))
+
+
+def _ring_flash(q, k, v, *, axis_name: str, causal: bool, hop_chunk=None,
+                dropout=0.0, seed=None):
     """Per-hop Pallas flash kernel + two-way lse merge (VERDICT r3 #4: the
     ring previously ran f32 einsum blockwise softmax — the dense math the
     kernel exists to replace). Each hop runs the fused kernel on local Q
@@ -37,15 +61,24 @@ def _ring_flash(q, k, v, *, axis_name: str, causal: bool, hop_chunk=None):
     unchanged. Local blocks past MAX_FLASH_T (the monolithic kernels'
     VMEM envelope) run each hop through chunked_flash_attention_lse, so
     the ring scales to n_shards x 128k-token sequences; `hop_chunk`
-    forces that tile length (tests use it at small Tl)."""
+    forces that tile length (tests use it at small Tl).
+
+    dropout/seed: in-kernel attention dropout (r6). Every hop hashes its
+    GLOBAL window origin (idx*Tl, src*Tl) with the GLOBAL length n*Tl,
+    so the keep mask for logical element (bh, i, j) equals the
+    single-chip monolithic kernel's — identical regardless of shard
+    count or hop order. `seed` is the replicated [1, 1] int32 step key
+    (same on every shard — the mask depends only on global coordinates)."""
     from deeplearning4j_tpu.ops.flash_attention import (
-        MAX_CHUNKS,
         MAX_FLASH_T,
         MONOLITHIC_COMPILE_MAX,
+        _drop_ctx,
         _tiles_str,
         chunked_flash_attention_lse,
         flash_attention_lse,
+        flash_attention_lse_drop,
         lse_combine,
+        max_chunks,
         pick_chunk,
     )
 
@@ -55,31 +88,52 @@ def _ring_flash(q, k, v, *, axis_name: str, causal: bool, hop_chunk=None):
     scale = 1.0 / float(np.sqrt(D))
     qf = q.reshape(B * H, Tl, D)
     perm = [(j, (j + 1) % n) for j in range(n)]
-    if hop_chunk or (Tl > MAX_FLASH_T and pick_chunk(Tl) > 0):
-        def hop_lse(qf, kf, vf, scale, causal_hop):
+    T_global = n * Tl
+    ones_km = (jnp.ones((B * H, 1, Tl), jnp.float32) if dropout else None)
+    # hop tiling obeys the NON-causal pair bound: every below-diagonal
+    # hop runs the full (non-causal) tile loop, which unrolls n_tiles^2
+    # kernel calls (ADVICE r5 #1)
+    if hop_chunk or (Tl > MAX_FLASH_T and pick_chunk(Tl, False) > 0):
+        def hop_lse(qf, kf, vf, scale, causal_hop, k0):
+            if dropout:
+                return chunked_flash_attention_lse(
+                    qf, kf, vf, scale, causal_hop, chunk=hop_chunk,
+                    dropout=dropout, seed=seed, q_origin=idx * Tl,
+                    k_origin=k0, hash_t=T_global)
             return chunked_flash_attention_lse(qf, kf, vf, scale,
                                                causal_hop, chunk=hop_chunk)
-    elif Tl <= MONOLITHIC_COMPILE_MAX:
+    elif Tl <= MAX_FLASH_T or (Tl <= MONOLITHIC_COMPILE_MAX and D <= 128):
         # non-tileable local blocks up to the measured compile ceiling
-        # keep the monolithic per-hop kernel (pre-r5 behavior)
-        hop_lse = flash_attention_lse
+        # keep the monolithic per-hop kernel (pre-r5 behavior). The
+        # extended tier past MAX_FLASH_T is gated at D <= 128 like
+        # supports_monolithic_fallback (ADVICE r5 #3: the backward's VMEM
+        # working set scales with D; the ceiling was measured at D=128) —
+        # blocks inside the proven envelope take any D, as on one chip
+        def hop_lse(qf, kf, vf, scale, causal_hop, k0):
+            if dropout:
+                return flash_attention_lse_drop(
+                    qf, kf, vf, ones_km, _drop_ctx(seed, idx * Tl, k0),
+                    scale, causal_hop, float(dropout), T_global)
+            return flash_attention_lse(qf, kf, vf, scale, causal_hop)
     else:
         raise ValueError(
-            f"ring attention local block Tl={Tl} is neither tileable "
-            f"(2-{MAX_CHUNKS} tiles of {_tiles_str()}) nor within the "
-            f"monolithic kernels' compile ceiling "
-            f"({MONOLITHIC_COMPILE_MAX}) — use more 'seq' shards or pad "
-            "T so the per-shard block is tileable")
+            f"ring attention local block Tl={Tl} (head_dim {D}) is "
+            f"neither tileable (2-{max_chunks(False)} tiles of "
+            f"{_tiles_str()}, non-causal pair budget) nor within the "
+            f"monolithic kernels' envelope (Tl <= "
+            f"{MONOLITHIC_COMPILE_MAX} at head_dim <= 128) — use more "
+            "'seq' shards or pad T so the per-shard block is tileable")
 
     def hop(k_cur, v_cur, src):
         kf = k_cur.reshape(B * H, Tl, D)
         vf = v_cur.reshape(B * H, Tl, D)
+        k0 = src * Tl
 
         def full(_):
-            return hop_lse(qf, kf, vf, scale, False)
+            return hop_lse(qf, kf, vf, scale, False, k0)
 
         def diag(_):
-            return hop_lse(qf, kf, vf, scale, True)
+            return hop_lse(qf, kf, vf, scale, True, k0)
 
         def skip(_):
             return (jnp.zeros_like(qf),
@@ -109,7 +163,7 @@ def _ring_flash(q, k, v, *, axis_name: str, causal: bool, hop_chunk=None):
 
 
 def ring_attention(q, k, v, *, axis_name: str = "seq", causal: bool = True,
-                   hop_chunk=None):
+                   hop_chunk=None, dropout=0.0, dropout_rng=None):
     """Per-shard blockwise attention. q,k,v: [B, H, Tl, D] local blocks of a
     sequence sharded over `axis_name`. Returns [B, H, Tl, D].
 
@@ -118,11 +172,25 @@ def ring_attention(q, k, v, *, axis_name: str = "seq", causal: bool = True,
     block length is kernel-legal (Tl % 128 == 0) each hop runs the Pallas
     flash kernel (chunk-tiled when Tl exceeds the monolithic VMEM
     envelope); otherwise the f32 einsum blockwise softmax (tiny-shape
-    tests, odd lengths)."""
+    tests, odd lengths).
+
+    dropout: in-kernel attention-weight dropout (r6) — the counter-hash
+    keep mask keys on GLOBAL sequence coordinates, so the ring drops
+    exactly what a single-chip kernel at T = n_shards*Tl would.
+    `dropout_rng` must be REPLICATED across the seq shards (the layer
+    passes its step rng unsplit); the einsum fallback regenerates the
+    identical mask via the jnp twin of the kernels' hash."""
     B, H, Tl, D = q.shape
+    seed = None
+    if dropout:
+        if dropout_rng is None:
+            raise ValueError("dropout > 0 requires dropout_rng")
+        from deeplearning4j_tpu.ops.flash_attention import _step_seed
+
+        seed = _step_seed(dropout_rng)
     if Tl % 128 == 0:
         return _ring_flash(q, k, v, axis_name=axis_name, causal=causal,
-                           hop_chunk=hop_chunk)
+                           hop_chunk=hop_chunk, dropout=dropout, seed=seed)
     n = lax.psum(1, axis_name)
     idx = lax.axis_index(axis_name)
     scale = 1.0 / jnp.sqrt(jnp.asarray(D, jnp.float32))
@@ -148,9 +216,15 @@ def ring_attention(q, k, v, *, axis_name: str = "seq", causal: bool = True,
         m_new = jnp.maximum(m, scores.max(axis=-1))
         p = jnp.exp(scores - m_new[..., None])
         corr = jnp.exp(m - m_new)
+        # l accumulates the UNDROPPED p (dense semantics: dropout applies
+        # to the softmax output), matching the kernels' _attn_single_block
         l_new = l * corr + p.sum(axis=-1)
+        pd = p
+        if dropout:
+            pd = p * _keep_scale_jnp(seed, B, H, idx * Tl, src * Tl, Tl,
+                                     n * Tl, dropout)
         o_new = o * corr[..., None] + jnp.einsum(
-            "bhqk,bhkd->bhqd", p, v_cur.astype(jnp.float32))
+            "bhqk,bhkd->bhqd", pd, v_cur.astype(jnp.float32))
         k_nxt = lax.ppermute(k_cur, axis_name, perm)
         v_nxt = lax.ppermute(v_cur, axis_name, perm)
         return (o_new, m_new, l_new, k_nxt, v_nxt), None
@@ -163,7 +237,7 @@ def ring_self_attention(q, k, v, mesh: Mesh, *, causal: bool = True,
                         seq_axis: str = "seq"):
     """Whole-sequence entry point: q,k,v [B, H, T, D] (T divisible by the
     seq-axis size). shard_maps the ring over the mesh."""
-    from jax import shard_map
+    from deeplearning4j_tpu.util.compat import shard_map
 
     spec = P(None, None, seq_axis, None)
     fn = shard_map(

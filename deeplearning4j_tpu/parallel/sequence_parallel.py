@@ -48,7 +48,7 @@ def make_sp_train_step(net, mesh: Mesh, seq_axis: str = "seq",
     manual over seq/data only): Megatron TP placements on the params
     propagate through the per-shard compute and XLA inserts the model
     psums — the same partial-manual composition the PP schedule uses."""
-    from jax import shard_map
+    from deeplearning4j_tpu.util.compat import shard_map
 
     axes = (seq_axis,) if data_axis is None else (data_axis, seq_axis)
     # [B, T] int tokens / [B, T] labels: batch over data, time over seq

@@ -12,6 +12,7 @@ import numpy as np
 
 from deeplearning4j_tpu.models.lenet import lenet5
 from deeplearning4j_tpu.models.transformer import transformer_lm
+import pytest
 
 
 def _one_step(net, batch):
@@ -24,6 +25,7 @@ def _one_step(net, batch):
     return net.params, float(loss)
 
 
+@pytest.mark.slow
 def test_lenet_bf16_train_step():
     """value_and_grad of a bf16 conv net must not die in the conv transpose
     rule (the exact failure mode of BENCH_r01)."""
@@ -60,6 +62,7 @@ def test_lenet_bf16_multiple_steps_decrease_loss():
     assert all(np.isfinite(l) for l in losses)
 
 
+@pytest.mark.slow
 def test_transformer_bf16_train_step():
     """The MFU bench runs the transformer in bf16 — keep that path tested."""
     net = transformer_lm(vocab_size=64, d_model=32, n_heads=2, n_layers=2,

@@ -100,6 +100,7 @@ def _spawn(address, wid, shard, ckpt="-", crash_at="none", local_mesh=0,
         stdout=subprocess.PIPE, stderr=subprocess.PIPE)
 
 
+@pytest.mark.slow
 def test_two_process_training_matches_single_process(tmp_path):
     """2 workers x half batch with per-step averaging == 1 process x full
     batch, for plain SGD (gradient linearity). True multi-process CPU run
@@ -130,6 +131,7 @@ def test_two_process_training_matches_single_process(tmp_path):
                                atol=5e-4)
 
 
+@pytest.mark.slow
 def test_kill_one_worker_then_resume_from_checkpoint(tmp_path):
     """One worker crashes after 2 syncs; the survivor finishes its rounds
     elastically; the crashed worker restarts from its checkpoint and
@@ -156,6 +158,7 @@ def test_kill_one_worker_then_resume_from_checkpoint(tmp_path):
         coord.shutdown()
 
 
+@pytest.mark.slow
 def test_two_process_times_four_device_hierarchy(tmp_path):
     """SURVEY.md §4.5 topology: 2 processes x 4 virtual devices each —
     in-process XLA allreduce + cross-process coordinator averaging gives

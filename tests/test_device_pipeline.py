@@ -133,6 +133,7 @@ def test_quality_on_zipf_corpus_with_trust_region():
         np.mean(paired), np.mean(cross))
 
 
+@pytest.mark.slow
 def test_cbow_device_pipeline_learns_and_mesh_parity():
     """CBOW on the device pipeline: learns co-occurrence structure and is
     device-count invariant (same psum'd-gradient contract as SGNS)."""

@@ -22,6 +22,7 @@ from deeplearning4j_tpu.optimize.listeners import CollectScoresIterationListener
 from deeplearning4j_tpu.util.model_serializer import ModelSerializer
 
 
+@pytest.mark.slow
 def test_lenet_mnist_end_to_end_slice(tmp_path):
     train_it = MnistDataSetIterator(batch_size=128, num_examples=2048,
                                     train=True, reshape_images=True,
@@ -84,6 +85,7 @@ def test_cloud_iterator_empty_prefix_raises(tmp_path):
         GcsDataSetIterator(str(tmp_path))
 
 
+@pytest.mark.slow
 def test_profiler_listener_window(tmp_path):
     from deeplearning4j_tpu.util.profiler import ProfilerIterationListener
 

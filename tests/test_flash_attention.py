@@ -461,9 +461,9 @@ class TestChunkedFlash:
         assert supports_chunked(big, causal=True, dropout=0.0, mask=None)
         # monolithic envelope excludes what chunked picks up
         assert not supports(big, causal=True, dropout=0.0, mask=None)
-        # dropout is not plumbed through the chunk loop; masks are (r5:
-        # each kv tile sees its mask slice)
-        assert not supports_chunked(big, causal=True, dropout=0.1, mask=None)
+        # dropout rides the chunk loop since r6 (global-coordinate keep
+        # mask); masks since r5 (each kv tile sees its mask slice)
+        assert supports_chunked(big, causal=True, dropout=0.1, mask=None)
         assert supports_chunked(big, causal=True, dropout=0.0,
                                 mask=np.ones((2, big[2])))
         # T inside the monolithic envelope stays monolithic
@@ -473,10 +473,63 @@ class TestChunkedFlash:
         assert pick_chunk(2 * MAX_FLASH_T) == MAX_FLASH_T
         assert pick_chunk(8192 + 128) == 0  # not tile-divisible
         # the unroll guard: an awkward T whose only tiles would exceed
-        # MAX_CHUNKS (49 x 512) is rejected, not compiled for minutes
+        # the pair budget (49 x 512) is rejected, not compiled for minutes
         assert pick_chunk(25088) == 0
         # the measured ceiling: MAX_CHUNKS tiles of MAX_FLASH_T
         assert pick_chunk(MAX_CHUNKS * MAX_FLASH_T) == MAX_FLASH_T
+
+    def test_pair_count_bound_non_causal(self):
+        """ADVICE r5 #1: the unroll budget is the PAIR count, so
+        non-causal T gets fewer chunks (n*n pairs vs n*(n+1)/2). The
+        dispatch picks a larger tile (fewer chunks) instead of unrolling
+        n^2 = 256 kernel calls, and rejects what cannot fit."""
+        from deeplearning4j_tpu.ops.flash_attention import (
+            MAX_CHUNK_PAIRS,
+            MAX_CHUNKS,
+            MAX_FLASH_T,
+            chunk_pairs,
+            max_chunks,
+            pick_chunk,
+            supports_chunked,
+        )
+
+        assert max_chunks(True) == MAX_CHUNKS == 16
+        assert max_chunks(False) == 11  # 121 pairs <= 136 < 144
+        # a T divisible into 16 small tiles picks the LARGER tile
+        # non-causally: 16384 = 16 x 1024 (256 pairs, over budget) but
+        # also 2 x 8192 (4 pairs) — dispatch must choose the latter
+        c = pick_chunk(16384, False)
+        assert c == MAX_FLASH_T
+        assert chunk_pairs(16384 // c, False) <= MAX_CHUNK_PAIRS
+        # causal 16-chunk ceiling stays; its non-causal twin is rejected
+        # outright (no tile fits 16 chunks in the n*n budget)
+        T_max = MAX_CHUNKS * MAX_FLASH_T
+        assert pick_chunk(T_max, True) == MAX_FLASH_T
+        assert pick_chunk(T_max, False) == 0
+        assert supports_chunked((1, 1, T_max, 64), causal=True,
+                                dropout=0.0, mask=None)
+        assert not supports_chunked((1, 1, T_max, 64), causal=False,
+                                    dropout=0.0, mask=None)
+        # every pick obeys the budget across causal x tileable-T sweeps
+        for T in range(16384, 131072 + 1, 4096):
+            for causal in (True, False):
+                c = pick_chunk(T, causal)
+                if c:
+                    assert chunk_pairs(T // c, causal) <= MAX_CHUNK_PAIRS
+
+    def test_explicit_non_causal_chunk_over_budget_raises(self):
+        from deeplearning4j_tpu.ops.flash_attention import (
+            chunked_flash_attention_lse,
+        )
+
+        q = jnp.zeros((1, 16384, 64), jnp.float32)
+        # 16 non-causal chunks = 256 unrolled pairs: over budget
+        with pytest.raises(ValueError, match="tile pairs"):
+            jax.eval_shape(lambda q: chunked_flash_attention_lse(
+                q, q, q, 1.0, False, chunk=1024), q)
+        # the same chunk count is INSIDE the causal budget (136 pairs)
+        jax.eval_shape(lambda q: chunked_flash_attention_lse(
+            q, q, q, 1.0, True, chunk=1024), q)
 
     @pytest.mark.parametrize("causal", [True, False])
     def test_masked_forward_matches_dense(self, causal):
@@ -567,8 +620,10 @@ class TestChunkedFlash:
             chunked_flash_attention(q, k, v, causal=True, chunk=64)
 
     def test_long_t_misconfig_raises_not_ooms(self):
-        """mask/dropout (or an untileable T) at long T must raise with
-        instructions — the dense fallback would be a device OOM."""
+        """An untileable long T must raise with instructions — the dense
+        fallback would be a device OOM. Dropout is NOT a misconfig
+        anymore (r6): the same layer config that raised in r5 now
+        dispatches to the chunked path."""
         from deeplearning4j_tpu.nn.conf.layers import SelfAttentionLayer
         from deeplearning4j_tpu.nn.layers.attention import (
             SelfAttentionImpl,
@@ -582,13 +637,194 @@ class TestChunkedFlash:
         impl = SelfAttentionImpl()
         params, state = impl.init(conf, jax.random.PRNGKey(0), jnp.float32)
         x = jnp.zeros((1, T, 16), jnp.float32)
-        with pytest.raises(ValueError, match="chunked flash path"):
-            jax.eval_shape(lambda p, s, x: impl.apply(
-                conf, p, s, x, train=True, rng=jax.random.PRNGKey(1)),
-                params, state, x)
+        # dropout + long T traces through the chunked path end-to-end
+        out, _ = jax.eval_shape(lambda p, s, x: impl.apply(
+            conf, p, s, x, train=True, rng=jax.random.PRNGKey(1)),
+            params, state, x)
+        assert out.shape == x.shape
         conf2 = SelfAttentionLayer(n_in=16, n_out=16, n_heads=2, causal=True,
                                    weight_init="xavier")
         with pytest.raises(ValueError, match="cannot be tiled"):
             jax.eval_shape(lambda p, s, x: impl.apply(
                 conf2, p, s, x, train=False, rng=None),
                 params, state, jnp.zeros((1, 25088, 16), jnp.float32))
+        # the untileable message names the monolithic fallback's head-dim
+        # gate when T is inside its ceiling (ADVICE r5 #2): head_dim 256
+        # at T=12288 is rejected by BOTH tiers and must say why
+        conf3 = SelfAttentionLayer(n_in=512, n_out=512, n_heads=2,
+                                   causal=True, weight_init="xavier")
+        params3, state3 = impl.init(conf3, jax.random.PRNGKey(0),
+                                    jnp.float32)
+        with pytest.raises(ValueError, match="head_dim"):
+            jax.eval_shape(lambda p, s, x: impl.apply(
+                conf3, p, s, x, train=False, rng=None),
+                params3, state3, jnp.zeros((1, 8320, 512), jnp.float32))
+
+
+# ------------------------------------- chunk-invariant in-kernel dropout (r6)
+
+class TestChunkInvariantDropout:
+    """The r6 tentpole: the in-kernel keep mask hashes GLOBAL (q, k)
+    coordinates, so the keep decision for logical element (bh, i, j) is
+    identical whether attention runs monolithically, per-chunk, or
+    per-ring-hop — dropout composes with the chunked long-context path
+    at full rate instead of raising."""
+
+    def test_keep_mask_bitwise_invariant_to_windowing(self):
+        """Bit-for-bit acceptance at the tile-straddling length
+        14336+BLOCK: _keep_mask evaluated over ANY window (origin, size)
+        equals the corresponding slice of the dropout_keep_mask_host
+        oracle at the full T — including windows that straddle the
+        512-block grid and an odd tail. (_keep_mask is plain jnp outside
+        pallas, so this runs the exact kernel hash at long T cheaply.)"""
+        from deeplearning4j_tpu.ops.flash_attention import (
+            BLOCK,
+            MONOLITHIC_COMPILE_MAX,
+            _keep_mask,
+            dropout_keep_mask_host,
+        )
+
+        T = MONOLITHIC_COMPILE_MAX + BLOCK  # 14464
+        seed, bh, rate = 987654321, 5, 0.3
+        ref = dropout_keep_mask_host(seed, bh, T, rate)
+        windows = [
+            (0, 0, 512, 512),            # block-aligned head
+            (13952, 640, 512, 512),      # tail x early-key straddle
+            (14336, 14336, BLOCK, BLOCK),  # the odd 128 tail, diagonal
+            (640, 13952, 256, 512),      # rectangular, unequal blocks
+        ]
+        for q0, k0, bq, bk in windows:
+            got = np.asarray(_keep_mask(
+                jnp.asarray(seed, jnp.int32), bh, 1, 1, q0, k0, bq, bk,
+                T, rate))[0]
+            np.testing.assert_array_equal(got, ref[q0:q0 + bq, k0:k0 + bk])
+
+    def test_chunked_dropout_matches_monolithic(self):
+        """Values AND gradients: chunked-with-dropout equals the
+        monolithic dropout kernel at the same T/seed (identical keep
+        mask; only lse-merge float reassociation differs). T=640
+        straddles the 512 block cap, chunk=128 gives 5 tiles."""
+        B, H, T, D = 1, 2, 640, 32
+        rate = 0.2
+        rng = np.random.default_rng(11)
+        q, k, v = (jnp.asarray(rng.standard_normal((B, H, T, D)),
+                               jnp.float32) for _ in range(3))
+        key = jax.random.PRNGKey(7)
+        from deeplearning4j_tpu.ops.flash_attention import (
+            chunked_flash_attention,
+        )
+
+        def mono(q, k, v):
+            return flash_attention(q, k, v, causal=True, dropout=rate,
+                                   dropout_rng=key)
+
+        def chunked(q, k, v):
+            return chunked_flash_attention(q, k, v, causal=True, chunk=128,
+                                           dropout=rate, dropout_rng=key)
+
+        np.testing.assert_allclose(np.asarray(chunked(q, k, v)),
+                                   np.asarray(mono(q, k, v)), atol=2e-5)
+        gm = jax.grad(lambda q, k, v: jnp.sum(mono(q, k, v) ** 2),
+                      (0, 1, 2))(q, k, v)
+        gc = jax.grad(lambda q, k, v: jnp.sum(chunked(q, k, v) ** 2),
+                      (0, 1, 2))(q, k, v)
+        for a, b in zip(gc, gm):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       atol=2e-4)
+
+    def test_chunked_dropout_invariant_to_chunk_count(self):
+        """The same (seed, bh, i, j) keeps/drops identically at chunk=128
+        and chunk=256 — the mask depends on global coordinates only."""
+        B, H, T, D = 1, 2, 512, 32
+        rate = 0.25
+        rng = np.random.default_rng(3)
+        q, k, v = (jnp.asarray(rng.standard_normal((B, H, T, D)),
+                               jnp.float32) for _ in range(3))
+        key = jax.random.PRNGKey(13)
+        from deeplearning4j_tpu.ops.flash_attention import (
+            chunked_flash_attention,
+        )
+
+        outs = [chunked_flash_attention(q, k, v, causal=True, chunk=c,
+                                        dropout=rate, dropout_rng=key)
+                for c in (128, 256)]
+        np.testing.assert_allclose(np.asarray(outs[0]), np.asarray(outs[1]),
+                                   atol=2e-5)
+
+    def test_chunked_dropout_matches_host_oracle_dense(self):
+        """End-to-end mask identity: the chunked kernel path reproduces a
+        dense reference applying the EXACT dropout_keep_mask_host oracle
+        (the same oracle the monolithic dropout tests pin against)."""
+        B, H, T, D = 2, 2, 512, 32
+        rate = 0.2
+        rng = np.random.default_rng(0)
+        q, k, v = (jnp.asarray(rng.standard_normal((B, H, T, D)),
+                               jnp.float32) for _ in range(3))
+        key = jax.random.PRNGKey(7)
+        seed = int(jax.random.randint(key, (1, 1), 0, 2**31 - 1,
+                                      dtype=jnp.int32)[0, 0])
+        from deeplearning4j_tpu.ops.flash_attention import (
+            chunked_flash_attention,
+        )
+
+        ref = _dense_dropout_ref(q, k, v, seed, rate, T, H)
+        out = chunked_flash_attention(q, k, v, causal=True, chunk=128,
+                                      dropout=rate, dropout_rng=key)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   atol=2e-5)
+
+    def test_masked_chunked_dropout_matches_monolithic(self):
+        """Padding masks AND dropout together through the chunk loop —
+        the full long-context training feature set on one dispatch."""
+        B, H, T, D = 2, 2, 512, 32
+        rate = 0.15
+        rng = np.random.default_rng(9)
+        q, k, v = (jnp.asarray(rng.standard_normal((B, H, T, D)),
+                               jnp.float32) for _ in range(3))
+        mask = _varlen_mask(B, T, [T, 300])
+        w = mask[:, None, :, None]
+        key = jax.random.PRNGKey(21)
+        from deeplearning4j_tpu.ops.flash_attention import (
+            chunked_flash_attention,
+        )
+
+        def mono(q, k, v):
+            return flash_attention(q, k, v, causal=True, mask=mask,
+                                   dropout=rate, dropout_rng=key)
+
+        def chunked(q, k, v):
+            return chunked_flash_attention(q, k, v, causal=True, mask=mask,
+                                           chunk=128, dropout=rate,
+                                           dropout_rng=key)
+
+        np.testing.assert_allclose(np.asarray(chunked(q, k, v) * w),
+                                   np.asarray(mono(q, k, v) * w), atol=2e-5)
+        gm = jax.grad(lambda q: jnp.sum((mono(q, k, v) * w) ** 2))(q)
+        gc = jax.grad(lambda q: jnp.sum((chunked(q, k, v) * w) ** 2))(q)
+        np.testing.assert_allclose(np.asarray(gc), np.asarray(gm),
+                                   atol=2e-4)
+
+    def test_layer_dispatches_dropout_to_chunked_path(self):
+        """The r5 hard exclusion is gone at the LAYER level: a dropout
+        config at T beyond the monolithic ceiling traces through the
+        chunked dispatch (shape-level end-to-end; the seq-32768 value
+        run is the transformer_lm_seq32768_dropout bench mode)."""
+        from deeplearning4j_tpu.nn.conf.layers import SelfAttentionLayer
+        from deeplearning4j_tpu.nn.layers.attention import SelfAttentionImpl
+        from deeplearning4j_tpu.ops.flash_attention import (
+            supports_chunked,
+        )
+
+        T = 32768
+        assert supports_chunked((1, 2, T, 64), causal=True, dropout=0.1,
+                                mask=None)
+        conf = SelfAttentionLayer(n_in=128, n_out=128, n_heads=2,
+                                  causal=True, weight_init="xavier",
+                                  attention_dropout=0.1)
+        impl = SelfAttentionImpl()
+        params, state = impl.init(conf, jax.random.PRNGKey(0), jnp.float32)
+        x = jnp.zeros((1, T, 128), jnp.float32)
+        out, _ = jax.eval_shape(lambda p, s, x: impl.apply(
+            conf, p, s, x, train=True, rng=jax.random.PRNGKey(1)),
+            params, state, x)
+        assert out.shape == x.shape

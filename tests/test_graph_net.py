@@ -273,6 +273,7 @@ def test_cg_fit_scanned():
     assert net.iteration_count == 4
 
 
+@pytest.mark.slow
 def test_cg_remat_matches_plain_gradients():
     """conf.remat wraps each layer vertex in jax.checkpoint — a pure
     HBM-for-FLOPs trade that must not change the math: loss and every

@@ -36,6 +36,7 @@ def test_lenet_builds_and_fits():
     np.testing.assert_allclose(out.sum(-1), 1.0, atol=1e-4)
 
 
+@pytest.mark.slow
 def test_vgg16_builds_and_steps():
     net = vgg16()
     net.init()
@@ -47,6 +48,7 @@ def test_vgg16_builds_and_steps():
     assert out.shape == (2, 10)
 
 
+@pytest.mark.slow
 def test_resnet20_builds_and_steps():
     net = resnet20()
     net.init()

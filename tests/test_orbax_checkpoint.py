@@ -18,6 +18,7 @@ def _net():
     return net
 
 
+@pytest.mark.slow
 def test_sharded_save_restore_round_trip(tmp_path):
     mesh = make_mesh({"data": 2, "model": 4})
     net = _net()

@@ -132,6 +132,7 @@ def test_tp_sharded_transformer_params():
     assert np.allclose(out.sum(-1), 1.0, atol=1e-4)
 
 
+@pytest.mark.slow
 def test_computation_graph_under_data_parallel_trainer():
     """DP-3: a DAG network trains under the mesh-sharded step and matches
     its own single-device training (gradient allreduce is exact for the

@@ -9,6 +9,7 @@ import jax
 import jax.numpy as jnp
 import pytest
 
+from deeplearning4j_tpu.util.compat import shard_map
 from deeplearning4j_tpu.datasets.api import DataSet
 from deeplearning4j_tpu.datasets.iterators import ListDataSetIterator
 from deeplearning4j_tpu.models.transformer import transformer_lm
@@ -67,6 +68,7 @@ def test_sp_step_matches_unsharded(mesh_axes, data_axis):
                 err_msg=f"{name}/{k} diverged under SP")
 
 
+@pytest.mark.slow
 def test_sp_loss_decreases_over_epochs():
     rng = np.random.default_rng(1)
     ds = _data(rng)
@@ -120,7 +122,7 @@ def test_sp_dropout_is_applied():
 
 def test_sp_learned_posenc_overflow_raises():
     from jax.sharding import PartitionSpec as P
-    from jax import shard_map
+    from deeplearning4j_tpu.util.compat import shard_map
     from deeplearning4j_tpu.nn.conf.layers import PositionalEncodingLayer
     from deeplearning4j_tpu.nn.layers.base import get_impl
 
@@ -143,7 +145,7 @@ def test_sp_learned_posenc_overflow_raises():
 def test_sp_posenc_offsets_match_dense():
     """The encodings each shard adds are the global-position rows."""
     from jax.sharding import PartitionSpec as P
-    from jax import shard_map
+    from deeplearning4j_tpu.util.compat import shard_map
     from deeplearning4j_tpu.nn.conf.layers import PositionalEncodingLayer
     from deeplearning4j_tpu.nn.layers.base import get_impl
 
@@ -192,7 +194,7 @@ def test_ring_flash_hop_matches_reference():
         np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
                                    atol=2e-5)
     spec = P(None, None, "seq", None)
-    fn = jax.shard_map(partial(ring_attention, axis_name="seq", causal=True),
+    fn = shard_map(partial(ring_attention, axis_name="seq", causal=True),
                        mesh=mesh, in_specs=(spec, spec, spec),
                        out_specs=spec, check_vma=False)
     g_ring = jax.grad(lambda q, k, v: jnp.sum(fn(q, k, v) ** 2),
@@ -232,6 +234,7 @@ def test_sp_composes_with_model_axis():
     assert abs(float(dense.score_value) - float(sp.score_value)) < 2e-3
 
 
+@pytest.mark.slow
 def test_sp_train_step_runs_flash_hops(monkeypatch):
     """Full SP training with local blocks long enough for the Pallas
     flash hop path (Tl = 128): the other SP train tests use tiny T where
@@ -297,7 +300,7 @@ def test_ring_chunked_hop_matches_reference():
                for _ in range(3))
     spec = P(None, None, "seq", None)
     for causal in (True, False):
-        fn = jax.shard_map(
+        fn = shard_map(
             partial(ring_attention, axis_name="seq", causal=causal,
                     hop_chunk=128),
             mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
@@ -306,7 +309,7 @@ def test_ring_chunked_hop_matches_reference():
         ref = sequence_sharded_attention_reference(q, k, v, causal=causal)
         np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
                                    atol=2e-5)
-    fn = jax.shard_map(
+    fn = shard_map(
         partial(ring_attention, axis_name="seq", causal=True, hop_chunk=128),
         mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
         check_vma=False)
@@ -317,3 +320,114 @@ def test_ring_chunked_hop_matches_reference():
         (0, 1, 2))(q, k, v)
     for a, b in zip(g_ring, g_ref):
         np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=5e-5)
+
+
+def test_ring_dropout_matches_single_chip_kernel():
+    """r6 tentpole, ring leg: per-hop in-kernel dropout hashes GLOBAL
+    coordinates, so a 4-shard ring drops exactly what the single-chip
+    monolithic kernel at T = 4*Tl does — outputs match for the same rng
+    on both the flash-hop path (Tl % 128 == 0) and, below, the einsum
+    fallback against the host keep-mask oracle."""
+    from functools import partial
+
+    from jax.sharding import PartitionSpec as P
+
+    from deeplearning4j_tpu.parallel.ring_attention import ring_attention
+    from deeplearning4j_tpu.ops.flash_attention import flash_attention
+
+    mesh = make_mesh({"seq": 4})
+    B, H, T, D = 1, 2, 512, 32  # Tl = 128: flash hop path
+    rate = 0.2
+    rng = np.random.default_rng(5)
+    q, k, v = (jnp.asarray(rng.standard_normal((B, H, T, D)), jnp.float32)
+               for _ in range(3))
+    key = jax.random.PRNGKey(17)
+    spec = P(None, None, "seq", None)
+    fn = shard_map(partial(ring_attention, axis_name="seq", causal=True,
+                           dropout=rate, dropout_rng=key),
+                   mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
+                   check_vma=False)
+    out = fn(q, k, v)
+    ref = flash_attention(q, k, v, causal=True, dropout=rate,
+                          dropout_rng=key)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5)
+    # gradients flow through the dropout hops (lse merge + custom VJPs)
+    g_ring = jax.grad(lambda q: jnp.sum(fn(q, k, v) ** 2))(q)
+    g_ref = jax.grad(lambda q: jnp.sum(flash_attention(
+        q, k, v, causal=True, dropout=rate, dropout_rng=key) ** 2))(q)
+    np.testing.assert_allclose(np.asarray(g_ring), np.asarray(g_ref),
+                               atol=2e-4)
+
+
+def test_ring_dropout_einsum_fallback_matches_host_oracle():
+    """Odd local blocks (Tl % 128 != 0) run the einsum fallback, whose
+    jnp keep mask must be bit-identical to the kernels' counter-hash —
+    checked against the dropout_keep_mask_host oracle at the global T."""
+    from functools import partial
+
+    from jax.sharding import PartitionSpec as P
+
+    from deeplearning4j_tpu.parallel.ring_attention import ring_attention
+    from deeplearning4j_tpu.ops.flash_attention import (
+        _step_seed,
+        dropout_keep_mask_host,
+    )
+
+    mesh = make_mesh({"seq": 2})
+    B, H, T, D = 2, 2, 16, 8  # Tl = 8: einsum path
+    rate = 0.25
+    rng = np.random.default_rng(1)
+    q, k, v = (jnp.asarray(rng.standard_normal((B, H, T, D)), jnp.float32)
+               for _ in range(3))
+    key = jax.random.PRNGKey(3)
+    seed = int(np.asarray(_step_seed(key))[0, 0])
+    spec = P(None, None, "seq", None)
+    fn = shard_map(partial(ring_attention, axis_name="seq", causal=True,
+                           dropout=rate, dropout_rng=key),
+                   mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
+                   check_vma=False)
+    out = fn(q, k, v)
+
+    # dense reference applying the exact host keep mask (dense
+    # semantics: dropout on the softmax output, l from undropped p)
+    s = jnp.einsum("bhqd,bhkd->bhqk", q, k) / np.sqrt(float(D))
+    s = jnp.where(jnp.tril(jnp.ones((T, T), bool)), s, -1e30)
+    w = jax.nn.softmax(s, axis=-1)
+    keeps = np.stack([dropout_keep_mask_host(seed, b * H + h, T, rate)
+                      for b in range(B) for h in range(H)]).reshape(
+                          B, H, T, T)
+    w = w * jnp.asarray(keeps, jnp.float32) / (1.0 - rate)
+    ref = jnp.einsum("bhqk,bhkd->bhqd", w, v)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=1e-5)
+
+
+def test_ring_monolithic_hop_tier_gates_head_dim():
+    """ADVICE r5 #3: the ring's extended monolithic per-hop tier
+    (MAX_FLASH_T < Tl <= MONOLITHIC_COMPILE_MAX) applies the same
+    D <= 128 gate as supports_monolithic_fallback — a D=256 block near
+    the compile ceiling raises with instructions instead of busting
+    VMEM on-chip. Blocks inside the proven envelope keep any D."""
+    from functools import partial
+
+    from jax.sharding import PartitionSpec as P
+
+    from deeplearning4j_tpu.parallel.ring_attention import ring_attention
+
+    mesh = make_mesh({"seq": 2})
+    spec = P(None, None, "seq", None)
+
+    def trace(Tl, D):
+        q = jnp.zeros((1, 1, 2 * Tl, D), jnp.float32)
+        fn = shard_map(partial(ring_attention, axis_name="seq",
+                               causal=True),
+                       mesh=mesh, in_specs=(spec, spec, spec),
+                       out_specs=spec, check_vma=False)
+        return jax.eval_shape(fn, q, q, q)
+
+    # extended tier + D=256: rejected with the head_dim named
+    with pytest.raises(ValueError, match="head_dim"):
+        trace(8320, 256)
+    # extended tier + D=128: accepted (pre-r5 behavior preserved)
+    trace(8320, 128)
+    # proven envelope + D=256: accepted (single-chip dispatch parity)
+    trace(256, 256)

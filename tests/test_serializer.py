@@ -125,6 +125,7 @@ def test_kind_specific_restores_reject_wrong_kind(tmp_path):
                       MultiLayerNetwork)
 
 
+@pytest.mark.slow
 def test_flat_layout_v1_checkpoint_upgrades(tmp_path):
     """Pre-r5 (flat_layout v1) checkpoints stored every leaf row-major in
     the flat optimizer vector; v2 axis-rotates lane-hostile leaves (2D+

@@ -181,6 +181,7 @@ def test_ep_train_via_set_mesh_matches_dense(lm_data):
     assert tuple(net.params["blk0_moe"]["We1"].sharding.spec)[0] == "expert"
 
 
+@pytest.mark.slow
 def test_sp_via_set_mesh_matches_dense(lm_data):
     """The fifth axis joins the entry point: axes={'seq': ...} routes fit()
     through the ring-attention sequence-parallel step (time sharded over
@@ -268,6 +269,7 @@ def test_seq_axis_requires_sp_conf():
         net.set_mesh(make_mesh({"seq": 8}), axes={"seq": "seq"})
 
 
+@pytest.mark.slow
 def test_zero1_with_renamed_data_axis(dense, lm_data):
     """zero1 must follow the MAPPED data axis name, not the literal
     'data' (regression: zero1_opt_shardings hardcoded the default)."""
@@ -464,6 +466,7 @@ def test_pp_conv_stack_fails_with_documented_reason():
         net.set_mesh(make_mesh({"pipe": 2}), axes={"pipe": "pipe"})
 
 
+@pytest.mark.slow
 def test_four_axis_composition_in_subprocess():
     """ALL FOUR param/compute axes at once — data x model x pipe x expert
     on a 2x2x2x2 16-device mesh, routed-MoE transformer, one jitted train

@@ -1,0 +1,30 @@
+"""graftlint — JAX/TPU static analysis for this repo (ISSUE 2).
+
+Two stages:
+
+1. AST pass (`ast_pass.lint_paths`): rules G001-G008 over the package —
+   tracer leaks, host syncs in hot paths, float64 drift, RNG discipline,
+   retrace hazards, shard_map arity, util/compat bypasses, import-time
+   device captures. Pure stdlib; never imports jax.
+2. jaxpr audit (`jaxpr_audit.audit`): traces the public jitted entry
+   points with abstract inputs on CPU and asserts the programs are
+   transfer-clean (J001), within frozen op-count budgets (J002), and
+   float64-free (J003).
+
+CLI: `python tools/graftlint.py --check deeplearning4j_tpu`. Inline
+suppression: `# graftlint: disable=G00x`; grandfathered findings live in
+tools/graftlint_baseline.json. Gate: tests/test_graftlint.py (tier-1).
+"""
+
+from deeplearning4j_tpu.analysis.ast_pass import (iter_py_files,
+                                                  lint_paths, lint_report,
+                                                  lint_source)
+from deeplearning4j_tpu.analysis.ast_rules import RULE_DOCS
+from deeplearning4j_tpu.analysis.core import (Finding, load_baseline,
+                                              split_baselined,
+                                              write_baseline)
+
+__all__ = [
+    "Finding", "RULE_DOCS", "iter_py_files", "lint_paths", "lint_report",
+    "lint_source", "load_baseline", "split_baselined", "write_baseline",
+]

@@ -1,0 +1,694 @@
+"""The G001-G008 AST rules.
+
+Every rule errs toward PRECISION over recall: a lint gate that cries
+wolf gets suppressed wholesale, while a quiet one keeps running in CI
+forever. Each rule documents what it deliberately does not catch.
+
+All name matching goes through the per-file import table (`Imports`), so
+`import numpy as onp` / `from jax import random as jr` spellings resolve
+to canonical dotted paths before any rule looks at them.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+
+from deeplearning4j_tpu.analysis.core import Finding
+
+# Paths whose code runs per training step — the G002 host-sync scope.
+HOT_PATH_FRAGMENTS = ("/ops/", "/parallel/", "/nn/layers/")
+
+# Decorators that put a function body under a jax trace.
+_JIT_NAMES = {"jax.jit", "jax.pjit", "jit", "pjit",
+              "jax.experimental.pjit.pjit"}
+_TRACED_DECOS = _JIT_NAMES | {
+    "jax.custom_vjp", "jax.custom_jvp", "jax.checkpoint", "jax.remat",
+    "jax.vmap", "jax.grad", "jax.value_and_grad"}
+
+# Attribute reads that return STATIC python values even on tracers.
+_STATIC_ATTRS = {"shape", "ndim", "dtype", "size", "sharding", "aval",
+                 "weak_type"}
+# Builtins whose result on a traced arg is static (or that never trace).
+_STATIC_CALLS = {"len", "isinstance", "type", "hasattr", "getattr", "id",
+                 "repr", "str"}
+
+_NP_CTORS = {"zeros", "ones", "empty", "full", "arange", "linspace",
+             "eye", "identity"}
+
+# jax.random.* that do NOT consume the key (safe to call repeatedly with
+# the same key). Everything else — split included — consumes it.
+_KEY_NONCONSUMING = {"fold_in", "key_data", "wrap_key_data", "key_impl",
+                     "clone"}
+
+# params treated as PRNG keys for the G004 reuse check, by convention
+_KEY_PARAM_RE = re.compile(r"(?:^|_)(?:key|rng|prng)s?$|^(?:key|rng)")
+
+_MUTABLE_DEFAULT_CALLS = {"list", "dict", "set", "bytearray",
+                          "defaultdict", "OrderedDict"}
+
+# jnp/jax calls that ALLOCATE a device buffer when run at module level.
+_DEVICE_ALLOC = {"jax.numpy." + n for n in
+                 _NP_CTORS | {"array", "asarray", "stack", "concatenate"}}
+_DEVICE_ALLOC |= {"jax.random.PRNGKey", "jax.random.key",
+                  "jax.device_put"}
+
+
+class Imports:
+    """Local alias -> canonical dotted module path, e.g. jnp ->
+    jax.numpy, shard_map -> deeplearning4j_tpu.util.compat.shard_map."""
+
+    def __init__(self, tree: ast.AST):
+        self.map: dict[str, str] = {}
+        for node in ast.walk(tree):
+            if isinstance(node, ast.Import):
+                for a in node.names:
+                    if a.asname:
+                        self.map[a.asname] = a.name
+                    else:
+                        root = a.name.split(".")[0]
+                        self.map[root] = root
+            elif isinstance(node, ast.ImportFrom) and node.module \
+                    and node.level == 0:
+                for a in node.names:
+                    self.map[a.asname or a.name] = f"{node.module}.{a.name}"
+
+    def canon(self, node: ast.AST) -> str | None:
+        """Canonical dotted path of a Name/Attribute chain, or None."""
+        parts = []
+        while isinstance(node, ast.Attribute):
+            parts.append(node.attr)
+            node = node.value
+        if not isinstance(node, ast.Name):
+            return None
+        parts.append(self.map.get(node.id, node.id))
+        return ".".join(reversed(parts))
+
+
+def _walk_with_parents(tree: ast.AST):
+    for parent in ast.walk(tree):
+        for child in ast.iter_child_nodes(parent):
+            child._gl_parent = parent  # type: ignore[attr-defined]
+    return tree
+
+
+def _parents(node: ast.AST):
+    while True:
+        node = getattr(node, "_gl_parent", None)
+        if node is None:
+            return
+        yield node
+
+
+def _decorator_canon(deco: ast.AST, imports: Imports):
+    """(canonical name, call node | None) for plain / called / partial-
+    wrapped decorators: @jax.jit, @jax.jit(...), @partial(jax.jit, ...)."""
+    call = None
+    if isinstance(deco, ast.Call):
+        call = deco
+        name = imports.canon(deco.func)
+        if name in ("functools.partial", "partial") and deco.args:
+            name = imports.canon(deco.args[0])
+        return name, call
+    return imports.canon(deco), call
+
+
+def _static_params(fn: ast.FunctionDef, deco_call: ast.Call | None,
+                   deco_name: str) -> set[str]:
+    """Param names the decorator marks static (static_argnums/argnames,
+    custom_vjp nondiff_argnums — passed as concrete python values)."""
+    pos = [a.arg for a in fn.args.posonlyargs + fn.args.args]
+    static: set[str] = set()
+    if deco_call is None:
+        return static
+    for kw in deco_call.keywords:
+        if kw.arg in ("static_argnums", "nondiff_argnums",
+                      "static_argnames"):
+            vals = kw.value.elts if isinstance(
+                kw.value, (ast.Tuple, ast.List)) else [kw.value]
+            for v in vals:
+                if isinstance(v, ast.Constant):
+                    if isinstance(v.value, int) and 0 <= v.value < len(pos):
+                        static.add(pos[v.value])
+                    elif isinstance(v.value, str):
+                        static.add(v.value)
+    return static
+
+
+def _traced_functions(tree: ast.AST, imports: Imports):
+    """(fn, traced param names) for every function whose body jax traces."""
+    for node in ast.walk(tree):
+        if not isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        for deco in node.decorator_list:
+            name, call = _decorator_canon(deco, imports)
+            if name in _TRACED_DECOS:
+                params = {a.arg for a in node.args.posonlyargs
+                          + node.args.args + node.args.kwonlyargs}
+                params -= _static_params(node, call, name)
+                yield node, params
+                break
+
+
+def _mentions_traced(expr: ast.AST, tracked: set[str],
+                     imports: Imports) -> bool:
+    """Does `expr` read a tracked (traced-value) name in a position that
+    yields a tracer? `.shape`/`.ndim`/... reads and len()/isinstance()
+    calls are static even on tracers and do not count."""
+    def visit(node) -> bool:
+        if isinstance(node, ast.Attribute) and node.attr in _STATIC_ATTRS:
+            return False
+        if isinstance(node, ast.Call):
+            fname = imports.canon(node.func)
+            if fname in _STATIC_CALLS:
+                return False
+            return visit(node.func) or any(
+                visit(a) for a in node.args) or any(
+                visit(k.value) for k in node.keywords)
+        if isinstance(node, ast.Name) and isinstance(node.ctx, ast.Load):
+            return node.id in tracked
+        return any(visit(c) for c in ast.iter_child_nodes(node))
+    return visit(expr)
+
+
+def _only_identity_tests(test: ast.AST) -> bool:
+    """`x is None` / `x is not None` and and/or/not combinations thereof
+    — legal on tracers (identity, not value)."""
+    if isinstance(test, ast.Compare):
+        return all(isinstance(op, (ast.Is, ast.IsNot)) for op in test.ops)
+    if isinstance(test, ast.BoolOp):
+        return all(_only_identity_tests(v) for v in test.values)
+    if isinstance(test, ast.UnaryOp) and isinstance(test.op, ast.Not):
+        return _only_identity_tests(test.operand)
+    return False
+
+
+def _grow_tracked(fn: ast.AST, tracked: set[str], imports: Imports):
+    """Fixpoint: names assigned from expressions over tracked names are
+    themselves tracked (y = x * 2). Bounded iterations; order-insensitive."""
+    for _ in range(4):
+        before = len(tracked)
+        for node in ast.walk(fn):
+            if isinstance(node, ast.Assign) and _mentions_traced(
+                    node.value, tracked, imports):
+                for tgt in node.targets:
+                    for n in ast.walk(tgt):
+                        if isinstance(n, ast.Name):
+                            tracked.add(n.id)
+            elif isinstance(node, ast.For) and _mentions_traced(
+                    node.iter, tracked, imports):
+                for n in ast.walk(node.target):
+                    if isinstance(n, ast.Name):
+                        tracked.add(n.id)
+        if len(tracked) == before:
+            break
+
+
+# --------------------------------------------------------------- G001
+
+def g001_traced_bool(tree, imports, path):
+    """Python control flow / bool()/float()/int() on traced values inside
+    jit-traced functions: ConcretizationTypeError at runtime, or worse, a
+    silent retrace per distinct value. Not caught: traced values entering
+    via closure instead of params."""
+    out = []
+    for fn, tracked in _traced_functions(tree, imports):
+        tracked = set(tracked)
+        _grow_tracked(fn, tracked, imports)
+        for node in ast.walk(fn):
+            if isinstance(node, (ast.If, ast.While)):
+                if _only_identity_tests(node.test):
+                    continue
+                if _mentions_traced(node.test, tracked, imports):
+                    kind = "if" if isinstance(node, ast.If) else "while"
+                    out.append((node, f"python `{kind}` on a traced value",
+                                "use jnp.where / lax.cond / lax.while_loop,"
+                                " or mark the driving arg static"))
+            elif isinstance(node, ast.Call) and isinstance(
+                    node.func, ast.Name) and node.func.id in (
+                    "bool", "float", "int") and node.args and \
+                    _mentions_traced(node.args[0], tracked, imports):
+                out.append((node, f"`{node.func.id}()` forces a traced "
+                            "value to a python scalar (device sync / "
+                            "ConcretizationTypeError)",
+                            "keep it as a jnp scalar, or hoist the "
+                            "conversion out of the traced function"))
+    return [("G001", n, m, f) for n, m, f in out]
+
+
+# --------------------------------------------------------------- G002
+
+def g002_host_sync(tree, imports, path):
+    """Implicit device->host syncs in hot paths (ops/, parallel/,
+    nn/layers/): .item(), jax.device_get, np.asarray/np.array on device
+    values stall the dispatch pipeline mid-step. Host-side setup code in
+    those dirs carries an inline disable with its justification."""
+    if not any(frag in path for frag in HOT_PATH_FRAGMENTS):
+        return []
+    out = []
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        name = imports.canon(node.func)
+        if name in ("numpy.asarray", "numpy.array"):
+            out.append(("G002", node,
+                        f"`{name.replace('numpy', 'np')}` in a hot path "
+                        "pulls the value to host (sync) and re-uploads",
+                        "stay in jnp (`jnp.asarray`), or move host "
+                        "conversion out of the per-step path"))
+        elif name == "jax.device_get":
+            out.append(("G002", node, "`jax.device_get` in a hot path is "
+                        "an explicit device sync",
+                        "batch transfers outside the step loop"))
+        elif isinstance(node.func, ast.Attribute) and \
+                node.func.attr == "item" and not node.args:
+            out.append(("G002", node, "`.item()` in a hot path blocks on "
+                        "the device value",
+                        "keep the scalar on device; log via jax.debug or "
+                        "after the step"))
+    return out
+
+
+# --------------------------------------------------------------- G003
+
+def g003_float64_drift(tree, imports, path):
+    """dtype-less np constructors inside functions that also do jnp math:
+    np defaults to float64/int64, so the host value either silently
+    downcasts at the jnp boundary or (x64 enabled) upcasts the whole
+    expression. Not caught: promotion via python float literals."""
+    out = []
+    fns = [n for n in ast.walk(tree)
+           if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))]
+    seen: set[int] = set()
+    for fn in fns:
+        uses_jnp = any(
+            (c := imports.canon(n)) and
+            (c.startswith("jax.numpy.") or c.startswith("jax.lax."))
+            for n in ast.walk(fn) if isinstance(n, (ast.Attribute, ast.Name)))
+        if not uses_jnp:
+            continue
+        for node in ast.walk(fn):
+            if not isinstance(node, ast.Call) or id(node) in seen:
+                continue
+            name = imports.canon(node.func)
+            if name and name.startswith("numpy.") and \
+                    name.split(".")[-1] in _NP_CTORS and \
+                    not any(kw.arg == "dtype" for kw in node.keywords) and \
+                    len(node.args) < _ctor_dtype_pos(name):
+                seen.add(id(node))
+                out.append(("G003", node,
+                            f"dtype-less `{name.replace('numpy', 'np')}` "
+                            "in jnp code defaults to float64/int64 "
+                            "(silent downcast or x64 promotion)",
+                            "pass an explicit dtype= (e.g. np.float32), "
+                            "or build it with jnp"))
+    return out
+
+
+def _ctor_dtype_pos(name: str) -> int:
+    # positional index where dtype may be passed without the keyword
+    return {"numpy.full": 3, "numpy.arange": 99, "numpy.linspace": 99,
+            "numpy.eye": 99}.get(name, 2)
+
+
+# --------------------------------------------------------------- G004
+
+def g004_rng_discipline(tree, imports, path):
+    """(a) np.random / stdlib random inside traced functions: baked in at
+    trace time, identical every step. (b) a PRNG key consumed by two
+    jax.random calls without a split between them: correlated streams."""
+    out = []
+    for fn, _tracked in _traced_functions(tree, imports):
+        for node in ast.walk(fn):
+            if isinstance(node, ast.Call):
+                name = imports.canon(node.func) or ""
+                if name.startswith("numpy.random.") or \
+                        name.startswith("random."):
+                    out.append(("G004", node,
+                                f"`{name}` inside a traced function is "
+                                "frozen at trace time (same draw every "
+                                "step)",
+                                "thread a jax PRNG key through the "
+                                "function and use jax.random"))
+    # (b) key reuse, per function scope
+    for fn in [n for n in ast.walk(tree)
+               if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))]:
+        # keys born here, plus params that are keys by naming convention
+        keys: set[str] = {
+            a.arg for a in fn.args.posonlyargs + fn.args.args
+            + fn.args.kwonlyargs if _KEY_PARAM_RE.search(a.arg)}
+        for node in ast.walk(fn):
+            if isinstance(node, ast.Assign) and isinstance(
+                    node.value, ast.Call):
+                name = imports.canon(node.value.func)
+                if name in ("jax.random.PRNGKey", "jax.random.key"):
+                    for tgt in node.targets:
+                        if isinstance(tgt, ast.Name):
+                            keys.add(tgt.id)
+        if not keys:
+            continue
+        consuming: dict[str, list[ast.Call]] = {k: [] for k in keys}
+        rebinds: dict[str, list[int]] = {k: [] for k in keys}
+        for node in ast.walk(fn):
+            if isinstance(node, ast.Call):
+                name = imports.canon(node.func) or ""
+                if name.startswith("jax.random.") and \
+                        name.split(".")[-1] not in _KEY_NONCONSUMING | {
+                            "PRNGKey", "key"}:
+                    for a in node.args:
+                        if isinstance(a, ast.Name) and a.id in keys:
+                            consuming[a.id].append(node)
+            elif isinstance(node, (ast.Assign, ast.AugAssign)):
+                tgts = node.targets if isinstance(node, ast.Assign) \
+                    else [node.target]
+                for tgt in tgts:
+                    for n in ast.walk(tgt):
+                        if isinstance(n, ast.Name) and n.id in keys:
+                            rebinds[n.id].append(node.lineno)
+        for key, uses in consuming.items():
+            uses.sort(key=lambda n: n.lineno)
+            for prev, cur in zip(uses, uses[1:]):
+                # rebind may share the consuming line: key, s = split(key)
+                if any(prev.lineno <= rb <= cur.lineno
+                       for rb in rebinds[key]):
+                    continue
+                if _exclusive_paths(prev, cur, fn):
+                    continue
+                out.append(("G004", cur,
+                            f"PRNG key `{key}` consumed again without "
+                            f"a split (previous use line {prev.lineno}): "
+                            "correlated random streams",
+                            f"`{key}, sub = jax.random.split({key})` "
+                            "and consume `sub`"))
+    return out
+
+
+def _enclosing_suites(node: ast.AST, fn: ast.AST):
+    """(owner, field, suite) for every statement-suite between `node`
+    and `fn`, innermost first — the control context of the node."""
+    suites = []
+    cur = node
+    for par in _parents(node):
+        for field in ("body", "orelse", "finalbody"):
+            suite = getattr(par, field, None)
+            if isinstance(suite, list) and any(s is cur for s in suite):
+                suites.append((par, field, suite))
+        cur = par
+        if par is fn:
+            break
+    return suites
+
+
+def _exclusive_paths(prev: ast.AST, cur: ast.AST, fn: ast.AST) -> bool:
+    """True when `prev` executing implies `cur` cannot: they sit in
+    opposite arms of one `if`, or prev's branch ends in return/raise
+    (the if/elif-return ladder of weights.init_weight)."""
+    prev_suites = _enclosing_suites(prev, fn)
+    cur_owner_ids = {id(owner) for owner, _f, _s in
+                     _enclosing_suites(cur, fn)}
+    cur_suite_ids = {id(s) for _o, _f, s in _enclosing_suites(cur, fn)}
+    for owner, field, suite in prev_suites:
+        if isinstance(owner, ast.If):
+            if id(owner) in cur_owner_ids and id(suite) not in \
+                    cur_suite_ids:
+                return True  # opposite arms of the same if
+            if id(suite) not in cur_suite_ids and suite and isinstance(
+                    suite[-1], (ast.Return, ast.Raise, ast.Continue,
+                                ast.Break)):
+                return True  # prev's arm leaves; cur is unreachable then
+    return False
+
+
+# --------------------------------------------------------------- G005
+
+def g005_retrace_hazards(tree, imports, path):
+    """jit re-creation per call — `jax.jit(f)(x)` or jit() inside a
+    loop — recompiles every invocation; unhashable static_argnums raise
+    at call time. Not caught: jit fns keyed on changing python scalars."""
+    out = []
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        name = imports.canon(node.func)
+        if isinstance(node.func, ast.Call):
+            inner = imports.canon(node.func.func)
+            if inner in _JIT_NAMES:
+                out.append(("G005", node,
+                            "`jax.jit(f)(...)` creates and discards a "
+                            "fresh compiled function every call (full "
+                            "retrace each time)",
+                            "hoist `jit(f)` to module level or cache it"))
+        if name in _JIT_NAMES:
+            for kw in node.keywords:
+                if kw.arg == "static_argnums" and isinstance(
+                        kw.value, (ast.List, ast.Dict, ast.Set)):
+                    out.append(("G005", node,
+                                "non-hashable static_argnums literal",
+                                "use an int or tuple of ints"))
+            for anc in _parents(node):
+                if isinstance(anc, (ast.For, ast.While)):
+                    out.append(("G005", node,
+                                "jit() inside a loop body compiles a "
+                                "fresh function per iteration",
+                                "create the jitted function once, "
+                                "outside the loop"))
+                    break
+                if isinstance(anc, (ast.FunctionDef,
+                                    ast.AsyncFunctionDef, ast.Lambda)):
+                    break
+    return out
+
+
+# --------------------------------------------------------------- G006
+
+def g006_shard_map_arity(tree, imports, path):
+    """shard_map in_specs/out_specs arity vs the wrapped function, when
+    both are statically visible. Single-spec (pytree-prefix) forms and
+    non-local callables are out of scope by design."""
+    out = []
+    local_defs = {n.name: n for n in ast.walk(tree)
+                  if isinstance(n, ast.FunctionDef)}
+
+    def check(call: ast.Call, fn_node, report_at):
+        specs = {kw.arg: kw.value for kw in call.keywords
+                 if kw.arg in ("in_specs", "out_specs")}
+        in_specs = specs.get("in_specs")
+        if isinstance(in_specs, (ast.Tuple, ast.List)) and \
+                fn_node is not None:
+            lo, hi = _arity_range(fn_node)
+            if lo is not None and not lo <= len(in_specs.elts) <= hi:
+                out.append(("G006", report_at,
+                            f"in_specs has {len(in_specs.elts)} specs but "
+                            f"`{getattr(fn_node, 'name', '<lambda>')}` "
+                            f"takes {lo}"
+                            + (f"-{hi}" if hi != lo else "")
+                            + " positional args",
+                            "one spec per positional arg (or a single "
+                            "pytree-prefix spec)"))
+        out_specs = specs.get("out_specs")
+        if isinstance(out_specs, (ast.Tuple, ast.List)) and \
+                isinstance(fn_node, ast.FunctionDef):
+            lens = _return_tuple_lens(fn_node)
+            if lens and all(n != len(out_specs.elts) for n in lens):
+                out.append(("G006", report_at,
+                            f"out_specs has {len(out_specs.elts)} specs "
+                            f"but `{fn_node.name}` returns "
+                            f"{sorted(lens)} values",
+                            "match out_specs to the returned tuple"))
+
+    def resolve_target(arg):
+        """(fn_node, bound_positional) for direct name / lambda /
+        functools.partial over a local def."""
+        if isinstance(arg, ast.Lambda):
+            return arg, 0
+        if isinstance(arg, ast.Name):
+            return local_defs.get(arg.id), 0
+        if isinstance(arg, ast.Call):
+            name = imports.canon(arg.func)
+            if name in ("functools.partial", "partial") and arg.args:
+                fn, extra = resolve_target(arg.args[0])
+                return fn, extra + len(arg.args) - 1
+        return None, 0
+
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Call):
+            name = imports.canon(node.func) or ""
+            if name == "shard_map" or name.endswith(".shard_map"):
+                if node.args:
+                    fn_node, bound = resolve_target(node.args[0])
+                    if fn_node is not None and bound == 0:
+                        check(node, fn_node, node)
+                    elif fn_node is None:
+                        check(node, None, node)
+        elif isinstance(node, ast.FunctionDef):
+            for deco in node.decorator_list:
+                dname, call = _decorator_canon(deco, imports)
+                if call is not None and dname and (
+                        dname == "shard_map"
+                        or dname.endswith(".shard_map")):
+                    check(call, node, call)
+    return out
+
+
+def _arity_range(fn_node):
+    args = fn_node.args
+    if args.vararg is not None:
+        return None, None
+    pos = len(args.posonlyargs) + len(args.args)
+    return pos - len(args.defaults), pos
+
+
+def _return_tuple_lens(fn: ast.FunctionDef) -> set[int] | None:
+    lens: set[int] = set()
+    for node in ast.walk(fn):
+        if isinstance(node, ast.Return) and node.value is not None:
+            # only returns belonging to THIS def, not nested ones
+            owner = next((p for p in _parents(node) if isinstance(
+                p, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda))),
+                None)
+            if owner is not fn:
+                continue
+            if isinstance(node.value, ast.Tuple):
+                lens.add(len(node.value.elts))
+            else:
+                return None  # opaque return — cannot judge
+    return lens or None
+
+
+# --------------------------------------------------------------- G007
+
+_COMPAT_SHIMS = {
+    "jax.shard_map": "deeplearning4j_tpu.util.compat.shard_map",
+    "jax.experimental.shard_map.shard_map":
+        "deeplearning4j_tpu.util.compat.shard_map",
+    "jax.lax.pcast": "deeplearning4j_tpu.util.compat.pcast_varying",
+}
+
+
+def g007_compat_bypass(tree, imports, path):
+    """Raw uses of version-moved jax symbols (shard_map /
+    TPUCompilerParams / pcast) that must route through util/compat.py so
+    the next jax bump stays a one-file change."""
+    if path.endswith("util/compat.py"):
+        return []
+    out = []
+    for node in ast.walk(tree):
+        if isinstance(node, ast.ImportFrom) and node.level == 0:
+            mod = node.module or ""
+            for a in node.names:
+                full = f"{mod}.{a.name}"
+                if full in ("jax.shard_map",
+                            "jax.experimental.shard_map.shard_map") or \
+                        mod == "jax.experimental.shard_map":
+                    out.append(("G007", node,
+                                f"raw `from {mod} import {a.name}` moved "
+                                "between jax 0.4/0.5",
+                                "from deeplearning4j_tpu.util.compat "
+                                "import shard_map"))
+                elif a.name in ("TPUCompilerParams", "CompilerParams") \
+                        and "pallas" in mod:
+                    out.append(("G007", node,
+                                f"raw `{a.name}` import was renamed "
+                                "across jax versions",
+                                "use util.compat.tpu_compiler_params()"))
+        elif isinstance(node, ast.Attribute):
+            name = imports.canon(node)
+            if name in _COMPAT_SHIMS:
+                out.append(("G007", node,
+                            f"raw `{name}` moved between jax 0.4/0.5",
+                            f"use {_COMPAT_SHIMS[name]}"))
+            elif node.attr in ("TPUCompilerParams",):
+                out.append(("G007", node,
+                            "`TPUCompilerParams` was renamed "
+                            "CompilerParams in jax 0.5",
+                            "use util.compat.tpu_compiler_params()"))
+            elif node.attr == "CompilerParams" and name and \
+                    "pallas" in name:
+                out.append(("G007", node,
+                            "`CompilerParams` does not exist on jax "
+                            "0.4.x pallas",
+                            "use util.compat.tpu_compiler_params()"))
+    return out
+
+
+# --------------------------------------------------------------- G008
+
+def g008_import_time(tree, imports, path):
+    """(a) mutable default args — shared across calls; (b) module-level
+    jnp allocations — they initialize a backend and pin a buffer at
+    IMPORT time, before the program can pick devices/platform."""
+    out = []
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.Lambda)):
+            for d in node.args.defaults + [
+                    d for d in node.args.kw_defaults if d is not None]:
+                bad = isinstance(d, (ast.List, ast.Dict, ast.Set,
+                                     ast.ListComp, ast.DictComp,
+                                     ast.SetComp))
+                if isinstance(d, ast.Call) and isinstance(
+                        d.func, ast.Name) and \
+                        d.func.id in _MUTABLE_DEFAULT_CALLS:
+                    bad = True
+                if bad:
+                    out.append(("G008", d,
+                                "mutable default argument is shared "
+                                "across calls",
+                                "default to None; create inside the "
+                                "function"))
+    # module-level device allocations: top-level stmts (incl. if/try
+    # bodies and class-attr assignments) — anything inside a def runs
+    # lazily and is out of scope here.
+    def scan(node):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.Lambda)):
+            return
+        if isinstance(node, ast.Call):
+            name = imports.canon(node.func)
+            if name in _DEVICE_ALLOC:
+                out.append(("G008", node,
+                            f"module-level `{name}` allocates a device "
+                            "buffer at import time (captures the default "
+                            "backend before it is configured)",
+                            "allocate lazily inside a function, or keep "
+                            "the constant in numpy"))
+        for child in ast.iter_child_nodes(node):
+            scan(child)
+
+    for stmt in getattr(tree, "body", []):
+        scan(stmt)
+    return out
+
+
+ALL_RULES = [g001_traced_bool, g002_host_sync, g003_float64_drift,
+             g004_rng_discipline, g005_retrace_hazards,
+             g006_shard_map_arity, g007_compat_bypass, g008_import_time]
+
+RULE_DOCS = {
+    "G001": "python control flow / bool()/float()/int() on traced values",
+    "G002": "implicit host sync (.item/np.asarray/device_get) in hot paths",
+    "G003": "dtype-less np constructors mixed into jnp code (float64 drift)",
+    "G004": "np.random/random in traced code; PRNG key reuse without split",
+    "G005": "per-call jit creation / non-hashable static_argnums (retraces)",
+    "G006": "shard_map in_specs/out_specs arity vs wrapped function",
+    "G007": "version-moved jax symbols bypassing util/compat.py",
+    "G008": "mutable default args; module-level jnp allocations",
+}
+
+
+def run_rules(tree: ast.AST, source: str, path: str) -> list[Finding]:
+    """All rules over one parsed file -> raw findings (no suppression)."""
+    _walk_with_parents(tree)
+    imports = Imports(tree)
+    lines = source.splitlines()
+    findings = []
+    for rule in ALL_RULES:
+        for rule_id, node, message, fixit in rule(tree, imports, path):
+            line = getattr(node, "lineno", 0)
+            col = getattr(node, "col_offset", 0)
+            snippet = lines[line - 1].strip() if 0 < line <= len(lines) \
+                else ""
+            findings.append(Finding(rule_id, path, line, col, message,
+                                    fixit, snippet))
+    findings.sort(key=lambda f: (f.path, f.line, f.rule))
+    return findings

@@ -1,0 +1,86 @@
+"""Finding model, inline suppressions, and the checked-in baseline.
+
+A finding's baseline KEY deliberately omits the line number: baselines
+must survive unrelated edits above the offending line, so the key is
+(rule, path, stripped source line). Two identical offending lines in one
+file share a key — acceptable for a grandfather list that is supposed to
+shrink to zero, not grow.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import re
+
+# `# graftlint: disable=G001` or `# graftlint: disable=G001,G005` on the
+# offending line (or the `if`/`def` line of the flagged statement).
+_SUPPRESS_RE = re.compile(r"#\s*graftlint:\s*disable=([A-Z0-9,\s]+)")
+
+
+@dataclasses.dataclass(frozen=True)
+class Finding:
+    rule: str        # "G001".."G008" (AST pass) / "J001".."J004" (jaxpr)
+    path: str        # repo-relative posix path, or an entry-point name
+    line: int        # 1-based; 0 for whole-artifact (jaxpr) findings
+    col: int
+    message: str
+    fixit: str       # how to fix it (every rule carries one)
+    snippet: str = ""
+
+    @property
+    def key(self) -> str:
+        return f"{self.rule}:{self.path}:{self.snippet}"
+
+    def format(self) -> str:
+        loc = f"{self.path}:{self.line}:{self.col}" if self.line else self.path
+        return f"{loc}: {self.rule} {self.message}\n    fix: {self.fixit}"
+
+    def to_json(self) -> dict:
+        return dataclasses.asdict(self)
+
+
+def suppressions(source: str) -> dict[int, set[str]]:
+    """line (1-based) -> set of rule ids disabled on that line.
+
+    Matched against the finding's reported line, so a disable comment
+    sits on the line the linter names (for multi-line statements that is
+    the statement's FIRST line)."""
+    out: dict[int, set[str]] = {}
+    for i, text in enumerate(source.splitlines(), start=1):
+        m = _SUPPRESS_RE.search(text)
+        if m:
+            out[i] = {r.strip() for r in m.group(1).split(",") if r.strip()}
+    return out
+
+
+def apply_suppressions(findings, source: str):
+    sup = suppressions(source)
+    return [f for f in findings if f.rule not in sup.get(f.line, ())]
+
+
+def load_baseline(path: str) -> set[str]:
+    try:
+        with open(path) as fh:
+            data = json.load(fh)
+    except FileNotFoundError:
+        return set()
+    return set(data.get("findings", []))
+
+
+def write_baseline(path: str, findings) -> None:
+    keys = sorted({f.key for f in findings})
+    with open(path, "w") as fh:
+        json.dump(
+            {"comment": "graftlint grandfathered findings — shrink, never "
+                        "grow. Regenerate: tools/graftlint.py --write-baseline",
+             "findings": keys}, fh, indent=1)
+        fh.write("\n")
+
+
+def split_baselined(findings, baseline: set[str]):
+    """-> (new, grandfathered)."""
+    new, old = [], []
+    for f in findings:
+        (old if f.key in baseline else new).append(f)
+    return new, old

@@ -1,0 +1,217 @@
+"""Stage 2: jaxpr audit of the public jitted entry points.
+
+Traces each entry point with abstract/CPU inputs (no FLOPs execute) and
+audits the closed jaxpr:
+
+- J001: forbidden primitive — device_put / callback / host-transfer ops
+  inside the traced program. At production scale these are per-step
+  host<->device syncs; they must be structurally absent, not "rare".
+- J002: op-count budget exceeded — every entry point has a frozen upper
+  bound in analysis/jaxpr_budget.json. Silent graph bloat (a retrace
+  that doubled the program, an accidentally unrolled loop) trips this
+  long before a TPU run notices.
+- J003: float64 value in the traced program — dtype drift; everything
+  compute-side is float32/bfloat16 by design.
+- J004: entry point missing from the budget file (run
+  `tools/graftlint.py --update-budget`).
+
+Import note: jax and bench load lazily so stage 1 stays jax-free.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+
+from deeplearning4j_tpu.analysis.core import Finding
+
+BUDGET_PATH = os.path.join(os.path.dirname(__file__), "jaxpr_budget.json")
+
+FORBIDDEN_PRIMITIVES = frozenset({
+    "device_put", "copy", "pure_callback", "io_callback", "debug_callback",
+    "callback", "outside_call", "infeed", "outfeed",
+})
+
+# op-count bounds get this headroom over the observed count when
+# (re)generated, then stay FROZEN until explicitly regenerated.
+_BUDGET_HEADROOM = 1.25
+_BUDGET_QUANTUM = 25
+
+_LM_STEP_PREFIX = "lm_step/"
+
+
+def entry_names() -> list[str]:
+    """All auditable entry points (stable order). Safe to call without
+    jax — used for test parametrization."""
+    names = [
+        "flash_attention/causal",
+        "flash_attention/masked",
+        "flash_attention/dropout",
+        "flash_attention/grad",
+        "flash_attention_qkv/causal",
+        "chunked_flash_attention/seq4096",
+        "fused_layer_norm",
+        "softmax_xent_head",
+    ]
+    import bench  # repo-root module; cheap (no jax work at import)
+    names += [_LM_STEP_PREFIX + mode for mode in sorted(bench.LM_MODE_DIMS)]
+    return names
+
+
+def _sds(shape, dtype):
+    import jax
+    return jax.ShapeDtypeStruct(shape, dtype)
+
+
+def _build(name):
+    """-> (fn, args tuple) for one entry point, with abstract inputs
+    wherever jax.make_jaxpr accepts them."""
+    import jax
+    import jax.numpy as jnp
+
+    if name.startswith(_LM_STEP_PREFIX):
+        import bench
+        mode = name[len(_LM_STEP_PREFIX):]
+        net, ds, _cfg = bench.lm_mode_net_ds(mode, force_tpu_dims=True)
+        batch = net._batch_dict(net._to_mds(ds))
+        step = net._get_train_step()
+        return step, (net.params, net.opt_state, net.state,
+                      jax.random.PRNGKey(0), batch)
+
+    from deeplearning4j_tpu.ops.flash_attention import (
+        chunked_flash_attention, flash_attention, flash_attention_qkv)
+    from deeplearning4j_tpu.ops.fused_layernorm import fused_layer_norm
+    from deeplearning4j_tpu.ops.fused_softmax_xent import softmax_xent_head
+
+    f32 = jnp.float32
+    B, H, T, D = 2, 2, 512, 64
+    qkv3 = tuple(_sds((B, H, T, D), f32) for _ in range(3))
+    if name == "flash_attention/causal":
+        return (lambda q, k, v: flash_attention(q, k, v, causal=True)), qkv3
+    if name == "flash_attention/masked":
+        return (lambda q, k, v, m: flash_attention(q, k, v, causal=True,
+                                                   mask=m)), \
+            qkv3 + (_sds((B, T), f32),)
+    if name == "flash_attention/dropout":
+        return (lambda q, k, v, key: flash_attention(
+            q, k, v, causal=True, dropout=0.1, dropout_rng=key)), \
+            qkv3 + (jax.random.PRNGKey(0),)
+    if name == "flash_attention/grad":
+        def loss(q, k, v):
+            return flash_attention(q, k, v, causal=True).sum()
+        return jax.grad(loss, argnums=(0, 1, 2)), qkv3
+    if name == "flash_attention_qkv/causal":
+        # d_model 256 / 2 heads -> D=128, the packed-qkv regime
+        return (lambda qkv: flash_attention_qkv(qkv, 2, causal=True)), \
+            (_sds((B, T, 3 * 256), f32),)
+    if name == "chunked_flash_attention/seq4096":
+        shapes = tuple(_sds((1, 2, 4096, D), f32) for _ in range(3))
+        return (lambda q, k, v: chunked_flash_attention(
+            q, k, v, causal=True)), shapes
+    if name == "fused_layer_norm":
+        return fused_layer_norm, (_sds((1024, 512), f32),
+                                  _sds((512,), f32), _sds((512,), f32))
+    if name == "softmax_xent_head":
+        return softmax_xent_head, (
+            _sds((1024, 256), f32), _sds((256, 10000), f32),
+            _sds((10000,), f32), _sds((1024,), jnp.int32))
+    raise KeyError(name)
+
+
+def _iter_eqns(jaxpr):
+    """Every eqn, recursing into sub-jaxprs (pjit bodies, scan, cond
+    branches, custom_vjp calls...)."""
+    for eqn in jaxpr.eqns:
+        yield eqn
+        for val in eqn.params.values():
+            for sub in (val if isinstance(val, (list, tuple)) else [val]):
+                inner = getattr(sub, "jaxpr", None)
+                if inner is not None and hasattr(inner, "eqns"):
+                    yield from _iter_eqns(inner)
+                elif hasattr(sub, "eqns"):
+                    yield from _iter_eqns(sub)
+
+
+def trace_entry(name):
+    """-> (op_count, findings-without-budget-check). Traces on the
+    current (CPU) backend with abstract inputs; nothing executes."""
+    import jax
+    import numpy as np
+
+    fn, args = _build(name)
+    closed = jax.make_jaxpr(fn)(*args)
+    count = 0
+    findings = []
+    seen_f64: set[str] = set()
+    for eqn in _iter_eqns(closed.jaxpr):
+        count += 1
+        prim = eqn.primitive.name
+        if prim in FORBIDDEN_PRIMITIVES:
+            findings.append(Finding(
+                "J001", name, 0, 0,
+                f"traced program contains `{prim}` (host/device transfer "
+                "or callback inside the step)",
+                "hoist the transfer/callback out of the jitted path",
+                snippet=prim))
+        for var in eqn.outvars:
+            aval = getattr(var, "aval", None)
+            dtype = getattr(aval, "dtype", None)
+            if dtype is not None and dtype == np.float64 and \
+                    prim not in seen_f64:
+                seen_f64.add(prim)
+                findings.append(Finding(
+                    "J003", name, 0, 0,
+                    f"`{prim}` produces float64 — dtype drift into the "
+                    "traced program",
+                    "pin the dtype at the source (np.float32 constant / "
+                    "explicit dtype=)", snippet=f"f64:{prim}"))
+    return count, findings
+
+
+def load_budget(path: str = BUDGET_PATH) -> dict[str, int]:
+    try:
+        with open(path) as fh:
+            return {k: int(v) for k, v in json.load(fh)["ops"].items()}
+    except FileNotFoundError:
+        return {}
+
+
+def audit(names=None, budget_path: str = BUDGET_PATH):
+    """Run the full stage-2 audit -> (findings, {entry: op_count})."""
+    budget = load_budget(budget_path)
+    findings, counts = [], {}
+    for name in names if names is not None else entry_names():
+        count, fs = trace_entry(name)
+        counts[name] = count
+        findings.extend(fs)
+        bound = budget.get(name)
+        if bound is None:
+            findings.append(Finding(
+                "J004", name, 0, 0,
+                f"entry point has no frozen op budget (traced {count} "
+                "ops)",
+                "run `python tools/graftlint.py --update-budget`",
+                snippet="missing-budget"))
+        elif count > bound:
+            findings.append(Finding(
+                "J002", name, 0, 0,
+                f"jaxpr has {count} ops, over the frozen bound of "
+                f"{bound} — retrace/bloat regression",
+                "find what grew the traced program; only then refresh "
+                "the budget (--update-budget)", snippet="over-budget"))
+    return findings, counts
+
+
+def write_budget(counts: dict[str, int], path: str = BUDGET_PATH) -> None:
+    ops = {}
+    for name, count in sorted(counts.items()):
+        padded = int(count * _BUDGET_HEADROOM)
+        ops[name] = padded + (-padded % _BUDGET_QUANTUM)
+    with open(path, "w") as fh:
+        json.dump(
+            {"comment": "frozen jaxpr op-count upper bounds per entry "
+                        "point (graftlint stage 2). Regenerate only when "
+                        "a legitimate change grows the program: "
+                        "tools/graftlint.py --update-budget",
+             "ops": ops}, fh, indent=1)
+        fh.write("\n")

@@ -56,12 +56,12 @@ def check_gradients(net, dataset, *, epsilon: float = 1e-6,
         loss, _ = net._loss(params, net.state, rng, batch)
         return loss
 
-    analytic = np.asarray(
-        jax.jit(jax.grad(loss_flat))(jnp.asarray(flat0, jnp.float64)),
-        np.float64)
+    grad_flat = jax.jit(jax.grad(loss_flat))
+    analytic = np.asarray(grad_flat(jnp.asarray(flat0, jnp.float64)),
+                          np.float64)
 
     n = flat0.size
-    idxs = np.arange(n)
+    idxs = np.arange(n, dtype=np.int64)
     if subset is not None and subset < n:
         idxs = np.random.default_rng(seed).choice(n, size=subset, replace=False)
 

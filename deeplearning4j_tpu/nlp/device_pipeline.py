@@ -181,7 +181,8 @@ def _window_context(tokens, sent_ids, start, key, *, chunk, window):
     # word2vec dynamic window: per center, b ~ uniform{1..window}
     b = jax.random.randint(kb, (chunk,), 1, window + 1)
     offs = jnp.asarray(np.concatenate(
-        [np.arange(-window, 0), np.arange(1, window + 1)]), jnp.int32)
+        [np.arange(-window, 0, dtype=np.int32),
+         np.arange(1, window + 1, dtype=np.int32)]), jnp.int32)
     cpos = pos[:, None] + offs[None, :]
     cposc = jnp.clip(cpos, 0, N - 1)
     valid = ((cpos >= 0) & (cpos < N)

@@ -432,7 +432,9 @@ class MultiLayerNetwork:
 
             featurize = None
             if i > 0:
-                featurize = jax.jit(
+                # one compile per LAYER (to_layer=i is baked into the
+                # traced program), reused across the whole epoch loop
+                featurize = jax.jit(  # graftlint: disable=G005
                     lambda p, s, x: self._forward(p, s, x, train=False, rng=None,
                                                   to_layer=i)[0])
             for _ in range(epochs):

@@ -38,7 +38,8 @@ def make_mesh(axes: dict[str, int] | None = None, *, devices=None) -> Mesh:
     if total > len(devices):
         raise ValueError(f"mesh {dict(zip(axes, sizes))} needs {total} devices, "
                          f"have {len(devices)}")
-    arr = np.asarray(devices[:total]).reshape(sizes)
+    # host-side Device OBJECTS at mesh-build time, not a device sync
+    arr = np.asarray(devices[:total]).reshape(sizes)  # graftlint: disable=G002
     return Mesh(arr, tuple(axes.keys()))
 
 

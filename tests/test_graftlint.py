@@ -1,0 +1,392 @@
+"""Tier-1 gate for graftlint (ISSUE 2): every AST rule G001-G008 proven
+on a positive AND a negative fixture, the suppression + baseline
+machinery, the stage-2 jaxpr audit over every public entry point, and
+the package itself held lint-clean (zero non-baselined findings).
+
+PR 1 burned its budget reactively fixing exactly these bug classes
+(silent RNG divergence, jax API drift, modes that crashed only at real
+dims); this file is what makes them build-breaking instead."""
+
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+from deeplearning4j_tpu.analysis import (RULE_DOCS, lint_report,
+                                         lint_source, load_baseline,
+                                         split_baselined)
+from deeplearning4j_tpu.analysis.core import Finding
+
+pytestmark = pytest.mark.lint
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+PKG = os.path.join(ROOT, "deeplearning4j_tpu")
+BASELINE = os.path.join(ROOT, "tools", "graftlint_baseline.json")
+CLI = os.path.join(ROOT, "tools", "graftlint.py")
+
+# fixtures land in a hot-path location so G002 participates
+FIXTURE_PATH = "deeplearning4j_tpu/ops/_graftlint_fixture.py"
+
+_PRELUDE = """\
+import functools
+import random
+import numpy as np
+import jax
+import jax.numpy as jnp
+from functools import partial
+from deeplearning4j_tpu.util.compat import shard_map
+"""
+
+
+def rules_in(src, path=FIXTURE_PATH):
+    return {f.rule for f in lint_source(_PRELUDE + src, path)}
+
+
+# ----------------------------------------------- per-rule fixtures
+# (rule, positive source, negative source) — the negative exercises the
+# precision carve-outs, not just an empty file.
+
+FIXTURES = [
+    ("G001", """\
+@jax.jit
+def f(x):
+    if x > 0:
+        return x
+    return -x
+""", """\
+@jax.jit
+def f(x, flag):
+    if x is None:
+        return flag
+    if x.shape[0] > 2:
+        return jnp.where(x > 0, x, -x)
+    return x
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(1,))
+def g(x, causal):
+    if causal:
+        return x
+    return -x
+"""),
+    ("G001", """\
+@jax.jit
+def f(x):
+    s = x.sum()
+    return float(s)
+""", """\
+def host(x):
+    return float(x.sum())
+"""),
+    ("G002", """\
+def step(x):
+    y = np.asarray(x)
+    return y.item()
+""", """\
+def step(x):
+    y = jnp.asarray(x)
+    return y
+"""),
+    ("G003", """\
+def f(x):
+    w = np.arange(5)
+    return jnp.dot(x, w)
+""", """\
+def f(x):
+    w = np.arange(5, dtype=np.float32)
+    return jnp.dot(x, w)
+
+
+def host_only():
+    return np.arange(5)
+"""),
+    ("G004", """\
+@jax.jit
+def f(x):
+    noise = np.random.randn(4)
+    return x + noise
+""", """\
+@jax.jit
+def f(x, key):
+    return x + jax.random.normal(key, x.shape)
+"""),
+    ("G004", """\
+def sample():
+    key = jax.random.PRNGKey(0)
+    a = jax.random.normal(key, (2,))
+    b = jax.random.uniform(key, (2,))
+    return a + b
+""", """\
+def sample():
+    key = jax.random.PRNGKey(0)
+    key, sub = jax.random.split(key)
+    a = jax.random.normal(sub, (2,))
+    key, sub2 = jax.random.split(key)
+    b = jax.random.uniform(sub2, (2,))
+    k1 = jax.random.fold_in(key, 1)
+    k2 = jax.random.fold_in(key, 2)
+    return a + b, k1, k2
+"""),
+    ("G004", """\
+def consume_twice(key):
+    a = jax.random.split(key)
+    b = jax.random.split(key)
+    return a, b
+""", """\
+def init_ladder(rng, scheme, shape):
+    if scheme == "normal":
+        return jax.random.normal(rng, shape)
+    if scheme == "uniform":
+        return jax.random.uniform(rng, shape)
+    raise ValueError(scheme)
+
+
+def arms(rng, flag):
+    if flag:
+        return jax.random.normal(rng, (2,))
+    else:
+        return jax.random.uniform(rng, (2,))
+"""),
+    ("G005", """\
+def g(x):
+    return x
+
+
+def f(x):
+    return jax.jit(g)(x)
+""", """\
+def g(x):
+    return x
+
+
+fast_g = jax.jit(g)
+
+
+def f(x):
+    return fast_g(x)
+"""),
+    ("G005", """\
+def g(x):
+    return x
+
+
+def f(xs):
+    out = []
+    for x in xs:
+        h = jax.jit(g)
+        out.append(h(x))
+    return out
+""", """\
+def g(x):
+    return x
+
+
+def f(xs):
+    h = jax.jit(g, static_argnums=(0,))
+    return [h(x) for x in xs]
+"""),
+    ("G006", """\
+def local(a, b):
+    return a + b
+
+
+def run(mesh, P):
+    return shard_map(local, mesh=mesh,
+                     in_specs=(P, P, P), out_specs=P)
+""", """\
+def local(a, b):
+    return a + b
+
+
+def run(mesh, P):
+    one = shard_map(local, mesh=mesh, in_specs=(P, P), out_specs=P)
+    pre = shard_map(local, mesh=mesh, in_specs=P, out_specs=P)
+    return one, pre
+"""),
+    ("G006", """\
+def local(a):
+    return a, a + 1
+
+
+def run(mesh, P):
+    return shard_map(local, mesh=mesh, in_specs=(P,),
+                     out_specs=(P, P, P))
+""", """\
+def local(a):
+    return a, a + 1
+
+
+def run(mesh, P):
+    return shard_map(local, mesh=mesh, in_specs=(P,),
+                     out_specs=(P, P))
+"""),
+    ("G007", """\
+from jax.experimental.shard_map import shard_map as raw_shard_map
+from jax.experimental.pallas import tpu as pltpu
+
+
+def params():
+    return pltpu.TPUCompilerParams(dimension_semantics=("parallel",))
+""", """\
+from deeplearning4j_tpu.util.compat import (pcast_varying, shard_map,
+                                            tpu_compiler_params)
+
+
+def params():
+    return tpu_compiler_params(dimension_semantics=("parallel",))
+"""),
+    ("G008", """\
+K = jnp.zeros((4,))
+
+
+def f(x, acc=[]):
+    acc.append(x)
+    return K + x
+""", """\
+K = np.zeros((4,), dtype=np.float32)
+
+
+def f(x, acc=None):
+    if acc is None:
+        acc = []
+    acc.append(x)
+    return jnp.zeros((4,)) + x
+"""),
+]
+
+
+@pytest.mark.parametrize(
+    "rule,pos,neg", FIXTURES,
+    ids=[f"{r}-{i}" for i, (r, _, _) in enumerate(FIXTURES)])
+def test_rule_fires_on_positive_not_negative(rule, pos, neg):
+    assert rule in rules_in(pos), f"{rule} missed its positive fixture"
+    assert rule not in rules_in(neg), f"{rule} false-positive"
+
+
+def test_every_rule_has_fixture_coverage():
+    assert {r for r, _, _ in FIXTURES} == set(RULE_DOCS) == {
+        f"G00{i}" for i in range(1, 9)}
+
+
+def test_g002_scoped_to_hot_paths():
+    src = "def step(x):\n    return np.asarray(x)\n"
+    assert "G002" in rules_in(src, "deeplearning4j_tpu/ops/x.py")
+    assert "G002" in rules_in(src, "deeplearning4j_tpu/nn/layers/x.py")
+    assert "G002" not in rules_in(src, "deeplearning4j_tpu/datasets/x.py")
+
+
+def test_g007_exempts_compat_itself():
+    src = "from jax.experimental.shard_map import shard_map\n"
+    assert "G007" in rules_in(src, "deeplearning4j_tpu/parallel/x.py")
+    assert "G007" not in rules_in(src, "deeplearning4j_tpu/util/compat.py")
+
+
+def test_inline_suppression_and_fixit():
+    src = """\
+def g(x):
+    return x
+
+
+def f(x):
+    return jax.jit(g)(x)
+"""
+    findings = lint_source(_PRELUDE + src, FIXTURE_PATH)
+    assert [f.rule for f in findings] == ["G005"]
+    assert findings[0].fixit  # every rule ships a fix-it message
+    suppressed = src.replace("jax.jit(g)(x)",
+                             "jax.jit(g)(x)  # graftlint: disable=G005")
+    assert not lint_source(_PRELUDE + suppressed, FIXTURE_PATH)
+
+
+def test_baseline_roundtrip(tmp_path):
+    f1 = Finding("G005", "a.py", 3, 0, "m", "f", "jax.jit(g)(x)")
+    f2 = Finding("G002", "b.py", 9, 0, "m", "f", "np.asarray(x)")
+    from deeplearning4j_tpu.analysis import write_baseline
+    path = tmp_path / "base.json"
+    write_baseline(str(path), [f1])
+    base = load_baseline(str(path))
+    new, old = split_baselined([f1, f2], base)
+    assert old == [f1] and new == [f2]
+    # the key survives line-number drift
+    assert Finding("G005", "a.py", 77, 4, "m", "f",
+                   "jax.jit(g)(x)").key in base
+
+
+def test_syntax_error_is_a_finding():
+    assert rules_in("def f(:\n") == {"G000"}
+
+
+# ----------------------------------------------- the package gate
+
+def test_package_is_lint_clean():
+    baseline = load_baseline(BASELINE)
+    assert len(baseline) <= 5, "baseline must shrink, never grow"
+    new, _old = lint_report([PKG], baseline, root=ROOT)
+    assert not new, "new graftlint findings:\n" + "\n".join(
+        f.format() for f in new)
+
+
+# ----------------------------------------------- stage 2: jaxpr audit
+
+from deeplearning4j_tpu.analysis import jaxpr_audit  # noqa: E402
+
+
+@pytest.mark.parametrize("entry", jaxpr_audit.entry_names())
+def test_jaxpr_audit_entry(entry):
+    findings, counts = jaxpr_audit.audit([entry])
+    assert not findings, "\n".join(f.format() for f in findings)
+    assert counts[entry] > 0
+
+
+def test_budget_catches_bloat(tmp_path):
+    bad = tmp_path / "budget.json"
+    bad.write_text(json.dumps({"ops": {"fused_layer_norm": 1}}))
+    findings, _ = jaxpr_audit.audit(["fused_layer_norm"],
+                                    budget_path=str(bad))
+    assert [f.rule for f in findings] == ["J002"]
+
+
+def test_missing_budget_is_a_finding(tmp_path):
+    empty = tmp_path / "budget.json"
+    empty.write_text(json.dumps({"ops": {}}))
+    findings, _ = jaxpr_audit.audit(["fused_layer_norm"],
+                                    budget_path=str(empty))
+    assert [f.rule for f in findings] == ["J004"]
+
+
+def test_forbidden_primitive_detection():
+    import jax
+
+    def leaky(x):
+        return jax.device_put(x)
+
+    closed = jax.make_jaxpr(leaky)(jax.ShapeDtypeStruct((2,), "float32"))
+    prims = {e.primitive.name for e in jaxpr_audit._iter_eqns(closed.jaxpr)}
+    assert prims & jaxpr_audit.FORBIDDEN_PRIMITIVES
+
+
+# ----------------------------------------------- CLI
+
+def _run_cli(*argv):
+    return subprocess.run([sys.executable, CLI, *argv], cwd=ROOT,
+                          capture_output=True, text=True, timeout=120)
+
+
+def test_cli_check_clean_tree_exits_zero():
+    proc = _run_cli("--check", "deeplearning4j_tpu")
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+
+
+def test_cli_check_fails_on_findings_and_emits_json(tmp_path):
+    bad = tmp_path / "bad.py"
+    bad.write_text("import jax\n\n\ndef f(x):\n    return jax.jit(x)(1)\n")
+    proc = _run_cli("--check", str(bad))
+    assert proc.returncode == 1, proc.stdout + proc.stderr
+    assert "G005" in proc.stdout
+    proc = _run_cli("--check", "--json", str(bad))
+    assert proc.returncode == 1
+    payload = json.loads(proc.stdout)
+    assert payload["findings"][0]["rule"] == "G005"
+    assert payload["findings"][0]["fixit"]

@@ -1,0 +1,118 @@
+"""Tests for tools/requote_bench.py — previously untested tooling that
+is the ONLY writer of the measured-performance blocks in README/PARITY.
+
+The load() recovery path matters most: the driver keeps only the TAIL of
+captured stdout, so early metric lines vanish (r5 lost lenet/vgg/w2v/
+resnet/flagship) and must be reconstructed from the summary line."""
+
+import importlib.util
+import json
+import os
+import sys
+
+import pytest
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+spec = importlib.util.spec_from_file_location(
+    "requote_bench", os.path.join(ROOT, "tools", "requote_bench.py"))
+requote = importlib.util.module_from_spec(spec)
+sys.modules.setdefault("requote_bench", requote)
+spec.loader.exec_module(requote)
+
+
+def _write(tmp_path, name, payload):
+    path = tmp_path / name
+    path.write_text(payload)
+    return str(path)
+
+
+def test_load_plain_jsonl(tmp_path):
+    art = _write(tmp_path, "b.json", "\n".join([
+        json.dumps({"metric": "transformer_lm_mfu", "value": 0.31,
+                    "tokens_per_sec": 2.2e6}),
+        "not json at all",
+        json.dumps({"metric": "summary", "value": 1}),
+    ]))
+    lines = requote.load(art)
+    assert lines["transformer_lm_mfu"]["value"] == 0.31
+
+
+def test_load_unwraps_driver_tail_object(tmp_path):
+    inner = json.dumps({"metric": "ring_hop_flash_tflops", "value": 42.0})
+    art = _write(tmp_path, "b.json", json.dumps({"tail": inner + "\n"}))
+    lines = requote.load(art)
+    assert lines["ring_hop_flash_tflops"]["value"] == 42.0
+
+
+def test_load_recovers_truncated_metrics_from_summary(tmp_path):
+    """The r5 failure mode: only the summary line survived truncation —
+    every numeric key it carries becomes a bare {value} row."""
+    summary = {"metric": "summary", "value": 9, "unit": "x",
+               "vs_baseline": "ok", "regressions": 0,
+               "lenet_mnist_images_per_sec": 2.1e6,
+               "transformer_lm_mfu": 0.305,
+               "notes": "non-numeric, must be ignored"}
+    art = _write(tmp_path, "b.json", json.dumps(summary))
+    lines = requote.load(art)
+    assert lines["lenet_mnist_images_per_sec"] == {
+        "metric": "lenet_mnist_images_per_sec", "value": 2.1e6,
+        "from_summary": True}
+    assert lines["transformer_lm_mfu"]["from_summary"]
+    # bookkeeping keys of the summary line are NOT metrics
+    for skip in ("value", "unit", "vs_baseline", "regressions", "notes"):
+        assert skip not in lines
+
+
+def test_summary_never_overrides_surviving_tail_line(tmp_path):
+    art = _write(tmp_path, "b.json", "\n".join([
+        json.dumps({"metric": "transformer_lm_mfu", "value": 0.31,
+                    "tokens_per_sec": 2.2e6}),
+        json.dumps({"metric": "summary", "value": 1,
+                    "transformer_lm_mfu": 0.999}),
+    ]))
+    line = requote.load(art)["transformer_lm_mfu"]
+    assert line["value"] == 0.31 and "from_summary" not in line
+
+
+def test_render_quotes_recovered_and_tpu_suffixed_rows():
+    lines = {
+        "transformer_lm_mfu": {"metric": "transformer_lm_mfu",
+                               "value": 0.305, "from_summary": True},
+        "lenet_mnist_images_per_sec_tpu": {
+            "metric": "lenet_mnist_images_per_sec_tpu", "value": 2.0e6},
+    }
+    block = requote.render(lines, "BENCH_rTEST.json")
+    assert "BENCH_rTEST.json" in block
+    assert "**0.305 MFU**" in block
+    assert "2.00M images/sec" in block
+
+
+def test_render_flags_regressions():
+    lines = {"transformer_lm_mfu": {"metric": "transformer_lm_mfu",
+                                    "value": 0.2, "regression": True}}
+    assert "⚠regression" in requote.render(lines, "a.json")
+
+
+def test_splice_replaces_only_the_marked_block(tmp_path):
+    doc = tmp_path / "README.md"
+    doc.write_text("intro\n<!-- BENCH:BEGIN -->\nstale\n"
+                   "<!-- BENCH:END -->\noutro\n")
+    requote.splice(str(doc), "FRESH")
+    text = doc.read_text()
+    assert "FRESH" in text and "stale" not in text
+    assert text.startswith("intro\n") and text.endswith("outro\n")
+
+
+def test_splice_refuses_doc_without_markers(tmp_path):
+    doc = tmp_path / "README.md"
+    doc.write_text("no markers here\n")
+    with pytest.raises(SystemExit):
+        requote.splice(str(doc), "FRESH")
+
+
+def test_mfu_str_labels_conventions():
+    with_exec = requote._mfu_str({"value": 0.31, "mfu_executed": 0.62})
+    assert "0.310 MFU" in with_exec and "0.620" in with_exec
+    legacy = requote._mfu_str({"value": 0.31})
+    assert "dense-accounted" in legacy

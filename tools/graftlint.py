@@ -1,0 +1,123 @@
+#!/usr/bin/env python
+"""graftlint CLI — the repo's JAX/TPU static-analysis suite.
+
+    python tools/graftlint.py deeplearning4j_tpu            # report
+    python tools/graftlint.py --check deeplearning4j_tpu    # exit 1 on findings
+    python tools/graftlint.py --check --stage all           # + jaxpr audit
+    python tools/graftlint.py --json ...                    # machine output
+    python tools/graftlint.py --write-baseline ...          # grandfather
+    python tools/graftlint.py --update-budget               # refreeze op bounds
+
+Stage `ast` (default) is pure stdlib and instant — suitable as a
+pre-commit step. Stage `jaxpr` traces the jitted entry points on CPU
+(~1 min). Exit codes: 0 clean, 1 findings (--check), 2 usage/env error.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, ROOT)
+
+DEFAULT_BASELINE = os.path.join(ROOT, "tools", "graftlint_baseline.json")
+
+
+def _stub_packages() -> None:
+    """Register `deeplearning4j_tpu(.analysis)` as namespace-style stubs
+    so `analysis.*` submodules import directly from their files, skipping
+    the root __init__'s nn/jax re-exports. All intra-repo imports use
+    full dotted paths, so the skipped re-exports are never missed."""
+    import types
+    pkg = types.ModuleType("deeplearning4j_tpu")
+    pkg.__path__ = [os.path.join(ROOT, "deeplearning4j_tpu")]
+    sub = types.ModuleType("deeplearning4j_tpu.analysis")
+    sub.__path__ = [os.path.join(ROOT, "deeplearning4j_tpu", "analysis")]
+    sys.modules.setdefault("deeplearning4j_tpu", pkg)
+    sys.modules.setdefault("deeplearning4j_tpu.analysis", sub)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(prog="graftlint", description=__doc__)
+    ap.add_argument("paths", nargs="*", default=None,
+                    help="files/dirs to lint (default: deeplearning4j_tpu)")
+    ap.add_argument("--check", action="store_true",
+                    help="exit non-zero when there are non-baselined "
+                         "findings")
+    ap.add_argument("--json", action="store_true", dest="as_json",
+                    help="emit findings as JSON")
+    ap.add_argument("--stage", choices=("ast", "jaxpr", "all"),
+                    default="ast")
+    ap.add_argument("--baseline", default=DEFAULT_BASELINE)
+    ap.add_argument("--write-baseline", action="store_true",
+                    help="grandfather all current AST findings into the "
+                         "baseline file")
+    ap.add_argument("--update-budget", action="store_true",
+                    help="retrace all entry points and refreeze the "
+                         "jaxpr op-count budget")
+    args = ap.parse_args(argv)
+
+    if args.stage == "ast" and not args.update_budget:
+        # Pre-commit path: stub the package parents so the analysis
+        # modules load WITHOUT the root __init__ (which imports the full
+        # nn stack and jax). Stage 1 stays pure-stdlib-fast.
+        _stub_packages()
+    from deeplearning4j_tpu.analysis.ast_pass import lint_paths
+    from deeplearning4j_tpu.analysis.core import (load_baseline,
+                                                  split_baselined,
+                                                  write_baseline)
+
+    paths = args.paths or [os.path.join(ROOT, "deeplearning4j_tpu")]
+    new, old, counts = [], [], {}
+
+    if args.stage in ("ast", "all"):
+        findings = lint_paths(paths, root=ROOT)
+        if args.write_baseline:
+            write_baseline(args.baseline, findings)
+            print(f"baselined {len(findings)} findings -> {args.baseline}")
+            return 0
+        n, o = split_baselined(findings, load_baseline(args.baseline))
+        new.extend(n)
+        old.extend(o)
+
+    if args.stage in ("jaxpr", "all") or args.update_budget:
+        # CPU-only + virtual devices, matching the tier-1 environment,
+        # before any jax backend initialization.
+        os.environ.setdefault("JAX_PLATFORMS", "cpu")
+        from deeplearning4j_tpu.util.virtual_devices import \
+            ensure_cpu_devices
+        ensure_cpu_devices(8)
+        from deeplearning4j_tpu.analysis import jaxpr_audit
+        if args.update_budget:
+            _, counts = jaxpr_audit.audit()
+            jaxpr_audit.write_budget(counts)
+            print(f"froze op budgets for {len(counts)} entry points -> "
+                  f"{jaxpr_audit.BUDGET_PATH}")
+            for name, count in sorted(counts.items()):
+                print(f"  {name}: {count} ops")
+            return 0
+        jfindings, counts = jaxpr_audit.audit()
+        new.extend(jfindings)
+
+    if args.as_json:
+        print(json.dumps({
+            "findings": [f.to_json() for f in new],
+            "grandfathered": [f.to_json() for f in old],
+            "jaxpr_op_counts": counts,
+        }, indent=1))
+    else:
+        for f in new:
+            print(f.format())
+        if old:
+            print(f"({len(old)} grandfathered finding(s) in baseline)")
+        if counts:
+            print(f"jaxpr audit: {len(counts)} entry points traced")
+        print(f"graftlint: {len(new)} finding(s)")
+    return 1 if (new and args.check) else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

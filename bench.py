@@ -96,6 +96,16 @@ def _peak_flops(device):
 
 REGRESSION_FLOOR = 0.9  # anchored metric below 0.9x its anchor fails loudly
 
+
+def _recorder():
+    """Process-global telemetry recorder (telemetry/recorder.py). A
+    NullRecorder no-op unless DL4J_TPU_TELEMETRY names a log file —
+    _run_all sets it so every mode subprocess appends to one shared
+    JSONL log alongside the stdout metric lines."""
+    from deeplearning4j_tpu.telemetry import get_default
+
+    return get_default()
+
 # Best chip-probe ceilings observed across rounds (r2-r5): the shared-
 # tenancy chip swings 2x on minute timescales (r5 measured the SAME VGG
 # binary at 40.7k and 116k img/s nine minutes apart), so an anchored
@@ -147,6 +157,15 @@ def _emit(mode: str, value: float, unit: str, **extra) -> None:
             f"{line['vs_baseline']:.2f}x its anchor "
             f"({TARGETS[mode]})\n")
     print(json.dumps(line), flush=True)
+    _recorder().metric(line)
+
+
+def _emit_info(line: dict) -> None:
+    """Print an informational (un-anchored) metric line AND record it as
+    a telemetry `metric` event — every bench mode leaves both a stdout
+    detail line and a truncation-proof telemetry record."""
+    print(json.dumps(line), flush=True)
+    _recorder().metric(line)
 
 
 def _sync(carry) -> float:
@@ -542,9 +561,35 @@ def bench_word2vec() -> None:
           metric="word2vec_sgns_words_per_sec", **extra)
 
 
+def _ab_ratio_stats(pairs):
+    """Per-repeat A/B ratio statistics for the DP-speedup bench
+    (VERDICT r5 #2: a single best-of ratio swung 0.96-1.21 between
+    rounds with nothing to diagnose it). `pairs` is [(a_rate, b_rate)]
+    from interleaved repeats; the reported value is the MEDIAN of the
+    per-repeat ratios (host-contention spikes hit one repeat, not the
+    middle of the distribution) and the spread is [min, max]."""
+    ratios = sorted(a / b for a, b in pairs)
+    n = len(ratios)
+    median = (ratios[n // 2] if n % 2
+              else 0.5 * (ratios[n // 2 - 1] + ratios[n // 2]))
+    return {
+        "ratio_median": round(median, 4),
+        "ratio_spread": [round(ratios[0], 4), round(ratios[-1], 4)],
+        "ratios": [round(r, 4) for r in ratios],
+        "repeats": n,
+    }
+
+
 def bench_resnet_dp() -> None:
     """Allreduce-DP vs parameter-averaging steps/sec on an 8-device mesh
-    (BASELINE #4: the Spark param-averaging flagship vs the ICI redesign)."""
+    (BASELINE #4: the Spark param-averaging flagship vs the ICI
+    redesign). The two trainers run >=5 INTERLEAVED A/B repeats — each
+    repeat times allreduce then paramavg back-to-back, so both sides of
+    every ratio see the same host-contention window — and the metric
+    line reports median + spread + the sync cadence of each side
+    (allreduce syncs gradients every step; paramavg averages params
+    every `averaging_frequency` steps — at cadence 1 the comparison is
+    like-for-like communication per step)."""
     from deeplearning4j_tpu.util.virtual_devices import ensure_cpu_devices
 
     n_dev = 8
@@ -560,39 +605,56 @@ def bench_resnet_dp() -> None:
     from deeplearning4j_tpu.datasets.iterators import ListDataSetIterator
 
     batch = 64
+    n_batches = 8
+    repeats = 5
+    averaging_frequency = 1
     rng = np.random.default_rng(0)
     x = rng.random((batch, 32, 32, 3), dtype=np.float32)
     y = np.eye(10, dtype=np.float32)[rng.integers(0, 10, batch)]
     ds = DataSet(x, y)
 
-    def timed_fit(trainer, n_batches, rounds=3):
-        # virtual-CPU-mesh timing is host-contention sensitive (r5 saw
-        # the ratio swing 0.98-1.21x between sweeps): best-of-3 rounds
-        trainer.fit(ListDataSetIterator([ds] * 2))  # warmup/compile
-        best = 0.0
-        for _ in range(rounds):
-            t0 = time.perf_counter()
-            trainer.fit(ListDataSetIterator([ds] * n_batches))
-            best = max(best, n_batches / (time.perf_counter() - t0))
-        return best
+    def one_round(trainer):
+        t0 = time.perf_counter()
+        trainer.fit(ListDataSetIterator([ds] * n_batches))
+        return n_batches / (time.perf_counter() - t0)
 
     mesh = make_mesh({"data": n_dev})
     net_ar = resnet20()
     net_ar.init()
-    sps_allreduce = timed_fit(DataParallelTrainer(net_ar, mesh), 8)
-
+    trainer_ar = DataParallelTrainer(net_ar, mesh)
     net_pa = resnet20()
     net_pa.init()
-    sps_paramavg = timed_fit(
-        ParameterAveragingTrainer(net_pa, mesh, averaging_frequency=1), 8)
+    trainer_pa = ParameterAveragingTrainer(
+        net_pa, mesh, averaging_frequency=averaging_frequency)
+    rec = _recorder()
+    with rec.span("compile", mode="resnet_dp"):
+        trainer_ar.fit(ListDataSetIterator([ds] * 2))  # warmup/compile
+        trainer_pa.fit(ListDataSetIterator([ds] * 2))
 
-    _emit("resnet_dp", sps_allreduce / sps_paramavg, "x",
+    pairs = []
+    for rep in range(repeats):
+        with rec.span("ab_repeat", mode="resnet_dp", repeat=rep) as sp:
+            a = one_round(trainer_ar)
+            b = one_round(trainer_pa)
+            sp["allreduce_steps_per_sec"] = round(a, 3)
+            sp["paramavg_steps_per_sec"] = round(b, 3)
+        pairs.append((a, b))
+
+    stats = _ab_ratio_stats(pairs)
+    _emit("resnet_dp", stats["ratio_median"], "x",
           metric="resnet20_dp_allreduce_vs_paramavg_speedup",
-          allreduce_steps_per_sec=round(sps_allreduce, 3),
-          paramavg_steps_per_sec=round(sps_paramavg, 3),
+          allreduce_steps_per_sec=round(
+              sorted(a for a, _ in pairs)[repeats // 2], 3),
+          paramavg_steps_per_sec=round(
+              sorted(b for _, b in pairs)[repeats // 2], 3),
+          # sync-cadence fields: the regime explains the ratio (a
+          # paramavg that averaged every k>1 steps would do LESS
+          # communication and should win on a chatty virtual-CPU mesh)
+          allreduce_sync_every_steps=1,
+          paramavg_averaging_frequency=averaging_frequency,
           # self-describing artifact: this ratio is measured on the virtual
           # CPU mesh (one real chip available), NOT an ICI measurement
-          mesh=f"virtual-cpu-{n_dev}")
+          mesh=f"virtual-cpu-{n_dev}", **stats)
 
 
 VOCAB_LM = 10000
@@ -733,12 +795,11 @@ def bench_transformer() -> None:
               **extra)
     else:
         # no peak-FLOPs table entry (CPU smoke runs): report raw throughput
-        print(json.dumps({
+        _emit_info({
             "metric": f"transformer_lm_tokens_per_sec_{backend}",
             "value": round(tokens_per_sec, 1), "unit": "tokens/sec",
             "vs_baseline": None,  # no MFU anchor without a peak-FLOPs entry
-            "model_flops_per_token": fields["model_flops_per_token"]}),
-            flush=True)
+            "model_flops_per_token": fields["model_flops_per_token"]})
 
 
 def _chip_context(model_flops_per_sec):
@@ -777,12 +838,12 @@ def _informational_lm_mode(mode, tag_fn, with_chip_context=False):
     if peak and with_chip_context:
         extra.update(_chip_context(
             fields["model_flops_per_token"] * tokens_per_sec))
-    print(json.dumps({
+    _emit_info({
         "metric": f"{tag_fn(d_model, heads)}_{backend}",
         "value": fields["mfu"] if peak else round(tokens_per_sec, 1),
         "unit": "MFU fraction" if peak else "tokens/sec",
         "vs_baseline": None,  # informational: no anchor
-        **extra}), flush=True)
+        **extra})
 
 
 def bench_transformer_d64() -> None:
@@ -810,8 +871,8 @@ def bench_transformer_large() -> None:
         # (its d1024 model-build path IS still covered off-TPU: the
         # compile smoke in tests/test_bench_modes.py traces it at the
         # real dims)
-        print(json.dumps({"metric": "transformer_lm_d1024_mfu",
-                          "skipped": "TPU-only mode"}), flush=True)
+        _emit_info({"metric": "transformer_lm_d1024_mfu",
+                    "skipped": "TPU-only mode"})
         return
     _informational_lm_mode(
         "transformer_large", lambda d, h: f"transformer_lm_d{d}_mfu",
@@ -842,7 +903,7 @@ def bench_transformer_masked() -> None:
     }
     if peak:
         line["mfu_executed"] = fields["mfu_executed"]
-    print(json.dumps(line), flush=True)
+    _emit_info(line)
 
 
 def bench_longcontext() -> None:
@@ -865,7 +926,7 @@ def bench_longcontext() -> None:
         "vs_baseline": None,  # informational: no anchor yet
     }
     line.update(fields)
-    print(json.dumps(line), flush=True)
+    _emit_info(line)
 
 
 def bench_longcontext_chunked() -> None:
@@ -887,8 +948,7 @@ def _chunked_lm_mode(mode, skip_metric, extra_fields=None):
     import jax
 
     if jax.default_backend() != "tpu":
-        print(json.dumps({"metric": skip_metric,
-                          "skipped": "TPU-only mode"}), flush=True)
+        _emit_info({"metric": skip_metric, "skipped": "TPU-only mode"})
         return
     backend = "tpu"
     net, ds, cfg = lm_mode_net_ds(mode)
@@ -905,7 +965,7 @@ def _chunked_lm_mode(mode, skip_metric, extra_fields=None):
     }
     line.update(fields)
     line.update(extra_fields or {})
-    print(json.dumps(line), flush=True)
+    _emit_info(line)
 
 
 def bench_longcontext_chunked_dropout() -> None:
@@ -988,12 +1048,12 @@ def bench_moe() -> None:
               capacity_factor=1.25, **extra)
     else:
         tokens_per_sec = batch * seq / _time_net_steps(net, ds, steps=steps)
-        print(json.dumps({
+        _emit_info({
             "metric": f"transformer_moe_lm_tokens_per_sec_{backend}",
             "value": round(tokens_per_sec, 1),
             "unit": "tokens/sec",
             "vs_baseline": None,  # CPU smoke: no anchor
-            "n_experts": 8, "top_k": 2}), flush=True)
+            "n_experts": 8, "top_k": 2})
 
 
 def bench_transformer_dropout() -> None:
@@ -1018,7 +1078,7 @@ def bench_transformer_dropout() -> None:
         "attention_dropout": cfg["attention_dropout"]}
     if peak:
         line["mfu_executed"] = fields["mfu_executed"]
-    print(json.dumps(line), flush=True)
+    _emit_info(line)
 
 
 def bench_ringhop() -> None:
@@ -1081,13 +1141,13 @@ def bench_ringhop() -> None:
         return flops / per if per > 0 else float("nan")
 
     f_rate, e_rate = rate(flash_hop), rate(einsum_hop)
-    print(json.dumps({
+    _emit_info({
         "metric": f"ring_hop_flash_tflops_{backend}",
         "value": round(f_rate / 1e12, 2), "unit": "TFLOP/s",
         "vs_baseline": None,
         "einsum_hop_tflops": round(e_rate / 1e12, 2),
         "speedup_vs_einsum_hop": round(f_rate / e_rate, 2),
-        "shape": [BH, Tl, D]}), flush=True)
+        "shape": [BH, Tl, D]})
 
 
 MODES = {
@@ -1109,11 +1169,30 @@ MODES = {
 
 
 def _run_all() -> int:
-    """Run each mode in a subprocess (isolated jax platform init)."""
+    """Run each mode in a subprocess (isolated jax platform init).
+
+    The sweep keeps TWO records: stdout metric lines (the driver
+    artifact, tail-truncated to ~2000 bytes) and a shared telemetry
+    JSONL log (`telemetry_bench.jsonl` unless DL4J_TPU_TELEMETRY names
+    another path) that every child appends to — per-mode spans, full
+    stderr/tracebacks of failing modes (VERDICT r5 #1: the
+    transformer_large traceback was unrecoverable from the truncated
+    tail), and every metric line verbatim."""
+    from deeplearning4j_tpu.telemetry import Recorder, set_default
+    from deeplearning4j_tpu.telemetry.artifact import build_summary
+
     rc = 0
     collected = []
+    tpath = os.environ.get("DL4J_TPU_TELEMETRY") or os.path.join(
+        os.path.dirname(os.path.abspath(__file__)), "telemetry_bench.jsonl")
+    with open(tpath, "w"):
+        pass  # fresh log per sweep; children append
+    rec = Recorder(tpath)
+    set_default(rec)
+    rec.meta(role="bench-sweep", modes=list(MODES))
     for mode in MODES:
         env = dict(os.environ)
+        env["DL4J_TPU_TELEMETRY"] = tpath
         if mode == "resnet_dp":
             # the DP-speedup bench needs a multi-device mesh; force the
             # virtual CPU cluster regardless of how many real chips exist
@@ -1122,6 +1201,7 @@ def _run_all() -> int:
                                 + " --xla_force_host_platform_device_count=8")
         out = None
         timed_out = False
+        t_mode = time.perf_counter()
         for attempt in range(3):
             try:
                 attempt_out = subprocess.run(
@@ -1139,12 +1219,19 @@ def _run_all() -> int:
                 break
             if attempt < 2:
                 time.sleep(20)  # let transient contention drain
+        seconds = round(time.perf_counter() - t_mode, 3)
         if out is None:
             print(json.dumps({"metric": mode, "error": "timeout"}), flush=True)
+            rec.error(f"mode:{mode}", error="timeout")
+            rec.event("span", name=f"mode:{mode}", ok=False, seconds=seconds)
             rc = 1
             continue
         if timed_out:  # only reachable after a signal-killed first attempt
             sys.stderr.write(out.stderr[-2000:])
+            rec.error(f"mode:{mode}",
+                      error=f"rc={out.returncode}, retry timeout",
+                      traceback_str=out.stderr)
+            rec.event("span", name=f"mode:{mode}", ok=False, seconds=seconds)
             print(json.dumps({"metric": mode,
                               "error": f"rc={out.returncode}, retry timeout"}),
                   flush=True)
@@ -1154,12 +1241,16 @@ def _run_all() -> int:
             if line.startswith("{"):
                 print(line, flush=True)
                 collected.append(line)
+        rec.event("span", name=f"mode:{mode}", ok=out.returncode == 0,
+                  seconds=seconds, rc=out.returncode)
         if out.returncode != 0:
             sys.stderr.write(out.stderr[-2000:])
-            # the r5 transformer_large crash left only "rc=1" in the
-            # artifact (the driver's tail truncated the stderr echo) —
-            # fold the exception line INTO the json error line so the
-            # cause survives any truncation
+            # the FULL stderr/traceback goes to the telemetry log (the
+            # stdout echo above is still tail-truncated by the driver);
+            # the last exception line is also folded INTO the json error
+            # line so the cause survives any truncation of stdout too
+            rec.error(f"mode:{mode}", error=f"rc={out.returncode}",
+                      traceback_str=out.stderr)
             exc_lines = [l.strip() for l in out.stderr.splitlines()
                          if l.strip()]
             print(json.dumps({"metric": mode,
@@ -1168,28 +1259,16 @@ def _run_all() -> int:
                               else ""}),
                   flush=True)
             rc = 1
-    # compact trailing summary: the driver keeps the END of the captured
-    # stdout, so a long early line can scroll a metric out of the
-    # artifact (r4's tail lost the LeNet line) — this one line re-states
-    # every metric:value pair and the regression count
-    summary = {"metric": "summary", "value": None, "unit": "",
-               "vs_baseline": None, "regressions": 0}
-    for raw in collected:
-        try:
-            line = json.loads(raw)
-        except json.JSONDecodeError:
-            continue
-        if "value" in line:
-            summary[line["metric"]] = line["value"]
-        if line.get("regression"):
-            summary["regressions"] += 1
-        if str(line.get("metric", "")).startswith("transformer_lm_mfu"):
-            # headline fields: the north-star MFU metric, so a parser
-            # taking the LAST line still sees a well-formed metric
-            summary["value"] = line["value"]
-            summary["unit"] = line["unit"]
-            summary["vs_baseline"] = line["vs_baseline"]
+    # gate-carrying trailing summary (telemetry/artifact.py): the driver
+    # keeps the END of the captured stdout, so early lines scroll out of
+    # the artifact (r4 lost the LeNet line; r5 lost five modes' gate
+    # fields — VERDICT r5 #6). This one line restates every metric:value
+    # pair, every gate field under `gates`, and names each regressed
+    # metric; tools/requote_bench.py and tools/benchdiff.py invert it.
+    summary = build_summary(collected)
     print(json.dumps(summary), flush=True)
+    rec.metric(summary)
+    rec.close()
     return rc
 
 
@@ -1199,7 +1278,17 @@ def main() -> int:
         if mode not in MODES:
             sys.stderr.write(f"unknown mode {mode}; one of {list(MODES)}\n")
             return 2
-        MODES[mode]()
+        rec = _recorder()
+        rec.meta(role="bench-mode", mode=mode)
+        try:
+            # a crash inside the span leaves an `error` event with the
+            # FULL traceback in the telemetry log (the truncation-proof
+            # copy) and still propagates — the stderr text and nonzero
+            # rc the parent sweep expects are unchanged
+            with rec.span(f"run:{mode}", mode=mode):
+                MODES[mode]()
+        finally:
+            rec.close()
         return 0
     return _run_all()
 

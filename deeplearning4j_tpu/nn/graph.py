@@ -776,6 +776,9 @@ class ComputationGraph:
             outs = out if isinstance(out, list) else [out]
             ev.eval(mds.labels[0], np.asarray(outs[0]),
                     mask=None if mds.labels_masks is None else mds.labels_masks[0])
+        from deeplearning4j_tpu.telemetry import get_default as _telemetry
+
+        _telemetry().eval(ev, top_n=top_n)  # no-op unless telemetry is on
         return ev
 
     # ------------------------------------------------- streaming RNN inference

@@ -596,6 +596,9 @@ class MultiLayerNetwork:
             ds = it.next()
             out = self.output(ds.features)
             ev.eval(ds.labels, np.asarray(out), mask=ds.labels_mask)
+        from deeplearning4j_tpu.telemetry import get_default as _telemetry
+
+        _telemetry().eval(ev, top_n=top_n)  # no-op unless telemetry is on
         return ev
 
     # ------------------------------------------------- streaming RNN inference

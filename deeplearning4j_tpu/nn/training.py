@@ -184,11 +184,22 @@ def fused_fit(net, batches, epochs):
             "fit_scanned needs uniform batch shapes — drop or pad the "
             "ragged tail batch, or use fit()")
     stacked = stack_batches(batches)
-    if net._scan_fit is None:
+    first_dispatch = net._scan_fit is None
+    if first_dispatch:
         net._scan_fit = make_scanned_fit(net._get_train_step())
-    net.params, net.opt_state, net.state, losses = net._scan_fit(
-        net.params, net.opt_state, net.state, net._next_rng(), stacked,
-        n_epochs=epochs)
+    # telemetry span around the scan dispatch: the FIRST dispatch blocks
+    # on trace+compile (the "compile" span — the wall-clock XProf can't
+    # cheaply give); later dispatches enqueue asynchronously, so their
+    # "step_scan" span measures dispatch, not execution. A NullRecorder
+    # (telemetry disabled — the default) makes this a no-op.
+    from deeplearning4j_tpu.telemetry import get_default as _telemetry
+
+    with _telemetry().span("compile" if first_dispatch else "step_scan",
+                           what="fit_scanned", epochs=epochs,
+                           n_batches=len(batches)):
+        net.params, net.opt_state, net.state, losses = net._scan_fit(
+            net.params, net.opt_state, net.state, net._next_rng(), stacked,
+            n_epochs=epochs)
     per_epoch = losses.mean(axis=1)
     nb = len(batches)
     if net.listeners:

@@ -7,3 +7,12 @@ from deeplearning4j_tpu.optimize.listeners import (  # noqa: F401
     PerformanceListener,
     ScoreIterationListener,
 )
+# the run-telemetry feed is a listener like the rest — importable from
+# here alongside them. It lives in telemetry/ with the recorder it
+# feeds and resolves lazily (telemetry.listener imports THIS package
+# for IterationListener; an eager import here would be circular).
+def __getattr__(name):
+    if name == "TelemetryListener":
+        from deeplearning4j_tpu.telemetry.listener import TelemetryListener
+        return TelemetryListener
+    raise AttributeError(name)

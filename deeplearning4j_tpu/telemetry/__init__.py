@@ -1,0 +1,22 @@
+"""Run telemetry: typed JSONL event recording for training and bench.
+
+`recorder` (Recorder/span API, process default) and `artifact` (bench
+summary/parsing) are stdlib-only and import eagerly; `TelemetryListener`
+pulls in the listener protocol and resolves lazily so the tools' no-jax
+package stubs can import this package.
+"""
+
+from deeplearning4j_tpu.telemetry.recorder import (  # noqa: F401
+    ENV_VAR,
+    NullRecorder,
+    Recorder,
+    get_default,
+    set_default,
+)
+
+
+def __getattr__(name):
+    if name == "TelemetryListener":
+        from deeplearning4j_tpu.telemetry.listener import TelemetryListener
+        return TelemetryListener
+    raise AttributeError(name)

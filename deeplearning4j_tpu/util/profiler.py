@@ -1,19 +1,24 @@
 """Profiling helpers (SURVEY.md §5: the reference has no tracing at all;
-the TPU build gets jax.profiler traces + the per-step PerformanceListener).
+the TPU build gets jax.profiler traces + the per-step PerformanceListener
++ the run-telemetry recorder in deeplearning4j_tpu/telemetry/).
 
 `trace(logdir)` wraps a training region in a jax.profiler trace whose
 output loads in TensorBoard/XProf (op-level TPU timelines, HBM usage);
 `ProfilerIterationListener` starts the trace at a given iteration and
 stops it N iterations later, so users profile a steady-state window of
-`fit()` without modifying their loop.
+`fit()` without modifying their loop. Both leave a `span` event named
+`profiler_trace` in the run-telemetry log (a NullRecorder no-op unless
+telemetry is enabled), so the coarse wall-clock of each profiled window
+survives even when nobody opens the XProf dump.
 """
 
 from __future__ import annotations
 
 import contextlib
-from typing import Optional
+import time
 
 from deeplearning4j_tpu.optimize.listeners import IterationListener
+from deeplearning4j_tpu.telemetry.recorder import get_default
 
 
 @contextlib.contextmanager
@@ -22,10 +27,14 @@ def trace(logdir: str):
     import jax
 
     jax.profiler.start_trace(logdir)
+    t0 = time.perf_counter()
     try:
         yield logdir
     finally:
         jax.profiler.stop_trace()
+        get_default().event(
+            "span", name="profiler_trace", logdir=logdir,
+            seconds=round(time.perf_counter() - t0, 6))
 
 
 class ProfilerIterationListener(IterationListener):
@@ -33,10 +42,11 @@ class ProfilerIterationListener(IterationListener):
     start_iteration + n_iterations)."""
 
     def __init__(self, logdir: str, start_iteration: int = 10,
-                 n_iterations: int = 5):
+                 n_iterations: int = 5, recorder=None):
         self.logdir = logdir
         self.start_iteration = start_iteration
         self.n_iterations = n_iterations
+        self.recorder = recorder
         self._active = False
         self.done = False
 
@@ -47,6 +57,8 @@ class ProfilerIterationListener(IterationListener):
                 and iteration >= self.start_iteration):
             jax.profiler.start_trace(self.logdir)
             self._active = True
+            self._t0 = time.perf_counter()
+            self._start_iter = iteration
             self._stop_at = iteration + self.n_iterations
         elif self._active and iteration >= self._stop_at:
             self.close()
@@ -61,6 +73,11 @@ class ProfilerIterationListener(IterationListener):
             jax.profiler.stop_trace()
             self._active = False
             self.done = True
+            rec = self.recorder if self.recorder is not None \
+                else get_default()
+            rec.event("span", name="profiler_trace", logdir=self.logdir,
+                      start_iteration=self._start_iter,
+                      seconds=round(time.perf_counter() - self._t0, 6))
 
     def __del__(self):  # best-effort flush
         try:

@@ -1,0 +1,169 @@
+"""tools/benchdiff.py tests — the cross-round regression detector the
+acceptance criterion names: `benchdiff BENCH_r04.json BENCH_r05.json`
+must name each changed metric with old/new/delta and exit non-zero on a
+regression, including when one side is a tail-truncated artifact whose
+rows only exist via the summary line."""
+
+import importlib.util
+import json
+import os
+import sys
+
+import pytest
+
+from deeplearning4j_tpu.telemetry import Recorder
+from deeplearning4j_tpu.telemetry.artifact import build_summary
+
+pytestmark = pytest.mark.telemetry
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+spec = importlib.util.spec_from_file_location(
+    "benchdiff", os.path.join(ROOT, "tools", "benchdiff.py"))
+benchdiff = importlib.util.module_from_spec(spec)
+sys.modules.setdefault("benchdiff", benchdiff)
+spec.loader.exec_module(benchdiff)
+
+
+def _lines(**metrics):
+    return {m: dict(line, metric=m) for m, line in metrics.items()}
+
+
+def test_value_drop_past_threshold_is_named_with_old_new_delta():
+    old = _lines(tps={"value": 100.0})
+    new = _lines(tps={"value": 80.0})
+    result = benchdiff.diff(old, new, threshold=0.1)
+    (row,) = result["regressions"]
+    assert row["metric"] == "tps" and row["field"] == "value"
+    assert row["old"] == 100.0 and row["new"] == 80.0
+    assert row["delta_pct"] == -20.0
+    assert "fell 20.0%" in row["reason"]
+
+
+def test_small_drop_and_improvement_are_changes_not_regressions():
+    old = _lines(a={"value": 100.0}, b={"value": 50.0})
+    new = _lines(a={"value": 95.0}, b={"value": 70.0})
+    result = benchdiff.diff(old, new, threshold=0.1)
+    assert result["regressions"] == []
+    deltas = {r["metric"]: r["delta_pct"] for r in result["changes"]}
+    assert deltas == {"a": -5.0, "b": 40.0}
+
+
+def test_gate_scale_grants_chip_state_slack():
+    """A 15% drop measured on a window the probe read at 0.8x healthy is
+    chip state, not code — bench.py's own gate philosophy."""
+    old = _lines(tps={"value": 100.0})
+    new = _lines(tps={"value": 85.0, "gate_scale": 0.8})
+    assert benchdiff.diff(old, new, threshold=0.1)["regressions"] == []
+    # without the gate_scale field the same drop regresses
+    new_plain = _lines(tps={"value": 85.0})
+    assert benchdiff.diff(old, new_plain, threshold=0.1)["regressions"]
+
+
+def test_new_regression_flag_trips_even_with_stable_value():
+    old = _lines(vgg={"value": 100.0})
+    new = _lines(vgg={"value": 99.0, "regression": True})
+    (row,) = benchdiff.diff(old, new)["regressions"]
+    assert row["field"] == "regression" and "newly set" in row["reason"]
+
+
+def test_quality_ratio_falling_below_its_floor_trips():
+    old = _lines(w2v={"value": 800e3, "quality_ratio_vs_host": 0.98,
+                      "quality_gate_min_ratio": 0.95})
+    new = _lines(w2v={"value": 900e3, "quality_ratio_vs_host": 0.90,
+                      "quality_gate_min_ratio": 0.95})
+    rows = benchdiff.diff(old, new)["regressions"]
+    assert any(r["field"] == "quality_ratio_vs_host"
+               and "below its" in r["reason"] for r in rows)
+
+
+def test_added_and_removed_metrics_are_listed():
+    result = benchdiff.diff(_lines(gone={"value": 1.0}),
+                            _lines(fresh={"value": 2.0}))
+    assert result["added"] == ["fresh"] and result["removed"] == ["gone"]
+
+
+def test_main_exit_codes_and_render(tmp_path, capsys):
+    old = tmp_path / "old.json"
+    new = tmp_path / "new.json"
+    old.write_text(json.dumps({"metric": "tps", "value": 100.0}) + "\n")
+    new.write_text(json.dumps({"metric": "tps", "value": 50.0}) + "\n")
+    assert benchdiff.main([str(old), str(new)]) == 1
+    out = capsys.readouterr().out
+    assert "REGRESSED tps.value: 100.0 -> 50.0 (-50.0%)" in out
+    # same artifact on both sides: clean exit
+    assert benchdiff.main([str(old), str(old)]) == 0
+    capsys.readouterr()
+
+
+def test_main_json_output_is_machine_readable(tmp_path, capsys):
+    old = tmp_path / "old.json"
+    new = tmp_path / "new.json"
+    old.write_text(json.dumps({"metric": "tps", "value": 100.0}) + "\n")
+    new.write_text(json.dumps({"metric": "tps", "value": 50.0}) + "\n")
+    assert benchdiff.main([str(old), str(new), "--json"]) == 1
+    parsed = json.loads(capsys.readouterr().out)
+    assert parsed["regressions"][0]["metric"] == "tps"
+
+
+def test_missing_file_is_a_usage_error(tmp_path, capsys):
+    some = tmp_path / "some.json"
+    some.write_text("{}")
+    assert benchdiff.main([str(some), str(tmp_path / "absent.json")]) == 2
+
+
+def test_diff_works_on_a_tail_truncated_artifact(tmp_path):
+    """The round-trip half benchdiff owns: the NEW side is only a
+    2000-byte tail whose rows come back via the summary line's gates —
+    the DP regression and the MoE ratio must still be diffable."""
+    old_lines = [
+        {"metric": "resnet20_dp_allreduce_vs_paramavg_speedup",
+         "value": 1.2067, "unit": "x", "vs_baseline": 1.2067},
+        {"metric": "moe_tps", "value": 1.0e6, "unit": "tokens/sec",
+         "vs_baseline": 1.0, "vs_dense_ratio": 0.78, "ratio_floor": 0.65},
+    ]
+    new_lines = [
+        {"metric": "resnet20_dp_allreduce_vs_paramavg_speedup",
+         "value": 0.9597, "unit": "x", "vs_baseline": 0.9597},
+        {"metric": "moe_tps", "value": 1.1e6, "unit": "tokens/sec",
+         "vs_baseline": 1.1, "vs_dense_ratio": 0.60, "ratio_floor": 0.65},
+    ]
+
+    def artifact_path(name, lines):
+        pad = json.dumps({"metric": "noise", "value": 0,
+                          "filler": "x" * 1500})
+        text = "\n".join([pad] + [json.dumps(l) for l in lines]
+                         + [json.dumps(build_summary(lines))]) + "\n"
+        path = tmp_path / name
+        path.write_text(text[-2000:])
+        return str(path)
+
+    rc = benchdiff.main([artifact_path("old.json", old_lines),
+                         artifact_path("new.json", new_lines)])
+    assert rc == 1
+
+
+def test_diff_reads_telemetry_jsonl_logs(tmp_path):
+    """A telemetry log is a first-class artifact: metric events diff
+    exactly like bench stdout lines."""
+    old = Recorder(str(tmp_path / "old.jsonl"))
+    old.meta(role="bench")
+    old.metric({"metric": "tps", "value": 100.0})
+    old.close()
+    new = Recorder(str(tmp_path / "new.jsonl"))
+    new.metric({"metric": "tps", "value": 80.0})
+    new.error("mode:tps", error="noise event, must be ignored")
+    new.close()
+    assert benchdiff.main([old.path, new.path]) == 1
+
+
+def test_committed_r04_vs_r05_names_the_dp_regression(capsys):
+    """The acceptance-criterion invocation, against the real committed
+    artifacts: r05's DP-speedup flip below parity (VERDICT r5 #2) is
+    named with old/new/delta and exits non-zero."""
+    rc = benchdiff.main([os.path.join(ROOT, "BENCH_r04.json"),
+                         os.path.join(ROOT, "BENCH_r05.json")])
+    out = capsys.readouterr().out
+    assert rc == 1
+    assert ("REGRESSED resnet20_dp_allreduce_vs_paramavg_speedup.value: "
+            "1.2067 -> 0.9597 (-20.5%)") in out

@@ -1,0 +1,103 @@
+"""util/profiler.py tests — previously untested: the steady-state
+window start/stop arithmetic, and above all the close()/unstopped-trace
+path (an unstopped jax.profiler trace is lost AND leaves the
+process-global profiler started, so every later trace in the process
+fails). jax.profiler is faked so no real trace runs."""
+
+import pytest
+
+from deeplearning4j_tpu.telemetry import Recorder
+from deeplearning4j_tpu.util.profiler import ProfilerIterationListener, trace
+
+pytestmark = pytest.mark.telemetry
+
+
+class _FakeProfiler:
+    def __init__(self):
+        self.calls = []
+
+    def start_trace(self, logdir):
+        self.calls.append(("start", logdir))
+
+    def stop_trace(self):
+        self.calls.append(("stop",))
+
+
+@pytest.fixture
+def fake_profiler(monkeypatch):
+    import jax
+
+    fake = _FakeProfiler()
+    monkeypatch.setattr(jax.profiler, "start_trace", fake.start_trace)
+    monkeypatch.setattr(jax.profiler, "stop_trace", fake.stop_trace)
+    return fake
+
+
+def test_listener_traces_exactly_the_window(fake_profiler):
+    rec = Recorder()
+    lst = ProfilerIterationListener("/tmp/prof", start_iteration=2,
+                                    n_iterations=3, recorder=rec)
+    for it in range(8):
+        lst.iteration_done(None, it)
+    assert fake_profiler.calls == [("start", "/tmp/prof"), ("stop",)]
+    assert lst.done and not lst._active
+    (span,) = rec.events
+    assert span["event"] == "span" and span["name"] == "profiler_trace"
+    assert span["start_iteration"] == 2 and span["seconds"] >= 0
+    # done: the window never restarts
+    for it in range(8, 16):
+        lst.iteration_done(None, it)
+    assert len(fake_profiler.calls) == 2
+
+
+def test_close_flushes_an_unstopped_trace(fake_profiler):
+    """fit() ends INSIDE the window: without close() the process-global
+    profiler stays started — the exact leak the docstring warns about."""
+    rec = Recorder()
+    lst = ProfilerIterationListener("/tmp/prof", start_iteration=1,
+                                    n_iterations=100, recorder=rec)
+    for it in range(3):
+        lst.iteration_done(None, it)
+    assert fake_profiler.calls == [("start", "/tmp/prof")]
+    assert lst._active and not lst.done
+    lst.close()
+    assert fake_profiler.calls[-1] == ("stop",)
+    assert lst.done and not lst._active
+    assert rec.events[-1]["name"] == "profiler_trace"
+    # idempotent: a second close must NOT stop an already-stopped trace
+    lst.close()
+    assert fake_profiler.calls.count(("stop",)) == 1
+
+
+def test_close_is_a_noop_before_the_window_opens(fake_profiler):
+    lst = ProfilerIterationListener("/tmp/prof", start_iteration=10)
+    lst.iteration_done(None, 1)
+    lst.close()
+    assert fake_profiler.calls == []
+    assert not lst.done  # close() before start leaves the window armed
+
+
+def test_del_flushes_best_effort(fake_profiler):
+    lst = ProfilerIterationListener("/tmp/prof", start_iteration=0,
+                                    n_iterations=100, recorder=Recorder())
+    lst.iteration_done(None, 0)
+    assert fake_profiler.calls == [("start", "/tmp/prof")]
+    lst.__del__()
+    assert fake_profiler.calls[-1] == ("stop",)
+
+
+def test_trace_context_manager_stops_on_exception(fake_profiler):
+    from deeplearning4j_tpu.telemetry import set_default
+
+    rec = Recorder()
+    prev = set_default(rec)
+    try:
+        with pytest.raises(RuntimeError):
+            with trace("/tmp/prof"):
+                assert fake_profiler.calls == [("start", "/tmp/prof")]
+                raise RuntimeError("mid-trace")
+    finally:
+        set_default(prev)
+    assert fake_profiler.calls[-1] == ("stop",)
+    (span,) = rec.events
+    assert span["name"] == "profiler_trace" and span["logdir"] == "/tmp/prof"

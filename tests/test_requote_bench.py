@@ -111,6 +111,41 @@ def test_splice_refuses_doc_without_markers(tmp_path):
         requote.splice(str(doc), "FRESH")
 
 
+def test_load_recovers_gate_fields_from_summary(tmp_path):
+    """r6: the summary line carries `gates` + `regressed_metrics`
+    (VERDICT r5 #6) — a tail that kept only the summary still yields
+    rows with every gate decision on them."""
+    summary = {"metric": "summary", "value": 0.5, "regressions": 1,
+               "regressed_metrics": ["vgg16_cifar_images_per_sec_tpu"],
+               "vgg16_cifar_images_per_sec_tpu": 56436.5,
+               "word2vec_sgns_words_per_sec": 850493.5,
+               "gates": {
+                   "word2vec_sgns_words_per_sec": {
+                       "quality_ratio_vs_host": 0.977,
+                       "quality_gate_min_ratio": 0.95},
+                   "vgg16_cifar_images_per_sec_tpu": {
+                       "gate_scale": 0.93, "regression": True}}}
+    art = _write(tmp_path, "b.json", json.dumps(summary))
+    lines = requote.load(art)
+    w2v = lines["word2vec_sgns_words_per_sec"]
+    assert w2v["value"] == 850493.5 and w2v["from_summary"]
+    assert w2v["quality_ratio_vs_host"] == 0.977
+    vgg = lines["vgg16_cifar_images_per_sec_tpu"]
+    assert vgg["regression"] is True and vgg["gate_scale"] == 0.93
+    # bookkeeping containers never become metric rows
+    assert "gates" not in lines and "regressed_metrics" not in lines
+
+
+def test_gate_fields_never_override_a_surviving_line(tmp_path):
+    art = _write(tmp_path, "b.json", "\n".join([
+        json.dumps({"metric": "m", "value": 1.0, "gate_scale": 0.5}),
+        json.dumps({"metric": "summary", "m": 9.0,
+                    "gates": {"m": {"gate_scale": 0.9}}}),
+    ]))
+    line = requote.load(art)["m"]
+    assert line["value"] == 1.0 and line["gate_scale"] == 0.5
+
+
 def test_mfu_str_labels_conventions():
     with_exec = requote._mfu_str({"value": 0.31, "mfu_executed": 0.62})
     assert "0.310 MFU" in with_exec and "0.620" in with_exec

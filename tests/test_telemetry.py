@@ -1,0 +1,426 @@
+"""Run-telemetry subsystem tests (deeplearning4j_tpu/telemetry/):
+Recorder JSONL events + span API, the process-global default, the
+no-host-sync TelemetryListener, and the truncation-proof summary line —
+including the round-trip the acceptance criterion names: build a full
+artifact, cut it to the driver's 2000-byte tail, and recover every gate
+decision from the surviving summary line."""
+
+import json
+
+import pytest
+
+from deeplearning4j_tpu.telemetry import (
+    NullRecorder,
+    Recorder,
+    TelemetryListener,
+    get_default,
+    set_default,
+)
+from deeplearning4j_tpu.telemetry import artifact, recorder as recorder_mod
+
+pytestmark = pytest.mark.telemetry
+
+
+# ---------------------------------------------------------------- recorder
+
+def _read_jsonl(path):
+    with open(path) as fh:
+        return [json.loads(line) for line in fh if line.strip()]
+
+
+def test_recorder_appends_typed_jsonl_events(tmp_path):
+    path = str(tmp_path / "run.jsonl")
+    rec = Recorder(path)
+    rec.meta(role="test")
+    rec.step(3, score=0.5, iterations_per_sec=10.0)
+    rec.metric({"metric": "m", "value": 1.0})
+    rec.close()
+    events = _read_jsonl(path)
+    assert [e["event"] for e in events] == ["meta", "step", "metric"]
+    # envelope: every event carries ts/run/seq; seq is monotonic
+    for i, e in enumerate(events):
+        assert e["run"] == rec.run_id and e["seq"] == i and e["ts"] > 0
+    assert events[1]["iteration"] == 3 and events[1]["score"] == 0.5
+    assert events[2]["metric"] == "m"
+
+
+def test_recorder_appends_across_instances_like_subprocesses(tmp_path):
+    """bench children share one log via append — two Recorder instances
+    on the same path interleave whole lines, not clobber."""
+    path = str(tmp_path / "run.jsonl")
+    a, b = Recorder(path), Recorder(path)
+    a.event("x")
+    b.event("y")
+    a.event("z")
+    a.close(), b.close()
+    assert [e["event"] for e in _read_jsonl(path)] == ["x", "y", "z"]
+
+
+def test_span_records_wall_clock_and_result_fields(tmp_path):
+    rec = Recorder(str(tmp_path / "run.jsonl"))
+    with rec.span("compile", mode="lenet") as sp:
+        sp["n_ops"] = 7
+    rec.close()
+    (event,) = _read_jsonl(rec.path)
+    assert event["event"] == "span" and event["name"] == "compile"
+    assert event["ok"] is True and event["seconds"] >= 0
+    assert event["mode"] == "lenet" and event["n_ops"] == 7
+
+
+def test_span_on_exception_emits_error_with_full_traceback(tmp_path):
+    rec = Recorder(str(tmp_path / "run.jsonl"))
+    with pytest.raises(ValueError, match="boom"):
+        with rec.span("step"):
+            raise ValueError("boom")
+    rec.close()
+    err, span = _read_jsonl(rec.path)
+    assert err["event"] == "error" and err["where"] == "span:step"
+    # the FULL traceback string — the thing the driver tail destroys
+    assert "Traceback (most recent call last)" in err["traceback"]
+    assert "ValueError: boom" in err["traceback"]
+    assert span["event"] == "span" and span["ok"] is False
+
+
+def test_error_event_from_exception_object():
+    rec = Recorder()
+    try:
+        raise RuntimeError("kaput")
+    except RuntimeError as exc:
+        rec.error("mode:vgg16", exc=exc)
+    (event,) = rec.events
+    assert event["error"] == "RuntimeError('kaput')"
+    assert "RuntimeError: kaput" in event["traceback"]
+
+
+def test_memory_snapshot_counts_live_arrays():
+    import jax.numpy as jnp
+
+    keep = jnp.ones((128, 128), jnp.float32)  # noqa: F841 — held live
+    rec = Recorder()
+    event = rec.memory()
+    assert event["live_array_bytes"] >= keep.nbytes
+    assert event["live_array_count"] >= 1
+
+
+def test_metric_event_parses_as_a_bench_line():
+    """Telemetry logs and bench stdout share one parser: a `metric`
+    event IS the bench line (flattened), and non-metric events are
+    invisible to the artifact parser."""
+    rec = Recorder()
+    rec.meta(role="x")
+    rec.metric({"metric": "lenet", "value": 2.0, "vs_baseline": 1.1})
+    rec.step(1, score=0.1)
+    text = "\n".join(json.dumps(e) for e in rec.events)
+    lines, summary = artifact.parse_metric_lines(text)
+    assert summary is None
+    assert set(lines) == {"lenet"} and lines["lenet"]["value"] == 2.0
+
+
+def test_default_recorder_is_null_until_configured(monkeypatch):
+    monkeypatch.delenv(recorder_mod.ENV_VAR, raising=False)
+    prev = set_default(None)
+    try:
+        rec = get_default()
+        assert isinstance(rec, NullRecorder)
+        assert rec.event("step") == {} and not rec.events
+        with rec.span("s") as sp:  # span still runs the body
+            sp["ran"] = True
+        assert sp["ran"]
+    finally:
+        set_default(prev)
+
+
+def test_default_recorder_from_env_var(tmp_path, monkeypatch):
+    path = str(tmp_path / "env.jsonl")
+    monkeypatch.setenv(recorder_mod.ENV_VAR, path)
+    prev = set_default(None)
+    try:
+        rec = get_default()
+        assert get_default() is rec  # stable across calls
+        rec.event("ping")
+        rec.close()
+        assert _read_jsonl(path)[0]["event"] == "ping"
+    finally:
+        set_default(prev)
+
+
+# ---------------------------------------------------------------- listener
+
+class _DeviceScalar:
+    """Stand-in for the jitted step's device scalar: float() is the host
+    sync the listener must defer to flush time."""
+
+    def __init__(self, value, sync_log):
+        self.value, self.sync_log = value, sync_log
+
+    def __float__(self):
+        self.sync_log.append(self.value)
+        return self.value
+
+
+class _Model:
+    def __init__(self):
+        self._score_raw = None
+
+
+def test_listener_defers_host_sync_to_window_flush():
+    syncs = []
+    model = _Model()
+    rec = Recorder()
+    lst = TelemetryListener(recorder=rec, frequency=3)
+    for it in range(1, 3):
+        model._score_raw = _DeviceScalar(0.1 * it, syncs)
+        lst.iteration_done(model, it)
+        assert syncs == []  # no host sync on the hot path
+    model._score_raw = _DeviceScalar(0.3, syncs)
+    lst.iteration_done(model, 3)  # window full -> one batched fetch
+    assert len(syncs) == 3
+    steps = [e for e in rec.events if e["event"] == "step"]
+    assert [e["iteration"] for e in steps] == [1, 2, 3]
+    assert steps[0]["score"] == pytest.approx(0.1)
+    # throughput over the window rides the LAST event only
+    assert "iterations_per_sec" in steps[-1]
+    assert all("iterations_per_sec" not in e for e in steps[:-1])
+
+
+def test_listener_close_flushes_partial_window():
+    model = _Model()
+    model._score_raw = 0.5
+    rec = Recorder()
+    lst = TelemetryListener(recorder=rec, frequency=100)
+    lst.iteration_done(model, 1)
+    assert not rec.events
+    lst.close()
+    (event,) = rec.events
+    assert event["iteration"] == 1 and event["score"] == 0.5
+    lst.close()  # idempotent
+    assert len(rec.events) == 1
+
+
+def test_listener_rides_fit(tmp_path):
+    """End-to-end through the real fit() loop: scores land as step
+    events without touching model.score_value's eager float path."""
+    import numpy as np
+
+    from deeplearning4j_tpu import MultiLayerNetwork, NeuralNetConfiguration
+    from deeplearning4j_tpu.nn.conf.layers import OutputLayer
+
+    conf = (NeuralNetConfiguration.builder()
+            .seed(7).learning_rate(0.1)
+            .list()
+            .layer(OutputLayer(n_in=4, n_out=3, activation="softmax"))
+            .build())
+    net = MultiLayerNetwork(conf).init()
+    rec = Recorder()
+    lst = TelemetryListener(recorder=rec, frequency=4)
+    net.set_listeners(lst)
+    rng = np.random.default_rng(0)
+    x = rng.random((8, 4)).astype(np.float32)
+    y = np.eye(3, dtype=np.float32)[rng.integers(0, 3, 8)]
+    net.fit(x, y, epochs=6)
+    lst.close()
+    steps = [e for e in rec.events if e["event"] == "step"]
+    assert [e["iteration"] for e in steps] == list(range(1, 7))
+    assert all(isinstance(e["score"], float) for e in steps)
+
+
+# ------------------------------------------------- summary / truncation
+
+GATED_LINES = [
+    {"metric": "lenet_mnist_images_per_sec_tpu", "value": 2043143.5,
+     "unit": "images/sec/chip", "vs_baseline": 1.2,
+     "gate_scale": 0.96, "attempts": [{"value": 1.9e6}, {"value": 2.04e6}]},
+    {"metric": "vgg16_cifar_images_per_sec_tpu", "value": 56436.5,
+     "unit": "images/sec/chip", "vs_baseline": 0.705,
+     "gate_scale": 0.93, "regression": True},
+    {"metric": "word2vec_sgns_words_per_sec", "value": 850493.5,
+     "unit": "words/sec", "vs_baseline": 1.06,
+     "quality_ratio_vs_host": 0.977, "quality_gate_min_ratio": 0.95},
+    {"metric": "resnet20_dp_allreduce_vs_paramavg_speedup",
+     "value": 1.09, "unit": "x", "vs_baseline": 1.09,
+     "ratio_median": 1.09, "ratio_spread": [1.02, 1.21],
+     "paramavg_averaging_frequency": 1},
+    {"metric": "transformer_lm_mfu_tpu", "value": 0.5113,
+     "unit": "MFU fraction", "vs_baseline": 1.7042,
+     "mfu_vs_achievable": 0.57, "mfu_executed": 0.4489},
+    {"metric": "transformer_moe_lm_tokens_per_sec_tpu", "value": 1459666.3,
+     "unit": "tokens/sec", "vs_baseline": 1.16,
+     "vs_dense_ratio": 0.7894, "ratio_floor": 0.65},
+]
+
+
+def _artifact_text(lines):
+    """A bench-stdout-shaped artifact: verbose detail lines (each
+    followed by the stderr-echo noise a real run interleaves — what
+    pushes early lines past the driver's tail), then the summary line
+    LAST (what survives)."""
+    rows = []
+    for i, l in enumerate(lines):
+        rows.append(json.dumps(l))
+        rows.append(f"REGRESSION-echo-noise-{i}: " + "x" * 500)
+    rows.append(json.dumps(artifact.build_summary(lines)))
+    return "\n".join(rows) + "\n"
+
+
+def test_build_summary_carries_every_gate_field():
+    summary = artifact.build_summary(GATED_LINES)
+    assert summary["regressions"] == 1
+    assert summary["regressed_metrics"] == [
+        "vgg16_cifar_images_per_sec_tpu"]
+    gates = summary["gates"]
+    assert gates["word2vec_sgns_words_per_sec"][
+        "quality_ratio_vs_host"] == 0.977
+    assert gates["transformer_moe_lm_tokens_per_sec_tpu"][
+        "vs_dense_ratio"] == 0.7894
+    assert gates["transformer_lm_mfu_tpu"]["mfu_vs_achievable"] == 0.57
+    assert gates["vgg16_cifar_images_per_sec_tpu"]["regression"] is True
+    assert gates["resnet20_dp_allreduce_vs_paramavg_speedup"][
+        "ratio_spread"] == [1.02, 1.21]
+    # headline = the north-star MFU metric
+    assert summary["value"] == 0.5113 and summary["vs_baseline"] == 1.7042
+    # the whole line must FIT in the driver's 2000-byte tail
+    assert len(json.dumps(summary)) < 1900
+
+
+def test_gate_decisions_survive_2000_byte_tail_cut(tmp_path):
+    """The acceptance round-trip: full artifact -> keep only the last
+    2000 bytes (the driver's truncation) -> every gate field of every
+    metric is still recoverable."""
+    text = _artifact_text(GATED_LINES)
+    tail = text[-2000:]
+    # the cut really destroyed the detail lines (not a vacuous test)
+    kept_lines, _ = artifact.parse_metric_lines(tail)
+    assert len(kept_lines) < len(GATED_LINES)
+    path = tmp_path / "BENCH_cut.json"
+    path.write_text(tail)
+    recovered = artifact.load(str(path))
+    for line in GATED_LINES:
+        row = recovered[line["metric"]]
+        assert row["value"] == line["value"]
+        for field in artifact.GATE_FIELDS:
+            if field in line:
+                assert row[field] == line[field], (line["metric"], field)
+        if line.get("regression"):
+            assert row["regression"] is True
+
+
+def test_merge_summary_never_overrides_surviving_rows():
+    lines = {"m": {"metric": "m", "value": 1.0, "gate_scale": 0.5}}
+    summary = {"metric": "summary", "m": 9.0,
+               "gates": {"m": {"gate_scale": 0.9}},
+               "regressed_metrics": []}
+    merged = artifact.merge_summary(lines, summary)
+    assert merged["m"]["value"] == 1.0 and merged["m"]["gate_scale"] == 0.5
+
+
+def test_ab_ratio_stats_median_and_spread():
+    import bench
+
+    stats = bench._ab_ratio_stats([(2.0, 1.0), (1.0, 1.0), (3.0, 1.0)])
+    assert stats["ratio_median"] == 2.0
+    assert stats["ratio_spread"] == [1.0, 3.0]
+    assert stats["repeats"] == 3
+    # even count -> midpoint of the two middle ratios
+    even = bench._ab_ratio_stats([(1.0, 1.0), (2.0, 1.0)])
+    assert even["ratio_median"] == 1.5
+
+
+def test_bench_mode_crash_leaves_full_traceback_in_telemetry(monkeypatch):
+    """Satellite of VERDICT r5 #1: a mode that dies under capture leaves
+    an `error` event with the FULL traceback in the telemetry log — the
+    r5 transformer_large crash was unrecoverable from the stdout tail."""
+    import sys as _sys
+
+    import bench
+
+    rec = Recorder()
+    prev = set_default(rec)
+
+    def boom():
+        raise RuntimeError("driver-capture crash")
+
+    monkeypatch.setitem(bench.MODES, "boom", boom)
+    monkeypatch.setattr(_sys, "argv", ["bench.py", "boom"])
+    try:
+        with pytest.raises(RuntimeError, match="driver-capture crash"):
+            bench.main()
+    finally:
+        set_default(prev)
+    (err,) = [e for e in rec.events if e["event"] == "error"]
+    assert "RuntimeError: driver-capture crash" in err["traceback"]
+    assert "in boom" in err["traceback"]  # full frames, not just the tail
+    spans = [e for e in rec.events if e["event"] == "span"]
+    assert spans and spans[-1]["ok"] is False
+
+
+def test_bench_emit_records_metric_event(capsys):
+    import bench
+
+    rec = Recorder()
+    prev = set_default(rec)
+    try:
+        bench._emit("lenet", 2.0e6, "images/sec/chip")
+    finally:
+        set_default(prev)
+    printed = json.loads(capsys.readouterr().out.strip())
+    (event,) = [e for e in rec.events if e["event"] == "metric"]
+    assert event["metric"] == printed["metric"] == "lenet"
+    assert event["value"] == printed["value"]
+
+
+def test_evaluate_records_eval_event():
+    """Both containers' evaluate() feed an `eval` event with the scalar
+    summary stats (a NullRecorder no-op when telemetry is off)."""
+    import numpy as np
+
+    from deeplearning4j_tpu import MultiLayerNetwork, NeuralNetConfiguration
+    from deeplearning4j_tpu.datasets.api import DataSet
+    from deeplearning4j_tpu.nn.conf.layers import OutputLayer
+
+    conf = (NeuralNetConfiguration.builder()
+            .seed(7).learning_rate(0.1)
+            .list()
+            .layer(OutputLayer(n_in=4, n_out=3, activation="softmax"))
+            .build())
+    net = MultiLayerNetwork(conf).init()
+    rng = np.random.default_rng(0)
+    x = rng.random((8, 4)).astype(np.float32)
+    y = np.eye(3, dtype=np.float32)[rng.integers(0, 3, 8)]
+    rec = Recorder()
+    prev = set_default(rec)
+    try:
+        ev = net.evaluate(DataSet(x, y))
+    finally:
+        set_default(prev)
+    (event,) = [e for e in rec.events if e["event"] == "eval"]
+    assert event["stats"]["accuracy"] == pytest.approx(ev.accuracy())
+    assert set(event["stats"]) >= {"accuracy", "precision", "recall", "f1"}
+
+
+def test_fused_fit_emits_compile_then_step_spans():
+    """nn/training.py threads a span around the scanned-fit dispatch:
+    first call = "compile" (blocks on trace+compile), later = step_scan."""
+    import numpy as np
+
+    from deeplearning4j_tpu import MultiLayerNetwork, NeuralNetConfiguration
+    from deeplearning4j_tpu.nn.conf.layers import OutputLayer
+
+    conf = (NeuralNetConfiguration.builder()
+            .seed(7).learning_rate(0.1)
+            .list()
+            .layer(OutputLayer(n_in=4, n_out=3, activation="softmax"))
+            .build())
+    net = MultiLayerNetwork(conf).init()
+    rng = np.random.default_rng(0)
+    x = rng.random((8, 4)).astype(np.float32)
+    y = np.eye(3, dtype=np.float32)[rng.integers(0, 3, 8)]
+    rec = Recorder()
+    prev = set_default(rec)
+    try:
+        net.fit_scanned(x, y, epochs=2)
+        net.fit_scanned(x, y, epochs=2)
+    finally:
+        set_default(prev)
+    spans = [e for e in rec.events if e["event"] == "span"]
+    assert [s["name"] for s in spans] == ["compile", "step_scan"]
+    assert all(s["what"] == "fit_scanned" and s["ok"] for s in spans)

@@ -1,0 +1,171 @@
+#!/usr/bin/env python
+"""benchdiff — the bench-artifact regression detector.
+
+    python tools/benchdiff.py BENCH_r04.json BENCH_r05.json
+    python tools/benchdiff.py OLD NEW --threshold 0.05
+    python tools/benchdiff.py OLD NEW --json
+
+Compares two bench artifacts (driver BENCH_r*.json wrappers, raw
+bench.py stdout, or telemetry JSONL logs — anything
+`telemetry/artifact.py` can parse, including tail-truncated artifacts
+whose rows are reconstructed from the gate-carrying summary line) and
+names EVERY changed metric with old/new/delta. Exit codes: 0 no
+regression, 1 regression past threshold, 2 usage error.
+
+What counts as a regression (all bench metrics are higher-is-better):
+
+* a metric value dropping more than `--threshold` (default 10%), with
+  chip-state slack: when the new line carries `gate_scale` (the bench's
+  measured probe/healthy ratio), the allowed drop grows by the measured
+  throttle so a slow shared-tenancy window doesn't read as a code
+  regression — the same philosophy as bench.py's own gate;
+* a `regression: true` flag present in NEW but not OLD;
+* a gated quality ratio (`quality_ratio_vs_host` vs
+  `quality_gate_min_ratio`, `vs_dense_ratio` vs `ratio_floor`) falling
+  below its floor in NEW.
+"""
+
+from __future__ import annotations
+
+import argparse
+import importlib
+import json
+import os
+import sys
+import types
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+DEFAULT_THRESHOLD = 0.10
+
+# gate fields that are themselves higher-is-better measurements worth
+# diffing (context fields like gate_scale/floors are reported, not judged)
+_JUDGED_GATE_FIELDS = ("quality_ratio_vs_host", "vs_dense_ratio",
+                       "mfu_vs_achievable", "mfu_executed")
+_GATED_PAIRS = (("quality_ratio_vs_host", "quality_gate_min_ratio"),
+                ("vs_dense_ratio", "ratio_floor"))
+
+
+def _artifact_mod():
+    """Import telemetry.artifact without the package root (which pulls
+    the full nn stack + jax) — the tools/graftlint.py stub idiom; a
+    fully imported real package (the test environment) is left alone."""
+    sys.path.insert(0, ROOT)
+    for name in ("deeplearning4j_tpu", "deeplearning4j_tpu.telemetry"):
+        if name not in sys.modules:
+            mod = types.ModuleType(name)
+            mod.__path__ = [os.path.join(ROOT, *name.split("."))]
+            sys.modules[name] = mod
+    return importlib.import_module("deeplearning4j_tpu.telemetry.artifact")
+
+
+def _num(line, key):
+    v = line.get(key)
+    return v if isinstance(v, (int, float)) and not isinstance(v, bool) \
+        else None
+
+
+def diff(old_lines: dict, new_lines: dict,
+         threshold: float = DEFAULT_THRESHOLD) -> dict:
+    """{metric: line} x2 -> {regressions, changes, added, removed}.
+
+    Every entry in `regressions`/`changes` names the metric and field
+    with old/new/delta_pct; `regressions` alone drives the exit code."""
+    regressions, changes = [], []
+    added = sorted(m for m in new_lines if m not in old_lines
+                   and m != "summary")
+    removed = sorted(m for m in old_lines if m not in new_lines
+                     and m != "summary")
+    for metric in sorted(set(old_lines) & set(new_lines) - {"summary"}):
+        old, new = old_lines[metric], new_lines[metric]
+        gate_scale = _num(new, "gate_scale")
+        slack = max(0.0, 1.0 - gate_scale) if gate_scale is not None else 0.0
+        for field in ("value",) + _JUDGED_GATE_FIELDS:
+            o, n = _num(old, field), _num(new, field)
+            if o is None or n is None or o == n:
+                continue
+            delta_pct = round(100.0 * (n - o) / abs(o), 2) if o else None
+            row = {"metric": metric, "field": field, "old": o, "new": n,
+                   "delta_pct": delta_pct}
+            dropped_past = (o > 0 and (o - n) / o > threshold + slack)
+            if field == "value" and slack and o > 0 and (o - n) / o > threshold:
+                row["gate_scale"] = gate_scale
+            if dropped_past:
+                row["reason"] = (f"{field} fell {-delta_pct:.1f}% "
+                                 f"(> {100 * (threshold + slack):.0f}% "
+                                 "allowed)")
+                regressions.append(row)
+            else:
+                changes.append(row)
+        if new.get("regression") and not old.get("regression"):
+            regressions.append({"metric": metric, "field": "regression",
+                                "old": False, "new": True, "delta_pct": None,
+                                "reason": "regression flag newly set"})
+        for ratio_field, floor_field in _GATED_PAIRS:
+            r, floor = _num(new, ratio_field), _num(new, floor_field)
+            if r is not None and floor is not None and r < floor:
+                old_r = _num(old, ratio_field)
+                if old_r is None or old_r >= floor:
+                    regressions.append({
+                        "metric": metric, "field": ratio_field,
+                        "old": old_r, "new": r, "delta_pct": None,
+                        "reason": f"{ratio_field} {r} below its "
+                                  f"{floor_field} {floor}"})
+    return {"regressions": regressions, "changes": changes,
+            "added": added, "removed": removed}
+
+
+def render(result: dict, old_name: str, new_name: str,
+           threshold: float) -> str:
+    out = [f"benchdiff {old_name} -> {new_name} "
+           f"(threshold {threshold:.0%})"]
+    for row in result["regressions"]:
+        out.append(f"REGRESSED {row['metric']}.{row['field']}: "
+                   f"{row['old']} -> {row['new']}"
+                   + (f" ({row['delta_pct']:+.1f}%)"
+                      if row["delta_pct"] is not None else "")
+                   + f" — {row['reason']}")
+    for row in result["changes"]:
+        out.append(f"changed   {row['metric']}.{row['field']}: "
+                   f"{row['old']} -> {row['new']}"
+                   + (f" ({row['delta_pct']:+.1f}%)"
+                      if row["delta_pct"] is not None else ""))
+    for m in result["added"]:
+        out.append(f"added     {m}")
+    for m in result["removed"]:
+        out.append(f"removed   {m}")
+    n = len(result["regressions"])
+    out.append(f"{n} regression(s) past threshold"
+               + (" -> exit 1" if n else ""))
+    return "\n".join(out)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(prog="benchdiff", description=__doc__)
+    ap.add_argument("old", help="older artifact (BENCH_r*.json / bench "
+                               "stdout / telemetry JSONL)")
+    ap.add_argument("new", help="newer artifact")
+    ap.add_argument("--threshold", type=float, default=DEFAULT_THRESHOLD,
+                    help="relative value drop that counts as a regression "
+                         f"(default {DEFAULT_THRESHOLD})")
+    ap.add_argument("--json", action="store_true", dest="as_json")
+    args = ap.parse_args(argv)
+
+    artifact = _artifact_mod()
+    try:
+        old_lines = artifact.load(args.old)
+        new_lines = artifact.load(args.new)
+    except OSError as exc:
+        print(f"benchdiff: {exc}", file=sys.stderr)
+        return 2
+    result = diff(old_lines, new_lines, threshold=args.threshold)
+    if args.as_json:
+        print(json.dumps(result, indent=1))
+    else:
+        print(render(result, os.path.basename(args.old),
+                     os.path.basename(args.new), args.threshold))
+    return 1 if result["regressions"] else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -13,12 +13,26 @@ artifacts. This script is the only writer of the blocks between
 from __future__ import annotations
 
 import glob
-import json
+import importlib
 import os
 import re
 import sys
+import types
 
 ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _artifact_mod():
+    """Import telemetry.artifact (the shared artifact parser, also used
+    by tools/benchdiff.py) without the package root — which would pull
+    the full nn stack + jax — via the tools/graftlint.py stub idiom."""
+    sys.path.insert(0, ROOT)
+    for name in ("deeplearning4j_tpu", "deeplearning4j_tpu.telemetry"):
+        if name not in sys.modules:
+            mod = types.ModuleType(name)
+            mod.__path__ = [os.path.join(ROOT, *name.split("."))]
+            sys.modules[name] = mod
+    return importlib.import_module("deeplearning4j_tpu.telemetry.artifact")
 
 def _mfu_str(l):
     """MFU cell: dense-accounted value, plus the executed-FLOPs figure
@@ -76,43 +90,14 @@ ROWS = [
 
 
 def load(path):
-    """Accepts either raw JSON-lines (bench.py stdout) or the driver's
-    wrapper object whose `tail` field holds the captured stdout."""
-    with open(path) as f:
-        text = f.read()
-    try:
-        wrapper = json.loads(text)
-        if isinstance(wrapper, dict) and "tail" in wrapper:
-            text = wrapper["tail"]
-    except json.JSONDecodeError:
-        pass
-    lines = {}
-    summary = None
-    for raw in text.splitlines():
-        raw = raw.strip()
-        if not raw.startswith("{"):
-            continue
-        try:
-            line = json.loads(raw)
-        except json.JSONDecodeError:
-            continue
-        if line.get("metric") == "summary":
-            summary = line
-        elif "metric" in line:
-            lines[line["metric"]] = line
-    if summary:
-        # the driver keeps only the TAIL of the captured stdout, so early
-        # metric lines can be truncated away (r5 lost lenet/vgg/w2v/
-        # resnet/flagship). The summary line restates every metric:value
-        # pair and always survives (it is printed last) — recover bare
-        # {value} rows for anything the tail lost.
-        skip = {"metric", "value", "unit", "vs_baseline", "regressions"}
-        for key, val in summary.items():
-            if key not in skip and key not in lines and isinstance(
-                    val, (int, float)):
-                lines[key] = {"metric": key, "value": val,
-                              "from_summary": True}
-    return lines
+    """Accepts raw JSON-lines (bench.py stdout), a telemetry JSONL log,
+    or the driver's wrapper object whose `tail` field holds the captured
+    stdout. The driver keeps only the TAIL of that stdout, so early
+    metric lines can be truncated away (r5 lost lenet/vgg/w2v/resnet/
+    flagship) — rows the tail lost are reconstructed from the
+    gate-carrying summary line, including every `gates[<metric>]` field
+    and the regression flags (telemetry/artifact.py, VERDICT r5 #6)."""
+    return _artifact_mod().load(path)
 
 
 def render(lines, artifact_name):

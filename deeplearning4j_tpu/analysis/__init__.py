@@ -2,10 +2,11 @@
 
 Two stages:
 
-1. AST pass (`ast_pass.lint_paths`): rules G001-G008 over the package —
+1. AST pass (`ast_pass.lint_paths`): rules G001-G009 over the package —
    tracer leaks, host syncs in hot paths, float64 drift, RNG discipline,
    retrace hazards, shard_map arity, util/compat bypasses, import-time
-   device captures. Pure stdlib; never imports jax.
+   device captures, rendezvous plumbing outside distributed/bootstrap.
+   Pure stdlib; never imports jax.
 2. jaxpr audit (`jaxpr_audit.audit`): traces the public jitted entry
    points with abstract inputs on CPU and asserts the programs are
    transfer-clean (J001), within frozen op-count budgets (J002), and

@@ -20,6 +20,9 @@ Distributed runtimes (reference Train.java `-runtime local|spark|hadoop`
     python -m deeplearning4j_tpu.cli coordinator [--port P]
     ... train --cluster HOST:PORT --num-workers 2 [--worker-id w0] \
         [--sync-every 1] [--checkpoint ck.zip] ...
+    # multi-process pjit fleet (jax.distributed over the rendezvous env
+    # contract; --multiprocess prints the dry-run launch plan)
+    ... train --mesh data=8 --multiprocess 2 [--local-devices 4] ...
 """
 
 from __future__ import annotations
@@ -69,6 +72,17 @@ def _build_parser() -> argparse.ArgumentParser:
                         "expert; uses jax.sharding over local devices)")
     t.add_argument("--microbatches", type=int, default=None,
                    help="pipeline microbatches (with a pipe mesh axis)")
+    t.add_argument("--multiprocess", type=int, default=None, metavar="N",
+                   help="dry run: print the N-process local rendezvous "
+                        "launch plan (DL4J_TPU_* env contract + one "
+                        "command per process over virtual CPU devices) "
+                        "and exit. Run the printed lines — each process "
+                        "auto-initializes jax.distributed from the env "
+                        "contract — or drive the fleet programmatically "
+                        "via deeplearning4j_tpu.distributed.launch_local")
+    t.add_argument("--local-devices", type=int, default=4,
+                   help="virtual CPU devices per process in the "
+                        "--multiprocess plan (default 4)")
     t.add_argument("--cluster", default=None,
                    help="coordinator HOST:PORT for multi-process elastic "
                         "data-parallel training (parameter averaging)")
@@ -191,6 +205,42 @@ def _apply_mesh(net, args) -> None:
           "devices")
 
 
+def _scrub_multiprocess_argv(argv) -> list:
+    """The per-process command of a --multiprocess plan is this same CLI
+    invocation minus the plan flags themselves (a spawned process must
+    train, not print another plan)."""
+    out = []
+    skip = False
+    for tok in argv:
+        if skip:
+            skip = False
+            continue
+        if tok in ("--multiprocess", "--local-devices"):
+            skip = True
+            continue
+        if tok.startswith(("--multiprocess=", "--local-devices=")):
+            continue
+        out.append(tok)
+    return out
+
+
+def _print_multiprocess_plan(args) -> int:
+    """`train --multiprocess N` dry run: the copy-pastable local fleet
+    (reference Train.java's `-runtime spark` analogue, rendered as
+    explicit rendezvous launch lines instead of a cluster submit)."""
+    from deeplearning4j_tpu.distributed.launcher import launch_plan
+
+    worker_argv = ([sys.executable, "-m", "deeplearning4j_tpu.cli"]
+                   + _scrub_multiprocess_argv(args._raw_argv))
+    print(f"# {args.multiprocess}-process local rendezvous fleet "
+          f"({args.local_devices} virtual CPU devices each); run these "
+          "lines from the repo root:")
+    for line in launch_plan(worker_argv, args.multiprocess,
+                            local_device_count=args.local_devices):
+        print(line)
+    return 0
+
+
 def _train_on_cluster(net, args, it) -> None:
     """Multi-process elastic parameter-averaging worker (the Spark/Akka
     cluster runtime analogue — reference cli-spark/SparkTrain.java):
@@ -264,6 +314,15 @@ def _cmd_train(args) -> int:
         raise SystemExit("--mesh (single-process pjit) and --cluster "
                          "(multi-process averaging) are separate runtimes; "
                          "pick one per process")
+    if args.multiprocess:
+        return _print_multiprocess_plan(args)
+    # spawned fleet member (env contract set by the launcher / a printed
+    # --multiprocess plan / tpu_vm's pod launch script): bring up
+    # jax.distributed before any mesh is built so jax.devices() is global
+    from deeplearning4j_tpu.distributed import bootstrap
+
+    if bootstrap.env_contract_present():
+        bootstrap.initialize()
     with open(_fetch_input(args.conf)) as f:
         conf_json = f.read()
     if args.type == "computation_graph":
@@ -279,6 +338,12 @@ def _cmd_train(args) -> int:
     if args.cluster:
         _train_on_cluster(net, args, it)
     else:
+        if getattr(net, "_multiprocess", False):
+            # every fleet member read the same input file; feed each its
+            # process-major slice of every batch (the global batch the
+            # jitted step sees is the original, assembled by
+            # distributed.global_mesh.globalize_batch in _batch_dict)
+            it = _shard_batches_by_process(it)
         net.fit(it, epochs=args.epochs)
 
     out = args.model or args.output
@@ -297,6 +362,23 @@ def _cmd_train(args) -> int:
         ModelSerializer.write_model(net, out)
     print(f"model saved to {out}")
     return 0
+
+
+def _shard_batches_by_process(it):
+    """Slice every DataSet to this process's rows (process-spanning mesh:
+    all members must step in lockstep over the same batch COUNT, so the
+    split is within each batch, not across batches)."""
+    from deeplearning4j_tpu.datasets.api import DataSet
+    from deeplearning4j_tpu.datasets.iterators import ListDataSetIterator
+    from deeplearning4j_tpu.distributed.global_mesh import local_shard
+
+    def cut(a):
+        return None if a is None else local_shard(a)
+
+    return ListDataSetIterator([
+        DataSet(local_shard(ds.features), local_shard(ds.labels),
+                cut(ds.features_mask), cut(ds.labels_mask))
+        for ds in it])
 
 
 def _cmd_coordinator(args) -> int:
@@ -354,6 +436,8 @@ def _cmd_predict(args) -> int:
 
 def main(argv=None) -> int:
     args = _build_parser().parse_args(argv)
+    # the tokens behind this parse — what a --multiprocess plan re-emits
+    args._raw_argv = list(sys.argv[1:] if argv is None else argv)
     return {"train": _cmd_train, "test": _cmd_test,
             "predict": _cmd_predict,
             "coordinator": _cmd_coordinator}[args.command](args)

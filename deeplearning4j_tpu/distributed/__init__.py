@@ -1,0 +1,53 @@
+"""Multi-process runtime: rendezvous bootstrap, local fleet launcher,
+and process-spanning meshes (the reference's deeplearning4j-scaleout
+bootstrap layer — Spark master / Akka worker actors — rebuilt on
+jax.distributed; SURVEY §2.4).
+
+- `bootstrap`: env-var contract + hardened `jax.distributed.initialize`
+  (retry/backoff, gloo CPU collectives, per-process telemetry).
+- `launcher`: N local OS processes x K virtual CPU devices with log
+  streaming, wall-clock timeouts, and straggler reaping.
+- `global_mesh`: the Mesh over every process's devices + per-process
+  batch-shard globalization, routed through the containers' `set_mesh`.
+
+Only `bootstrap` (pure stdlib) loads eagerly; the rest resolve lazily so
+importing this package never drags in jax (graftlint stub contract —
+telemetry/recorder.py reads the env contract through `bootstrap`).
+"""
+
+from deeplearning4j_tpu.distributed.bootstrap import (  # noqa: F401
+    ENV_COORDINATOR,
+    ENV_LOCAL_DEVICE_COUNT,
+    ENV_NUM_PROCESSES,
+    ENV_PROCESS_ID,
+    contract_from_env,
+    env_contract_present,
+    initialize,
+    rendezvous_env,
+    shutdown,
+)
+
+_LAZY = {
+    "ProcessResult": "deeplearning4j_tpu.distributed.launcher",
+    "free_port": "deeplearning4j_tpu.distributed.launcher",
+    "launch_local": "deeplearning4j_tpu.distributed.launcher",
+    "launch_plan": "deeplearning4j_tpu.distributed.launcher",
+    "globalize_batch": "deeplearning4j_tpu.distributed.global_mesh",
+    "globalize_full": "deeplearning4j_tpu.distributed.global_mesh",
+    "local_shard": "deeplearning4j_tpu.distributed.global_mesh",
+    "make_global_mesh": "deeplearning4j_tpu.distributed.global_mesh",
+    "spans_processes": "deeplearning4j_tpu.distributed.global_mesh",
+}
+
+
+def __getattr__(name):
+    mod = _LAZY.get(name)
+    if mod is None:
+        raise AttributeError(f"module {__name__!r} has no attribute {name!r}")
+    import importlib
+
+    return getattr(importlib.import_module(mod), name)
+
+
+def __dir__():
+    return sorted(set(globals()) | set(_LAZY))

@@ -1,0 +1,234 @@
+"""Multi-process rendezvous bootstrap — the hardened replacement for the
+`initialize_multihost` thin wrapper (`parallel/cluster.py`).
+
+The reference's flagship capability is cluster training: SparkDl4jMultiLayer
+scale-out on a Spark master, Akka actors for the worker bootstrap
+(SURVEY §2.4; cf. SparkNet, arXiv:1511.06051). The TPU-native data plane is
+`jax.distributed` + XLA collectives over ICI/DCN — but MPI-style
+multi-process training (arXiv:1810.11112) shows the bootstrap/rendezvous
+layer is its own subsystem, not a one-liner: processes race the
+coordinator's bind, connects fail transiently, and a silent mis-wiring
+(wrong process count, wrong device visibility) surfaces only as a hang
+inside the first collective. This module owns that layer:
+
+- **env-var contract** (`ENV_*` below): process id / process count /
+  coordinator address / virtual-device count, written by
+  `distributed/launcher.py` for local fleets and by
+  `provision/tpu_vm.py`'s pod launch script for real TPU hosts. The
+  constants are the single spelling — graftlint G009 flags literal
+  copies anywhere else in the package.
+- **initialize()**: `jax.distributed.initialize` with explicit retry /
+  timeout / backoff on connect, automatic gloo CPU-collectives selection
+  for off-TPU fleets (the installed CPU backend refuses multi-process
+  programs without it), and telemetry `meta`/`span` events per process so
+  a wedged rendezvous leaves evidence in each process's JSONL.
+
+jax is imported lazily: this module must stay importable under
+graftlint's no-jax package stubs (telemetry/recorder.py reads the env
+contract through it).
+"""
+
+from __future__ import annotations
+
+import os
+import time
+from typing import Optional
+
+# ------------------------------------------------------------ env contract
+# One spelling for the rendezvous environment, shared by the local
+# launcher, the TPU pod launch script, and the telemetry per-process
+# log suffix. graftlint G009 keeps every other module importing these.
+ENV_COORDINATOR = "DL4J_TPU_COORDINATOR"
+ENV_PROCESS_ID = "DL4J_TPU_PROCESS_ID"
+ENV_NUM_PROCESSES = "DL4J_TPU_NUM_PROCESSES"
+ENV_LOCAL_DEVICE_COUNT = "DL4J_TPU_LOCAL_DEVICE_COUNT"
+
+RENDEZVOUS_ENV_VARS = (ENV_COORDINATOR, ENV_PROCESS_ID, ENV_NUM_PROCESSES,
+                       ENV_LOCAL_DEVICE_COUNT)
+
+
+def rendezvous_env(coordinator_address: str, process_id: int,
+                   num_processes: int,
+                   local_device_count: Optional[int] = None) -> dict:
+    """The env-var block one process of a fleet needs (a plain dict —
+    merge it into a child's environment or print it as a launch line)."""
+    env = {
+        ENV_COORDINATOR: str(coordinator_address),
+        ENV_PROCESS_ID: str(int(process_id)),
+        ENV_NUM_PROCESSES: str(int(num_processes)),
+    }
+    if local_device_count:
+        env[ENV_LOCAL_DEVICE_COUNT] = str(int(local_device_count))
+    return env
+
+
+def env_contract_present(environ=None) -> bool:
+    """True when the spawning layer wired this process for rendezvous."""
+    e = os.environ if environ is None else environ
+    return (ENV_COORDINATOR in e and ENV_PROCESS_ID in e
+            and ENV_NUM_PROCESSES in e)
+
+
+def contract_from_env(environ=None) -> dict:
+    """Parse the rendezvous contract: {coordinator_address, process_id,
+    num_processes, local_device_count} with absent fields as None."""
+    e = os.environ if environ is None else environ
+
+    def _int(var):
+        return int(e[var]) if var in e else None
+
+    return {
+        "coordinator_address": e.get(ENV_COORDINATOR),
+        "process_id": _int(ENV_PROCESS_ID),
+        "num_processes": _int(ENV_NUM_PROCESSES),
+        "local_device_count": _int(ENV_LOCAL_DEVICE_COUNT),
+    }
+
+
+# --------------------------------------------------------------- lifecycle
+
+def is_initialized() -> bool:
+    """Whether jax's distributed runtime is already up in this process.
+    Reads jax-internal state behind a guard (the public API has no
+    query); False when jax or the internals are unavailable."""
+    try:
+        from jax._src import distributed as _dist  # jax internals: no API
+
+        return getattr(_dist.global_state, "client", None) is not None
+    except Exception:
+        return False
+
+
+def shutdown() -> None:
+    """Tear down the distributed runtime (no-op when never initialized)."""
+    if not is_initialized():
+        return
+    import jax
+
+    jax.distributed.shutdown()
+
+
+def _want_cpu_collectives(environ) -> bool:
+    """Off-TPU fleets need a CPU cross-process collectives backend: the
+    plain CPU client refuses multi-process programs ("Multiprocess
+    computations aren't implemented on the CPU backend"). Decide from the
+    environment BEFORE backends initialize (querying jax would initialize
+    them, which must not happen before jax.distributed.initialize)."""
+    if ENV_LOCAL_DEVICE_COUNT in environ:
+        return True
+    return "cpu" in environ.get("JAX_PLATFORMS", "").lower()
+
+
+def initialize(coordinator_address: Optional[str] = None,
+               num_processes: Optional[int] = None,
+               process_id: Optional[int] = None, *,
+               local_device_ids=None,
+               cpu_collectives: Optional[str] = "auto",
+               connect_timeout: float = 90.0,
+               max_backoff: float = 5.0,
+               init_timeout: Optional[float] = None) -> dict:
+    """Bring up jax's multi-process runtime with rendezvous hardening.
+
+    Arguments default from the env contract (``rendezvous_env``); on a
+    Cloud TPU pod slice everything may stay None and jax auto-detects the
+    topology from the metadata server. Returns an info dict
+    {process_id, num_processes, local_devices, global_devices,
+    coordinator, attempts} and emits one telemetry ``meta`` event plus a
+    ``distributed_init`` span per process. Idempotent: a second call
+    returns immediately.
+
+    connect_timeout / max_backoff: outer retry loop around connect-time
+    failures (coordinator not yet bound, transient refusals) — each
+    failed attempt backs off exponentially up to max_backoff seconds.
+    init_timeout: forwarded to jax's own initialization_timeout (how long
+    jax itself waits inside ONE attempt). cpu_collectives: "auto" picks
+    gloo for CPU fleets, None/"" disables, or name a backend explicitly.
+    """
+    from deeplearning4j_tpu.telemetry.recorder import get_default
+
+    environ = os.environ
+    contract = contract_from_env(environ)
+    if coordinator_address is None:
+        coordinator_address = contract["coordinator_address"]
+    if num_processes is None:
+        num_processes = contract["num_processes"]
+    if process_id is None:
+        process_id = contract["process_id"]
+
+    rec = get_default()
+    if is_initialized():
+        import jax
+
+        info = {"process_id": jax.process_index(),
+                "num_processes": jax.process_count(),
+                "local_devices": jax.local_device_count(),
+                "global_devices": jax.device_count(),
+                "coordinator": coordinator_address, "attempts": 0}
+        rec.event("span", name="distributed_init", ok=True, seconds=0.0,
+                  already_initialized=True, **{k: info[k] for k in
+                                               ("process_id",
+                                                "num_processes")})
+        return info
+
+    # virtual-device forcing must precede backend initialization; the
+    # flags are pure env mutations here (asserting device counts would
+    # initialize backends too early)
+    if contract["local_device_count"]:
+        from deeplearning4j_tpu.util.virtual_devices import cpu_device_flags
+
+        environ["XLA_FLAGS"] = cpu_device_flags(
+            contract["local_device_count"], environ.get("XLA_FLAGS", ""))
+        environ.setdefault("JAX_PLATFORMS", "cpu")
+
+    import jax
+
+    if cpu_collectives == "auto":
+        cpu_collectives = "gloo" if _want_cpu_collectives(environ) else None
+    if cpu_collectives:
+        try:
+            jax.config.update("jax_cpu_collectives_implementation",
+                              cpu_collectives)
+        except Exception:
+            # newer jax generations select CPU collectives automatically
+            # (or renamed the flag); proceed and let the first collective
+            # surface a real incompatibility
+            pass
+
+    kwargs = {"coordinator_address": coordinator_address,
+              "num_processes": num_processes, "process_id": process_id,
+              "local_device_ids": local_device_ids}
+    kwargs = {k: v for k, v in kwargs.items() if v is not None}
+    if init_timeout is not None:
+        kwargs["initialization_timeout"] = init_timeout
+
+    deadline = time.monotonic() + connect_timeout
+    backoff = 0.25
+    attempt = 0
+    with rec.span("distributed_init", process_id=process_id,
+                  num_processes=num_processes,
+                  coordinator=coordinator_address) as span:
+        while True:
+            attempt += 1
+            try:
+                jax.distributed.initialize(**kwargs)
+                break
+            except Exception as exc:
+                if time.monotonic() + backoff > deadline:
+                    rec.error("distributed_init", exc=exc, attempt=attempt,
+                              process_id=process_id,
+                              coordinator=coordinator_address)
+                    raise
+                try:  # clear any half-initialized client before retrying
+                    jax.distributed.shutdown()
+                except Exception:
+                    pass
+                time.sleep(backoff)
+                backoff = min(backoff * 2.0, max_backoff)
+        info = {"process_id": jax.process_index(),
+                "num_processes": jax.process_count(),
+                "local_devices": jax.local_device_count(),
+                "global_devices": jax.device_count(),
+                "coordinator": coordinator_address, "attempts": attempt}
+        span["attempts"] = attempt
+    rec.meta(distributed=info)
+    return info

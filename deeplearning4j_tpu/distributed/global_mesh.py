@@ -1,0 +1,117 @@
+"""Process-spanning meshes and host-data globalization.
+
+A multi-process fleet (``distributed/bootstrap.py``) sees one global
+device set: ``jax.devices()`` spans every process, and a ``Mesh`` built
+over it turns the existing ``net.set_mesh`` data-parallel path into a
+cross-process pjit program — XLA's allreduce over ICI/DCN (gloo on CPU
+fleets) replaces the coordinator's host-side parameter averaging
+entirely (SURVEY §2.4; the SparkDl4jMultiLayer aggregate-and-broadcast
+becomes one compiled collective).
+
+The one host-side wrinkle: a process can only hand jax data for its OWN
+devices. Parameters ride through jit's input handling (every process
+holds identical values, so the replicated placement is well-defined),
+but each process's *batch* is its local shard of the global batch —
+``globalize_batch`` assembles those shards into global arrays
+(``jax.make_array_from_process_local_data``), and the containers'
+``_batch_dict`` routes through it whenever the active mesh spans
+processes. ``local_shard`` is the complementary host-side splitter for
+code that starts from a full dataset on every process.
+
+jax imports stay inside functions: the module (and the ``distributed``
+package) must remain importable under graftlint's no-jax stubs.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+
+def make_global_mesh(axes=None):
+    """A Mesh over the GLOBAL device set (every process's devices, in
+    jax's process-major enumeration — consecutive device blocks belong
+    to consecutive processes). Same axes spec as `parallel.mesh.make_mesh`
+    ({axis: size}, -1 = all remaining); defaults to pure DP."""
+    import jax
+
+    from deeplearning4j_tpu.parallel.mesh import make_mesh
+
+    return make_mesh(axes or {"data": -1}, devices=jax.devices())
+
+
+def spans_processes(mesh) -> bool:
+    """True when the mesh's devices live in more than one OS process."""
+    from deeplearning4j_tpu.parallel.mesh import spans_processes as _sp
+
+    return _sp(mesh)
+
+
+def globalize_batch(batch, mesh, data_axis: str = "data"):
+    """Assemble per-process local batch shards into global arrays.
+
+    Every leaf of ``batch`` is this process's slice of the global batch
+    (leading dim = local batch); the returned leaves are global
+    ``jax.Array``s sharded over ``data_axis`` (global leading dim = sum
+    of the processes' local dims). ``data_axis=None`` (or an axis the
+    mesh lacks) replicates instead — every process must then hold the
+    full identical value. Leaves that are already process-spanning
+    global arrays pass through untouched; on a single-process mesh the
+    batch is returned as-is (the jit path's sharding handles it).
+    """
+    import jax
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    if not spans_processes(mesh):
+        return batch
+    shard_spec = (P(data_axis) if data_axis and data_axis in mesh.axis_names
+                  else P())
+
+    def leaf(x):
+        if isinstance(x, jax.Array) and not x.is_fully_addressable:
+            return x  # already a global array
+        arr = np.asarray(x)
+        spec = shard_spec if arr.ndim else P()
+        sharding = NamedSharding(mesh, spec)
+        if spec == P():
+            # replicated: every process holds the full value; callback
+            # placement avoids cross-process transfers
+            return jax.make_array_from_callback(arr.shape, sharding,
+                                                lambda idx: arr[idx])
+        return jax.make_array_from_process_local_data(sharding, arr)
+
+    return jax.tree.map(leaf, batch)
+
+
+def globalize_full(x, mesh, data_axis: str = "data"):
+    """Global array from a FULL host value held identically on every
+    process (the inference path: `output()`/`evaluate()` take the whole
+    batch, unlike `fit()`'s per-process shards). Sharded over
+    ``data_axis`` when the mesh has it — each process materializes only
+    its addressable slices via callback — else replicated."""
+    import jax
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    arr = np.asarray(x)
+    spec = (P(data_axis) if data_axis and data_axis in mesh.axis_names
+            and arr.ndim else P())
+    sharding = NamedSharding(mesh, spec)
+    return jax.make_array_from_callback(arr.shape, sharding,
+                                        lambda idx: arr[idx])
+
+
+def local_shard(x, axis: int = 0):
+    """This process's contiguous slice of a full host array: the
+    process-major split matching ``make_global_mesh``'s device order
+    (process i gets rows [i*B/N, (i+1)*B/N))."""
+    import jax
+
+    n = jax.process_count()
+    i = jax.process_index()
+    arr = np.asarray(x)
+    if arr.shape[axis] % n:
+        raise ValueError(
+            f"dim {axis} of {arr.shape} does not split over {n} processes")
+    size = arr.shape[axis] // n
+    idx = [slice(None)] * arr.ndim
+    idx[axis] = slice(i * size, (i + 1) * size)
+    return arr[tuple(idx)]

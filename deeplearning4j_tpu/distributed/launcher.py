@@ -1,0 +1,178 @@
+"""Local multi-process fleet launcher — the piece that makes the
+multi-process runtime *testable off-TPU*.
+
+Spawns N OS processes running the same program, each wired with the
+rendezvous env contract (`distributed/bootstrap.py`) and, by default,
+given 4 virtual CPU devices via
+``XLA_FLAGS=--xla_force_host_platform_device_count`` — the 2-process x
+4-device topology of SURVEY §4.5 without any accelerator. Per-process
+stdout/stderr is streamed line-by-line with a ``[pN]`` prefix and kept
+for post-mortems; a wall-clock deadline terminates and then kills
+stragglers so a wedged rendezvous can never hang a test run.
+
+``launch_plan`` renders the same fleet as copy-pastable shell lines —
+the CLI's ``--multiprocess`` dry-run output and the README quickstart.
+"""
+
+from __future__ import annotations
+
+import os
+import re
+import shlex
+import socket
+import subprocess
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Callable, List, Optional, Sequence
+
+from deeplearning4j_tpu.distributed import bootstrap
+
+
+@dataclass
+class ProcessResult:
+    """Outcome of one fleet member: exit code (None while running or when
+    the reaper had to SIGKILL a straggler that never reported one),
+    captured log lines, and whether the launch deadline expired on it."""
+
+    process_id: int
+    returncode: Optional[int] = None
+    lines: List[str] = field(default_factory=list)
+    timed_out: bool = False
+
+    @property
+    def output(self) -> str:
+        return "\n".join(self.lines)
+
+
+def free_port(host: str = "127.0.0.1") -> int:
+    """An OS-assigned free TCP port (bind-0 probe). Racy by nature —
+    good enough for same-host fleets spawned immediately after."""
+    with socket.socket(socket.AF_INET, socket.SOCK_STREAM) as s:
+        s.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        s.bind((host, 0))
+        return s.getsockname()[1]
+
+
+def _process_env(coordinator: str, process_id: int, n_processes: int,
+                 local_device_count: Optional[int],
+                 extra_env: Optional[dict]) -> dict:
+    env = bootstrap.rendezvous_env(coordinator, process_id, n_processes,
+                                   local_device_count)
+    if local_device_count:
+        from deeplearning4j_tpu.util.virtual_devices import cpu_device_flags
+
+        env["JAX_PLATFORMS"] = "cpu"
+        # the fleet's topology must be EXACT: strip any inherited device
+        # forcing (e.g. the test harness's own) before applying ours,
+        # keeping unrelated inherited XLA flags
+        flags = (extra_env or {}).get("XLA_FLAGS",
+                                      os.environ.get("XLA_FLAGS", ""))
+        flags = re.sub(r"--xla_force_host_platform_device_count=\d+",
+                       "", flags).strip()
+        env["XLA_FLAGS"] = cpu_device_flags(local_device_count, flags)
+    if extra_env:
+        env.update({k: v for k, v in extra_env.items() if k != "XLA_FLAGS"})
+    return env
+
+
+def _pump(proc, process_id: int, lines: List[str],
+          echo: Optional[Callable[[str], None]]) -> None:
+    """Reader thread: stream one process's merged stdout/stderr into its
+    result (and through `echo` with the ``[pN]`` prefix)."""
+    for raw in iter(proc.stdout.readline, b""):
+        line = raw.decode("utf-8", errors="replace").rstrip("\n")
+        lines.append(line)
+        if echo is not None:
+            echo(f"[p{process_id}] {line}")
+    proc.stdout.close()
+
+
+def launch_local(argv: Sequence[str], n_processes: int = 2, *,
+                 local_device_count: Optional[int] = 4,
+                 timeout: float = 300.0, grace: float = 5.0,
+                 coordinator_port: Optional[int] = None,
+                 extra_env: Optional[dict] = None,
+                 echo: Optional[Callable[[str], None]] = None,
+                 cwd: Optional[str] = None) -> List[ProcessResult]:
+    """Run ``argv`` as an N-process rendezvous fleet on this host.
+
+    Every child gets the env contract (coordinator on a free local port
+    unless ``coordinator_port`` pins one) plus virtual-CPU forcing when
+    ``local_device_count`` is set (None: inherit the real platform).
+    Blocks until every process exits or ``timeout`` seconds elapse; on
+    expiry the whole fleet is terminated, then killed after ``grace``
+    seconds — stragglers are always reaped. Results arrive in process-id
+    order with captured logs; ``echo`` (e.g. ``print``) streams lines
+    live as ``[pN] ...``.
+    """
+    from deeplearning4j_tpu.telemetry.recorder import get_default
+
+    coordinator = f"127.0.0.1:{coordinator_port or free_port()}"
+    argv = list(argv)
+    procs: List[subprocess.Popen] = []
+    threads: List[threading.Thread] = []
+    results = [ProcessResult(i) for i in range(n_processes)]
+    rec = get_default()
+    with rec.span("distributed_launch", n_processes=n_processes,
+                  argv0=argv[0], coordinator=coordinator) as span:
+        base = dict(os.environ)
+        for i in range(n_processes):
+            env = dict(base)
+            env.update(_process_env(coordinator, i, n_processes,
+                                    local_device_count, extra_env))
+            p = subprocess.Popen(argv, env=env, cwd=cwd,
+                                 stdout=subprocess.PIPE,
+                                 stderr=subprocess.STDOUT)
+            t = threading.Thread(target=_pump,
+                                 args=(p, i, results[i].lines, echo),
+                                 daemon=True)
+            t.start()
+            procs.append(p)
+            threads.append(t)
+        deadline = time.monotonic() + timeout
+        for i, p in enumerate(procs):
+            try:
+                results[i].returncode = p.wait(
+                    timeout=max(deadline - time.monotonic(), 0.01))
+            except subprocess.TimeoutExpired:
+                break
+        stragglers = [i for i, p in enumerate(procs) if p.poll() is None]
+        if stragglers:
+            for i in stragglers:
+                results[i].timed_out = True
+                procs[i].terminate()
+            kill_at = time.monotonic() + grace
+            for i in stragglers:
+                try:
+                    procs[i].wait(timeout=max(kill_at - time.monotonic(),
+                                              0.1))
+                except subprocess.TimeoutExpired:
+                    procs[i].kill()
+        for i, p in enumerate(procs):
+            if results[i].returncode is None and not results[i].timed_out:
+                results[i].returncode = p.poll()
+        for t in threads:
+            t.join(timeout=2.0)
+        span["returncodes"] = [r.returncode for r in results]
+        span["timed_out"] = [r.process_id for r in results if r.timed_out]
+    return results
+
+
+def launch_plan(argv: Sequence[str], n_processes: int = 2, *,
+                local_device_count: Optional[int] = 4,
+                coordinator: Optional[str] = None) -> List[str]:
+    """The same fleet as printable shell lines (dry run): one
+    env-prefixed command per process, backgrounded, plus a ``wait``.
+    What ``cli --multiprocess N`` prints and the README quotes."""
+    coordinator = coordinator or f"127.0.0.1:{free_port()}"
+    cmd = " ".join(shlex.quote(a) for a in argv)
+    lines = []
+    for i in range(n_processes):
+        env = _process_env(coordinator, i, n_processes, local_device_count,
+                           None)
+        prefix = " ".join(f"{k}={shlex.quote(v)}"
+                          for k, v in sorted(env.items()))
+        lines.append(f"{prefix} {cmd} &")
+    lines.append("wait")
+    return lines

@@ -75,6 +75,7 @@ class ComputationGraph:
         self._rng = None
         self._mesh = None
         self._zero1 = False
+        self._multiprocess = False
         self._rnn_carries = None  # streaming inference state (rnn_time_step)
         self._rnn_jit = None
 
@@ -354,7 +355,19 @@ class ComputationGraph:
         if mds.labels_masks is not None:
             b["labels_masks"] = tuple(
                 None if m is None else jnp.asarray(m) for m in mds.labels_masks)
-        return b
+        return self._globalize_batch(b)
+
+    def _globalize_batch(self, b):
+        """Process-spanning mesh: assemble this process's local batch
+        shard into global arrays (distributed/global_mesh.py); identity
+        on single-process meshes."""
+        if not getattr(self, "_multiprocess", False):
+            return b
+        from deeplearning4j_tpu.distributed.global_mesh import globalize_batch
+
+        axes = getattr(self, "_mesh_axes", None)
+        return globalize_batch(b, self._mesh,
+                               (axes or {}).get("data", "data"))
 
     def fit(self, data, labels=None, epochs: int = 1):
         """Train (reference ComputationGraph.fit:545-672, incl. the
@@ -655,9 +668,13 @@ class ComputationGraph:
                 p_in = (None if (getattr(self, "_pp_plan", None) is not None
                                  or getattr(self, "_param_sh", None)
                                  is not None) else repl)
+                # process-spanning mesh: replicated output (a data-sharded
+                # result spans non-addressable devices — unfetchable)
+                out_sh = (repl if getattr(self, "_multiprocess", False)
+                          else data)
                 self._output_jit = jax.jit(
                     _out, in_shardings=(p_in, repl, data),
-                    out_shardings=data)
+                    out_shardings=out_sh)
             else:
                 self._output_jit = jax.jit(_out)
         input_dict = {k: jnp.asarray(v) for k, v in input_dict.items()}
@@ -668,6 +685,15 @@ class ComputationGraph:
 
             input_dict, pad = pad_batch_to_multiple(
                 input_dict, self._mesh.shape[data_axis])
+            if getattr(self, "_multiprocess", False):
+                # inference takes the FULL batch on every process (unlike
+                # fit's per-process shards): globalize it data-sharded
+                from deeplearning4j_tpu.distributed.global_mesh import (
+                    globalize_full,
+                )
+
+                input_dict = {k: globalize_full(v, self._mesh, data_axis)
+                              for k, v in input_dict.items()}
         ys = self._output_jit(self.params, self.state, input_dict)
         if pad:
             ys = [y[:-pad] for y in ys]

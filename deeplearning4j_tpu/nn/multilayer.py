@@ -73,6 +73,7 @@ class MultiLayerNetwork:
         self._rnn_jit = None
         self._mesh = None
         self._zero1 = False
+        self._multiprocess = False
         self.score_value = float("nan")
 
     # ------------------------------------------------------------------ init
@@ -257,14 +258,26 @@ class MultiLayerNetwork:
                 param_sharding=getattr(self, "_param_sh", None))
         return self._train_step
 
-    @staticmethod
-    def _batch_dict(ds: DataSet):
+    def _batch_dict(self, ds: DataSet):
         b = {"features": jnp.asarray(ds.features), "labels": jnp.asarray(ds.labels)}
         if ds.features_mask is not None:
             b["features_mask"] = jnp.asarray(ds.features_mask)
         if ds.labels_mask is not None:
             b["labels_mask"] = jnp.asarray(ds.labels_mask)
-        return b
+        return self._globalize_batch(b)
+
+    def _globalize_batch(self, b):
+        """Process-spanning mesh: this process's batch is its LOCAL shard
+        of the global batch — assemble the global arrays (see
+        distributed/global_mesh.py). Single-process meshes pass through
+        (the jitted step's in_shardings place the batch)."""
+        if not getattr(self, "_multiprocess", False):
+            return b
+        from deeplearning4j_tpu.distributed.global_mesh import globalize_batch
+
+        axes = getattr(self, "_mesh_axes", None)
+        return globalize_batch(b, self._mesh,
+                               (axes or {}).get("data", "data"))
 
     def fit_scanned(self, data, labels=None, epochs: int = 1):
         """Whole-epoch fused training: every minibatch is staged on device
@@ -476,9 +489,14 @@ class MultiLayerNetwork:
                 repl, data = mesh_shardings(self._mesh, data_axis)
                 p_in = (None if getattr(self, "_param_sh", None) is not None
                         else repl)
+                # process-spanning mesh: the result must come back fully
+                # replicated (a data-sharded output spans non-addressable
+                # devices and cannot be fetched host-side)
+                out_sh = (repl if getattr(self, "_multiprocess", False)
+                          else data)
                 self._output_jit = jax.jit(
                     _out, in_shardings=(p_in, repl, data, None),
-                    out_shardings=data)
+                    out_shardings=out_sh)
             else:
                 self._output_jit = jax.jit(_out)
         if train:
@@ -495,9 +513,19 @@ class MultiLayerNetwork:
             bundle = (x,) if mask is None else (x, mask)
             bundle, pad = pad_batch_to_multiple(bundle,
                                                 self._mesh.shape[data_axis])
+            x = bundle[0]
+            mask = bundle[1] if mask is not None else None
+            if getattr(self, "_multiprocess", False):
+                # inference takes the FULL batch on every process (unlike
+                # fit's per-process shards): globalize it data-sharded
+                from deeplearning4j_tpu.distributed.global_mesh import (
+                    globalize_full,
+                )
+
+                x = globalize_full(x, self._mesh, data_axis)
+                if mask is not None:
+                    mask = globalize_full(mask, self._mesh, data_axis)
             if pad:
-                x = bundle[0]
-                mask = bundle[1] if mask is not None else None
                 return self._output_jit(self.params, self.state, x, mask)[:B]
         return self._output_jit(self.params, self.state, x, mask)
 

@@ -3,13 +3,16 @@ dl4j-spark parameter averaging, scaleout-akka actors, Hazelcast state,
 ZooKeeper config — SURVEY.md §2.4).
 
 On TPU the whole communication backend is XLA collectives compiled over the
-ICI mesh (DCN across slices); the host control plane is jax.distributed.
+ICI mesh (DCN across slices); the host control plane is jax.distributed,
+brought up through `deeplearning4j_tpu.distributed.bootstrap` (rendezvous
+env contract, retry/backoff, per-process telemetry).
 """
 
 from deeplearning4j_tpu.parallel.mesh import (  # noqa: F401
     make_mesh,
     replicate,
     shard_batch,
+    spans_processes,
 )
 from deeplearning4j_tpu.parallel.data_parallel import (  # noqa: F401
     DataParallelTrainer,

@@ -12,7 +12,11 @@ with a single small TCP coordinator plus worker clients:
   dead-worker removal, a JSON config registry, synchronization barriers,
   and synchronous parameter-averaging rounds (the Spark master's
   aggregate-and-broadcast, elastic: a round completes with whoever is
-  still alive when a contributor dies mid-round).
+  still alive when a contributor dies mid-round). With `snapshot_path`
+  the registry/claim state persists to JSON on every mutation and a
+  restarted coordinator reloads it (HazelCastStateTracker semantics) —
+  paired with the client's reconnect-and-re-register, the control plane
+  itself is no longer a single in-memory point of failure.
 - **ClusterClient**: register/heartbeat/config/barrier/average calls.
 - **run_elastic_worker**: the worker training loop — local steps on the
   worker's data shard, parameter averaging every `sync_every` steps,
@@ -118,7 +122,8 @@ class ClusterCoordinator:
 
     def __init__(self, host: str = "127.0.0.1", port: int = 0,
                  heartbeat_timeout: float = 10.0,
-                 round_timeout: Optional[float] = None):
+                 round_timeout: Optional[float] = None,
+                 snapshot_path: Optional[str] = None):
         self.heartbeat_timeout = heartbeat_timeout
         # max wall time an averaging round waits for alive-but-silent
         # workers before finishing without them (progress guarantee; a
@@ -132,6 +137,28 @@ class ClusterCoordinator:
         self._next_rank = 0
         self._avg_rounds: Dict[int, _Round] = {}
         self._barriers: Dict[str, _Round] = {}
+        # durable registry/claim state (HazelCastStateTracker semantics):
+        # every mutation snapshots {ranks, configs, workers} to JSON, and a
+        # restarted coordinator reloads it — shard claims (config keys
+        # "shard_owner/<s>") and ranks survive a coordinator crash, so the
+        # fleet resumes instead of re-sharding from scratch. In-flight
+        # averaging rounds are NOT persisted: contributors' reconnect
+        # logic simply re-submits and a fresh round forms.
+        self.snapshot_path = snapshot_path
+        if snapshot_path and os.path.exists(snapshot_path):
+            with open(snapshot_path) as fh:
+                snap = json.load(fh)
+            self._ranks = {w: int(r) for w, r in snap.get("ranks", {}).items()}
+            self._next_rank = int(snap.get("next_rank", len(self._ranks)))
+            self._configs = dict(snap.get("configs", {}))
+            # restored workers start provisionally alive: their clients'
+            # heartbeats re-confirm within one interval, and treating them
+            # dead instead would let a fast re-claimer steal their shard
+            # slots during the restart gap
+            now = time.monotonic()
+            self._workers = {w: {"rank": self._ranks[w], "last_seen": now}
+                             for w in snap.get("workers", [])
+                             if w in self._ranks}
 
         coord = self
 
@@ -163,6 +190,26 @@ class ClusterCoordinator:
         self._server.shutdown()
         self._server.server_close()
 
+    @property
+    def port(self) -> int:
+        """Bound TCP port (rebind a restarted coordinator to the same one
+        so reconnecting clients find it)."""
+        return self._server.server_address[1]
+
+    def _save_snapshot(self) -> None:
+        """Persist registry/claim state; call under self._lock after every
+        mutation. Atomic tmp+replace so a crash mid-write leaves the
+        previous snapshot intact."""
+        if not self.snapshot_path:
+            return
+        snap = {"version": 1, "ranks": self._ranks,
+                "next_rank": self._next_rank, "configs": self._configs,
+                "workers": sorted(self._workers)}
+        tmp = self.snapshot_path + ".tmp"
+        with open(tmp, "w") as fh:
+            json.dump(snap, fh)
+        os.replace(tmp, self.snapshot_path)
+
     # ------------------------------------------------------------- queries
     def alive_workers(self):
         now = time.monotonic()
@@ -171,6 +218,8 @@ class ClusterCoordinator:
                     if now - info["last_seen"] > self.heartbeat_timeout]
             for w in dead:  # dead-worker removal (MasterActor semantics)
                 del self._workers[w]
+            if dead:
+                self._save_snapshot()
             return dict(self._workers)
 
     # ------------------------------------------------------------ dispatch
@@ -185,6 +234,7 @@ class ClusterCoordinator:
                     self._next_rank += 1
                 self._workers[wid] = {"rank": self._ranks[wid],
                                       "last_seen": time.monotonic()}
+                self._save_snapshot()
                 return {"ok": True, "rank": self._ranks[wid],
                         "n_workers": len(self._workers),
                         "heartbeat_timeout": self.heartbeat_timeout,
@@ -198,12 +248,14 @@ class ClusterCoordinator:
         if op == "deregister":
             with self._lock:
                 self._workers.pop(msg["worker"], None)
+                self._save_snapshot()
             return {"ok": True}, None
         if op == "workers":
             return {"ok": True, "workers": sorted(self.alive_workers())}, None
         if op == "set_config":
             with self._lock:
                 self._configs[msg["key"]] = msg["value"]
+                self._save_snapshot()
             return {"ok": True}, None
         if op == "get_config":
             with self._lock:
@@ -233,6 +285,7 @@ class ClusterCoordinator:
                     owner = self._configs.get(key)
                     if owner is None or owner not in alive:
                         self._configs[key] = wid
+                        self._save_snapshot()
                         return {"ok": True, "slot": s}, None
                 return {"ok": True, "slot": None}, None
         if op == "average":
@@ -304,43 +357,98 @@ class ClusterCoordinator:
 
 class ClusterClient:
     """Worker-side connection to the coordinator (one socket, heartbeats on
-    a daemon thread — the worker actor's heartbeat loop)."""
+    a daemon thread — the worker actor's heartbeat loop).
+
+    Survives a coordinator restart: calls and heartbeats that hit a dead
+    socket reconnect with backoff for up to ``reconnect_timeout`` seconds
+    and re-register (ranks and shard claims are stable — the restarted
+    coordinator reloads them from its snapshot), so a fleet rides through
+    a kill-and-restart of the control plane without losing claims."""
 
     def __init__(self, address: str, worker_id: str,
-                 heartbeat_interval: float = 1.0):
+                 heartbeat_interval: float = 1.0,
+                 reconnect_timeout: float = 30.0):
         host, port = address.rsplit(":", 1)
         self.address = (host, int(port))
         self.worker_id = worker_id
+        self.reconnect_timeout = reconnect_timeout
         self._lock = threading.Lock()
-        self._sock = socket.create_connection(self.address, timeout=120)
-        self._file = self._sock.makefile("rb")
-        reply, _ = self._call({"op": "register"})
-        self.rank = reply["rank"]
-        # a blocked average() waits up to the server's round_timeout; give
-        # the socket comfortable headroom beyond it
-        self._sock.settimeout(2.0 * reply.get("round_timeout", 60.0) + 60.0)
+        self._sock = None
+        self._file = None
+        with self._lock:
+            self._reconnect()  # initial connect retries like any other
         self._hb_stop = threading.Event()
         self._hb = threading.Thread(
             target=self._heartbeat_loop, args=(heartbeat_interval,),
             daemon=True)
         self._hb.start()
 
+    def _connect_once(self) -> None:
+        """One connection + registration attempt (caller holds _lock)."""
+        if self._sock is not None:
+            try:
+                self._sock.close()
+            except OSError:
+                pass
+        self._sock = socket.create_connection(self.address, timeout=120)
+        self._file = self._sock.makefile("rb")
+        _send_msg(self._sock, {"op": "register", "worker": self.worker_id})
+        reply, _ = _recv_msg(self._file)
+        self.rank = reply["rank"]
+        # a blocked average() waits up to the server's round_timeout; give
+        # the socket comfortable headroom beyond it
+        self._sock.settimeout(2.0 * reply.get("round_timeout", 60.0) + 60.0)
+
+    def _reconnect(self) -> None:
+        """Connect/re-register with exponential backoff until
+        reconnect_timeout (caller holds _lock) — the window a restarting
+        coordinator has to come back up."""
+        deadline = time.monotonic() + self.reconnect_timeout
+        backoff = 0.1
+        while True:
+            try:
+                self._connect_once()
+                return
+            except (ConnectionError, OSError):
+                if time.monotonic() + backoff > deadline:
+                    raise
+                time.sleep(backoff)
+                backoff = min(backoff * 2.0, 2.0)
+
     def _call(self, msg: dict, payload: Optional[bytes] = None):
         msg = dict(msg, worker=self.worker_id)
         with self._lock:
-            _send_msg(self._sock, msg, payload)
-            reply, reply_payload = _recv_msg(self._file)
+            deadline = time.monotonic() + self.reconnect_timeout
+            while True:
+                try:
+                    _send_msg(self._sock, msg, payload)
+                    reply, reply_payload = _recv_msg(self._file)
+                    break
+                except (ConnectionError, OSError):
+                    # dead socket (coordinator restart?): the ops are safe
+                    # to re-send — registration/config/claims are
+                    # idempotent and an average contribution is keyed by
+                    # (step, worker). deregister is NOT retried: a dead
+                    # coordinator forgets us anyway.
+                    if (msg.get("op") == "deregister"
+                            or time.monotonic() > deadline):
+                        raise
+                    self._reconnect()
         if not reply.get("ok"):
             raise RuntimeError(f"coordinator error: {reply.get('error')}")
         return reply, reply_payload
 
     def _heartbeat_loop(self, interval: float) -> None:
         # separate connection so heartbeats never queue behind a long
-        # averaging round
-        try:
-            sock = socket.create_connection(self.address, timeout=30)
-            f = sock.makefile("rb")
-            while not self._hb_stop.wait(interval):
+        # averaging round; a broken socket is dropped and re-dialed on the
+        # next beat (coordinator-restart tolerance)
+        sock = None
+        f = None
+        while not self._hb_stop.wait(interval):
+            try:
+                if sock is None:
+                    sock = socket.create_connection(self.address, timeout=30)
+                    f = sock.makefile("rb")
                 _send_msg(sock, {"op": "heartbeat", "worker": self.worker_id})
                 reply, _ = _recv_msg(f)
                 if not reply.get("ok") and not self._hb_stop.is_set():
@@ -351,8 +459,18 @@ class ClusterClient:
                     _send_msg(sock, {"op": "register",
                                      "worker": self.worker_id})
                     _recv_msg(f)
-        except (OSError, ConnectionError):
-            pass
+            except (OSError, ConnectionError):
+                if sock is not None:
+                    try:
+                        sock.close()
+                    except OSError:
+                        pass
+                sock = None
+        if sock is not None:
+            try:
+                sock.close()
+            except OSError:
+                pass
 
     # ---------------------------------------------------------------- API
     def workers(self):
@@ -481,11 +599,15 @@ def initialize_multihost(coordinator_address: Optional[str] = None,
     TPU-native replacement for the reference's Spark/Akka data plane. The
     ClusterCoordinator above remains useful purely as control plane
     (registration, elastic restart, config registry).
-    """
-    import jax
 
-    jax.distributed.initialize(
-        coordinator_address=coordinator_address,
-        num_processes=num_processes,
-        process_id=process_id,
-        local_device_ids=local_device_ids)
+    Compatibility alias: the hardened implementation (env contract,
+    retry/backoff, CPU-fleet collectives, per-process telemetry) lives in
+    `distributed/bootstrap.py` — new code should call
+    `distributed.bootstrap.initialize` directly.
+    """
+    from deeplearning4j_tpu.distributed import bootstrap
+
+    bootstrap.initialize(coordinator_address=coordinator_address,
+                         num_processes=num_processes,
+                         process_id=process_id,
+                         local_device_ids=local_device_ids)

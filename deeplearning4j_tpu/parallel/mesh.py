@@ -8,9 +8,13 @@ workers). Axis conventions used throughout the framework:
 - "model" : tensor parallelism (attention heads / FF hidden sharded)
 - "seq"   : sequence/context parallelism (ring attention)
 
-Multi-host: call jax.distributed.initialize() first (the control plane the
-reference delegated to Spark/ZooKeeper); jax.devices() then spans hosts and
-the same mesh code scales from 1 chip to a multi-slice pod.
+Multi-host: initialize the rendezvous first via
+`distributed.bootstrap.initialize()` (the control plane the reference
+delegated to Spark/ZooKeeper); jax.devices() then spans hosts and the same
+mesh code scales from 1 chip to a multi-slice pod.
+`distributed.global_mesh.make_global_mesh` builds the process-spanning
+mesh; `spans_processes` below is how the train-step plumbing detects that
+host batches need per-process globalization.
 """
 
 from __future__ import annotations
@@ -41,6 +45,13 @@ def make_mesh(axes: dict[str, int] | None = None, *, devices=None) -> Mesh:
     # host-side Device OBJECTS at mesh-build time, not a device sync
     arr = np.asarray(devices[:total]).reshape(sizes)  # graftlint: disable=G002
     return Mesh(arr, tuple(axes.keys()))
+
+
+def spans_processes(mesh: Mesh) -> bool:
+    """True when the mesh's devices live in more than one OS process —
+    the switch that turns set_mesh's DP path multi-process (host batches
+    then globalize via distributed.global_mesh.globalize_batch)."""
+    return len({d.process_index for d in mesh.devices.flat}) > 1
 
 
 def shard_batch(batch, mesh: Mesh, axis: str = "data"):

@@ -3,7 +3,9 @@
 Builds pipeline stages from the REAL network conf — the builder-API
 ComputationGraph (reference ComputationGraphConfiguration.GraphBuilder,
 nn/conf/ComputationGraphConfiguration.java:446) — instead of requiring a
-hand-stacked homogeneous stage_fn (the r2 demo in pipeline_parallel.py):
+hand-stacked homogeneous stage_fn (the retired r2 demo
+`pipeline_parallel.py` — its schedule ideas live in the scan body below;
+ARCHITECTURE.md §The five parallel axes has the history):
 
 - **Partitioning**: the DAG's topological order is scanned for single-value
   cuts (positions where exactly one activation is live); the longest run of

@@ -120,6 +120,13 @@ def configure_mesh(net, mesh, *, zero1=False, axes=None, n_microbatches=None,
         exit_pipeline(net)
     net._mesh = mesh
     net._zero1 = zero1
+    # process-spanning mesh (distributed/bootstrap + global_mesh): host
+    # batches must globalize per process — _batch_dict keys off this flag
+    net._multiprocess = False
+    if mesh is not None:
+        from deeplearning4j_tpu.parallel.mesh import spans_processes
+
+        net._multiprocess = spans_processes(mesh)
     net._mesh_axes = dict(axes) if axes else None
     net._param_sh = None
     net._resolved_rules = None
@@ -137,6 +144,16 @@ def configure_mesh(net, mesh, *, zero1=False, axes=None, n_microbatches=None,
     bad = set(axes) - set(ROLES)
     if bad:
         raise ValueError(f"unknown mesh roles {sorted(bad)}; valid: {ROLES}")
+    if net._multiprocess and set(axes) - {"data"}:
+        # model/expert/pipe placement device_puts param shards host-side,
+        # which cannot target another process's devices; cross-process
+        # TP/PP needs jit-driven placement (ARCHITECTURE.md §Distributed
+        # runtime names the lifting plan)
+        raise ValueError(
+            "a process-spanning mesh currently supports the 'data' role "
+            "only (got {}); model/expert/pipe/seq placement does host-side "
+            "device_puts that cannot reach non-addressable devices — see "
+            "ARCHITECTURE.md §Distributed runtime".format(sorted(axes)))
     for role, ax in axes.items():
         if ax not in mesh.axis_names:
             raise ValueError(
@@ -144,7 +161,9 @@ def configure_mesh(net, mesh, *, zero1=False, axes=None, n_microbatches=None,
                 f"(mesh has {mesh.axis_names})")
     if zero1 and set(axes) - {"data"}:
         raise ValueError("zero1 currently composes with the 'data' axis "
-                         "only — drop it or the model/pipe/expert/seq axes")
+                         "only — drop it or the model/pipe/expert/seq axes "
+                         "(ARCHITECTURE.md §Placement design notes has the "
+                         "lifting plan)")
     if "seq" in axes:
         # sequence parallelism shards TIME inside shard_map: the layer
         # impls must know the ring axis (ring attention, offset posenc) —
@@ -157,7 +176,9 @@ def configure_mesh(net, mesh, *, zero1=False, axes=None, n_microbatches=None,
             raise ValueError(
                 "the 'seq' axis composes with 'data', 'model' and 'pipe' "
                 "(time-sharded ring attention runs manual inside the SP "
-                "or PP shard_map; 'expert' needs a different schedule)")
+                "or PP shard_map; 'expert' needs a different schedule — "
+                "ARCHITECTURE.md §Placement design notes carries the "
+                "seq x expert impossibility argument)")
         if not hasattr(net, "layer_vertices"):
             raise ValueError(
                 "the 'seq' axis requires the ComputationGraph container "

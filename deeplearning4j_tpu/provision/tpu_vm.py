@@ -5,7 +5,9 @@ running/terminate EC2 boxes) and `provision/install-deps.sh`-style
 bootstrap. The TPU equivalent provisions TPU-VM pod slices: this module
 generates the exact `gcloud compute tpus tpu-vm ...` invocations, the
 per-host bootstrap script, and the multi-host launch plan wired to
-`parallel.cluster.initialize_multihost` (jax.distributed). It builds
+`distributed.bootstrap.initialize` (jax.distributed) — either via TPU
+metadata auto-detection or via `pod_launch_script`'s explicit env
+contract, the same one the off-TPU test fleet uses. It builds
 COMMANDS and SCRIPTS rather than calling cloud APIs directly — the
 environment has no egress and no cloud credentials, and emitting the plan
 keeps it auditable and dry-runnable (`--dry-run` prints what would run).
@@ -102,14 +104,45 @@ touch ~/.deeplearning4j_tpu_provisioned
 """
 
 
+def pod_launch_script(train_command: str, num_hosts: int,
+                      coordinator_port: int = 8476) -> str:
+    """Pod-ready launch script for EVERY host of a slice, driving the
+    `distributed/bootstrap.py` env contract on real TPU hardware.
+
+    Cloud TPU runtime images export ``TPU_WORKER_ID`` (this host's index)
+    and ``TPU_WORKER_HOSTNAMES`` (comma list, host 0 first) on each VM;
+    the script translates them into the same DL4J_TPU_* contract the
+    local launcher wires, with host 0 as the jax.distributed coordinator.
+    `bootstrap.initialize()` inside the training entrypoint then behaves
+    identically on a pod and in an off-TPU simulated fleet — one
+    rendezvous code path, exercised by the CPU tests, launched here.
+    """
+    from deeplearning4j_tpu.distributed import bootstrap as _bootstrap
+
+    return f"""#!/usr/bin/env bash
+set -euo pipefail
+# rendezvous env contract (deeplearning4j_tpu/distributed/bootstrap.py):
+# host 0 of the slice hosts the jax.distributed coordination service
+WORKER_ID="${{TPU_WORKER_ID:-0}}"
+HOSTS="${{TPU_WORKER_HOSTNAMES:-127.0.0.1}}"
+COORD_HOST="${{HOSTS%%,*}}"
+export {_bootstrap.ENV_PROCESS_ID}="$WORKER_ID"
+export {_bootstrap.ENV_NUM_PROCESSES}={num_hosts}
+export {_bootstrap.ENV_COORDINATOR}="$COORD_HOST:{coordinator_port}"
+exec {train_command}
+"""
+
+
 class TpuPodLauncher:
     """Multi-host launch plan: bootstrap every host, then start the same
     training entrypoint on each with jax.distributed coordinates (the
     reference's master/worker actor bootstrap, minus Akka).
 
     Process 0's host doubles as the jax.distributed coordinator; the
-    training entrypoint calls `parallel.cluster.initialize_multihost`
-    with the env vars this launcher sets.
+    training entrypoint calls `distributed.bootstrap.initialize()` (or
+    the `parallel.cluster.initialize_multihost` alias), fed either by
+    TPU-metadata auto-detection or by the explicit env contract of
+    `pod_launch_script`.
     """
 
     def __init__(self, creator: TpuVmCreator):
@@ -119,7 +152,7 @@ class TpuPodLauncher:
         """One broadcast ssh (`--worker=all`) running the training
         entrypoint on every host. On Cloud TPU pod slices
         `jax.distributed.initialize()` (and thus
-        `parallel.cluster.initialize_multihost()` with no arguments)
+        `distributed.bootstrap.initialize()` with no arguments)
         auto-detects coordinator address, process count, and process id
         from the TPU metadata server — no per-host environment wiring is
         needed or attempted here."""
@@ -127,9 +160,27 @@ class TpuPodLauncher:
         remote = f"DL4J_TPU_EXPECTED_HOSTS={n} {train_command}"
         return [self.creator.ssh_command(remote, worker="all")]
 
+    def pod_launch_commands(self, train_command: str,
+                            coordinator_port: int = 8476) -> List[List[str]]:
+        """Broadcast launch through `pod_launch_script`: every host runs
+        the same script, which derives its process id / coordinator from
+        the TPU runtime env and exports the explicit DL4J_TPU_* contract
+        before exec'ing the entrypoint. Use this instead of
+        `launch_commands` when the rendezvous must be explicit (mixed
+        runtime versions, DCN multi-slice, or debugging a wedged
+        auto-detection)."""
+        script = pod_launch_script(train_command, self.creator.num_hosts(),
+                                   coordinator_port)
+        encoded = base64.b64encode(script.encode()).decode()
+        return [self.creator.ssh_command(
+            f"echo {encoded} | base64 -d | bash", worker="all")]
+
     def plan(self, train_command: str,
-             package_source: str = "deeplearning4j_tpu") -> List[str]:
-        """Full ordered dry-run plan as printable shell lines."""
+             package_source: str = "deeplearning4j_tpu",
+             explicit_rendezvous: bool = False) -> List[str]:
+        """Full ordered dry-run plan as printable shell lines.
+        explicit_rendezvous=True launches through `pod_launch_script`'s
+        env contract instead of TPU-metadata auto-detection."""
         script = bootstrap_script(package_source)
         # ship the multiline script intact: base64 through the ssh command
         # (newline-folding would hide everything behind the shebang comment)
@@ -137,5 +188,8 @@ class TpuPodLauncher:
         steps = [self.creator.create_command()]
         steps.append(self.creator.ssh_command(
             f"echo {encoded} | base64 -d | bash", worker="all"))
-        steps += self.launch_commands(train_command)
+        if explicit_rendezvous:
+            steps += self.pod_launch_commands(train_command)
+        else:
+            steps += self.launch_commands(train_command)
         return [" ".join(shlex.quote(part) for part in cmd) for cmd in steps]

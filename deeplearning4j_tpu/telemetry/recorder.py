@@ -223,15 +223,34 @@ def set_default(recorder: Recorder | None) -> Recorder | None:
     return prev
 
 
+def _process_scoped(path: str) -> str:
+    """Multi-process safety: N fleet processes inherit ONE
+    `DL4J_TPU_TELEMETRY` value from their launcher, and while O_APPEND
+    keeps whole lines intact, N interleaved event streams in one file are
+    unattributable (and a `requote` recovery can't tell whose crash it is
+    reading). When the rendezvous contract names a process id
+    (distributed/bootstrap.py), each process appends to its own
+    `<path>.p<id>` instead — two writers, two parseable logs."""
+    try:
+        from deeplearning4j_tpu.distributed.bootstrap import ENV_PROCESS_ID
+    except Exception:  # pragma: no cover - stubbed package layouts
+        return path
+    process_id = os.environ.get(ENV_PROCESS_ID)
+    if process_id is None:
+        return path
+    return f"{path}.p{process_id}"
+
+
 def get_default() -> Recorder:
     """The process-global recorder. Resolution order: an explicit
     `set_default`, else a file recorder appending to `$DL4J_TPU_TELEMETRY`
-    (created on first use), else a no-op NullRecorder."""
+    (created on first use; suffixed per process when the distributed
+    rendezvous contract is active), else a no-op NullRecorder."""
     global _default
     if _default is not None:
         return _default
     path = os.environ.get(ENV_VAR)
     if path:
-        _default = Recorder(path)
+        _default = Recorder(_process_scoped(path))
         return _default
     return _NULL

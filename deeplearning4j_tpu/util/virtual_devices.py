@@ -18,6 +18,20 @@ import re
 _FLAG = "xla_force_host_platform_device_count"
 
 
+def cpu_device_flags(n: int, existing: str = "") -> str:
+    """An XLA_FLAGS value forcing >= ``n`` virtual host devices — a pure
+    string operation (no jax import, no backend touch), so the
+    multi-process bootstrap can set it BEFORE jax.distributed.initialize
+    without tripping the backends-already-initialized check."""
+    flags = existing
+    m = re.search(rf"--{_FLAG}=(\d+)", flags)
+    if m is None:
+        flags = (flags + f" --{_FLAG}={n}").strip()
+    elif int(m.group(1)) < n:
+        flags = flags.replace(m.group(0), f"--{_FLAG}={n}")
+    return flags
+
+
 def ensure_cpu_devices(n: int) -> None:
     """Force a pure-CPU JAX platform with at least ``n`` virtual devices.
 
@@ -46,13 +60,8 @@ def ensure_cpu_devices(n: int) -> None:
     if initialized and len(jax.devices()) >= n:
         return
 
-    flags = os.environ.get("XLA_FLAGS", "")
-    m = re.search(rf"--{_FLAG}=(\d+)", flags)
-    if m is None:
-        flags = (flags + f" --{_FLAG}={n}").strip()
-    elif int(m.group(1)) < n:
-        flags = flags.replace(m.group(0), f"--{_FLAG}={n}")
-    os.environ["XLA_FLAGS"] = flags
+    os.environ["XLA_FLAGS"] = cpu_device_flags(
+        n, os.environ.get("XLA_FLAGS", ""))
     os.environ["JAX_PLATFORMS"] = "cpu"
     jax.config.update("jax_platforms", "cpu")
     if _xb is not None and not _xb.backends_are_initialized():

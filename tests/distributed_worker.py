@@ -1,0 +1,56 @@
+"""One member of the 2-process x 4-virtual-device pjit fleet spawned by
+tests/test_distributed.py via `distributed.launch_local`.
+
+Run: python tests/distributed_worker.py <out_dir>
+
+The launcher provides the whole rendezvous env contract
+(DL4J_TPU_COORDINATOR/PROCESS_ID/NUM_PROCESSES/LOCAL_DEVICE_COUNT plus
+the virtual-CPU XLA flags); this script only has to call
+`bootstrap.initialize()`, build the global mesh, and run ONE jitted
+allreduce train step through the ordinary `set_mesh` + `fit` path on its
+local batch shard. It saves the resulting flat parameter vector so the
+test can assert bit-identical replicas across processes and parity with
+the single-process full-batch reference.
+"""
+
+import os
+import sys
+
+
+def main() -> int:
+    out_dir = sys.argv[1]
+
+    from deeplearning4j_tpu.distributed import bootstrap
+
+    info = bootstrap.initialize(connect_timeout=60.0)
+    print(f"rendezvous up: {info}", flush=True)
+
+    import numpy as np
+
+    from deeplearning4j_tpu.datasets.api import DataSet
+    from deeplearning4j_tpu.distributed.global_mesh import (
+        local_shard,
+        make_global_mesh,
+        spans_processes,
+    )
+    from tests.cluster_worker import build_net, full_data
+
+    mesh = make_global_mesh({"data": -1})
+    assert spans_processes(mesh), "mesh does not span processes"
+    net = build_net().init()  # same seed everywhere -> identical replicas
+    net.set_mesh(mesh)
+
+    x, y = full_data()
+    ds = DataSet(local_shard(x), local_shard(y))  # this process's rows
+    net.fit(ds)  # ONE jitted allreduce train step over the global mesh
+
+    pid = info["process_id"]
+    flat = np.asarray(net.params_flat())
+    np.save(os.path.join(out_dir, f"params_p{pid}.npy"), flat)
+    print(f"p{pid}: step done, score={net.score_value:.6f}, "
+          f"devices={info['global_devices']}", flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

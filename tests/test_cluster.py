@@ -207,6 +207,92 @@ def test_two_process_computation_graph_training(tmp_path):
     assert np.isfinite(flat0).all()
 
 
+def test_coordinator_snapshot_roundtrip(tmp_path):
+    """Registry/rank/config/claim state persists on every mutation and a
+    fresh coordinator reloads it from the JSON snapshot."""
+    import json
+
+    snap = str(tmp_path / "coord.json")
+    c1 = ClusterCoordinator(heartbeat_timeout=5.0, snapshot_path=snap).start()
+    try:
+        a = ClusterClient(c1.address, "wA", heartbeat_interval=0.2)
+        b = ClusterClient(c1.address, "wB", heartbeat_interval=0.2)
+        a.set_config("training", {"lr": 0.1})
+        sa, sb = a.claim_slot(2), b.claim_slot(2)
+        assert {sa, sb} == {0, 1}
+        a.close(deregister=False)
+        b.close(deregister=False)
+    finally:
+        c1.shutdown()
+    data = json.load(open(snap))
+    assert data["ranks"] == {"wA": 0, "wB": 1}
+    assert data["configs"]["training"] == {"lr": 0.1}
+    assert data["configs"][f"shard_owner/{sa}"] == "wA"
+    assert sorted(data["workers"]) == ["wA", "wB"]
+
+    c2 = ClusterCoordinator(heartbeat_timeout=5.0, snapshot_path=snap).start()
+    try:
+        # reloaded: ranks stable, claims intact, restored workers count
+        # as provisionally alive so nothing is stealable
+        a2 = ClusterClient(c2.address, "wA", heartbeat_interval=0.2)
+        assert a2.rank == 0
+        assert a2.get_config("training") == {"lr": 0.1}
+        assert a2.claim_slot(2) == sa  # idempotent re-claim, not a steal
+        c = ClusterClient(c2.address, "wC", heartbeat_interval=0.2)
+        assert c.claim_slot(2) is None  # wB's slot survived the restart
+        a2.close(); c.close()
+    finally:
+        c2.shutdown()
+
+
+def test_kill_coordinator_and_restart_preserves_claims(tmp_path):
+    """The acceptance-criterion recovery: kill the coordinator mid-fleet,
+    restart it on the same port from its snapshot, and the SAME live
+    clients ride through — reconnect + re-register, keep their ranks and
+    shard claims, and finish an averaging round together."""
+    snap = str(tmp_path / "coord.json")
+    c1 = ClusterCoordinator(heartbeat_timeout=5.0, round_timeout=10.0,
+                            snapshot_path=snap).start()
+    port = c1.port
+    a = ClusterClient(c1.address, "wA", heartbeat_interval=0.2,
+                      reconnect_timeout=30.0)
+    b = ClusterClient(c1.address, "wB", heartbeat_interval=0.2,
+                      reconnect_timeout=30.0)
+    sa, sb = a.claim_slot(2), b.claim_slot(2)
+    assert {sa, sb} == {0, 1}
+    rank_a, rank_b = a.rank, b.rank
+
+    c1.shutdown()  # coordinator dies with the fleet still running
+    time.sleep(0.5)
+    c2 = ClusterCoordinator(port=port, heartbeat_timeout=5.0,
+                            round_timeout=10.0, snapshot_path=snap).start()
+    try:
+        # the LIVE clients reconnect on their next call and keep identity
+        assert a.claim_slot(2) == sa
+        assert b.claim_slot(2) == sb
+        assert (a.rank, b.rank) == (rank_a, rank_b)
+
+        # the fleet finishes the round through the restarted coordinator
+        out = {}
+
+        def go(client, vec):
+            out[client.worker_id] = client.average(
+                1, np.asarray(vec, np.float32))
+
+        ta = threading.Thread(target=go, args=(a, [1.0, 3.0]))
+        tb = threading.Thread(target=go, args=(b, [3.0, 5.0]))
+        ta.start(); tb.start()
+        ta.join(timeout=30); tb.join(timeout=30)
+        assert not ta.is_alive() and not tb.is_alive(), \
+            "round never completed after coordinator restart"
+        np.testing.assert_allclose(out["wA"], [2.0, 4.0])
+        np.testing.assert_allclose(out["wB"], [2.0, 4.0])
+        a.close()
+        b.close()
+    finally:
+        c2.shutdown()
+
+
 def test_claim_slot_atomic_and_elastic(coord):
     a = ClusterClient(coord.address, "wA", heartbeat_interval=0.2)
     b = ClusterClient(coord.address, "wB", heartbeat_interval=0.2)

@@ -1,0 +1,282 @@
+"""Multi-process runtime (deeplearning4j_tpu/distributed/): the tier-1
+proof that a mesh spanning 2 OS processes x 4 virtual CPU devices runs
+ONE jitted allreduce train step through the ordinary `set_mesh` path
+with bit-identical resulting params on every process (VERDICT r5
+Missing #1 — until this test, the L8 "distributed" column was a claim),
+plus the rendezvous env contract, the launcher's straggler reaping and
+log streaming, per-process telemetry logs, the bootstrap failure mode,
+and the CLI / pod dry-run plans.
+
+Every spawned-process test carries a hard subprocess timeout (the
+launcher enforces its own wall-clock deadline on top)."""
+
+import json
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.distributed import bootstrap
+from deeplearning4j_tpu.distributed.launcher import (
+    free_port,
+    launch_local,
+    launch_plan,
+)
+
+pytestmark = pytest.mark.distributed
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _clean_env(extra=None):
+    """Child env additions: import path + no inherited rendezvous or
+    telemetry state leaking from the test process."""
+    env = {"PYTHONPATH": ROOT}
+    env.update(extra or {})
+    return env
+
+
+# ------------------------------------------------------------ the proof
+
+def test_two_process_pjit_mesh_runs_one_allreduce_step(tmp_path):
+    """2 processes x 4 virtual CPU devices rendezvous via
+    jax.distributed, build the 8-device global mesh, and run one jitted
+    allreduce train step via set_mesh/fit on per-process batch shards:
+    params must come out BIT-identical on both processes and match the
+    single-process full-batch reference (gradient linearity)."""
+    results = launch_local(
+        [sys.executable, "tests/distributed_worker.py", str(tmp_path)],
+        n_processes=2, local_device_count=4, timeout=240.0,
+        extra_env=_clean_env(), cwd=ROOT)
+    for r in results:
+        assert not r.timed_out, f"p{r.process_id} timed out:\n{r.output}"
+        assert r.returncode == 0, f"p{r.process_id} failed:\n{r.output}"
+
+    p0 = np.load(str(tmp_path / "params_p0.npy"))
+    p1 = np.load(str(tmp_path / "params_p1.npy"))
+    assert np.array_equal(p0, p1), "replicas diverged across processes"
+
+    # single-process full-batch reference: same config, same seed, one
+    # step — DP averaging over equal shards must equal the full batch
+    from deeplearning4j_tpu.datasets.api import DataSet
+    from tests.cluster_worker import build_net, full_data
+
+    x, y = full_data()
+    ref = build_net().init()
+    ref.fit(DataSet(x, y))
+    np.testing.assert_allclose(p0, np.asarray(ref.params_flat()),
+                               atol=1e-5)
+
+
+def test_bootstrap_failure_mode_is_bounded(tmp_path):
+    """The documented failure mode of a fleet member whose coordinator
+    never appears: on this jax generation the XLA distributed client
+    ABORTS the process (SIGABRT, "Deadline Exceeded") once init_timeout
+    expires — no Python exception ever surfaces, which is exactly why
+    the launcher must reap and capture logs (ARCHITECTURE.md
+    §Distributed runtime failure matrix). Assert the death is bounded
+    and attributable, not hung."""
+    script = (
+        "from deeplearning4j_tpu.distributed import bootstrap\n"
+        "try:\n"
+        "    bootstrap.initialize(coordinator_address='127.0.0.1:9',\n"
+        "                         num_processes=2, process_id=1,\n"
+        "                         connect_timeout=6.0, init_timeout=2)\n"
+        "except Exception as exc:\n"
+        "    print('RAISED', type(exc).__name__)\n"
+        "    raise SystemExit(0)\n"
+        "raise SystemExit(1)\n")
+    env = dict(os.environ)
+    env.update(_clean_env({"JAX_PLATFORMS": "cpu"}))
+    proc = subprocess.run([sys.executable, "-c", script], env=env, cwd=ROOT,
+                          capture_output=True, text=True, timeout=120)
+    # either outcome is the documented contract: a clean Python raise
+    # (newer jax) or the hard C++ abort with the deadline marker (this
+    # jax) — a silent hang or a bogus success is the only failure
+    if proc.returncode == 0:
+        assert "RAISED" in proc.stdout
+    else:
+        blob = proc.stdout + proc.stderr
+        assert ("Deadline Exceeded" in blob
+                or "DEADLINE_EXCEEDED" in blob), blob
+
+
+# ------------------------------------------------------------- launcher
+
+def test_launcher_reaps_stragglers():
+    """A fleet member that never exits is terminated (then killed) at the
+    wall-clock deadline — no spawned-process test can hang the suite."""
+    results = launch_local(
+        [sys.executable, "-c", "import time; print('up', flush=True); "
+                               "time.sleep(600)"],
+        n_processes=2, local_device_count=None, timeout=3.0, grace=2.0)
+    assert all(r.timed_out for r in results)
+    # the reaper observed their death (terminate or kill), so no zombies
+    assert all(r.returncode is None or r.returncode != 0 for r in results)
+
+
+def test_launcher_streams_prefixed_logs_and_env_contract():
+    """Each process's lines are captured per-process and echoed with a
+    [pN] prefix; the rendezvous env contract reaches every child."""
+    echoed = []
+    script = ("import os; "
+              "print(os.environ['DL4J_TPU_PROCESS_ID'], "
+              "os.environ['DL4J_TPU_NUM_PROCESSES'], "
+              "os.environ['DL4J_TPU_COORDINATOR'], flush=True)")
+    results = launch_local([sys.executable, "-c", script], n_processes=3,
+                           local_device_count=None, timeout=60.0,
+                           echo=echoed.append)
+    assert [r.returncode for r in results] == [0, 0, 0]
+    for i, r in enumerate(results):
+        pid, n, coord = r.lines[0].split()
+        assert (pid, n) == (str(i), "3")
+        assert coord.startswith("127.0.0.1:")
+    assert any(line.startswith("[p2] ") for line in echoed)
+
+
+def test_launch_plan_lines_are_complete():
+    lines = launch_plan(["python", "train.py"], n_processes=2,
+                        local_device_count=4,
+                        coordinator="127.0.0.1:5555")
+    assert len(lines) == 3 and lines[-1] == "wait"
+    for i, line in enumerate(lines[:2]):
+        assert f"{bootstrap.ENV_PROCESS_ID}={i}" in line
+        assert f"{bootstrap.ENV_COORDINATOR}=127.0.0.1:5555" in line
+        assert f"{bootstrap.ENV_NUM_PROCESSES}=2" in line
+        assert "xla_force_host_platform_device_count=4" in line
+        assert line.endswith("python train.py &")
+
+
+# ------------------------------------------------------------- contract
+
+def test_rendezvous_env_roundtrip():
+    env = bootstrap.rendezvous_env("10.0.0.1:8476", 3, 8,
+                                   local_device_count=4)
+    assert bootstrap.env_contract_present(env)
+    parsed = bootstrap.contract_from_env(env)
+    assert parsed == {"coordinator_address": "10.0.0.1:8476",
+                      "process_id": 3, "num_processes": 8,
+                      "local_device_count": 4}
+    assert not bootstrap.env_contract_present({})
+    assert bootstrap.contract_from_env({})["process_id"] is None
+
+
+def test_free_port_is_bindable():
+    import socket
+
+    port = free_port()
+    with socket.socket() as s:
+        s.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        s.bind(("127.0.0.1", port))
+
+
+# ----------------------------------------------------------- global mesh
+
+def test_single_process_mesh_passes_batches_through():
+    """Off the multi-process path nothing changes: a local mesh does not
+    span processes and globalize_batch is the identity."""
+    from deeplearning4j_tpu.distributed.global_mesh import (
+        globalize_batch,
+        local_shard,
+        make_global_mesh,
+        spans_processes,
+    )
+
+    mesh = make_global_mesh({"data": -1})
+    assert not spans_processes(mesh)
+    batch = {"features": np.ones((4, 2), np.float32)}
+    assert globalize_batch(batch, mesh) is batch
+    # one process: the local shard IS the full array
+    x = np.arange(8.0).reshape(4, 2)
+    np.testing.assert_array_equal(local_shard(x), x)
+
+
+def test_multiprocess_rejects_param_placement_roles(monkeypatch):
+    """Process-spanning meshes support the data role only — the error
+    must name the restriction and point at the design note."""
+    import deeplearning4j_tpu.parallel.mesh as mesh_mod
+    from deeplearning4j_tpu.parallel.mesh import make_mesh
+    from tests.cluster_worker import build_net
+
+    net = build_net().init()
+    mesh = make_mesh({"data": 1})
+    # configure_mesh recomputes _multiprocess from the mesh, so patch the
+    # detector it calls rather than the attribute
+    monkeypatch.setattr(mesh_mod, "spans_processes", lambda m: True)
+    with pytest.raises(ValueError, match="Distributed runtime"):
+        net.set_mesh(mesh, axes={"data": "data", "model": "data"})
+
+
+# ------------------------------------------------- per-process telemetry
+
+def test_two_telemetry_writers_two_parseable_logs(tmp_path):
+    """N fleet processes sharing one DL4J_TPU_TELEMETRY value must not
+    interleave a single JSONL: with the env contract active each writes
+    `<path>.p<id>`, and both logs parse line-by-line."""
+    base = str(tmp_path / "run.jsonl")
+    script = ("from deeplearning4j_tpu.telemetry.recorder import "
+              "get_default\n"
+              "rec = get_default()\n"
+              "rec.meta(role='writer')\n"
+              "rec.event('span', name='work', seconds=0.1)\n")
+    for pid in ("0", "1"):
+        env = dict(os.environ)
+        env.update(_clean_env({"DL4J_TPU_TELEMETRY": base,
+                               bootstrap.ENV_PROCESS_ID: pid}))
+        proc = subprocess.run([sys.executable, "-c", script], env=env,
+                              cwd=ROOT, capture_output=True, timeout=60)
+        assert proc.returncode == 0, proc.stderr.decode()
+    assert not os.path.exists(base), "writers clobbered the shared path"
+    for pid in ("0", "1"):
+        events = [json.loads(l) for l in open(f"{base}.p{pid}")]
+        assert [e["event"] for e in events] == ["meta", "span"]
+        assert all(e["run"] for e in events)
+
+
+# ------------------------------------------------------------- dry runs
+
+def test_cli_multiprocess_prints_launch_plan(tmp_path, capsys):
+    from deeplearning4j_tpu.cli import main
+
+    conf = tmp_path / "conf.json"
+    conf.write_text("{}")  # never parsed: the plan prints before loading
+    argv = ["train", "--conf", str(conf), "--input", "d.csv",
+            "--model", "m.zip", "--num-classes", "2",
+            "--mesh", "data=8", "--multiprocess", "2",
+            "--local-devices", "4"]
+    assert main(argv) == 0
+    out = capsys.readouterr().out
+    lines = [l for l in out.splitlines() if l.endswith("&")]
+    assert len(lines) == 2
+    for i, line in enumerate(lines):
+        assert f"{bootstrap.ENV_PROCESS_ID}={i}" in line
+        assert "--mesh data=8" in line
+        # the plan flags themselves are scrubbed from the child command
+        assert "--multiprocess" not in line
+        assert "--local-devices" not in line
+    assert out.splitlines()[-1] == "wait"
+
+
+def test_pod_launch_script_drives_bootstrap_contract():
+    from deeplearning4j_tpu.provision.tpu_vm import (
+        TpuPodLauncher,
+        TpuVmCreator,
+        pod_launch_script,
+    )
+
+    script = pod_launch_script("python3 -m deeplearning4j_tpu.cli train "
+                               "--conf c.json", num_hosts=4,
+                               coordinator_port=8476)
+    assert f'export {bootstrap.ENV_PROCESS_ID}="$WORKER_ID"' in script
+    assert f"export {bootstrap.ENV_NUM_PROCESSES}=4" in script
+    assert f'export {bootstrap.ENV_COORDINATOR}="$COORD_HOST:8476"' \
+        in script
+    assert "TPU_WORKER_HOSTNAMES" in script and script.startswith("#!")
+
+    creator = TpuVmCreator(name="pod", accelerator_type="v5litepod-32")
+    plan = TpuPodLauncher(creator).plan("python3 train.py",
+                                       explicit_rendezvous=True)
+    assert len(plan) == 3  # create, bootstrap, rendezvous launch
+    assert "base64 -d | bash" in plan[-1]

@@ -1,4 +1,4 @@
-"""Tier-1 gate for graftlint (ISSUE 2): every AST rule G001-G008 proven
+"""Tier-1 gate for graftlint (ISSUE 2): every AST rule G001-G009 proven
 on a positive AND a negative fixture, the suppression + baseline
 machinery, the stage-2 jaxpr audit over every public entry point, and
 the package itself held lint-clean (zero non-baselined findings).
@@ -254,6 +254,35 @@ def f(x, acc=None):
     acc.append(x)
     return jnp.zeros((4,)) + x
 """),
+    ("G009", """\
+def up(addr, n, i):
+    jax.distributed.initialize(coordinator_address=addr,
+                               num_processes=n, process_id=i)
+""", """\
+def up(addr, n, i):
+    from deeplearning4j_tpu.distributed import bootstrap
+
+    bootstrap.initialize(coordinator_address=addr, num_processes=n,
+                         process_id=i)
+"""),
+    ("G009", """\
+import os
+
+
+def wire(env):
+    env["DL4J_TPU_PROCESS_ID"] = "0"
+    return os.environ.get("DL4J_TPU_COORDINATOR")
+""", """\
+import os
+
+from deeplearning4j_tpu.distributed.bootstrap import (ENV_COORDINATOR,
+                                                      ENV_PROCESS_ID)
+
+
+def wire(env):
+    env[ENV_PROCESS_ID] = "0"
+    return os.environ.get(ENV_COORDINATOR)
+"""),
 ]
 
 
@@ -267,7 +296,7 @@ def test_rule_fires_on_positive_not_negative(rule, pos, neg):
 
 def test_every_rule_has_fixture_coverage():
     assert {r for r, _, _ in FIXTURES} == set(RULE_DOCS) == {
-        f"G00{i}" for i in range(1, 9)}
+        f"G00{i}" for i in range(1, 10)}
 
 
 def test_g002_scoped_to_hot_paths():
@@ -281,6 +310,15 @@ def test_g007_exempts_compat_itself():
     src = "from jax.experimental.shard_map import shard_map\n"
     assert "G007" in rules_in(src, "deeplearning4j_tpu/parallel/x.py")
     assert "G007" not in rules_in(src, "deeplearning4j_tpu/util/compat.py")
+
+
+def test_g009_exempts_bootstrap_itself():
+    src = ("def up():\n"
+           "    jax.distributed.initialize()\n"
+           'ENV = "DL4J_TPU_NUM_PROCESSES"\n')
+    assert "G009" in rules_in(src, "deeplearning4j_tpu/parallel/x.py")
+    assert "G009" not in rules_in(
+        src, "deeplearning4j_tpu/distributed/bootstrap.py")
 
 
 def test_inline_suppression_and_fixit():

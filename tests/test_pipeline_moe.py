@@ -1,7 +1,8 @@
-"""Pipeline parallelism (parallel/pipeline_parallel.py) and MoE + expert
-parallelism (nn/layers/moe.py, parallel/expert_parallel.py): parity with
-dense/sequential references on the virtual mesh, differentiability, and
-training integration."""
+"""MoE + expert parallelism (nn/layers/moe.py, parallel/expert_parallel.py):
+parity with dense/sequential references on the virtual mesh,
+differentiability, and training integration. (The r2 hand-stacked GPipe
+demo once tested here was folded into parallel/pipeline.py's PipelinePlan
+— the production PP path, covered by test_unified_mesh.)"""
 
 import jax
 import jax.numpy as jnp
@@ -23,72 +24,6 @@ from deeplearning4j_tpu.parallel.expert_parallel import (
     shard_expert_params,
 )
 from deeplearning4j_tpu.parallel.mesh import make_mesh
-from deeplearning4j_tpu.parallel.pipeline_parallel import (
-    pipeline_apply,
-    pipeline_loss,
-    shard_stacked_params,
-    stack_stage_params,
-)
-
-
-def _stages(S, D, seed=0):
-    rng = np.random.default_rng(seed)
-    return [{"w": jnp.asarray(rng.standard_normal((D, D)) * 0.3, jnp.float32),
-             "b": jnp.asarray(rng.standard_normal(D) * 0.1, jnp.float32)}
-            for _ in range(S)]
-
-
-def _stage_fn(p, x):
-    return jnp.tanh(x @ p["w"] + p["b"])
-
-
-@pytest.mark.parametrize("S,M", [(4, 8), (8, 8), (2, 6)])
-def test_pipeline_forward_matches_sequential(S, M):
-    D, mb = 16, 4
-    mesh = make_mesh({"pipe": S})
-    stages = _stages(S, D)
-    stacked = shard_stacked_params(stack_stage_params(stages), mesh)
-    x = jnp.asarray(np.random.default_rng(1).standard_normal((M * mb, D)),
-                    jnp.float32)
-    out = pipeline_apply(_stage_fn, stacked, x, mesh=mesh, n_microbatches=M)
-    ref = x
-    for p in stages:
-        ref = _stage_fn(p, ref)
-    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=1e-5)
-
-
-def test_pipeline_gradients_match_sequential():
-    S, M, D, mb = 4, 8, 16, 4
-    mesh = make_mesh({"pipe": S})
-    stages = _stages(S, D)
-    stacked = shard_stacked_params(stack_stage_params(stages), mesh)
-    rng = np.random.default_rng(2)
-    x = jnp.asarray(rng.standard_normal((M * mb, D)), jnp.float32)
-    y = jnp.asarray(rng.standard_normal((M * mb, D)), jnp.float32)
-
-    def loss_pp(sp):
-        return pipeline_loss(_stage_fn, lambda o, t: jnp.mean((o - t) ** 2),
-                             sp, x, y, mesh=mesh, n_microbatches=M)
-
-    def loss_seq(plist):
-        h = x
-        for p in plist:
-            h = _stage_fn(p, h)
-        return jnp.mean((h - y) ** 2)
-
-    g_pp = jax.grad(loss_pp)(stacked)
-    g_seq = stack_stage_params(jax.grad(loss_seq)(stages))
-    for k in ("w", "b"):
-        np.testing.assert_allclose(np.asarray(g_pp[k]), np.asarray(g_seq[k]),
-                                   atol=1e-5)
-
-
-def test_pipeline_rejects_bad_microbatching():
-    mesh = make_mesh({"pipe": 4})
-    stacked = shard_stacked_params(stack_stage_params(_stages(4, 8)), mesh)
-    with pytest.raises(ValueError):
-        pipeline_apply(_stage_fn, stacked, jnp.zeros((10, 8)), mesh=mesh,
-                       n_microbatches=3)
 
 
 # -------------------------------------------------------------------- MoE

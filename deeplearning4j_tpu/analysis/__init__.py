@@ -1,20 +1,29 @@
-"""graftlint — JAX/TPU static analysis for this repo (ISSUE 2).
+"""graftlint — JAX/TPU static analysis for this repo (ISSUEs 2 + 5).
 
-Two stages:
+Three stages:
 
-1. AST pass (`ast_pass.lint_paths`): rules G001-G009 over the package —
+1. AST pass (`ast_pass.lint_paths`): rules G001-G013 over the package —
    tracer leaks, host syncs in hot paths, float64 drift, RNG discipline,
    retrace hazards, shard_map arity, util/compat bypasses, import-time
-   device captures, rendezvous plumbing outside distributed/bootstrap.
-   Pure stdlib; never imports jax.
+   device captures, rendezvous plumbing outside distributed/bootstrap
+   (G001-G009, ast_rules.py), and the SPMD rank-divergence shapes:
+   rank-guarded collectives/jit/mesh, host nondeterminism into traced
+   values, unbound collective axis names, rank-conditional host syncs
+   (G010-G013, spmd_rules.py). Pure stdlib; never imports jax.
 2. jaxpr audit (`jaxpr_audit.audit`): traces the public jitted entry
    points with abstract inputs on CPU and asserts the programs are
    transfer-clean (J001), within frozen op-count budgets (J002), and
    float64-free (J003).
+3. collective audit (`collective_audit.audit`, `--stage spmd`): ordered
+   collective signatures per distributed/parallel entry point checked
+   against a frozen budget (C001/C002), plus re-tracing under simulated
+   process_index 0 vs 1 — a rank-divergent sequence is a fleet-DEADLOCK
+   finding (C003), never a budget diff.
 
 CLI: `python tools/graftlint.py --check deeplearning4j_tpu`. Inline
 suppression: `# graftlint: disable=G00x`; grandfathered findings live in
-tools/graftlint_baseline.json. Gate: tests/test_graftlint.py (tier-1).
+tools/graftlint_baseline.json. Gates: tests/test_graftlint.py +
+tests/test_spmd_lint.py (tier-1, `pytest -m lint`).
 """
 
 from deeplearning4j_tpu.analysis.ast_pass import (iter_py_files,

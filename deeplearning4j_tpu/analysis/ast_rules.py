@@ -1,4 +1,5 @@
-"""The G001-G008 AST rules.
+"""The G001-G009 AST rules (G010-G013 live in spmd_rules.py and
+register into ALL_RULES/RULE_DOCS at the bottom of this module).
 
 Every rule errs toward PRECISION over recall: a lint gate that cries
 wolf gets suppressed wholesale, while a quiet one keeps running in CI
@@ -715,10 +716,18 @@ def g008_import_time(tree, imports, path):
     return out
 
 
+# stage-3 AST rules (G010-G013) live in spmd_rules.py and register here;
+# the import sits below every helper they borrow lazily, so importing
+# either module first resolves cleanly.
+from deeplearning4j_tpu.analysis.spmd_rules import (  # noqa: E402
+    SPMD_RULE_DOCS,
+    SPMD_RULES,
+)
+
 ALL_RULES = [g001_traced_bool, g002_host_sync, g003_float64_drift,
              g004_rng_discipline, g005_retrace_hazards,
              g006_shard_map_arity, g007_compat_bypass, g008_import_time,
-             g009_rendezvous_routing]
+             g009_rendezvous_routing] + SPMD_RULES
 
 RULE_DOCS = {
     "G001": "python control flow / bool()/float()/int() on traced values",
@@ -731,6 +740,7 @@ RULE_DOCS = {
     "G008": "mutable default args; module-level jnp allocations",
     "G009": "raw jax.distributed / rendezvous env plumbing bypassing "
             "distributed/bootstrap.py",
+    **SPMD_RULE_DOCS,
 }
 
 
@@ -747,6 +757,6 @@ def run_rules(tree: ast.AST, source: str, path: str) -> list[Finding]:
             snippet = lines[line - 1].strip() if 0 < line <= len(lines) \
                 else ""
             findings.append(Finding(rule_id, path, line, col, message,
-                                    fixit, snippet))
+                                    fixit, snippet, stage="ast"))
     findings.sort(key=lambda f: (f.path, f.line, f.rule))
     return findings

@@ -1,0 +1,384 @@
+"""Stage 3: collective-consistency audit (SPMD divergence detection).
+
+The trace-level twin of the G010-G013 AST rules (spmd_rules.py). Walks
+each frozen entry point's closed jaxpr (recursing into pjit/scan/cond
+sub-jaxprs via jaxpr_audit._iter_eqns) and extracts the **ordered
+collective signature** — the (primitive, axis names, operand
+shape/dtype) sequence the program issues. Treating that sequence as a
+checkable artifact follows arXiv:2112.01075 (collective sequences as
+portable, verifiable programs) and arXiv:2004.13336 (sharding decisions
+audited, not emergent):
+
+- C001: collective signature drift — the traced sequence differs from
+  the frozen one in analysis/collective_budget.json. A reordered,
+  added, or dropped collective is how rank-divergence regressions start;
+  regenerate deliberately with `tools/graftlint.py --update-collectives`
+  (same UX as the stage-2 op budget).
+- C002: entry point missing from the frozen signature file.
+- C003: rank-divergent collective sequence — the entry point re-traced
+  under simulated `process_index` 0 vs 1 (env-contract override +
+  patched jax.process_index; virtual devices, no real fleet) issues
+  different collective sequences. That program DEADLOCKS a live fleet
+  (the jax 0.4.x SIGABRT "Deadline Exceeded" failure mode documented in
+  ARCHITECTURE.md §Distributed runtime) — so it is reported as a
+  deadlock finding naming both sequences, never as a budget diff.
+
+Entry points cover both ways collectives exist in this repo:
+
+- shard_map programs carry collectives IN the jaxpr (`psum`,
+  `ppermute`, ... primitives) — the ring-attention and sequence-parallel
+  entries.
+- pjit programs get their collectives from GSPMD *after* partitioning,
+  so the jaxpr is collective-free; for those the signature is extracted
+  from the compiled HLO (`hlo:all-reduce ...` items, ordered by
+  channel id) on an 8-virtual-device CPU mesh — the
+  `distributed/allreduce_step_2x4` entry is the same set_mesh/fit
+  allreduce step tests/test_distributed.py proves on a live 2-process
+  x 4-device fleet.
+
+External fixture entries: a .py file passed to `graftlint --stage spmd`
+that defines ``GRAFTLINT_SPMD_ENTRIES = {name: builder}`` (builder() ->
+(fn, args)) gets each entry divergence-checked — the demo path for the
+deadlock finding without freezing a signature.
+
+jax and the model stack load lazily; importing this module is cheap and
+jax-free (the AST stage never touches it).
+"""
+
+from __future__ import annotations
+
+import contextlib
+import json
+import os
+import re
+
+from deeplearning4j_tpu.analysis.core import Finding
+
+BUDGET_PATH = os.path.join(os.path.dirname(__file__),
+                           "collective_budget.json")
+
+# the hook external fixture modules expose: {entry_name: builder}
+ENTRY_HOOK = "GRAFTLINT_SPMD_ENTRIES"
+
+SIMULATED_PROCESSES = (0, 1)
+
+# jaxpr-level collective primitives (pmean lowers to psum; axis_index is
+# rank-local and issues no communication, so it is not part of the
+# deadlock-relevant sequence)
+JAXPR_COLLECTIVES = frozenset({
+    "psum", "pmin", "pmax", "ppermute", "pshuffle", "all_gather",
+    "all_to_all", "psum_scatter", "reduce_scatter", "pbroadcast", "pcast",
+})
+
+# post-GSPMD HLO collective ops (async *-start/-done variants normalize
+# to the base name)
+HLO_COLLECTIVES = ("all-reduce", "all-gather", "reduce-scatter",
+                   "collective-permute", "all-to-all")
+
+_HLO_RE = re.compile(
+    r"=\s+(\(?[a-z0-9]+\[[^=]*?)\s+"
+    r"(" + "|".join(HLO_COLLECTIVES) + r")(?:-start|-done)?\(")
+_CHANNEL_RE = re.compile(r"channel_id=(\d+)")
+_LAYOUT_RE = re.compile(r"\{[^}]*\}")
+
+
+def entry_names() -> list[str]:
+    """Auditable stage-3 entry points (stable order). Safe to call
+    without jax — used for test parametrization."""
+    return [
+        "distributed/allreduce_step_2x4",
+        "ring_attention/seq4",
+        "sequence_parallel/sp_step_seq2",
+    ]
+
+
+# ----------------------------------------------------------- extraction
+
+def jaxpr_collectives(closed) -> list[str]:
+    """Ordered collective signature of a closed jaxpr:
+    `primitive@axes operand-shape/dtype` per collective eqn, recursing
+    into pjit/scan/cond sub-jaxprs."""
+    from deeplearning4j_tpu.analysis.jaxpr_audit import _iter_eqns
+
+    sig = []
+    for eqn in _iter_eqns(closed.jaxpr):
+        prim = eqn.primitive.name
+        if prim not in JAXPR_COLLECTIVES:
+            continue
+        axes = eqn.params.get("axes", eqn.params.get("axis_name", ()))
+        if not isinstance(axes, (tuple, list)):
+            axes = (axes,)
+        aval = getattr(eqn.invars[0], "aval", None) if eqn.invars else None
+        short = aval.str_short() if hasattr(aval, "str_short") else ""
+        sig.append(f"{prim}@{','.join(str(a) for a in axes)} {short}".strip())
+    return sig
+
+
+def hlo_collectives(hlo_text: str) -> list[str]:
+    """Ordered collective signature of a compiled HLO module:
+    `hlo:op result-shape` per collective, ordered by channel id (XLA
+    assigns channel ids in program order; textual order follows
+    computation nesting instead)."""
+    hits = []
+    for line in hlo_text.splitlines():
+        m = _HLO_RE.search(line)
+        if not m:
+            continue
+        shape = _LAYOUT_RE.sub("", m.group(1)).strip()
+        chan = _CHANNEL_RE.search(line)
+        hits.append((int(chan.group(1)) if chan else 1 << 30, len(hits),
+                     f"hlo:{m.group(2)} {shape}"))
+    return [item for _, _, item in sorted(hits)]
+
+
+def trace_signature(build, *, hlo: bool = False):
+    """-> (signature, eqn_count) for one built entry. `build` is a
+    zero-arg callable returning (fn, args); tracing uses abstract
+    evaluation (nothing executes), and `hlo=True` additionally compiles
+    on the current (virtual-CPU) devices to harvest the post-GSPMD
+    collectives pjit hides from the jaxpr."""
+    import jax
+
+    from deeplearning4j_tpu.analysis.jaxpr_audit import _iter_eqns
+
+    fn, args = build()
+    closed = jax.make_jaxpr(fn)(*args)
+    sig = jaxpr_collectives(closed)
+    if hlo:
+        sig += hlo_collectives(fn.lower(*args).compile().as_text())
+    return sig, sum(1 for _ in _iter_eqns(closed.jaxpr))
+
+
+# ----------------------------------------------------- rank simulation
+
+@contextlib.contextmanager
+def simulated_process_index(pid: int):
+    """Trace-time rank simulation — no real fleet. Overrides the env
+    contract's process id (distributed/bootstrap.py's single spelling)
+    and patches jax.process_index, so any rank read an entry performs at
+    trace time sees `pid`. Virtual devices stay as-is: collectives only
+    need to be *issued* identically, not executed."""
+    import jax
+
+    from deeplearning4j_tpu.distributed import bootstrap
+
+    saved = {var: os.environ.get(var)
+             for var in (bootstrap.ENV_PROCESS_ID,
+                         bootstrap.ENV_NUM_PROCESSES)}
+    os.environ[bootstrap.ENV_PROCESS_ID] = str(pid)
+    os.environ[bootstrap.ENV_NUM_PROCESSES] = str(len(SIMULATED_PROCESSES))
+    real = jax.process_index
+    jax.process_index = lambda backend=None: pid
+    try:
+        yield
+    finally:
+        jax.process_index = real
+        for var, val in saved.items():
+            if val is None:
+                os.environ.pop(var, None)
+            else:
+                os.environ[var] = val
+
+
+def check_divergence(name: str, build) -> list[Finding]:
+    """Re-trace one entry under simulated process_index 0 vs 1 and
+    assert the collective sequences are identical. A divergent sequence
+    is a DEADLOCK finding (C003) naming both sequences; identical
+    sequences with different traced op counts is the same class (the
+    programs differ, the fleet desyncs) with a count-based message."""
+    results = {}
+    for pid in SIMULATED_PROCESSES:
+        with simulated_process_index(pid):
+            results[pid] = trace_signature(build)
+    (sig0, n0), (sig1, n1) = (results[p] for p in SIMULATED_PROCESSES)
+    if sig0 != sig1:
+        return [Finding(
+            "C003", name, 0, 0,
+            "rank-divergent collective sequence — this program DEADLOCKS "
+            f"a live fleet (SIGABRT \"Deadline Exceeded\"): process 0 "
+            f"issues {sig0 or '[]'} but process 1 issues {sig1 or '[]'}",
+            "remove the rank-dependent branch around the collective "
+            "(G010); every process must issue the identical sequence",
+            snippet="rank-divergent-collectives", stage="spmd")]
+    if n0 != n1:
+        return [Finding(
+            "C003", name, 0, 0,
+            f"rank-divergent traced program — identical collective "
+            f"sequences but {n0} vs {n1} traced ops under simulated "
+            "process_index 0 vs 1: a rank-dependent value is baked into "
+            "the program (G011 shape) and the replicas will desync",
+            "make the trace rank-invariant; read the rank only inside "
+            "host-side (untraced) code",
+            snippet="rank-divergent-ops", stage="spmd")]
+    return []
+
+
+# -------------------------------------------------------- entry points
+
+def _ensure_devices():
+    from deeplearning4j_tpu.util.virtual_devices import ensure_cpu_devices
+
+    ensure_cpu_devices(8)
+
+
+def _build_allreduce_step():
+    """The 2-process x 4-device allreduce train step of
+    tests/test_distributed.py, on the equivalent 8-virtual-device local
+    mesh (same global device count, same set_mesh/fit pjit program; the
+    live-fleet test proves execution, this entry freezes the collective
+    program it runs)."""
+    import jax
+    import numpy as np
+
+    _ensure_devices()
+    from deeplearning4j_tpu.datasets.api import DataSet
+    from deeplearning4j_tpu.nn.conf import (DenseLayer,
+                                            NeuralNetConfiguration,
+                                            OutputLayer)
+    from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+    from deeplearning4j_tpu.parallel.mesh import make_mesh
+
+    conf = (NeuralNetConfiguration.builder()
+            .seed(7).learning_rate(0.1).updater("sgd").list()
+            .layer(DenseLayer(n_in=6, n_out=8, activation="tanh"))
+            .layer(OutputLayer(n_in=8, n_out=3, activation="softmax",
+                               loss_function="mcxent"))
+            .build())
+    net = MultiLayerNetwork(conf).init()
+    net.set_mesh(make_mesh({"data": 8}))
+    rng = np.random.default_rng(0)
+    x = rng.random((32, 6), dtype=np.float32)
+    y = np.eye(3, dtype=np.float32)[rng.integers(0, 3, 32)]
+    batch = net._batch_dict(DataSet(x, y))
+    step = net._get_train_step()
+    return step, (net.params, net.opt_state, net.state,
+                  jax.random.PRNGKey(0), batch)
+
+
+def _build_ring_attention():
+    """ring_self_attention over a 4-way seq mesh (einsum fallback at
+    Tl=2): the ppermute ring is the jaxpr-level collective workload."""
+    import jax
+
+    _ensure_devices()
+    from deeplearning4j_tpu.parallel.mesh import make_mesh
+    from deeplearning4j_tpu.parallel.ring_attention import \
+        ring_self_attention
+
+    mesh = make_mesh({"seq": 4})
+    sds = jax.ShapeDtypeStruct((1, 1, 8, 4), "float32")
+    return (lambda q, k, v: ring_self_attention(q, k, v, mesh)), \
+        (sds, sds, sds)
+
+
+def _build_sp_step():
+    """make_sp_train_step on a tiny transformer over a 2-way seq mesh:
+    pmean'd grads/loss/state + the ring's ppermutes."""
+    import jax
+    import numpy as np
+
+    _ensure_devices()
+    from deeplearning4j_tpu.models.transformer import transformer_lm
+    from deeplearning4j_tpu.parallel.mesh import make_mesh
+    from deeplearning4j_tpu.parallel.sequence_parallel import \
+        make_sp_train_step
+
+    net = transformer_lm(vocab_size=17, d_model=8, n_heads=2, n_layers=1,
+                         d_ff=16, max_length=8, seed=3,
+                         seq_parallel_axis="seq")
+    net.init()
+    step = make_sp_train_step(net, make_mesh({"seq": 2}), seq_axis="seq")
+    toks = np.zeros((2, 8), np.int32)
+    return step, (net.params, net.opt_state, net.state,
+                  jax.random.PRNGKey(0), toks,
+                  np.roll(toks, -1, axis=1))
+
+
+# pjit entries get their collectives from GSPMD, so they need the HLO
+# extraction; shard_map entries carry them in the jaxpr
+_BUILDERS = {
+    "distributed/allreduce_step_2x4": (_build_allreduce_step, True),
+    "ring_attention/seq4": (_build_ring_attention, False),
+    "sequence_parallel/sp_step_seq2": (_build_sp_step, False),
+}
+
+
+# -------------------------------------------------------------- audit
+
+def load_budget(path: str | None = None) -> dict[str, list[str]]:
+    try:
+        with open(path or BUDGET_PATH) as fh:
+            return {k: list(v)
+                    for k, v in json.load(fh)["signatures"].items()}
+    except FileNotFoundError:
+        return {}
+
+
+def write_budget(signatures: dict[str, list[str]],
+                 path: str | None = None) -> None:
+    with open(path or BUDGET_PATH, "w") as fh:
+        json.dump(
+            {"comment": "frozen ordered collective signatures per entry "
+                        "point (graftlint stage 3). A drift here is a "
+                        "rank-divergence regression unless deliberate: "
+                        "tools/graftlint.py --update-collectives",
+             "signatures": {k: signatures[k] for k in sorted(signatures)}},
+            fh, indent=1)
+        fh.write("\n")
+
+
+def audit(names=None, budget_path: str | None = None, *,
+          divergence: bool = True):
+    """Run the stage-3 audit -> (findings, {entry: signature})."""
+    budget = load_budget(budget_path)
+    findings, signatures = [], {}
+    for name in names if names is not None else entry_names():
+        build, want_hlo = _BUILDERS[name]
+        sig, _count = trace_signature(build, hlo=want_hlo)
+        signatures[name] = sig
+        frozen = budget.get(name)
+        if frozen is None:
+            findings.append(Finding(
+                "C002", name, 0, 0,
+                f"entry point has no frozen collective signature (traced "
+                f"{len(sig)} collective(s))",
+                "run `python tools/graftlint.py --update-collectives`",
+                snippet="missing-signature", stage="spmd"))
+        elif frozen != sig:
+            findings.append(Finding(
+                "C001", name, 0, 0,
+                f"collective signature drift — frozen {frozen} but the "
+                f"trace now issues {sig}: a reordered/added/dropped "
+                "collective is how rank-divergence regressions start",
+                "find what changed the collective sequence; only then "
+                "refreeze (--update-collectives)",
+                snippet="signature-drift", stage="spmd"))
+        if divergence:
+            findings.extend(check_divergence(name, build))
+    return findings, signatures
+
+
+def load_entry_module(path: str):
+    """Import a fixture .py by path and return its GRAFTLINT_SPMD_ENTRIES
+    hook ({name: builder}), or {} when it defines none."""
+    import importlib.util
+
+    modname = "_graftlint_spmd_" + re.sub(r"\W", "_", os.path.abspath(path))
+    spec = importlib.util.spec_from_file_location(modname, path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return getattr(mod, ENTRY_HOOK, {})
+
+
+def audit_paths(paths) -> tuple[list[Finding], dict[str, list[str]]]:
+    """Divergence-check every external entry the given .py files expose
+    (no frozen-signature requirement — these are demo/fixture entries)."""
+    findings, signatures = [], {}
+    for path in paths:
+        if not (path.endswith(".py") and os.path.isfile(path)):
+            continue
+        for name, build in load_entry_module(path).items():
+            sig, _count = trace_signature(build)
+            signatures[name] = sig
+            findings.extend(check_divergence(name, build))
+    return findings, signatures

@@ -20,13 +20,19 @@ _SUPPRESS_RE = re.compile(r"#\s*graftlint:\s*disable=([A-Z0-9,\s]+)")
 
 @dataclasses.dataclass(frozen=True)
 class Finding:
-    rule: str        # "G001".."G008" (AST pass) / "J001".."J004" (jaxpr)
+    rule: str        # "G001".."G013" (AST pass) / "J001".."J004" (jaxpr)
+                     # / "C001".."C003" (collective audit)
     path: str        # repo-relative posix path, or an entry-point name
     line: int        # 1-based; 0 for whole-artifact (jaxpr) findings
     col: int
     message: str
     fixit: str       # how to fix it (every rule carries one)
     snippet: str = ""
+    # which lint stage produced it ("ast" | "jaxpr" | "spmd") so --json
+    # consumers (benchdiff-style tooling) can filter without re-deriving
+    # the stage from the rule id. Excluded from `key`: baselines must
+    # stay valid if a rule migrates stages.
+    stage: str = ""
 
     @property
     def key(self) -> str:

@@ -152,7 +152,7 @@ def trace_entry(name):
                 f"traced program contains `{prim}` (host/device transfer "
                 "or callback inside the step)",
                 "hoist the transfer/callback out of the jitted path",
-                snippet=prim))
+                snippet=prim, stage="jaxpr"))
         for var in eqn.outvars:
             aval = getattr(var, "aval", None)
             dtype = getattr(aval, "dtype", None)
@@ -164,7 +164,8 @@ def trace_entry(name):
                     f"`{prim}` produces float64 — dtype drift into the "
                     "traced program",
                     "pin the dtype at the source (np.float32 constant / "
-                    "explicit dtype=)", snippet=f"f64:{prim}"))
+                    "explicit dtype=)", snippet=f"f64:{prim}",
+                    stage="jaxpr"))
     return count, findings
 
 
@@ -191,14 +192,15 @@ def audit(names=None, budget_path: str = BUDGET_PATH):
                 f"entry point has no frozen op budget (traced {count} "
                 "ops)",
                 "run `python tools/graftlint.py --update-budget`",
-                snippet="missing-budget"))
+                snippet="missing-budget", stage="jaxpr"))
         elif count > bound:
             findings.append(Finding(
                 "J002", name, 0, 0,
                 f"jaxpr has {count} ops, over the frozen bound of "
                 f"{bound} — retrace/bloat regression",
                 "find what grew the traced program; only then refresh "
-                "the budget (--update-budget)", snippet="over-budget"))
+                "the budget (--update-budget)", snippet="over-budget",
+                stage="jaxpr"))
     return findings, counts
 
 

@@ -1,0 +1,400 @@
+"""The G010-G013 SPMD-divergence AST rules (graftlint stage 3, AST side).
+
+PR 4's multi-process runtime made rank-divergence the most expensive bug
+class in the repo: a program that issues different collective sequences
+on different processes deadlocks the whole fleet, and on this jax
+generation the death is a SIGABRT ("Deadline Exceeded") with no Python
+traceback (ARCHITECTURE.md §Distributed runtime failure matrix). These
+rules catch the statically-visible shapes of that bug; the trace-level
+twin (analysis/collective_audit.py) catches what only shows up in the
+jaxpr.
+
+Like G001-G009 the rules are pure stdlib — importing this module must
+NOT import jax, so `tools/graftlint.py --stage ast` stays a pre-commit
+fast path. Helpers shared with ast_rules.py are imported lazily inside
+the rule functions (ast_rules registers these rules at its module
+bottom, so a top-level import either way would be circular).
+
+Each rule errs toward precision over recall, same contract as G001-G009:
+
+- G010: rank-dependent control flow (`jax.process_index()`,
+  `process_id`, the DL4J_TPU_PROCESS_ID env contract) guarding code that
+  issues collectives, jit calls, or mesh construction — the deadlock
+  shape. Not caught: rank-divergent programs reached through calls the
+  AST cannot see into (those are collective_audit's job).
+- G011: host nondeterminism (time.*, os.urandom, unseeded np.random,
+  uuid, id()/hash()) flowing into jax calls or mesh/spec construction in
+  distributed/, parallel/, nn/ — a per-process value baked into the
+  traced program diverges the replicas' jaxprs. Not caught: taint
+  through attributes or across function boundaries.
+- G012: collective calls whose literal axis_name is not bound by an
+  enclosing shard_map/pmap/mesh in the same function (or received as a
+  parameter) — an unbound axis raises at trace time at best, and at
+  worst silently binds to a different caller's axis. Not caught:
+  axis names threaded through containers.
+- G013: blocking host syncs (block_until_ready, device_get, .item())
+  inside rank-conditional blocks — one process stalls on a value whose
+  producing collective the other processes may never reach.
+"""
+
+from __future__ import annotations
+
+import ast
+
+# Collective-issuing calls, canonical (the per-file import table resolves
+# `from jax import lax` / `import jax.lax as lax` spellings to these).
+COLLECTIVE_CALLS = frozenset(
+    {"jax.lax." + n for n in (
+        "psum", "pmean", "pmax", "pmin", "ppermute", "pshuffle",
+        "all_gather", "all_to_all", "psum_scatter")}
+    | {"jax.lax.pcast", "deeplearning4j_tpu.util.compat.pcast_varying"})
+
+# Mesh construction — every process must build the identical mesh, so a
+# rank-guarded construction is the same deadlock shape as a collective.
+MESH_CTORS = frozenset({
+    "jax.sharding.Mesh", "jax.make_mesh",
+    "deeplearning4j_tpu.parallel.mesh.make_mesh",
+    "deeplearning4j_tpu.distributed.global_mesh.make_global_mesh",
+})
+
+# Calls that BIND axis names for G012: collecting the string constants
+# inside these calls yields the axis names visibly in scope.
+_AXIS_BINDERS = frozenset({
+    "jax.pmap", "jax.sharding.NamedSharding", "jax.sharding.PartitionSpec",
+}) | MESH_CTORS
+
+_RANK_NAMES = frozenset({"process_id", "process_index"})
+
+_G011_SCOPE = ("/distributed/", "/parallel/", "/nn/")
+
+# Host calls whose value differs per process (or per interpreter run —
+# str hash is randomized by PYTHONHASHSEED, id() is an address).
+_NONDET_CALLS = frozenset({
+    "time.time", "time.time_ns", "time.monotonic", "time.monotonic_ns",
+    "time.perf_counter", "time.perf_counter_ns", "os.urandom",
+    "uuid.uuid1", "uuid.uuid4", "id", "hash",
+})
+# np.random entry points that are deterministic given their (seed) args.
+_NONDET_SEEDABLE = frozenset({
+    "numpy.random.default_rng", "numpy.random.RandomState",
+})
+_NONDET_EXEMPT_TAILS = frozenset({"seed", "default_rng", "RandomState",
+                                  "Random", "get_state", "set_state"})
+
+_BLOCKING_ATTRS = frozenset({"block_until_ready", "item"})
+_BLOCKING_CALLS = frozenset({"jax.block_until_ready", "jax.device_get"})
+
+SPMD_RULE_IDS = frozenset({"G010", "G011", "G012", "G013"})
+
+
+def _env_rank_var() -> str:
+    """The env contract's process-id variable, imported from its single
+    spelling (distributed/bootstrap.py — the G009 contract; bootstrap is
+    stdlib-only so this keeps the AST stage jax-free)."""
+    from deeplearning4j_tpu.distributed.bootstrap import ENV_PROCESS_ID
+
+    return ENV_PROCESS_ID
+
+
+def _is_rank_expr(expr: ast.AST, imports) -> bool:
+    """Does `expr` read this process's rank? Recognized spellings:
+    jax.process_index(), names/attrs/keys `process_id`/`process_index`,
+    and the DL4J_TPU_PROCESS_ID env contract (literal or the imported
+    ENV_PROCESS_ID constant)."""
+    rank_env = _env_rank_var()
+    for node in ast.walk(expr):
+        if isinstance(node, ast.Call):
+            if imports.canon(node.func) == "jax.process_index":
+                return True
+        elif isinstance(node, ast.Name):
+            if node.id in _RANK_NAMES:
+                return True
+            canon = imports.canon(node) or ""
+            if canon.endswith(".ENV_PROCESS_ID"):
+                return True
+        elif isinstance(node, ast.Attribute) and node.attr in _RANK_NAMES:
+            return True
+        elif isinstance(node, ast.Constant) and isinstance(node.value, str) \
+                and node.value in (rank_env, "process_id"):
+            return True
+    return False
+
+
+def _iter_executed(stmts):
+    """Nodes that EXECUTE when the given statements run — skips nested
+    def/lambda bodies (defining a function under a rank guard issues
+    nothing; calling it elsewhere is out of AST scope)."""
+    stack = list(stmts)
+    while stack:
+        node = stack.pop()
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.Lambda)):
+            continue
+        yield node
+        stack.extend(ast.iter_child_nodes(node))
+
+
+def _rank_conditionals(tree, imports):
+    """Every if/while whose test reads the process rank."""
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.If, ast.While)) and \
+                _is_rank_expr(node.test, imports):
+            yield node
+
+
+# --------------------------------------------------------------- G010
+
+def g010_rank_divergent_control_flow(tree, imports, path):
+    """Rank-dependent control flow around collectives / jit / mesh
+    construction: the processes issue different SPMD programs and the
+    first collective deadlocks the fleet (jax 0.4.x: SIGABRT "Deadline
+    Exceeded", no Python traceback). Rank-guarded host-side effects
+    (logging, checkpoint IO) are deliberately NOT flagged."""
+    out = []
+    for cond in _rank_conditionals(tree, imports):
+        for node in _iter_executed(cond.body + cond.orelse):
+            if not isinstance(node, ast.Call):
+                continue
+            name = imports.canon(node.func)
+            if name in COLLECTIVE_CALLS:
+                what = f"collective `{name}`"
+            elif name in MESH_CTORS:
+                what = f"mesh construction `{name}`"
+            else:
+                from deeplearning4j_tpu.analysis.ast_rules import _JIT_NAMES
+
+                if name not in _JIT_NAMES:
+                    continue
+                what = f"jit call `{name}`"
+            out.append(("G010", cond,
+                        f"rank-dependent control flow guards {what} "
+                        f"(line {node.lineno}) — processes issue different "
+                        "collective sequences and the fleet deadlocks "
+                        "(SIGABRT \"Deadline Exceeded\")",
+                        "issue the identical collective/jit/mesh program "
+                        "on every process; keep rank branches to host-side "
+                        "effects (logging, checkpoint IO)"))
+    return out
+
+
+# --------------------------------------------------------------- G011
+
+def _is_nondet_call(node: ast.Call, imports) -> bool:
+    name = imports.canon(node.func) or ""
+    if name in _NONDET_CALLS:
+        return True
+    if name in _NONDET_SEEDABLE:
+        return not (node.args or node.keywords)  # unseeded
+    if name.startswith(("numpy.random.", "random.")):
+        return name.rsplit(".", 1)[-1] not in _NONDET_EXEMPT_TAILS
+    return False
+
+
+def _walk_scope(scope):
+    """Nodes of one lexical scope, not descending into nested defs."""
+    stack = list(ast.iter_child_nodes(scope))
+    while stack:
+        node = stack.pop()
+        yield node
+        if not isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                 ast.Lambda)):
+            stack.extend(ast.iter_child_nodes(node))
+
+
+def g011_host_nondeterminism(tree, imports, path):
+    """Host nondeterminism flowing into jax calls or mesh/spec
+    construction in distributed/, parallel/, nn/: a time.*/os.urandom/
+    unseeded-np.random/id()/hash() value differs per process, so baking
+    it into a traced value (or a mesh/PartitionSpec) silently diverges
+    the replicas' programs — the G010 deadlock without a visible branch.
+    Taint tracking is per-scope and name-based (attributes and
+    cross-function flow are out of scope)."""
+    if not any(frag in path for frag in _G011_SCOPE):
+        return []
+    out = []
+    scopes = [tree] + [n for n in ast.walk(tree)
+                       if isinstance(n, (ast.FunctionDef,
+                                         ast.AsyncFunctionDef))]
+    for scope in scopes:
+        tainted: set[str] = set()
+        for _ in range(4):  # bounded fixpoint, order-insensitive
+            before = len(tainted)
+            for node in _walk_scope(scope):
+                if not isinstance(node, ast.Assign):
+                    continue
+                dirty = any(
+                    (isinstance(c, ast.Call)
+                     and _is_nondet_call(c, imports))
+                    or (isinstance(c, ast.Name)
+                        and isinstance(c.ctx, ast.Load)
+                        and c.id in tainted)
+                    for c in ast.walk(node.value))
+                if dirty:
+                    for tgt in node.targets:
+                        for n in ast.walk(tgt):
+                            if isinstance(n, ast.Name):
+                                tainted.add(n.id)
+            if len(tainted) == before:
+                break
+        for node in _walk_scope(scope):
+            if not isinstance(node, ast.Call):
+                continue
+            name = imports.canon(node.func) or ""
+            if not (name.startswith("jax.") or name in MESH_CTORS):
+                continue
+            for arg in list(node.args) + [k.value for k in node.keywords]:
+                dirty = any(
+                    (isinstance(c, ast.Call)
+                     and _is_nondet_call(c, imports))
+                    or (isinstance(c, ast.Name)
+                        and isinstance(c.ctx, ast.Load)
+                        and c.id in tainted)
+                    for c in ast.walk(arg))
+                if dirty:
+                    out.append(("G011", node,
+                                f"host nondeterminism flows into `{name}` "
+                                "— the value differs per process, so the "
+                                "traced program / mesh diverges across "
+                                "ranks (rank-divergent constant in the "
+                                "jaxpr)",
+                                "derive the value deterministically (seed "
+                                "it, or broadcast rank-0's value through "
+                                "the env contract) before it reaches jax"))
+                    break
+    return out
+
+
+# --------------------------------------------------------------- G012
+
+def _literal_axes(call: ast.Call):
+    """String-constant axis names of a collective call: the `axis_name`
+    keyword or the conventional second positional arg."""
+    value = None
+    for kw in call.keywords:
+        if kw.arg == "axis_name":
+            value = kw.value
+    if value is None and len(call.args) >= 2:
+        value = call.args[1]
+    if value is None:
+        return []
+    elts = value.elts if isinstance(value, (ast.Tuple, ast.List)) else [value]
+    return [e.value for e in elts
+            if isinstance(e, ast.Constant) and isinstance(e.value, str)]
+
+
+def _binder_strings(node: ast.AST, imports) -> set[str]:
+    """String constants inside shard_map/pmap/mesh/spec calls under
+    `node` — the axis names those calls visibly bind."""
+    bound: set[str] = set()
+    for sub in ast.walk(node):
+        if not isinstance(sub, ast.Call):
+            continue
+        name = imports.canon(sub.func) or ""
+        if name in _AXIS_BINDERS or name == "shard_map" \
+                or name.endswith(".shard_map"):
+            bound |= {c.value for c in ast.walk(sub)
+                      if isinstance(c, ast.Constant)
+                      and isinstance(c.value, str)}
+    return bound
+
+
+def g012_unbound_axis_name(tree, imports, path):
+    """Collective calls naming a literal axis that no enclosing
+    shard_map/pmap/mesh in the same function chain binds (and that is
+    not wrapped as a shard_map/pmap target elsewhere in the module):
+    at best a NameError-at-trace, at worst the literal silently binds a
+    different caller's axis. Axis names received as parameters (or any
+    non-literal expression) are trusted."""
+    from deeplearning4j_tpu.analysis.ast_rules import _parents
+
+    out = []
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        name = imports.canon(node.func) or ""
+        if name not in COLLECTIVE_CALLS:
+            continue
+        axes = _literal_axes(node)
+        if not axes:
+            continue
+        chain = [p for p in _parents(node)
+                 if isinstance(p, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                   ast.Lambda))]
+        bound: set[str] = set()
+        for fn in chain:
+            bound |= _binder_strings(fn, imports)
+        # functions wrapped as shard_map/pmap targets elsewhere in the
+        # module bind their axes at the wrap site
+        chain_names = {fn.name for fn in chain
+                       if isinstance(fn, (ast.FunctionDef,
+                                          ast.AsyncFunctionDef))}
+        for sub in ast.walk(tree):
+            if not isinstance(sub, ast.Call) or not sub.args:
+                continue
+            sname = imports.canon(sub.func) or ""
+            if not (sname == "shard_map" or sname.endswith(".shard_map")
+                    or sname == "jax.pmap"):
+                continue
+            target = sub.args[0]
+            if isinstance(target, ast.Call):  # partial(fn, ...)
+                target = target.args[0] if target.args else target
+            if isinstance(target, ast.Name) and target.id in chain_names:
+                bound |= {c.value for c in ast.walk(sub)
+                          if isinstance(c, ast.Constant)
+                          and isinstance(c.value, str)}
+        for ax in axes:
+            if ax not in bound:
+                out.append(("G012", node,
+                            f"collective `{name}` names axis {ax!r} but "
+                            "no enclosing shard_map/pmap/mesh in this "
+                            "function binds it",
+                            f"run the collective under a shard_map/mesh "
+                            f"that binds {ax!r}, or accept the axis name "
+                            "as a parameter"))
+    return out
+
+
+# --------------------------------------------------------------- G013
+
+def g013_rank_conditional_host_sync(tree, imports, path):
+    """Blocking host syncs (block_until_ready / device_get / .item())
+    under a rank condition: the blocking process waits on a value whose
+    producing collective the other ranks may never issue — the passive
+    half of the G010 deadlock, and even when it resolves, it skews step
+    pacing across the fleet."""
+    out = []
+    for cond in _rank_conditionals(tree, imports):
+        for node in _iter_executed(cond.body + cond.orelse):
+            if not isinstance(node, ast.Call):
+                continue
+            name = imports.canon(node.func) or ""
+            blocking = name in _BLOCKING_CALLS or (
+                isinstance(node.func, ast.Attribute)
+                and node.func.attr in _BLOCKING_ATTRS and not node.args)
+            if blocking:
+                what = name if name in _BLOCKING_CALLS \
+                    else f".{node.func.attr}()"
+                out.append(("G013", node,
+                            f"blocking host sync `{what}` inside a "
+                            "rank-conditional block — the blocked rank "
+                            "waits on device work the other ranks may "
+                            "never schedule, skewing (or deadlocking) "
+                            "the fleet",
+                            "sync on every rank, or defer the host read "
+                            "until after the collective step completes"))
+    return out
+
+
+SPMD_RULES = [g010_rank_divergent_control_flow, g011_host_nondeterminism,
+              g012_unbound_axis_name, g013_rank_conditional_host_sync]
+
+SPMD_RULE_DOCS = {
+    "G010": "rank-dependent control flow guarding collectives/jit/mesh "
+            "(fleet deadlock shape)",
+    "G011": "host nondeterminism (time/urandom/unseeded rng/id/hash) "
+            "flowing into traced values or mesh construction",
+    "G012": "collective axis_name not bound by an enclosing "
+            "shard_map/pmap/mesh or a parameter",
+    "G013": "blocking host sync (.item/device_get/block_until_ready) "
+            "inside rank-conditional blocks",
+}

@@ -1,0 +1,40 @@
+"""Demo stage-3 fixture: a rank-conditional collective — the deadlock
+shape graftlint's SPMD stage exists to catch.
+
+`python tools/graftlint.py --check --stage spmd tests/fixtures/\
+spmd_divergent_entry.py` must exit non-zero with BOTH a G010 AST finding
+(the rank-guarded psum below is statically visible) and a C003 deadlock
+finding from the collective audit naming the two divergent sequences
+(process 0 issues the psum, process 1 never joins it — on a live fleet
+every process then aborts with the SIGABRT "Deadline Exceeded" mode
+documented in ARCHITECTURE.md §Distributed runtime).
+
+The GRAFTLINT_SPMD_ENTRIES hook is the external-entry contract of
+analysis/collective_audit.py: {name: builder}, builder() -> (fn, args).
+"""
+
+
+def build_divergent():
+    import jax
+
+    from deeplearning4j_tpu.util.virtual_devices import ensure_cpu_devices
+
+    ensure_cpu_devices(2)
+    from jax.sharding import PartitionSpec as P
+
+    from deeplearning4j_tpu.parallel.mesh import make_mesh
+    from deeplearning4j_tpu.util.compat import shard_map
+
+    mesh = make_mesh({"data": 2})
+
+    def local(x):
+        if jax.process_index() == 0:  # rank-conditional collective
+            return jax.lax.psum(x, "data")
+        return x * 2.0  # process 1 never reaches the allreduce: deadlock
+
+    fn = shard_map(local, mesh=mesh, in_specs=(P("data"),),
+                   out_specs=P("data"), check_vma=False)
+    return fn, (jax.ShapeDtypeStruct((4,), "float32"),)
+
+
+GRAFTLINT_SPMD_ENTRIES = {"demo/rank_conditional_psum": build_divergent}

@@ -1,7 +1,9 @@
-"""Tier-1 gate for graftlint (ISSUE 2): every AST rule G001-G009 proven
-on a positive AND a negative fixture, the suppression + baseline
-machinery, the stage-2 jaxpr audit over every public entry point, and
-the package itself held lint-clean (zero non-baselined findings).
+"""Tier-1 gate for graftlint (ISSUE 2 + the ISSUE 5 SPMD rules): every
+AST rule G001-G013 proven on a positive AND a negative fixture, the
+suppression + baseline machinery, the stage-2 jaxpr audit over every
+public entry point, and the package itself held lint-clean (zero
+non-baselined findings). The stage-3 collective audit has its own gate
+in tests/test_spmd_lint.py.
 
 PR 1 burned its budget reactively fixing exactly these bug classes
 (silent RNG divergence, jax API drift, modes that crashed only at real
@@ -26,16 +28,20 @@ PKG = os.path.join(ROOT, "deeplearning4j_tpu")
 BASELINE = os.path.join(ROOT, "tools", "graftlint_baseline.json")
 CLI = os.path.join(ROOT, "tools", "graftlint.py")
 
-# fixtures land in a hot-path location so G002 participates
-FIXTURE_PATH = "deeplearning4j_tpu/ops/_graftlint_fixture.py"
+# fixtures land in a location that is BOTH a G002 hot path and inside
+# the G011 SPMD scope (parallel/ is in HOT_PATH_FRAGMENTS and _G011_SCOPE)
+FIXTURE_PATH = "deeplearning4j_tpu/parallel/_graftlint_fixture.py"
 
 _PRELUDE = """\
 import functools
+import os
 import random
+import time
 import numpy as np
 import jax
 import jax.numpy as jnp
 from functools import partial
+from jax.sharding import PartitionSpec as P
 from deeplearning4j_tpu.util.compat import shard_map
 """
 
@@ -283,6 +289,80 @@ def wire(env):
     env[ENV_PROCESS_ID] = "0"
     return os.environ.get(ENV_COORDINATOR)
 """),
+    ("G010", """\
+def up(x):
+    if jax.process_index() == 0:
+        return jax.lax.psum(x, "data")
+    return x
+""", """\
+def up(x, process_id, axis_name):
+    if process_id == 0:
+        print("rank 0: host-side logging/checkpoint IO is fine")
+    return jax.lax.psum(x, axis_name)
+"""),
+    ("G010", """\
+from deeplearning4j_tpu.distributed.bootstrap import ENV_PROCESS_ID
+from deeplearning4j_tpu.parallel.mesh import make_mesh
+
+
+def up(f, x):
+    if os.environ[ENV_PROCESS_ID] == "0":
+        mesh = make_mesh({"data": 8})
+    return f(x)
+""", """\
+from deeplearning4j_tpu.parallel.mesh import make_mesh
+
+
+def up(f, x, process_index):
+    mesh = make_mesh({"data": 8})
+    if process_index == 0:
+        path = "checkpoint.zip"
+    return f(x)
+"""),
+    ("G011", """\
+def f(x):
+    t = time.time()
+    return jnp.full((2,), t)
+""", """\
+def f(x, rec):
+    rec.event(time.time())
+    rng = np.random.default_rng(0)
+    return jnp.asarray(rng.random(3))
+"""),
+    ("G012", """\
+def f(x):
+    return jax.lax.pmean(x, "data")
+""", """\
+def g(x, axis_name):
+    return jax.lax.psum(x, axis_name)
+
+
+def run(mesh, x):
+    local = lambda a: jax.lax.pmean(a, "data")
+    return shard_map(local, mesh=mesh, in_specs=(P("data"),),
+                     out_specs=P())(x)
+
+
+def wrapped(a):
+    return jax.lax.psum(a, "seq")
+
+
+def outer(mesh, x):
+    return shard_map(wrapped, mesh=mesh, in_specs=(P("seq"),),
+                     out_specs=P())(x)
+"""),
+    ("G013", """\
+def sync(x, loss):
+    if jax.process_index() == 0:
+        return loss.item()
+    return x
+""", """\
+def sync(x, loss, process_id):
+    if process_id == 0:
+        path = "ck.zip"
+    jax.block_until_ready(x)
+    return x
+"""),
 ]
 
 
@@ -296,7 +376,7 @@ def test_rule_fires_on_positive_not_negative(rule, pos, neg):
 
 def test_every_rule_has_fixture_coverage():
     assert {r for r, _, _ in FIXTURES} == set(RULE_DOCS) == {
-        f"G00{i}" for i in range(1, 10)}
+        f"G{i:03d}" for i in range(1, 14)}
 
 
 def test_g002_scoped_to_hot_paths():
@@ -304,6 +384,13 @@ def test_g002_scoped_to_hot_paths():
     assert "G002" in rules_in(src, "deeplearning4j_tpu/ops/x.py")
     assert "G002" in rules_in(src, "deeplearning4j_tpu/nn/layers/x.py")
     assert "G002" not in rules_in(src, "deeplearning4j_tpu/datasets/x.py")
+
+
+def test_g011_scoped_to_spmd_dirs():
+    src = "def f():\n    t = time.time()\n    return jnp.full((2,), t)\n"
+    assert "G011" in rules_in(src, "deeplearning4j_tpu/distributed/x.py")
+    assert "G011" in rules_in(src, "deeplearning4j_tpu/nn/layers/x.py")
+    assert "G011" not in rules_in(src, "deeplearning4j_tpu/ops/x.py")
 
 
 def test_g007_exempts_compat_itself():
@@ -386,6 +473,23 @@ def test_budget_catches_bloat(tmp_path):
     assert [f.rule for f in findings] == ["J002"]
 
 
+def test_every_finding_carries_its_stage_label(tmp_path):
+    """--json consumers (benchdiff-style tooling) filter on the `stage`
+    field, so AST findings AND budget trips must both carry it."""
+    src = "def g(x):\n    return x\n\n\ndef f(x):\n    return jax.jit(g)(x)\n"
+    findings = lint_source(_PRELUDE + src, FIXTURE_PATH)
+    assert findings and all(f.stage == "ast" for f in findings)
+    assert findings[0].to_json()["stage"] == "ast"
+    bad = tmp_path / "budget.json"
+    bad.write_text(json.dumps({"ops": {"fused_layer_norm": 1}}))
+    jfindings, _ = jaxpr_audit.audit(["fused_layer_norm"],
+                                     budget_path=str(bad))
+    assert [f.stage for f in jfindings] == ["jaxpr"]
+    # the stage is display metadata, not identity: baseline keys ignore it
+    assert Finding("G005", "a.py", 3, 0, "m", "f", "s").key == \
+        Finding("G005", "a.py", 3, 0, "m", "f", "s", stage="ast").key
+
+
 def test_missing_budget_is_a_finding(tmp_path):
     empty = tmp_path / "budget.json"
     empty.write_text(json.dumps({"ops": {}}))
@@ -428,3 +532,20 @@ def test_cli_check_fails_on_findings_and_emits_json(tmp_path):
     payload = json.loads(proc.stdout)
     assert payload["findings"][0]["rule"] == "G005"
     assert payload["findings"][0]["fixit"]
+    assert payload["findings"][0]["stage"] == "ast"
+
+
+def test_ast_stage_completes_without_importing_jax(tmp_path):
+    """The pre-commit fast path: --stage ast (G001-G013 included) must
+    never import jax. A poisoned `jax` module on PYTHONPATH turns any
+    violation into a hard failure."""
+    shim = tmp_path / "shim"
+    shim.mkdir()
+    (shim / "jax.py").write_text(
+        "raise ImportError('graftlint --stage ast imported jax')\n")
+    env = dict(os.environ)
+    env["PYTHONPATH"] = f"{shim}{os.pathsep}{ROOT}"
+    proc = subprocess.run(
+        [sys.executable, CLI, "--check", "deeplearning4j_tpu"],
+        cwd=ROOT, env=env, capture_output=True, text=True, timeout=120)
+    assert proc.returncode == 0, proc.stdout + proc.stderr

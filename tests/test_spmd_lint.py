@@ -1,0 +1,174 @@
+"""Tier-1 gate for graftlint stage 3 (ISSUE 5): the collective-
+consistency audit (analysis/collective_audit.py). Proves that every
+frozen entry point's ordered collective signature matches the shipped
+analysis/collective_budget.json and is rank-divergence-free, that the
+2-process allreduce entry from tests/test_distributed.py has a frozen
+NON-EMPTY signature (the stage actually sees the PR 4 runtime), that a
+mutated frozen signature trips a named C001 finding with a non-zero CLI
+exit, and that a rank-conditional collective is reported as a C003
+DEADLOCK finding naming both divergent sequences — the SIGABRT
+"Deadline Exceeded" failure mode caught before launch instead of as a
+wedged fleet."""
+
+import importlib.util
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+from deeplearning4j_tpu.analysis import collective_audit
+
+pytestmark = pytest.mark.lint
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+CLI = os.path.join(ROOT, "tools", "graftlint.py")
+FIXTURE = os.path.join(ROOT, "tests", "fixtures",
+                       "spmd_divergent_entry.py")
+
+
+def _cli_main():
+    spec = importlib.util.spec_from_file_location("_graftlint_cli", CLI)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod.main
+
+
+# ------------------------------------------------ the shipped entry set
+
+@pytest.mark.parametrize("entry", collective_audit.entry_names())
+def test_entry_matches_frozen_signature_and_never_diverges(entry):
+    findings, sigs = collective_audit.audit([entry])
+    assert not findings, "\n".join(f.format() for f in findings)
+    assert sigs[entry] == collective_audit.load_budget()[entry]
+
+
+def test_allreduce_entry_signature_is_nonempty():
+    """The set_mesh/fit allreduce step tests/test_distributed.py proves
+    on a live 2-process x 4-device fleet must be VISIBLE to the stage:
+    pjit hides collectives from the jaxpr, so its frozen signature is
+    the post-GSPMD HLO sequence — and it must not be empty."""
+    sig = collective_audit.load_budget()["distributed/allreduce_step_2x4"]
+    assert sig, "the allreduce entry's frozen signature is empty"
+    assert all(item.startswith("hlo:all-reduce") for item in sig)
+
+
+def test_shard_map_entries_carry_jaxpr_collectives():
+    frozen = collective_audit.load_budget()
+    ring = frozen["ring_attention/seq4"]
+    assert any(item.startswith("ppermute@seq") for item in ring)
+    sp = frozen["sequence_parallel/sp_step_seq2"]
+    assert any(item.startswith("psum@seq") for item in sp)
+    assert set(frozen) == set(collective_audit.entry_names())
+
+
+# ------------------------------------------------------ drift tripping
+
+def test_signature_drift_trips_named_finding_and_cli_exit(
+        tmp_path, monkeypatch, capsys):
+    frozen = collective_audit.load_budget()
+    mutated = dict(frozen)
+    mutated["ring_attention/seq4"] = ["psum@bogus float32[2]"]
+    bad = tmp_path / "collective_budget.json"
+    bad.write_text(json.dumps({"signatures": mutated}))
+
+    findings, _ = collective_audit.audit(
+        ["ring_attention/seq4"], budget_path=str(bad), divergence=False)
+    assert [f.rule for f in findings] == ["C001"]
+    assert findings[0].path == "ring_attention/seq4"
+    assert findings[0].stage == "spmd"
+    assert "signature drift" in findings[0].message
+    assert "psum@bogus" in findings[0].message  # names the frozen side
+
+    # deadlock findings are NOT budget diffs: a divergent budget file
+    # must not be able to mask a C003 (different rule, always emitted)
+    monkeypatch.setattr(collective_audit, "BUDGET_PATH", str(bad))
+    assert _cli_main()(["--check", "--stage", "spmd"]) == 1
+    out = capsys.readouterr().out
+    assert "C001" in out and "ring_attention/seq4" in out
+
+
+def test_missing_signature_is_a_finding(tmp_path):
+    empty = tmp_path / "collective_budget.json"
+    empty.write_text(json.dumps({"signatures": {}}))
+    findings, _ = collective_audit.audit(
+        ["ring_attention/seq4"], budget_path=str(empty), divergence=False)
+    assert [f.rule for f in findings] == ["C002"]
+    assert "--update-collectives" in findings[0].fixit
+
+
+# ------------------------------------------------- divergence/deadlock
+
+def test_rank_conditional_collective_is_a_deadlock_finding():
+    """Satellite: inject a rank-conditional collective into a toy entry
+    (the checked-in demo fixture) and assert a DEADLOCK finding that
+    names both divergent sequences."""
+    findings, sigs = collective_audit.audit_paths([FIXTURE])
+    assert [f.rule for f in findings] == ["C003"]
+    msg = findings[0].message
+    assert "DEADLOCK" in msg
+    assert "process 0 issues" in msg and "process 1 issues" in msg
+    assert "psum@data" in msg and "[]" in msg  # both sequences named
+    assert findings[0].stage == "spmd"
+    assert sigs["demo/rank_conditional_psum"]  # pid-unsimulated trace
+
+
+def test_rank_divergent_op_count_is_the_same_class():
+    """A rank-dependent value baked into the trace (no collective in
+    sight) still desyncs the replicas: caught as C003 via op counts."""
+
+    def build():
+        import jax
+
+        def fn(x):
+            if jax.process_index() == 0:
+                return x + 1.0
+            return (x * 2.0) + (x * 3.0)
+
+        return fn, (jax.ShapeDtypeStruct((2,), "float32"),)
+
+    findings = collective_audit.check_divergence("toy/op_count", build)
+    assert [f.rule for f in findings] == ["C003"]
+    assert "traced ops" in findings[0].message
+
+
+def test_simulated_process_index_restores_state():
+    import jax
+
+    from deeplearning4j_tpu.distributed import bootstrap
+
+    before_env = os.environ.get(bootstrap.ENV_PROCESS_ID)
+    before_fn = jax.process_index
+    with collective_audit.simulated_process_index(1):
+        assert jax.process_index() == 1
+        assert os.environ[bootstrap.ENV_PROCESS_ID] == "1"
+    assert jax.process_index is before_fn
+    assert os.environ.get(bootstrap.ENV_PROCESS_ID) == before_env
+
+
+# --------------------------------------------------------------- CLI
+
+def test_cli_spmd_demo_exits_nonzero_with_both_finding_classes():
+    """The acceptance demo: `--stage spmd` on the divergent fixture must
+    exit non-zero with the G010 AST finding AND the C003 deadlock
+    finding naming both sequences."""
+    proc = subprocess.run(
+        [sys.executable, CLI, "--check", "--stage", "spmd", FIXTURE],
+        cwd=ROOT, capture_output=True, text=True, timeout=300)
+    assert proc.returncode == 1, proc.stdout + proc.stderr
+    assert "G010" in proc.stdout and "C003" in proc.stdout
+    assert "DEADLOCK" in proc.stdout
+    assert "process 0 issues" in proc.stdout
+
+
+def test_cli_spmd_clean_tree_emits_labeled_json():
+    proc = subprocess.run(
+        [sys.executable, CLI, "--check", "--stage", "spmd", "--json"],
+        cwd=ROOT, capture_output=True, text=True, timeout=300)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    payload = json.loads(proc.stdout)
+    assert payload["findings"] == []
+    sigs = payload["collective_signatures"]
+    assert set(sigs) == set(collective_audit.entry_names())
+    assert sigs["distributed/allreduce_step_2x4"]

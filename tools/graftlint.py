@@ -3,14 +3,22 @@
 
     python tools/graftlint.py deeplearning4j_tpu            # report
     python tools/graftlint.py --check deeplearning4j_tpu    # exit 1 on findings
-    python tools/graftlint.py --check --stage all           # + jaxpr audit
+    python tools/graftlint.py --check --stage all           # + jaxpr + spmd
+    python tools/graftlint.py --check --stage spmd          # SPMD/collectives
     python tools/graftlint.py --json ...                    # machine output
     python tools/graftlint.py --write-baseline ...          # grandfather
     python tools/graftlint.py --update-budget               # refreeze op bounds
+    python tools/graftlint.py --update-collectives          # refreeze stage 3
 
 Stage `ast` (default) is pure stdlib and instant — suitable as a
-pre-commit step. Stage `jaxpr` traces the jitted entry points on CPU
-(~1 min). Exit codes: 0 clean, 1 findings (--check), 2 usage/env error.
+pre-commit step; it runs all AST rules G001-G013. Stage `jaxpr` traces
+the jitted entry points on CPU (~1 min). Stage `spmd` runs the
+G010-G013 rules plus the collective-consistency audit
+(analysis/collective_audit.py): frozen ordered collective signatures and
+the simulated-rank divergence (deadlock) check; pass a fixture .py
+defining GRAFTLINT_SPMD_ENTRIES to divergence-check its entries instead
+of the built-ins. Exit codes: 0 clean, 1 findings (--check), 2
+usage/env error.
 """
 
 from __future__ import annotations
@@ -49,7 +57,7 @@ def main(argv=None) -> int:
                          "findings")
     ap.add_argument("--json", action="store_true", dest="as_json",
                     help="emit findings as JSON")
-    ap.add_argument("--stage", choices=("ast", "jaxpr", "all"),
+    ap.add_argument("--stage", choices=("ast", "jaxpr", "spmd", "all"),
                     default="ast")
     ap.add_argument("--baseline", default=DEFAULT_BASELINE)
     ap.add_argument("--write-baseline", action="store_true",
@@ -58,9 +66,13 @@ def main(argv=None) -> int:
     ap.add_argument("--update-budget", action="store_true",
                     help="retrace all entry points and refreeze the "
                          "jaxpr op-count budget")
+    ap.add_argument("--update-collectives", action="store_true",
+                    help="retrace the stage-3 entry points and refreeze "
+                         "the ordered collective signatures")
     args = ap.parse_args(argv)
 
-    if args.stage == "ast" and not args.update_budget:
+    if args.stage == "ast" and not (args.update_budget
+                                    or args.update_collectives):
         # Pre-commit path: stub the package parents so the analysis
         # modules load WITHOUT the root __init__ (which imports the full
         # nn stack and jax). Stage 1 stays pure-stdlib-fast.
@@ -71,10 +83,16 @@ def main(argv=None) -> int:
                                                   write_baseline)
 
     paths = args.paths or [os.path.join(ROOT, "deeplearning4j_tpu")]
-    new, old, counts = [], [], {}
+    new, old, counts, signatures = [], [], {}, {}
 
-    if args.stage in ("ast", "all"):
+    if args.stage in ("ast", "all", "spmd"):
         findings = lint_paths(paths, root=ROOT)
+        if args.stage == "spmd":
+            # the SPMD stage lints its own rule family only; G001-G009
+            # stay with --stage ast
+            from deeplearning4j_tpu.analysis.spmd_rules import \
+                SPMD_RULE_IDS
+            findings = [f for f in findings if f.rule in SPMD_RULE_IDS]
         if args.write_baseline:
             write_baseline(args.baseline, findings)
             print(f"baselined {len(findings)} findings -> {args.baseline}")
@@ -83,13 +101,17 @@ def main(argv=None) -> int:
         new.extend(n)
         old.extend(o)
 
-    if args.stage in ("jaxpr", "all") or args.update_budget:
+    needs_jax = (args.stage in ("jaxpr", "spmd", "all")
+                 or args.update_budget or args.update_collectives)
+    if needs_jax:
         # CPU-only + virtual devices, matching the tier-1 environment,
         # before any jax backend initialization.
         os.environ.setdefault("JAX_PLATFORMS", "cpu")
         from deeplearning4j_tpu.util.virtual_devices import \
             ensure_cpu_devices
         ensure_cpu_devices(8)
+
+    if args.stage in ("jaxpr", "all") or args.update_budget:
         from deeplearning4j_tpu.analysis import jaxpr_audit
         if args.update_budget:
             _, counts = jaxpr_audit.audit()
@@ -102,11 +124,30 @@ def main(argv=None) -> int:
         jfindings, counts = jaxpr_audit.audit()
         new.extend(jfindings)
 
+    if args.stage in ("spmd", "all") or args.update_collectives:
+        from deeplearning4j_tpu.analysis import collective_audit
+        if args.update_collectives:
+            _, signatures = collective_audit.audit(divergence=False)
+            collective_audit.write_budget(signatures)
+            print(f"froze collective signatures for {len(signatures)} "
+                  f"entry points -> {collective_audit.BUDGET_PATH}")
+            for name, sig in sorted(signatures.items()):
+                print(f"  {name}: {len(sig)} collective(s)")
+            return 0
+        # fixture .py paths exposing GRAFTLINT_SPMD_ENTRIES are audited
+        # INSTEAD of the built-ins (targeted demo/debug runs); otherwise
+        # the frozen entry points get the full budget + divergence pass
+        cfindings, signatures = collective_audit.audit_paths(paths)
+        if not signatures:
+            cfindings, signatures = collective_audit.audit()
+        new.extend(cfindings)
+
     if args.as_json:
         print(json.dumps({
             "findings": [f.to_json() for f in new],
             "grandfathered": [f.to_json() for f in old],
             "jaxpr_op_counts": counts,
+            "collective_signatures": signatures,
         }, indent=1))
     else:
         for f in new:
@@ -115,6 +156,9 @@ def main(argv=None) -> int:
             print(f"({len(old)} grandfathered finding(s) in baseline)")
         if counts:
             print(f"jaxpr audit: {len(counts)} entry points traced")
+        if signatures:
+            print(f"collective audit: {len(signatures)} entry points "
+                  "traced")
         print(f"graftlint: {len(new)} finding(s)")
     return 1 if (new and args.check) else 0
 

@@ -2,14 +2,14 @@
 
 Three stages:
 
-1. AST pass (`ast_pass.lint_paths`): rules G001-G013 over the package —
+1. AST pass (`ast_pass.lint_paths`): rules G001-G014 over the package —
    tracer leaks, host syncs in hot paths, float64 drift, RNG discipline,
    retrace hazards, shard_map arity, util/compat bypasses, import-time
    device captures, rendezvous plumbing outside distributed/bootstrap
    (G001-G009, ast_rules.py), and the SPMD rank-divergence shapes:
    rank-guarded collectives/jit/mesh, host nondeterminism into traced
    values, unbound collective axis names, rank-conditional host syncs
-   (G010-G013, spmd_rules.py). Pure stdlib; never imports jax.
+   (G010-G014, spmd_rules.py). Pure stdlib; never imports jax.
 2. jaxpr audit (`jaxpr_audit.audit`): traces the public jitted entry
    points with abstract inputs on CPU and asserts the programs are
    transfer-clean (J001), within frozen op-count budgets (J002), and

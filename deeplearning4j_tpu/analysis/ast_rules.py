@@ -1,4 +1,4 @@
-"""The G001-G009 AST rules (G010-G013 live in spmd_rules.py and
+"""The G001-G009 AST rules (G010-G014 live in spmd_rules.py and
 register into ALL_RULES/RULE_DOCS at the bottom of this module).
 
 Every rule errs toward PRECISION over recall: a lint gate that cries
@@ -623,6 +623,7 @@ _RENDEZVOUS_HOME = "distributed/bootstrap.py"
 _RENDEZVOUS_ENV_VARS = {
     "DL4J_TPU_COORDINATOR", "DL4J_TPU_PROCESS_ID",
     "DL4J_TPU_NUM_PROCESSES", "DL4J_TPU_LOCAL_DEVICE_COUNT",
+    "DL4J_TPU_FAULTS",
 }
 
 
@@ -716,7 +717,7 @@ def g008_import_time(tree, imports, path):
     return out
 
 
-# stage-3 AST rules (G010-G013) live in spmd_rules.py and register here;
+# stage-3 AST rules (G010-G014) live in spmd_rules.py and register here;
 # the import sits below every helper they borrow lazily, so importing
 # either module first resolves cleanly.
 from deeplearning4j_tpu.analysis.spmd_rules import (  # noqa: E402

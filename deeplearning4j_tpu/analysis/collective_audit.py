@@ -1,6 +1,6 @@
 """Stage 3: collective-consistency audit (SPMD divergence detection).
 
-The trace-level twin of the G010-G013 AST rules (spmd_rules.py). Walks
+The trace-level twin of the G010-G014 AST rules (spmd_rules.py). Walks
 each frozen entry point's closed jaxpr (recursing into pjit/scan/cond
 sub-jaxprs via jaxpr_audit._iter_eqns) and extracts the **ordered
 collective signature** — the (primitive, axis names, operand

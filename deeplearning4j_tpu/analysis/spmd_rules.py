@@ -1,4 +1,5 @@
-"""The G010-G013 SPMD-divergence AST rules (graftlint stage 3, AST side).
+"""The G010-G014 SPMD-divergence / fleet-robustness AST rules
+(graftlint stage 3, AST side).
 
 PR 4's multi-process runtime made rank-divergence the most expensive bug
 class in the repo: a program that issues different collective sequences
@@ -84,7 +85,7 @@ _NONDET_EXEMPT_TAILS = frozenset({"seed", "default_rng", "RandomState",
 _BLOCKING_ATTRS = frozenset({"block_until_ready", "item"})
 _BLOCKING_CALLS = frozenset({"jax.block_until_ready", "jax.device_get"})
 
-SPMD_RULE_IDS = frozenset({"G010", "G011", "G012", "G013"})
+SPMD_RULE_IDS = frozenset({"G010", "G011", "G012", "G013", "G014"})
 
 
 def _env_rank_var() -> str:
@@ -385,8 +386,90 @@ def g013_rank_conditional_host_sync(tree, imports, path):
     return out
 
 
+# --------------------------------------------------------------- G014
+
+# Calls whose failure must never be silently swallowed: a collective or
+# rendezvous error is the fleet telling you a peer is gone — an
+# overbroad handler that eats it turns a recoverable death into a
+# divergent fleet (some ranks "succeeded", some are gone). Deliberately
+# NOT included: jax.distributed.shutdown and teardown paths, where
+# best-effort `except: pass` is the correct idiom.
+_G014_SWALLOW_TRIGGERS = (COLLECTIVE_CALLS
+                          | {"jax.distributed.initialize",
+                             "deeplearning4j_tpu.distributed.bootstrap."
+                             "initialize"})
+
+_G014_OVERBROAD = frozenset({"Exception", "BaseException"})
+
+
+def _handler_is_overbroad(handler: ast.ExceptHandler, imports) -> bool:
+    if handler.type is None:  # bare `except:`
+        return True
+    name = imports.canon(handler.type)
+    return name in _G014_OVERBROAD
+
+
+def g014_swallowed_fleet_errors(tree, imports, path):
+    """(a) bare/overbroad `except` that swallows (never re-raises)
+    around collective or rendezvous-initialize calls — package-wide; and
+    (b) `while True` retry loops in distributed/ that sleep inside an
+    exception handler with no raise anywhere in the loop body — an
+    uncapped retry (the bounded idiom is `bootstrap.Backoff`, whose
+    exhausted budget makes the caller raise). Not caught: swallowing
+    through helper functions the AST cannot see into, and loops bounded
+    by non-`while True` conditions (those carry their own exit)."""
+    out = []
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Try):
+            trigger = None
+            for sub in _iter_executed(node.body):
+                if isinstance(sub, ast.Call) and \
+                        imports.canon(sub.func) in _G014_SWALLOW_TRIGGERS:
+                    trigger = imports.canon(sub.func)
+                    break
+            if trigger is None:
+                continue
+            for handler in node.handlers:
+                if not _handler_is_overbroad(handler, imports):
+                    continue
+                if any(isinstance(s, ast.Raise)
+                       for s in ast.walk(handler)):
+                    continue
+                out.append(("G014", handler,
+                            f"overbroad `except` swallows failures of "
+                            f"`{trigger}` — a dead peer's error "
+                            "disappears and the fleet diverges instead "
+                            "of recovering",
+                            "catch the narrow exception, or re-raise "
+                            "after cleanup so the elastic supervisor "
+                            "can classify the death"))
+        elif isinstance(node, ast.While) and "/distributed/" in \
+                path.replace("\\", "/"):
+            test = node.test
+            if not (isinstance(test, ast.Constant) and test.value is True):
+                continue
+            body_nodes = list(_iter_executed(node.body))
+            has_handler = any(isinstance(s, ast.ExceptHandler)
+                              for s in body_nodes)
+            sleeps = any(isinstance(s, ast.Call)
+                         and imports.canon(s.func) == "time.sleep"
+                         for s in body_nodes)
+            raises = any(isinstance(s, ast.Raise) for s in body_nodes)
+            if has_handler and sleeps and not raises:
+                out.append(("G014", node,
+                            "`while True` retry loop sleeps on failure "
+                            "with no raise path — an unreachable "
+                            "coordinator retries forever instead of "
+                            "dying classifiably",
+                            "use bootstrap.Backoff (full jitter + "
+                            "max-elapsed cap) and raise when pause() "
+                            "returns False"))
+    return out
+
+
 SPMD_RULES = [g010_rank_divergent_control_flow, g011_host_nondeterminism,
-              g012_unbound_axis_name, g013_rank_conditional_host_sync]
+              g012_unbound_axis_name, g013_rank_conditional_host_sync,
+              g014_swallowed_fleet_errors]
 
 SPMD_RULE_DOCS = {
     "G010": "rank-dependent control flow guarding collectives/jit/mesh "
@@ -397,4 +480,6 @@ SPMD_RULE_DOCS = {
             "shard_map/pmap/mesh or a parameter",
     "G013": "blocking host sync (.item/device_get/block_until_ready) "
             "inside rank-conditional blocks",
+    "G014": "overbroad except swallowing collective/rendezvous errors; "
+            "uncapped retry loops in distributed/",
 }

@@ -9,6 +9,11 @@ jax.distributed; SURVEY §2.4).
   streaming, wall-clock timeouts, and straggler reaping.
 - `global_mesh`: the Mesh over every process's devices + per-process
   batch-shard globalization, routed through the containers' `set_mesh`.
+- `faults`: deterministic fault injection (kill@step / hang@step /
+  delay-connect / drop-heartbeat) through the same env contract.
+- `elastic`: the recovery supervisor — checkpoint cadence, exit
+  classification, generational re-form at N' processes, resume with a
+  continuous step counter.
 
 Only `bootstrap` (pure stdlib) loads eagerly; the rest resolve lazily so
 importing this package never drags in jax (graftlint stub contract —
@@ -29,9 +34,15 @@ from deeplearning4j_tpu.distributed.bootstrap import (  # noqa: F401
 
 _LAZY = {
     "ProcessResult": "deeplearning4j_tpu.distributed.launcher",
+    "classify_exit": "deeplearning4j_tpu.distributed.launcher",
     "free_port": "deeplearning4j_tpu.distributed.launcher",
     "launch_local": "deeplearning4j_tpu.distributed.launcher",
     "launch_plan": "deeplearning4j_tpu.distributed.launcher",
+    "Fault": "deeplearning4j_tpu.distributed.faults",
+    "FaultSchedule": "deeplearning4j_tpu.distributed.faults",
+    "active_faults": "deeplearning4j_tpu.distributed.faults",
+    "ElasticSupervisor": "deeplearning4j_tpu.distributed.elastic",
+    "run_elastic_steps": "deeplearning4j_tpu.distributed.elastic",
     "globalize_batch": "deeplearning4j_tpu.distributed.global_mesh",
     "globalize_full": "deeplearning4j_tpu.distributed.global_mesh",
     "local_shard": "deeplearning4j_tpu.distributed.global_mesh",

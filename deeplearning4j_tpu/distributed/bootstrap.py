@@ -31,8 +31,9 @@ contract through it).
 from __future__ import annotations
 
 import os
+import random
 import time
-from typing import Optional
+from typing import Callable, Optional
 
 # ------------------------------------------------------------ env contract
 # One spelling for the rendezvous environment, shared by the local
@@ -42,9 +43,73 @@ ENV_COORDINATOR = "DL4J_TPU_COORDINATOR"
 ENV_PROCESS_ID = "DL4J_TPU_PROCESS_ID"
 ENV_NUM_PROCESSES = "DL4J_TPU_NUM_PROCESSES"
 ENV_LOCAL_DEVICE_COUNT = "DL4J_TPU_LOCAL_DEVICE_COUNT"
+# fault-injection schedule (distributed/faults.py) — part of the same
+# contract so the launcher's env block and the workers' runtime agree on
+# one spelling (and G009 flags literal copies like the vars above)
+ENV_FAULTS = "DL4J_TPU_FAULTS"
 
 RENDEZVOUS_ENV_VARS = (ENV_COORDINATOR, ENV_PROCESS_ID, ENV_NUM_PROCESSES,
                        ENV_LOCAL_DEVICE_COUNT)
+
+
+# ----------------------------------------------------------------- backoff
+
+class Backoff:
+    """Full-jitter exponential backoff under a max-elapsed-time cap.
+
+    ``next_delay()`` returns how long to sleep before the next retry —
+    drawn uniformly from [0, min(cap, base*2^attempt)] (the AWS
+    "full jitter" scheme: a rejoin storm of N workers decorrelates
+    instead of thundering-herding the coordinator in lockstep waves) —
+    or ``None`` once the total elapsed time since the first call would
+    exceed ``max_elapsed`` (the caller's signal to give up and raise).
+    The last delay is clipped so sleeping it never overshoots the cap.
+
+    ``pause()`` is the convenience loop body: sleep the next delay and
+    return True, or return False when the budget is exhausted.
+
+    clock/sleep/rng are injectable so unit tests assert the bounded
+    total wait with a fake clock and zero real sleeping; the default rng
+    seeds from the pid, giving each fleet member its own jitter stream
+    while staying reproducible within a process.
+    """
+
+    def __init__(self, base: float = 0.25, cap: float = 5.0,
+                 max_elapsed: float = 60.0,
+                 rng: Optional[random.Random] = None,
+                 clock: Callable[[], float] = time.monotonic,
+                 sleep: Callable[[float], None] = time.sleep):
+        self.base = base
+        self.cap = cap
+        self.max_elapsed = max_elapsed
+        self._rng = rng if rng is not None else random.Random(os.getpid())
+        self._clock = clock
+        self._sleep = sleep
+        self._attempt = 0
+        self._start: Optional[float] = None
+
+    @property
+    def attempts(self) -> int:
+        return self._attempt
+
+    def next_delay(self) -> Optional[float]:
+        now = self._clock()
+        if self._start is None:
+            self._start = now
+        remaining = (self._start + self.max_elapsed) - now
+        if remaining <= 0:
+            return None
+        upper = min(self.cap, self.base * (2.0 ** self._attempt))
+        self._attempt += 1
+        return min(self._rng.uniform(0.0, upper), remaining)
+
+    def pause(self) -> bool:
+        """Sleep the next jittered delay; False when max_elapsed is spent."""
+        delay = self.next_delay()
+        if delay is None:
+            return False
+        self._sleep(delay)
+        return True
 
 
 def rendezvous_env(coordinator_address: str, process_id: int,
@@ -138,13 +203,21 @@ def initialize(coordinator_address: Optional[str] = None,
     returns immediately.
 
     connect_timeout / max_backoff: outer retry loop around connect-time
-    failures (coordinator not yet bound, transient refusals) — each
-    failed attempt backs off exponentially up to max_backoff seconds.
-    init_timeout: forwarded to jax's own initialization_timeout (how long
-    jax itself waits inside ONE attempt). cpu_collectives: "auto" picks
-    gloo for CPU fleets, None/"" disables, or name a backend explicitly.
+    failures (coordinator not yet bound, transient refusals) — failed
+    attempts back off with FULL-JITTER exponential delays capped at
+    max_backoff seconds each, under a connect_timeout max-elapsed cap
+    (see `Backoff`: a rejoin storm after an elastic re-form must not
+    thundering-herd the coordinator). init_timeout: forwarded to jax's
+    own initialization_timeout (how long jax itself waits inside ONE
+    attempt). cpu_collectives: "auto" picks gloo for CPU fleets,
+    None/"" disables, or name a backend explicitly.
     """
+    from deeplearning4j_tpu.distributed.faults import active_faults
     from deeplearning4j_tpu.telemetry.recorder import get_default
+
+    # injected `delay-connect` fault: sleep BEFORE touching the
+    # coordinator, simulating a late worker racing the rendezvous
+    active_faults().delay_connect()
 
     environ = os.environ
     contract = contract_from_env(environ)
@@ -201,34 +274,32 @@ def initialize(coordinator_address: Optional[str] = None,
     if init_timeout is not None:
         kwargs["initialization_timeout"] = init_timeout
 
-    deadline = time.monotonic() + connect_timeout
-    backoff = 0.25
-    attempt = 0
+    backoff = Backoff(base=0.25, cap=max_backoff,
+                      max_elapsed=connect_timeout)
     with rec.span("distributed_init", process_id=process_id,
                   num_processes=num_processes,
                   coordinator=coordinator_address) as span:
         while True:
-            attempt += 1
             try:
                 jax.distributed.initialize(**kwargs)
                 break
             except Exception as exc:
-                if time.monotonic() + backoff > deadline:
-                    rec.error("distributed_init", exc=exc, attempt=attempt,
-                              process_id=process_id,
-                              coordinator=coordinator_address)
-                    raise
                 try:  # clear any half-initialized client before retrying
                     jax.distributed.shutdown()
                 except Exception:
                     pass
-                time.sleep(backoff)
-                backoff = min(backoff * 2.0, max_backoff)
+                if not backoff.pause():
+                    rec.error("distributed_init", exc=exc,
+                              attempt=backoff.attempts + 1,
+                              process_id=process_id,
+                              coordinator=coordinator_address)
+                    raise
         info = {"process_id": jax.process_index(),
                 "num_processes": jax.process_count(),
                 "local_devices": jax.local_device_count(),
                 "global_devices": jax.device_count(),
-                "coordinator": coordinator_address, "attempts": attempt}
-        span["attempts"] = attempt
+                "coordinator": coordinator_address,
+                "attempts": backoff.attempts + 1}
+        span["attempts"] = backoff.attempts + 1
     rec.meta(distributed=info)
     return info

@@ -1,0 +1,285 @@
+"""Elastic fault-tolerant training: checkpoint-resume recovery for the
+multi-process fleet.
+
+PR 4's runtime proved the 2-process pjit mesh; this module makes the
+fleet *survivable*. The failure model (ARCHITECTURE §Distributed runtime
+failure matrix): one worker dies mid-fit — SIGKILLed by a preemption or
+the fault harness (`distributed/faults.py`), SIGABRT'd by the jax 0.4.x
+"Deadline Exceeded" death, or wedged until the launcher reaps it — and
+on this jax generation the survivors cannot simply continue: the gloo
+world is broken and every further collective fails. Recovery is
+therefore *generational*, the SparkNet coarse-sync shape
+(arXiv:1511.06051) rather than in-place peer patching, and all of it
+stays off the hot collective path (arXiv:1810.11112):
+
+1. **While healthy**, every process materializes the post-step host
+   values in lockstep and process 0 persists them through
+   `util/orbax_checkpoint.ShardedCheckpointer.save(host=True)` — a
+   process-count-portable checkpoint (restores onto N' processes, or 1).
+2. **On a peer's death**, a surviving worker that sees the failure as a
+   Python exception checkpoints the last COMPLETED step (its params are
+   untouched by the failed step) and exits `RESUMABLE_EXIT_CODE`;
+   workers that die the hard SIGABRT way are covered by the cadence
+   checkpoint. Either way the step's evidence is already in telemetry.
+3. **The supervisor** (`ElasticSupervisor`, launcher-side) classifies
+   every exit, tears down the dead rendezvous (stragglers are reaped by
+   the launch deadline; each generation gets a fresh coordinator port),
+   journals the re-form durably through the `ClusterCoordinator`
+   config registry, and relaunches at N' = max(survivors,
+   min_processes) — topping up with *replacement* workers when the
+   floor requires it (control-plane rank adoption:
+   `ClusterClient(replace_dead=True)`).
+4. **Rejoining workers** restore the latest checkpoint
+   (`net.resume_from`) before `set_mesh`, so the continuous step
+   counter and `batch_for_step` (`nn/training.fit_steps`) make the
+   resumed run optimize the identical batch sequence an uninterrupted
+   run would have seen.
+
+jax is imported lazily: the module must stay importable under
+graftlint's no-jax package stubs.
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+from dataclasses import dataclass, field
+from typing import Callable, List, Optional, Sequence
+
+from deeplearning4j_tpu.distributed import faults as faults_mod
+from deeplearning4j_tpu.distributed.faults import RESUMABLE_EXIT_CODE
+
+# exit classes that mean "this worker is gone" (vs rejoining next gen)
+_DEAD_CLASSES = frozenset({
+    faults_mod.EXIT_SIGABRT, faults_mod.EXIT_DEADLINE,
+    faults_mod.EXIT_INJECTED_KILL, faults_mod.EXIT_ERROR,
+})
+
+GEN_KEY = "elastic/gen"  # coordinator config key: last attempted generation
+# supervisor -> worker: the step budget every generation trains toward
+ENV_TOTAL_STEPS = "DL4J_TPU_ELASTIC_TOTAL_STEPS"
+
+
+# ------------------------------------------------------------ worker side
+
+def run_elastic_steps(net, batch_for_step, total_steps: int, *,
+                      checkpoint_dir: str, checkpoint_every: int = 1):
+    """The worker-side elastic fit loop (call after `bootstrap.initialize`,
+    `net.resume_from(checkpoint_dir)`, and `set_mesh` on the global mesh).
+
+    Runs `nn/training.fit_steps` from the net's restored step to
+    ``total_steps``; after each completed step the post-step host values
+    are checkpointed every ``checkpoint_every`` steps (plus always at the
+    final step), and any kill/hang fault scheduled for that step fires.
+    A peer's death surfacing as a Python exception triggers the rescue
+    path: checkpoint the last completed step, emit the telemetry trail,
+    and exit ``RESUMABLE_EXIT_CODE`` so the supervisor counts this
+    worker as a survivor for the next generation.
+    """
+    import jax
+
+    from deeplearning4j_tpu.nn.training import fit_steps
+    from deeplearning4j_tpu.telemetry.recorder import get_default
+    from deeplearning4j_tpu.util.orbax_checkpoint import ShardedCheckpointer
+
+    rec = get_default()
+    ckptr = ShardedCheckpointer(checkpoint_dir)
+    faults = faults_mod.active_faults()
+    start = net.iteration_count
+
+    def on_step(step):
+        if step % checkpoint_every == 0 or step == total_steps:
+            ckptr.save(net, step, host=True)
+        faults.check_step(step)
+
+    # a typed "I am resuming from `start`" mark in this process's JSONL
+    rec.event("span", name="elastic_resume", ok=True, seconds=0.0,
+              start_step=start, total_steps=total_steps,
+              process_id=jax.process_index(),
+              num_processes=jax.process_count())
+    try:
+        fit_steps(net, batch_for_step, total_steps, on_step=on_step)
+    except Exception as exc:
+        # a dead peer usually lands here as an XlaRuntimeError from the
+        # failed collective; params still hold the last COMPLETED step
+        rec.error("elastic_step", exc=exc, step=net.iteration_count)
+        try:
+            ckptr.save(net, net.iteration_count, host=True)
+            saved = True
+        except Exception as save_exc:  # broken world: cadence ckpt covers
+            rec.error("elastic_rescue_save", exc=save_exc,
+                      step=net.iteration_count)
+            saved = False
+        rec.fault("peer-loss-exit", step=net.iteration_count,
+                  rescue_checkpoint=saved, resumable=True)
+        raise SystemExit(RESUMABLE_EXIT_CODE)
+    return net
+
+
+# -------------------------------------------------------- supervisor side
+
+@dataclass
+class FleetGeneration:
+    """One launch attempt: its size, per-process results, and the death
+    accounting that sized the next generation."""
+
+    gen: int
+    n_processes: int
+    results: list
+    exit_classes: List[str] = field(default_factory=list)
+
+    @property
+    def dead(self) -> List[int]:
+        return [r.process_id for r in self.results
+                if r.exit_class in _DEAD_CLASSES]
+
+    @property
+    def clean(self) -> bool:
+        return all(r.exit_class == faults_mod.EXIT_CLEAN
+                   for r in self.results)
+
+
+@dataclass
+class ElasticRunResult:
+    generations: List[FleetGeneration]
+    total_steps: int
+
+    @property
+    def final_n(self) -> int:
+        return self.generations[-1].n_processes
+
+
+class ElasticError(RuntimeError):
+    """The fleet could not finish within max_reforms generations."""
+
+
+class ElasticSupervisor:
+    """Launcher-side recovery supervisor: run a worker fleet to
+    completion across worker deaths.
+
+    ``argv`` is the worker program (it must follow the worker-side
+    contract above: resume from ``checkpoint_dir``, run
+    `run_elastic_steps`, exit 0 when ``total_steps`` is reached). Each
+    generation launches through `launcher.launch_local` — fresh
+    coordinator port, wall-clock deadline as the hard straggler bound —
+    and the supervisor journals every generation into a durable
+    `ClusterCoordinator` (``snapshot_path``): a restarted supervisor
+    resumes the generation count, and replacement workers adopting dead
+    ranks go through the same coordinator's ``replace_dead``
+    registration. ``faults`` (a `FaultSchedule`) applies to generation 0
+    only — the injected failure, not an afterlife curse.
+    """
+
+    def __init__(self, argv: Sequence[str], *, n_processes: int,
+                 checkpoint_dir: str, total_steps: int,
+                 min_processes: int = 1, max_reforms: int = 3,
+                 local_device_count: Optional[int] = 2,
+                 gen_timeout: float = 240.0, grace: float = 5.0,
+                 death_grace: float = 20.0,
+                 faults=None, snapshot_path: Optional[str] = None,
+                 extra_env: Optional[dict] = None,
+                 echo: Optional[Callable[[str], None]] = None,
+                 cwd: Optional[str] = None):
+        if min_processes < 1:
+            raise ValueError("min_processes must be >= 1")
+        if min_processes > n_processes:
+            raise ValueError("min_processes cannot exceed n_processes")
+        self.argv = list(argv)
+        self.n_processes = n_processes
+        self.checkpoint_dir = checkpoint_dir
+        self.total_steps = total_steps
+        self.min_processes = min_processes
+        self.max_reforms = max_reforms
+        self.local_device_count = local_device_count
+        self.gen_timeout = gen_timeout
+        self.grace = grace
+        # dead-rendezvous teardown: after the first death, survivors get
+        # this long to rescue-checkpoint and exit resumable on their own
+        # before the launcher reaps them (on jax 0.4.x they usually
+        # cannot — the coordination service aborts them from a blocked
+        # collective — so waiting longer buys nothing; the cadence
+        # checkpoint is the durable record either way)
+        self.death_grace = death_grace
+        self.faults = (faults_mod.FaultSchedule.parse(faults)
+                       if faults is not None
+                       and not isinstance(faults, faults_mod.FaultSchedule)
+                       else faults)
+        self.extra_env = dict(extra_env or {})
+        self.echo = echo
+        self.cwd = cwd
+        from deeplearning4j_tpu.parallel.cluster import ClusterCoordinator
+
+        # the durable control plane: generation journal + rank registry
+        # (replacement workers adopt dead ranks through it); with
+        # snapshot_path every re-form survives a supervisor restart too
+        self.coordinator = ClusterCoordinator(
+            snapshot_path=snapshot_path).start()
+
+    def close(self) -> None:
+        self.coordinator.shutdown()
+
+    # ------------------------------------------------------------- run
+    def run(self) -> ElasticRunResult:
+        from deeplearning4j_tpu.distributed.launcher import launch_local
+        from deeplearning4j_tpu.telemetry.recorder import get_default
+
+        rec = get_default()
+        generations: List[FleetGeneration] = []
+        gen = int(self.coordinator.read_config(GEN_KEY, -1)) + 1
+        n = self.n_processes
+        env = dict(self.extra_env)
+        env.setdefault(ENV_TOTAL_STEPS, str(self.total_steps))
+        while True:
+            self.coordinator.record_config(GEN_KEY, gen)
+            with rec.span("elastic_generation", gen=gen,
+                          n_processes=n) as span:
+                results = launch_local(
+                    self.argv, n,
+                    local_device_count=self.local_device_count,
+                    timeout=self.gen_timeout, grace=self.grace,
+                    death_grace=self.death_grace,
+                    faults=self.faults if gen == 0 else None,
+                    extra_env=env, echo=self.echo, cwd=self.cwd)
+                g = FleetGeneration(
+                    gen=gen, n_processes=n, results=results,
+                    exit_classes=[r.exit_class for r in results])
+                generations.append(g)
+                span["exit_classes"] = g.exit_classes
+                self.coordinator.record_config(
+                    f"elastic/members/{gen}",
+                    {"n_processes": n, "exit_classes": g.exit_classes})
+            if g.clean:
+                return ElasticRunResult(generations=generations,
+                                        total_steps=self.total_steps)
+            survivors = n - len(g.dead)
+            n_next = max(survivors, self.min_processes)
+            replacements = n_next - survivors
+            if len(generations) > self.max_reforms:
+                raise ElasticError(
+                    f"fleet did not finish within {self.max_reforms} "
+                    f"re-forms; exit classes per generation: "
+                    f"{[h.exit_classes for h in generations]}")
+            rec.fault("reform", gen=gen + 1, n_processes=n_next,
+                      survivors=survivors, replacements=replacements,
+                      dead=g.dead, prior_exit_classes=g.exit_classes)
+            gen += 1
+            n = n_next
+
+
+def worker_total_steps(default: Optional[int] = None) -> int:
+    """The supervisor-announced step budget, from the env it gives every
+    generation (worker-side convenience for `run_elastic_steps` callers).
+    """
+    val = os.environ.get(ENV_TOTAL_STEPS)
+    if val is None:
+        if default is None:
+            raise KeyError(f"{ENV_TOTAL_STEPS} is not set — launch "
+                           "through ElasticSupervisor or pass "
+                           "total_steps explicitly")
+        return default
+    return int(val)
+
+
+def main_argv(worker_script: str, *args: str) -> List[str]:
+    """`argv` for a python worker script run by the current interpreter."""
+    return [sys.executable, worker_script, *list(args)]

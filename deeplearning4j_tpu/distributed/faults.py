@@ -1,0 +1,227 @@
+"""Deterministic fault injection for the multi-process fleet.
+
+A fleet that has never been killed mid-step has an untested recovery
+path; this module makes worker death, hangs, slow joins, and silent
+heartbeat loss *reproducible* so `distributed/elastic.py`'s recovery
+supervisor (and the tier-1 tests) exercise them on demand. SparkNet
+(arXiv:1511.06051) argues coarse-sync training tolerates stragglers and
+restarts gracefully — but only a harness that injects those failures on
+a fixed schedule can prove it, and the CUDA-aware-MPI characterization
+(arXiv:1810.11112) motivates keeping all of this recovery machinery off
+the hot collective path (faults fire from the host-side step loop, never
+inside a traced program).
+
+**Spec syntax** — one fault per spec, `;`-joined into a schedule:
+
+    p1:kill@step3        SIGKILL process 1 right after its step 3 completes
+    p2:hang@step4        process 2 stops making progress after step 4
+    p0:delay-connect:1.5 process 0 sleeps 1.5 s before dialing the rendezvous
+    p1:drop-heartbeat    process 1 silently stops heartbeating its
+                         ClusterClient (coordinator reaps it; its slot
+                         becomes claimable)
+
+The schedule travels to fleet members through the env contract
+(`bootstrap.ENV_FAULTS`, set by `launcher.launch_local(faults=...)`);
+each process filters the schedule by its own `ENV_PROCESS_ID`, so one
+string describes the whole fleet. Every fired fault emits a typed
+telemetry `fault` event BEFORE acting (the recorder flushes per line, so
+even a SIGKILL leaves its evidence in the JSONL).
+
+Pure stdlib: importable under graftlint's no-jax package stubs, and
+usable from processes that never import jax (the classification unit
+tests run in bare interpreters).
+"""
+
+from __future__ import annotations
+
+import os
+import random
+import signal
+import time
+from dataclasses import dataclass
+from typing import Callable, List, Optional, Sequence
+
+from deeplearning4j_tpu.distributed import bootstrap
+
+KINDS = ("kill", "hang", "delay-connect", "drop-heartbeat")
+
+# Exit classes the launcher reports per fleet member (see
+# `launcher.classify_exit`). One spelling, shared with telemetry events
+# and the elastic supervisor's death accounting.
+EXIT_CLEAN = "clean"
+EXIT_SIGABRT = "sigabrt"
+EXIT_DEADLINE = "deadline-reaped"
+EXIT_INJECTED_KILL = "injected-kill"
+EXIT_RESUMABLE = "resumable"
+EXIT_ERROR = "error"
+
+# Exit code a worker uses to say "I survived a peer's death, checkpointed
+# the last completed step, and want to rejoin the next generation"
+# (sysexits EX_TEMPFAIL — a transient, retryable condition).
+RESUMABLE_EXIT_CODE = 75
+
+
+@dataclass(frozen=True)
+class Fault:
+    """One scheduled fault: what, to whom, and when."""
+
+    process_id: int
+    kind: str  # one of KINDS
+    step: Optional[int] = None      # kill/hang trigger step
+    seconds: Optional[float] = None  # delay-connect sleep
+
+    def spec(self) -> str:
+        s = f"p{self.process_id}:{self.kind}"
+        if self.step is not None:
+            s += f"@step{self.step}"
+        if self.seconds is not None:
+            s += f":{self.seconds:g}"
+        return s
+
+
+def parse_fault(spec: str) -> Fault:
+    """Parse one `pN:kind[@stepK][:seconds]` spec (see module docstring)."""
+    spec = spec.strip()
+    head, _, rest = spec.partition(":")
+    if not head.startswith("p") or not head[1:].isdigit():
+        raise ValueError(f"fault spec {spec!r}: expected 'p<N>:<kind>...'")
+    process_id = int(head[1:])
+    kind, step, seconds = rest, None, None
+    if "@" in rest:
+        kind, _, when = rest.partition("@")
+        if when.startswith("step"):
+            when = when[4:]
+        if not when.isdigit():
+            raise ValueError(f"fault spec {spec!r}: bad step {when!r}")
+        step = int(when)
+    elif ":" in rest:
+        kind, _, secs = rest.partition(":")
+        seconds = float(secs)
+    if kind not in KINDS:
+        raise ValueError(f"fault spec {spec!r}: unknown kind {kind!r} "
+                         f"(one of {', '.join(KINDS)})")
+    if kind in ("kill", "hang") and step is None:
+        raise ValueError(f"fault spec {spec!r}: {kind} needs '@step<N>'")
+    if kind == "delay-connect" and seconds is None:
+        raise ValueError(f"fault spec {spec!r}: delay-connect needs "
+                         "':<seconds>'")
+    return Fault(process_id, kind, step=step, seconds=seconds)
+
+
+class FaultSchedule:
+    """An ordered set of Faults for one fleet launch."""
+
+    def __init__(self, faults: Sequence[Fault] = ()):
+        self.faults: List[Fault] = list(faults)
+
+    @classmethod
+    def parse(cls, specs) -> "FaultSchedule":
+        """From a `;`-joined string or an iterable of spec strings."""
+        if isinstance(specs, str):
+            specs = [s for s in specs.split(";") if s.strip()]
+        return cls([parse_fault(s) for s in specs])
+
+    @classmethod
+    def seeded(cls, seed: int, n_processes: int, max_step: int,
+               kinds: Sequence[str] = ("kill", "hang")) -> "FaultSchedule":
+        """A deterministic one-fault schedule: the same seed always names
+        the same victim, kind, and step (stdlib Random — reproducible
+        across platforms and interpreter runs, unlike hash())."""
+        rng = random.Random(seed)
+        kind = kinds[rng.randrange(len(kinds))]
+        victim = rng.randrange(n_processes)
+        fault = Fault(victim, kind, step=rng.randint(1, max_step))
+        return cls([fault])
+
+    def to_env(self) -> str:
+        return ";".join(f.spec() for f in self.faults)
+
+    def for_process(self, process_id: int) -> List[Fault]:
+        return [f for f in self.faults if f.process_id == process_id]
+
+    def kill_scheduled(self, process_id: int) -> bool:
+        return any(f.kind == "kill" for f in self.for_process(process_id))
+
+    def __iter__(self):
+        return iter(self.faults)
+
+    def __len__(self):
+        return len(self.faults)
+
+
+class FaultRuntime:
+    """The in-process half: the hooks a fleet member consults.
+
+    Constructed by `active_faults()` from the env contract; a process
+    outside any schedule gets an empty runtime whose hooks cost one
+    attribute read. `_sleep`/`_kill` are injectable for unit tests.
+    """
+
+    def __init__(self, faults: Sequence[Fault] = (),
+                 process_id: Optional[int] = None,
+                 sleep: Callable[[float], None] = time.sleep,
+                 kill: Callable[[int, int], None] = os.kill):
+        self.faults = list(faults)
+        self.process_id = process_id
+        self._sleep = sleep
+        self._kill = kill
+
+    def _emit(self, fault: Fault, **fields) -> None:
+        from deeplearning4j_tpu.telemetry.recorder import get_default
+
+        get_default().fault(fault.kind, process_id=self.process_id,
+                            step=fault.step, spec=fault.spec(), fired=True,
+                            **fields)
+
+    @property
+    def drop_heartbeat(self) -> bool:
+        """True when this process must silently stop heartbeating its
+        ClusterClient (consulted once per heartbeat thread)."""
+        return any(f.kind == "drop-heartbeat" for f in self.faults)
+
+    def delay_connect(self) -> float:
+        """Sleep any scheduled pre-rendezvous delay (called by
+        `bootstrap.initialize` before dialing); returns seconds slept."""
+        total = 0.0
+        for f in self.faults:
+            if f.kind == "delay-connect" and f.seconds:
+                self._emit(f, seconds=f.seconds)
+                self._sleep(f.seconds)
+                total += f.seconds
+        return total
+
+    def check_step(self, step: int) -> None:
+        """Fire any kill/hang scheduled at `step` (called by the elastic
+        step loop after the step completes — so the injected death
+        happens between a completed collective and the next one, the
+        same place a real preemption lands)."""
+        for f in self.faults:
+            if f.step != step:
+                continue
+            if f.kind == "kill":
+                self._emit(f)
+                self._kill(os.getpid(), signal.SIGKILL)
+            elif f.kind == "hang":
+                self._emit(f)
+                while True:  # reaped by the launcher's wall-clock deadline
+                    self._sleep(3600.0)
+
+
+_EMPTY = FaultRuntime()
+
+
+def active_faults(environ=None) -> FaultRuntime:
+    """This process's FaultRuntime from the env contract: the schedule in
+    `ENV_FAULTS` filtered by `ENV_PROCESS_ID`. Re-parses per call (cheap,
+    and monkeypatched environments in tests take effect immediately);
+    returns a shared empty runtime when no schedule targets us."""
+    e = os.environ if environ is None else environ
+    raw = e.get(bootstrap.ENV_FAULTS)
+    pid_s = e.get(bootstrap.ENV_PROCESS_ID)
+    if not raw or pid_s is None:
+        return _EMPTY
+    process_id = int(pid_s)
+    mine = FaultSchedule.parse(raw).for_process(process_id)
+    if not mine:
+        return _EMPTY
+    return FaultRuntime(mine, process_id=process_id)

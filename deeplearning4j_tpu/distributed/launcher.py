@@ -19,6 +19,7 @@ from __future__ import annotations
 import os
 import re
 import shlex
+import signal
 import socket
 import subprocess
 import threading
@@ -26,23 +27,55 @@ import time
 from dataclasses import dataclass, field
 from typing import Callable, List, Optional, Sequence
 
-from deeplearning4j_tpu.distributed import bootstrap
+from deeplearning4j_tpu.distributed import bootstrap, faults as faults_mod
 
 
 @dataclass
 class ProcessResult:
     """Outcome of one fleet member: exit code (None while running or when
     the reaper had to SIGKILL a straggler that never reported one),
-    captured log lines, and whether the launch deadline expired on it."""
+    captured log lines, whether the launch deadline expired on it, and
+    the classified exit (`classify_exit` — a bare returncode cannot
+    distinguish a SIGABRT'd rendezvous from an injected kill)."""
 
     process_id: int
     returncode: Optional[int] = None
     lines: List[str] = field(default_factory=list)
     timed_out: bool = False
+    exit_class: str = ""
 
     @property
     def output(self) -> str:
         return "\n".join(self.lines)
+
+
+def classify_exit(returncode: Optional[int], timed_out: bool,
+                  kill_injected: bool = False) -> str:
+    """One fleet member's exit, as a class the supervisor can act on:
+
+    - ``deadline-reaped``: never exited; the launcher terminated/killed
+      it at the wall-clock deadline (wedged rendezvous, injected hang).
+    - ``clean``: returncode 0.
+    - ``resumable``: `faults.RESUMABLE_EXIT_CODE` — the worker survived
+      a peer's death, checkpointed, and wants to rejoin.
+    - ``injected-kill``: died by SIGKILL *and* the fault schedule named
+      this process for a kill (an unscheduled SIGKILL stays ``error`` —
+      the OOM killer must not be mistaken for the harness).
+    - ``sigabrt``: the documented jax 0.4.x fleet death (XLA client
+      aborts on "Deadline Exceeded" — ARCHITECTURE §failure matrix).
+    - ``error``: any other nonzero/signal exit.
+    """
+    if timed_out:
+        return faults_mod.EXIT_DEADLINE
+    if returncode == 0:
+        return faults_mod.EXIT_CLEAN
+    if returncode == faults_mod.RESUMABLE_EXIT_CODE:
+        return faults_mod.EXIT_RESUMABLE
+    if returncode == -signal.SIGKILL and kill_injected:
+        return faults_mod.EXIT_INJECTED_KILL
+    if returncode == -signal.SIGABRT:
+        return faults_mod.EXIT_SIGABRT
+    return faults_mod.EXIT_ERROR
 
 
 def free_port(host: str = "127.0.0.1") -> int:
@@ -94,7 +127,9 @@ def launch_local(argv: Sequence[str], n_processes: int = 2, *,
                  coordinator_port: Optional[int] = None,
                  extra_env: Optional[dict] = None,
                  echo: Optional[Callable[[str], None]] = None,
-                 cwd: Optional[str] = None) -> List[ProcessResult]:
+                 cwd: Optional[str] = None,
+                 faults=None,
+                 death_grace: Optional[float] = None) -> List[ProcessResult]:
     """Run ``argv`` as an N-process rendezvous fleet on this host.
 
     Every child gets the env contract (coordinator on a free local port
@@ -103,11 +138,29 @@ def launch_local(argv: Sequence[str], n_processes: int = 2, *,
     Blocks until every process exits or ``timeout`` seconds elapse; on
     expiry the whole fleet is terminated, then killed after ``grace``
     seconds — stragglers are always reaped. Results arrive in process-id
-    order with captured logs; ``echo`` (e.g. ``print``) streams lines
-    live as ``[pN] ...``.
+    order with captured logs and a classified exit (`classify_exit`),
+    each also echoed as a ``[pN] -- exit: <class>`` epilogue line;
+    ``echo`` (e.g. ``print``) streams lines live as ``[pN] ...``.
+
+    ``faults``: a `faults.FaultSchedule` (or spec string/list) applied to
+    the named processes via the `ENV_FAULTS` contract — every injected
+    fault and every observed exit class is emitted as a typed telemetry
+    ``fault`` event, so the whole run is reconstructable from JSONL.
+
+    ``death_grace``: responsive rendezvous teardown for the elastic
+    supervisor. Once any member exits with a DEATH code (neither 0 nor
+    the resumable code), the rest get this many seconds to notice and
+    exit on their own (the rescue path) before being reaped — on jax
+    0.4.x the survivors of a killed peer otherwise sit in the broken
+    collective until the coordination service aborts them ~60 s later,
+    and the full wall-clock ``timeout`` is the only other bound. None
+    (the default) keeps the deadline as the sole reaper.
     """
     from deeplearning4j_tpu.telemetry.recorder import get_default
 
+    if faults is not None and not isinstance(faults,
+                                             faults_mod.FaultSchedule):
+        faults = faults_mod.FaultSchedule.parse(faults)
     coordinator = f"127.0.0.1:{coordinator_port or free_port()}"
     argv = list(argv)
     procs: List[subprocess.Popen] = []
@@ -116,11 +169,17 @@ def launch_local(argv: Sequence[str], n_processes: int = 2, *,
     rec = get_default()
     with rec.span("distributed_launch", n_processes=n_processes,
                   argv0=argv[0], coordinator=coordinator) as span:
+        if faults is not None:
+            for f in faults:
+                rec.fault(f.kind, process_id=f.process_id, step=f.step,
+                          spec=f.spec(), injected=True)
         base = dict(os.environ)
         for i in range(n_processes):
             env = dict(base)
             env.update(_process_env(coordinator, i, n_processes,
                                     local_device_count, extra_env))
+            if faults is not None and faults.for_process(i):
+                env[bootstrap.ENV_FAULTS] = faults.to_env()
             p = subprocess.Popen(argv, env=env, cwd=cwd,
                                  stdout=subprocess.PIPE,
                                  stderr=subprocess.STDOUT)
@@ -131,12 +190,25 @@ def launch_local(argv: Sequence[str], n_processes: int = 2, *,
             procs.append(p)
             threads.append(t)
         deadline = time.monotonic() + timeout
-        for i, p in enumerate(procs):
-            try:
-                results[i].returncode = p.wait(
-                    timeout=max(deadline - time.monotonic(), 0.01))
-            except subprocess.TimeoutExpired:
+        death_at = None
+        pending = set(range(n_processes))
+        while pending:
+            now = time.monotonic()
+            if now >= deadline or (death_at is not None
+                                   and now >= death_at):
                 break
+            for i in sorted(pending):
+                rc = procs[i].poll()
+                if rc is None:
+                    continue
+                results[i].returncode = rc
+                pending.discard(i)
+                if death_grace is not None and death_at is None and \
+                        rc not in (0, faults_mod.RESUMABLE_EXIT_CODE):
+                    death_at = time.monotonic() + death_grace
+                    span["death_grace_tripped_by"] = i
+            if pending:
+                time.sleep(0.05)
         stragglers = [i for i, p in enumerate(procs) if p.poll() is None]
         if stragglers:
             for i in stragglers:
@@ -154,7 +226,32 @@ def launch_local(argv: Sequence[str], n_processes: int = 2, *,
                 results[i].returncode = p.poll()
         for t in threads:
             t.join(timeout=2.0)
+        for r in results:
+            injected = (faults is not None
+                        and faults.kill_scheduled(r.process_id))
+            r.exit_class = classify_exit(r.returncode, r.timed_out,
+                                         kill_injected=injected)
+            epilogue = (f"-- exit: {r.exit_class} "
+                        f"(rc={r.returncode}, timed_out={r.timed_out})")
+            r.lines.append(epilogue)
+            if echo is not None:
+                echo(f"[p{r.process_id}] {epilogue}")
+            rec.fault(r.exit_class, process_id=r.process_id,
+                      returncode=r.returncode, timed_out=r.timed_out,
+                      observed_exit=True)
+            if r.exit_class not in (faults_mod.EXIT_CLEAN,
+                                    faults_mod.EXIT_RESUMABLE,
+                                    faults_mod.EXIT_INJECTED_KILL,
+                                    faults_mod.EXIT_DEADLINE):
+                # unexpected death (SIGABRT'd rendezvous, crash): an
+                # `error` event with the captured log tail for post-mortem
+                rec.error("distributed_launch",
+                          error=f"p{r.process_id} {r.exit_class}",
+                          traceback_str="\n".join(r.lines[-40:]),
+                          process_id=r.process_id,
+                          returncode=r.returncode)
         span["returncodes"] = [r.returncode for r in results]
+        span["exit_classes"] = [r.exit_class for r in results]
         span["timed_out"] = [r.process_id for r in results if r.timed_out]
     return results
 

@@ -369,6 +369,23 @@ class ComputationGraph:
         return globalize_batch(b, self._mesh,
                                (axes or {}).get("data", "data"))
 
+    def resume_from(self, checkpoint_dir: str, step=None):
+        """Elastic-recovery resume entry (same contract as
+        `MultiLayerNetwork.resume_from`): restore the latest (or given)
+        Orbax checkpoint into this graph, returning the restored step —
+        0 when the directory holds no checkpoint yet."""
+        from deeplearning4j_tpu.util.orbax_checkpoint import (
+            ShardedCheckpointer,
+        )
+
+        try:
+            ShardedCheckpointer(checkpoint_dir).restore(self, step=step)
+        except FileNotFoundError:
+            if step is not None:  # a NAMED step missing is a real error
+                raise
+            return 0
+        return self.iteration_count
+
     def fit(self, data, labels=None, epochs: int = 1):
         """Train (reference ComputationGraph.fit:545-672, incl. the
         pretrain:165-equivalent, tbptt branch, and Solver dispatch)."""

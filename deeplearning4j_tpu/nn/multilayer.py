@@ -300,6 +300,27 @@ class MultiLayerNetwork:
             data = ListDataSetIterator([data])
         return fused_fit(self, [self._batch_dict(ds) for ds in data], epochs)
 
+    def resume_from(self, checkpoint_dir: str, step=None):
+        """Elastic-recovery resume entry: restore params / optimizer
+        state / step counter from an Orbax checkpoint directory
+        (`util/orbax_checkpoint.ShardedCheckpointer` layout) INTO this
+        net, keeping its runtime configuration (mesh, listeners). Call
+        before `set_mesh` when rejoining a re-formed fleet — the
+        restored host values ride jit's replicated placement on the
+        next `fit`. Returns the restored step (0 when the directory has
+        no checkpoint yet: a cold start, not an error)."""
+        from deeplearning4j_tpu.util.orbax_checkpoint import (
+            ShardedCheckpointer,
+        )
+
+        try:
+            ShardedCheckpointer(checkpoint_dir).restore(self, step=step)
+        except FileNotFoundError:
+            if step is not None:  # a NAMED step missing is a real error
+                raise
+            return 0
+        return self.iteration_count
+
     def fit(self, data, labels=None, epochs: int = 1):
         """Train (reference fit(DataSetIterator):1011). Accepts a
         DataSetIterator, a DataSet, or (features, labels) arrays."""

@@ -223,6 +223,43 @@ def fused_fit(net, batches, epochs):
     return net
 
 
+def fit_steps(net, batch_for_step, total_steps, *, on_step=None):
+    """Global-step training loop — the elastic-recovery engine.
+
+    Unlike epoch-oriented ``fit``, progress here is a single continuous
+    step counter (``net.iteration_count``) that survives process death:
+    a restored net resumes at its checkpointed step and
+    ``batch_for_step(step)`` (1-based) regenerates the SAME global batch
+    any fleet size would see for that step, so an interrupted-and-resumed
+    run optimizes the identical sequence as an uninterrupted one.
+
+    ``on_step(step)`` fires after each completed step — where
+    `distributed/elastic.py` hangs its checkpoint cadence and the fault
+    harness its kill/hang triggers (between one finished collective and
+    the next, the same spot a real preemption lands). Emits one
+    telemetry ``step`` event per step (no host sync: the device score is
+    not read here).
+    """
+    from deeplearning4j_tpu.telemetry import get_default as _telemetry
+
+    rec = _telemetry()
+    while net.iteration_count < total_steps:
+        step = net.iteration_count + 1
+        net.fit(batch_for_step(step))
+        # fit() advances iteration_count by the batches it consumed; one
+        # DataSet per call keeps the counter == the global step
+        if net.iteration_count != step:
+            raise ValueError(
+                f"batch_for_step({step}) yielded "
+                f"{net.iteration_count - step + 1} optimizer passes — "
+                "fit_steps needs exactly one DataSet per step (check "
+                "`iterations` in the net config)")
+        rec.step(step)
+        if on_step is not None:
+            on_step(step)
+    return net
+
+
 def mesh_shardings(mesh, data_axis: str = "data"):
     """(replicated, data-sharded) NamedShardings for a mesh data axis."""
     from jax.sharding import NamedSharding, PartitionSpec as P

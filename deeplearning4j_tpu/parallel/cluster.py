@@ -211,6 +211,18 @@ class ClusterCoordinator:
         os.replace(tmp, self.snapshot_path)
 
     # ------------------------------------------------------------- queries
+    def record_config(self, key: str, value) -> None:
+        """In-process config write (no socket round-trip) — the elastic
+        supervisor journals fleet generations through this, making every
+        re-form durable in the same snapshot the ranks live in."""
+        with self._lock:
+            self._configs[key] = value
+            self._save_snapshot()
+
+    def read_config(self, key: str, default=None):
+        with self._lock:
+            return self._configs.get(key, default)
+
     def alive_workers(self):
         now = time.monotonic()
         with self._lock:
@@ -229,6 +241,23 @@ class ClusterCoordinator:
         if op == "register":
             with self._lock:
                 wid = msg["worker"]
+                reassigned_from = None
+                if wid not in self._ranks and msg.get("replace_dead"):
+                    # elastic replacement: a NEW worker adopts the
+                    # lowest rank whose owner left the alive set (died,
+                    # deregistered, or heartbeat-expired), so a re-formed
+                    # fleet keeps a dense [0, N') rank space instead of
+                    # growing fresh ranks past dead ones. A known wid
+                    # never reassigns — rejoining workers keep their own
+                    # rank (the snapshot-restore invariant).
+                    alive = self.alive_workers()
+                    for old, rank in sorted(self._ranks.items(),
+                                            key=lambda kv: kv[1]):
+                        if old not in alive:
+                            del self._ranks[old]
+                            self._ranks[wid] = rank
+                            reassigned_from = old
+                            break
                 if wid not in self._ranks:
                     self._ranks[wid] = self._next_rank
                     self._next_rank += 1
@@ -236,6 +265,7 @@ class ClusterCoordinator:
                                       "last_seen": time.monotonic()}
                 self._save_snapshot()
                 return {"ok": True, "rank": self._ranks[wid],
+                        "reassigned_from": reassigned_from,
                         "n_workers": len(self._workers),
                         "heartbeat_timeout": self.heartbeat_timeout,
                         "round_timeout": self.round_timeout}, None
@@ -367,11 +397,16 @@ class ClusterClient:
 
     def __init__(self, address: str, worker_id: str,
                  heartbeat_interval: float = 1.0,
-                 reconnect_timeout: float = 30.0):
+                 reconnect_timeout: float = 30.0,
+                 replace_dead: bool = False):
         host, port = address.rsplit(":", 1)
         self.address = (host, int(port))
         self.worker_id = worker_id
         self.reconnect_timeout = reconnect_timeout
+        # replacement worker (elastic re-form): adopt the lowest rank
+        # whose owner is no longer alive instead of minting a new one
+        self.replace_dead = replace_dead
+        self.reassigned_from = None
         self._lock = threading.Lock()
         self._sock = None
         self._file = None
@@ -392,28 +427,36 @@ class ClusterClient:
                 pass
         self._sock = socket.create_connection(self.address, timeout=120)
         self._file = self._sock.makefile("rb")
-        _send_msg(self._sock, {"op": "register", "worker": self.worker_id})
+        reg = {"op": "register", "worker": self.worker_id}
+        if self.replace_dead:
+            reg["replace_dead"] = True
+        _send_msg(self._sock, reg)
         reply, _ = _recv_msg(self._file)
         self.rank = reply["rank"]
+        self.reassigned_from = reply.get("reassigned_from")
         # a blocked average() waits up to the server's round_timeout; give
         # the socket comfortable headroom beyond it
         self._sock.settimeout(2.0 * reply.get("round_timeout", 60.0) + 60.0)
 
     def _reconnect(self) -> None:
-        """Connect/re-register with exponential backoff until
+        """Connect/re-register with FULL-JITTER exponential backoff until
         reconnect_timeout (caller holds _lock) — the window a restarting
-        coordinator has to come back up."""
-        deadline = time.monotonic() + self.reconnect_timeout
-        backoff = 0.1
+        coordinator has to come back up. Jitter matters here even more
+        than in the rendezvous bootstrap: after an elastic re-form every
+        surviving worker reconnects at once, and synchronized retry
+        waves are exactly the thundering herd a tiny single-threaded
+        accept queue cannot absorb."""
+        from deeplearning4j_tpu.distributed.bootstrap import Backoff
+
+        backoff = Backoff(base=0.1, cap=2.0,
+                          max_elapsed=self.reconnect_timeout)
         while True:
             try:
                 self._connect_once()
                 return
             except (ConnectionError, OSError):
-                if time.monotonic() + backoff > deadline:
+                if not backoff.pause():
                     raise
-                time.sleep(backoff)
-                backoff = min(backoff * 2.0, 2.0)
 
     def _call(self, msg: dict, payload: Optional[bytes] = None):
         msg = dict(msg, worker=self.worker_id)
@@ -439,6 +482,18 @@ class ClusterClient:
         return reply, reply_payload
 
     def _heartbeat_loop(self, interval: float) -> None:
+        # injected `drop-heartbeat` fault: this worker goes silent (the
+        # coordinator reaps it after heartbeat_timeout and its shard slot
+        # becomes claimable) while the process itself stays alive — the
+        # partial-failure mode a kill can't simulate
+        from deeplearning4j_tpu.distributed.faults import active_faults
+        from deeplearning4j_tpu.telemetry.recorder import get_default
+
+        faults = active_faults()
+        if faults.drop_heartbeat:
+            get_default().fault("drop-heartbeat", worker=self.worker_id,
+                                fired=True)
+            return
         # separate connection so heartbeats never queue behind a long
         # averaging round; a broken socket is dropped and re-dialed on the
         # next beat (coordinator-restart tolerance)

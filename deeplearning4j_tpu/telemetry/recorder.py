@@ -22,6 +22,7 @@ Event schema — one JSON object per line, every event carrying
 | `eval`   | evaluation results (accuracy/f1/stats dict) |
 | `memory` | device-memory snapshot: `live_array_bytes`, `live_array_count`, per-device `memory_stats` when the backend exposes them |
 | `error`  | `where`, `error` (repr), `traceback` (FULL string — never truncated at the source) |
+| `fault`  | fault-injection / elastic-recovery record: `kind` (an injected fault kind from distributed/faults.py or a launcher exit class), `process_id`, `step`, free-form fields — written BEFORE the fault acts, so even a SIGKILL leaves its line |
 
 The file format is append-only JSONL so concurrent writers (bench runs
 every mode in a subprocess) can share one log: each process appends
@@ -64,7 +65,9 @@ class Recorder:
         self._fh: io.TextIOBase | None = None
 
     # ------------------------------------------------------------- core
-    def event(self, kind: str, **fields) -> dict:
+    # `kind` is positional-only so a payload field may itself be named
+    # "kind" (the `fault` events carry one)
+    def event(self, kind: str, /, **fields) -> dict:
         rec = {"event": kind, "ts": round(time.time(), 3),
                "run": self.run_id, "seq": self._seq}
         self._seq += 1
@@ -134,6 +137,14 @@ class Recorder:
             error=repr(exc) if exc is not None else fields.pop("error", ""),
             traceback=traceback_str or "", **fields)
 
+    def fault(self, kind: str, **fields) -> dict:
+        """A `fault` event: an injected failure firing
+        (distributed/faults.py), a launcher exit classification, or an
+        elastic-recovery lifecycle record. Emitted BEFORE the fault acts
+        (`_write` flushes per line) so the full fault→recovery timeline
+        is reconstructable from the JSONL even across SIGKILLs."""
+        return self.event("fault", kind=kind, **fields)
+
     def memory(self, **fields) -> dict:
         """Device-memory snapshot: bytes held by live jax arrays plus
         the backend's own memory_stats when exposed (TPU HBM; CPU
@@ -187,7 +198,7 @@ class NullRecorder(Recorder):
     def __init__(self):
         super().__init__(path=None, run_id="null", keep=1)
 
-    def event(self, kind: str, **fields) -> dict:  # noqa: D102
+    def event(self, kind: str, /, **fields) -> dict:  # noqa: D102
         return {}
 
     def eval(self, stats, **fields) -> dict:

@@ -28,6 +28,38 @@ def _tree(net):
             "state": net.state}
 
 
+def host_materialize(tree):
+    """The tree with every leaf as a host numpy array — the
+    process-count-portable checkpoint form for the elastic fleet
+    (distributed/elastic.py): a checkpoint written as host values under
+    an N-process mesh restores onto N' processes (or one) with no
+    resharding machinery.
+
+    A process can only read its addressable shards, so this supports the
+    leaves a data-parallel fleet actually holds: fully-addressable
+    arrays, and process-spanning REPLICATED arrays (each process's first
+    addressable shard is the whole value). Cross-process *sharded* state
+    (ZeRO-1 moments over a spanning mesh) needs the ROADMAP's portable
+    resharding engine and raises until that lands.
+    """
+    import numpy as np
+
+    def leaf(x):
+        if not isinstance(x, jax.Array):
+            return np.asarray(x) if hasattr(x, "shape") else x
+        if x.is_fully_addressable:
+            return np.asarray(x)
+        if x.is_fully_replicated:
+            return np.asarray(x.addressable_data(0))
+        raise NotImplementedError(
+            f"cannot host-materialize a cross-process sharded leaf "
+            f"{x.shape} ({x.sharding}) — the portable resharding engine "
+            "(ROADMAP) is the planned path; until then elastic "
+            "checkpoints support replicated params/optimizer state only")
+
+    return jax.tree.map(leaf, tree)
+
+
 class ShardedCheckpointer:
     """Save/restore sharded networks without host gathering.
 
@@ -48,7 +80,30 @@ class ShardedCheckpointer:
         # StandardCheckpointer commits asynchronously in recent orbax:
         # save() returns before files exist; sync mode waits per save
         self._ckptr = ocp.StandardCheckpointer()
+        self._solo_ckptr = None
         os.makedirs(self.directory, exist_ok=True)
+
+    def _solo(self):
+        """A checkpointer whose barriers involve ONLY this process.
+
+        Host-mode checkpoints in a multi-process fleet must not sync the
+        world: the default checkpointer broadcasts across every process
+        on save/restore, which deadlocks the elastic rescue path (the
+        peer whose death triggered the checkpoint can never join the
+        barrier) and couples N' restore processes that each hold the
+        full host values anyway."""
+        import orbax.checkpoint as ocp
+
+        if self._solo_ckptr is None:
+            me = jax.process_index()
+            self._solo_ckptr = ocp.StandardCheckpointer(
+                multiprocessing_options=ocp.options.MultiprocessingOptions(
+                    primary_host=me, active_processes={me},
+                    # N concurrent solo restores would otherwise hit the
+                    # coordination service with the SAME barrier key and
+                    # conflicting process sets (INVALID_ARGUMENT)
+                    barrier_sync_key_prefix=f"solo_p{me}"))
+        return self._solo_ckptr
 
     # ------------------------------------------------------------- listing
     def steps(self):
@@ -67,9 +122,24 @@ class ShardedCheckpointer:
         return os.path.join(self.directory, f"step_{step}")
 
     # ---------------------------------------------------------------- save
-    def save(self, net, step: Optional[int] = None) -> str:
+    def save(self, net, step: Optional[int] = None, *,
+             host: bool = False) -> str:
+        """host=True writes HOST-materialized values (see
+        `host_materialize`) — the elastic-fleet form. Every process of a
+        multi-process fleet calls this in lockstep (materialization syncs
+        all ranks identically), but only process 0 touches the directory:
+        N writers racing one step dir would corrupt it, and for
+        replicated state one copy IS the checkpoint."""
         step = net.iteration_count if step is None else step
         d = self._step_dir(step)
+        tree = _tree(net)
+        ckptr = self._ckptr
+        if host:
+            tree = host_materialize(tree)
+            if jax.process_count() > 1:
+                if jax.process_index() != 0:
+                    return d
+                ckptr = self._solo()
         if getattr(self, "_pending", None) is not None:
             # an earlier async save is still uncommitted: finalize it first
             # or its meta.json would never be written (invisible + unpruned)
@@ -87,7 +157,7 @@ class ShardedCheckpointer:
             # nn/updater.upgrade_flat_layout)
             "flat_layout": FLAT_LAYOUT_VERSION,
         }, serde.to_json(net.conf))
-        self._ckptr.save(os.path.join(d, "model"), _tree(net), force=True)
+        ckptr.save(os.path.join(d, "model"), tree, force=True)
         if not self.use_async:
             self.wait()
         return d
@@ -111,8 +181,9 @@ class ShardedCheckpointer:
     def wait(self):
         """Block until pending saves have committed; finalizes the step's
         meta/config and prunes retention afterwards."""
-        if hasattr(self._ckptr, "wait_until_finished"):
-            self._ckptr.wait_until_finished()
+        for ck in (self._ckptr, self._solo_ckptr):
+            if ck is not None and hasattr(ck, "wait_until_finished"):
+                ck.wait_until_finished()
         self._commit_pending()
 
     # ------------------------------------------------------------- restore
@@ -141,7 +212,11 @@ class ShardedCheckpointer:
                                                    x, "sharding", None)),
                 tree)
 
-        ckptr = ocp.StandardCheckpointer()
+        # in a fleet every process reads the checkpoint independently
+        # (host-value checkpoints are replicated by construction) — the
+        # default checkpointer would barrier-sync the restore instead
+        ckptr = self._solo() if jax.process_count() > 1 \
+            else ocp.StandardCheckpointer()
         try:
             restored = ckptr.restore(os.path.join(d, "model"),
                                      _abstract(_tree(net)))

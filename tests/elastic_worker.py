@@ -1,0 +1,86 @@
+"""One member of the elastic fleet driven by
+`distributed.elastic.ElasticSupervisor` in tests/test_elastic.py.
+
+Run: python tests/elastic_worker.py <checkpoint_dir> <out_dir>
+
+The worker follows the elastic contract end to end: rendezvous via
+`bootstrap.initialize()` (which honors injected delay-connect faults),
+resume from the latest checkpoint BEFORE `set_mesh`, rebuild the global
+mesh at whatever process count this generation has, and train to the
+supervisor-announced step budget through `elastic.run_elastic_steps`
+(per-step host checkpoints, kill/hang faults firing between steps, the
+rescue path on a peer's death).
+
+`batch_for_step` regenerates the SAME deterministic global batch for a
+given step at any fleet size — each process feeds its `local_shard` —
+so a kill-interrupted, re-formed N'=2 run must land on the same params
+as an uninterrupted single-process run over the full batches
+(tests/test_elastic.py asserts parity within the documented tolerance).
+"""
+
+import os
+import sys
+
+import numpy as np
+
+# the global batch: 24 rows divide over 3, 2, or 1 processes and over
+# the 6- or 4-device global meshes those fleets build (K=2 local devices)
+GLOBAL_BATCH = 24
+
+
+def batch_for_step(step: int):
+    """The full deterministic global batch for one 1-based step."""
+    from tests.cluster_worker import C, F
+
+    rng = np.random.default_rng(1000 + step)
+    x = rng.random((GLOBAL_BATCH, F), dtype=np.float32)
+    y = np.eye(C, dtype=np.float32)[rng.integers(0, C, GLOBAL_BATCH)]
+    return x, y
+
+
+def main() -> int:
+    ckpt_dir, out_dir = sys.argv[1], sys.argv[2]
+
+    from deeplearning4j_tpu.distributed import bootstrap, elastic
+
+    total_steps = elastic.worker_total_steps()
+    info = bootstrap.initialize(connect_timeout=60.0)
+    pid = info["process_id"]
+    print(f"rendezvous up: {info}", flush=True)
+
+    from deeplearning4j_tpu.datasets.api import DataSet
+    from deeplearning4j_tpu.distributed.global_mesh import (
+        local_shard,
+        make_global_mesh,
+        spans_processes,
+    )
+    from tests.cluster_worker import build_net
+
+    net = build_net()
+    start = net.resume_from(ckpt_dir)  # restore BEFORE set_mesh
+    print(f"p{pid}: resuming from step {start}/{total_steps}", flush=True)
+
+    mesh = make_global_mesh({"data": -1})
+    assert spans_processes(mesh), "mesh does not span processes"
+    net.set_mesh(mesh)
+
+    def local_batch(step):
+        x, y = batch_for_step(step)
+        return DataSet(local_shard(x), local_shard(y))
+
+    elastic.run_elastic_steps(net, local_batch, total_steps,
+                              checkpoint_dir=ckpt_dir, checkpoint_every=1)
+
+    assert net.iteration_count == total_steps
+    if pid == 0:
+        flat = np.asarray(net.params_flat())
+        np.save(os.path.join(out_dir, "final_params.npy"), flat)
+        with open(os.path.join(out_dir, "done.txt"), "w") as fh:
+            fh.write(f"steps={net.iteration_count} "
+                     f"n_processes={info['num_processes']}\n")
+    print(f"p{pid}: finished at step {net.iteration_count}", flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -308,3 +308,81 @@ def test_claim_slot_atomic_and_elastic(coord):
     time.sleep(2.5)                    # > coord heartbeat_timeout (2.0)
     assert c.claim_slot(2) == sb       # dead owner's slot is reassigned
     a.close(); c.close()
+
+
+def test_replacement_worker_adopts_dead_rank(coord, tmp_path):
+    """Elastic re-form (ISSUE 6): a NEW worker registering with
+    replace_dead=True adopts the lowest dead worker's rank instead of
+    minting a fresh one — the [0, N') rank space stays dense across a
+    death — and the reassignment survives a coordinator restart."""
+    a = ClusterClient(coord.address, "wA", heartbeat_interval=0.2)
+    b = ClusterClient(coord.address, "wB", heartbeat_interval=0.2)
+    assert (a.rank, b.rank) == (0, 1)
+    # a REJOINING worker (known id) always keeps its own rank, even when
+    # it asks for replacement
+    b.close(deregister=False)
+    rejoin = ClusterClient(coord.address, "wB", heartbeat_interval=0.2,
+                           replace_dead=True)
+    assert rejoin.rank == 1 and rejoin.reassigned_from is None
+    # wB dies for real; its heartbeats stop and the alive set drops it
+    rejoin.close(deregister=True)
+    replacement = ClusterClient(coord.address, "wC",
+                                heartbeat_interval=0.2, replace_dead=True)
+    assert replacement.rank == 1 and replacement.reassigned_from == "wB"
+    # without the flag a newcomer still gets a fresh rank
+    fresh = ClusterClient(coord.address, "wD", heartbeat_interval=0.2)
+    assert fresh.rank == 2
+    a.close(); replacement.close(); fresh.close()
+
+
+def test_rank_reassignment_persists_in_snapshot(tmp_path):
+    snap = str(tmp_path / "coord.json")
+    c1 = ClusterCoordinator(heartbeat_timeout=1.0,
+                            snapshot_path=snap).start()
+    port = c1.port
+    try:
+        a = ClusterClient(c1.address, "wA", heartbeat_interval=0.2)
+        b = ClusterClient(c1.address, "wB", heartbeat_interval=0.2)
+        b.close(deregister=True)
+        c = ClusterClient(c1.address, "wC", heartbeat_interval=0.2,
+                          replace_dead=True)
+        assert c.rank == 1 and c.reassigned_from == "wB"
+        a.close(); c.close()
+    finally:
+        c1.shutdown()
+    c2 = ClusterCoordinator(port=port, heartbeat_timeout=1.0,
+                            snapshot_path=snap).start()
+    try:
+        # the restarted registry knows wC's adopted rank and forgot wB
+        assert c2._ranks == {"wA": 0, "wC": 1}
+    finally:
+        c2.shutdown()
+
+
+def test_drop_heartbeat_fault_silences_worker(coord, monkeypatch):
+    """The injected drop-heartbeat fault (distributed/faults.py): the
+    worker process stays alive but goes silent, the coordinator reaps it
+    after heartbeat_timeout, and its claims become stealable — the
+    partial-failure mode a kill cannot simulate."""
+    from deeplearning4j_tpu.distributed import bootstrap
+
+    # the schedule targets process 1 only; the heartbeat thread reads the
+    # env when it starts, so pin the non-victim id BEFORE each client
+    monkeypatch.setenv(bootstrap.ENV_FAULTS, "p1:drop-heartbeat")
+    monkeypatch.setenv(bootstrap.ENV_PROCESS_ID, "0")
+    healthy = ClusterClient(coord.address, "wA", heartbeat_interval=0.2)
+    slot = healthy.claim_slot(2)
+    monkeypatch.setenv(bootstrap.ENV_PROCESS_ID, "1")
+    silent = ClusterClient(coord.address, "wSilent",
+                           heartbeat_interval=0.2)
+    silent_slot = silent.claim_slot(2)
+    monkeypatch.setenv(bootstrap.ENV_PROCESS_ID, "0")
+    assert {slot, silent_slot} == {0, 1}
+    assert sorted(coord.alive_workers()) == ["wA", "wSilent"]
+    time.sleep(2.5)  # > heartbeat_timeout (2.0): the fault bites
+    assert sorted(coord.alive_workers()) == ["wA"]
+    # the silenced worker's slot is now claimable by a newcomer
+    taker = ClusterClient(coord.address, "wB", heartbeat_interval=0.2)
+    assert taker.claim_slot(2) == silent_slot
+    healthy.close(); taker.close()
+    silent.close(deregister=False)  # it was already reaped

@@ -1,0 +1,185 @@
+"""Elastic fault-tolerant training: the tier-1 recovery proof.
+
+The acceptance arc (ISSUE 6): launch N=3 processes, inject `kill@step3`
+into worker 1 mid-fit, and assert the fleet checkpoints, re-forms at
+N'=2 through the supervisor, resumes with a CONTINUOUS step counter,
+and reaches final params matching an uninterrupted same-total-steps
+single-process run — with the whole fault→recovery timeline
+reconstructable from the telemetry JSONL alone.
+
+Documented tolerance: the N-process run averages gradients over equal
+batch shards via the mesh allreduce while the reference takes the full
+batch on one device, so the trajectories agree up to float32 reduction
+order — atol 1e-5 on the flat parameter vector (the same bound
+tests/test_distributed.py uses for the single-step parity proof).
+
+Every spawned-fleet test runs under a hard wall-clock deadline (the
+launcher reaps stragglers; a wedged fleet fails bounded, never hangs).
+"""
+
+import json
+import os
+import sys
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.distributed import elastic, faults as faults_mod
+from deeplearning4j_tpu.telemetry.recorder import Recorder, set_default
+
+pytestmark = [pytest.mark.distributed, pytest.mark.faults]
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+WORKER = os.path.join("tests", "elastic_worker.py")
+
+TOTAL_STEPS = 6
+
+
+def _events(path):
+    if not os.path.exists(path):
+        return []
+    with open(path) as fh:
+        return [json.loads(line) for line in fh]
+
+
+def _reference_params():
+    """The uninterrupted run: one process, full global batches, same
+    seed, same TOTAL_STEPS."""
+    from deeplearning4j_tpu.datasets.api import DataSet
+    from tests.cluster_worker import build_net
+    from tests.elastic_worker import batch_for_step
+
+    net = build_net().init()
+    for step in range(1, TOTAL_STEPS + 1):
+        net.fit(DataSet(*batch_for_step(step)))
+    assert net.iteration_count == TOTAL_STEPS
+    return np.asarray(net.params_flat())
+
+
+def test_kill_one_worker_fleet_reforms_and_resumes(tmp_path):
+    ckpt = tmp_path / "ckpt"
+    out = tmp_path / "out"
+    ckpt.mkdir()
+    out.mkdir()
+    fleet_log = str(tmp_path / "fleet.jsonl")
+    sup_log = str(tmp_path / "sup.jsonl")
+
+    rec = Recorder(sup_log)
+    prev = set_default(rec)
+    sup = elastic.ElasticSupervisor(
+        [sys.executable, WORKER, str(ckpt), str(out)],
+        n_processes=3, min_processes=2, total_steps=TOTAL_STEPS,
+        checkpoint_dir=str(ckpt), max_reforms=2, local_device_count=2,
+        gen_timeout=150.0, faults="p1:kill@step3",
+        snapshot_path=str(tmp_path / "coord.json"),
+        extra_env={"PYTHONPATH": ROOT,
+                   "DL4J_TPU_TELEMETRY": fleet_log},
+        cwd=ROOT)
+    try:
+        result = sup.run()
+    finally:
+        set_default(prev)
+        sup.close()
+
+    # --- the generational shape: N=3 with the injected death, then a
+    # clean re-form at N'=2
+    assert [g.n_processes for g in result.generations] == [3, 2]
+    gen0, gen1 = result.generations
+    assert gen0.results[1].exit_class == faults_mod.EXIT_INJECTED_KILL
+    assert 1 in gen0.dead and not gen0.clean
+    assert gen1.clean
+
+    # --- continuous step counter + final-params parity with the
+    # uninterrupted reference (documented tolerance: see module docstring)
+    done = (out / "done.txt").read_text()
+    assert f"steps={TOTAL_STEPS}" in done and "n_processes=2" in done
+    final = np.load(str(out / "final_params.npy"))
+    np.testing.assert_allclose(final, _reference_params(), atol=1e-5)
+
+    # --- the checkpoint trail: the resumed run's steps committed, and
+    # the latest step's meta carries the continuous counter
+    from deeplearning4j_tpu.util.orbax_checkpoint import ShardedCheckpointer
+
+    ckptr = ShardedCheckpointer(str(ckpt))
+    assert ckptr.steps()[-1] == TOTAL_STEPS
+    with open(os.path.join(str(ckpt), f"step_{TOTAL_STEPS}",
+                           "meta.json")) as fh:
+        assert json.load(fh)["iteration"] == TOTAL_STEPS
+
+    # --- the durable coordinator journaled both generations
+    assert int(sup.coordinator.read_config(elastic.GEN_KEY)) == 1
+    members = sup.coordinator.read_config("elastic/members/1")
+    assert members["n_processes"] == 2
+
+    # ---------------- timeline from telemetry JSONL alone ----------------
+    sup_events = _events(sup_log)
+    # 1. the injected fault was declared before anything died
+    injected = [e for e in sup_events if e["event"] == "fault"
+                and e.get("injected")]
+    assert [(e["kind"], e["process_id"], e["step"])
+            for e in injected] == [("kill", 1, 3)]
+    # 3. the re-form decision names the new fleet size and the dead
+    reform = [e for e in sup_events if e["event"] == "fault"
+              and e["kind"] == "reform"]
+    assert len(reform) == 1 and reform[0]["n_processes"] == 2 \
+        and 1 in reform[0]["dead"]
+    # 2. every generation-0 exit was classified (the events BEFORE the
+    # re-form decision; generation 1's clean exits come after it)
+    gen0_cut = sup_events.index(reform[0])
+    observed = {e["process_id"]: e["kind"] for e in sup_events[:gen0_cut]
+                if e["event"] == "fault" and e.get("observed_exit")}
+    assert observed[1] == faults_mod.EXIT_INJECTED_KILL
+    assert set(observed) == {0, 1, 2}
+    # generation 1 then exits clean across the board
+    gen1_observed = {e["process_id"]: e["kind"]
+                     for e in sup_events[gen0_cut:]
+                     if e["event"] == "fault" and e.get("observed_exit")}
+    assert gen1_observed == {0: faults_mod.EXIT_CLEAN,
+                             1: faults_mod.EXIT_CLEAN}
+    # 4. the victim's own log ends with the fault firing at step 3
+    p1_events = _events(fleet_log + ".p1")
+    fired = [e for e in p1_events if e["event"] == "fault"
+             and e.get("fired")]
+    assert [(e["kind"], e["step"]) for e in fired] == [("kill", 3)]
+    # 5. worker 0's log shows the CONTINUOUS counter: steps up to the
+    # kill in one run id, an elastic_resume mark, then the rest in a
+    # second run id — 1..TOTAL_STEPS overall with no step repeated
+    p0_events = _events(fleet_log + ".p0")
+    steps = [e["iteration"] for e in p0_events if e["event"] == "step"]
+    assert steps == list(range(1, TOTAL_STEPS + 1))
+    resumes = [e for e in p0_events if e["event"] == "span"
+               and e.get("name") == "elastic_resume"]
+    assert [r["start_step"] for r in resumes] == [0, 3]
+    assert resumes[-1]["num_processes"] == 2
+    assert len({e["run"] for e in p0_events}) == 2  # two generations
+
+
+def test_checkpoint_under_spanning_mesh_restores_on_one_process(tmp_path):
+    """The ROADMAP resharding seed: params saved (host-materialized)
+    under a 2-process mesh restore onto ONE process bit-identically."""
+    ckpt = tmp_path / "ckpt"
+    out = tmp_path / "out"
+    ckpt.mkdir()
+    out.mkdir()
+
+    sup = elastic.ElasticSupervisor(
+        [sys.executable, WORKER, str(ckpt), str(out)],
+        n_processes=2, min_processes=2, total_steps=2,
+        checkpoint_dir=str(ckpt), max_reforms=0, local_device_count=2,
+        gen_timeout=120.0,
+        extra_env={"PYTHONPATH": ROOT}, cwd=ROOT)
+    try:
+        result = sup.run()
+    finally:
+        sup.close()
+    assert len(result.generations) == 1 and result.generations[0].clean
+
+    # restore IN THIS single process (no rendezvous, its own devices)
+    from tests.cluster_worker import build_net
+
+    net = build_net()
+    assert net.resume_from(str(ckpt)) == 2
+    restored = np.asarray(net.params_flat())
+    saved = np.load(str(out / "final_params.npy"))
+    assert np.array_equal(restored, saved), \
+        "2-process host checkpoint did not restore bit-identically on 1"

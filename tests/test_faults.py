@@ -1,0 +1,328 @@
+"""Fault-injection harness (distributed/faults.py), connect backoff
+(bootstrap.Backoff), and launcher exit classification — the fast,
+mostly-in-process half of the elastic-recovery gate (the spawned
+N-process recovery proof lives in tests/test_elastic.py).
+
+No real sleeps in the unit tests (fake clock / injected sleep); the one
+spawned-fleet test here uses tiny no-jax interpreters under a hard
+launcher deadline.
+"""
+
+import os
+import sys
+
+import pytest
+
+from deeplearning4j_tpu.distributed import bootstrap
+from deeplearning4j_tpu.distributed.faults import (
+    EXIT_CLEAN,
+    EXIT_DEADLINE,
+    EXIT_ERROR,
+    EXIT_INJECTED_KILL,
+    EXIT_RESUMABLE,
+    EXIT_SIGABRT,
+    RESUMABLE_EXIT_CODE,
+    Fault,
+    FaultRuntime,
+    FaultSchedule,
+    active_faults,
+    parse_fault,
+)
+from deeplearning4j_tpu.distributed.launcher import classify_exit, launch_local
+from deeplearning4j_tpu.telemetry.recorder import Recorder, set_default
+
+pytestmark = [pytest.mark.distributed, pytest.mark.faults]
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+# ------------------------------------------------------------ spec parsing
+
+def test_parse_every_fault_kind():
+    assert parse_fault("p1:kill@step3") == Fault(1, "kill", step=3)
+    assert parse_fault("p2:hang@step4") == Fault(2, "hang", step=4)
+    assert parse_fault("p0:delay-connect:1.5") == \
+        Fault(0, "delay-connect", seconds=1.5)
+    assert parse_fault("p3:drop-heartbeat") == Fault(3, "drop-heartbeat")
+    # bare step number is accepted too
+    assert parse_fault("p1:kill@3") == Fault(1, "kill", step=3)
+
+
+@pytest.mark.parametrize("bad", [
+    "kill@step3",          # no process
+    "p1:kill",             # kill needs a step
+    "p1:delay-connect",    # delay needs seconds
+    "p1:oom@step2",        # unknown kind
+    "px:kill@step1",       # bad process id
+    "p1:kill@stepX",       # bad step
+])
+def test_parse_rejects_malformed_specs(bad):
+    with pytest.raises(ValueError):
+        parse_fault(bad)
+
+
+def test_schedule_env_roundtrip_and_filtering():
+    sched = FaultSchedule.parse("p1:kill@step3;p0:delay-connect:0.5")
+    assert FaultSchedule.parse(sched.to_env()).to_env() == sched.to_env()
+    assert [f.kind for f in sched.for_process(1)] == ["kill"]
+    assert sched.kill_scheduled(1) and not sched.kill_scheduled(0)
+    assert len(FaultSchedule.parse("")) == 0
+
+
+def test_seeded_schedule_is_deterministic():
+    a = FaultSchedule.seeded(7, n_processes=3, max_step=5)
+    b = FaultSchedule.seeded(7, n_processes=3, max_step=5)
+    assert a.to_env() == b.to_env()
+    (fault,) = list(a)
+    assert 0 <= fault.process_id < 3 and 1 <= fault.step <= 5
+    assert fault.kind in ("kill", "hang")
+    # some other seed produces a different schedule (not all collide)
+    assert any(FaultSchedule.seeded(s, 3, 5).to_env() != a.to_env()
+               for s in range(20))
+
+
+# ---------------------------------------------------------- fault runtime
+
+def test_active_faults_filters_by_process_and_reparses(monkeypatch):
+    monkeypatch.setenv(bootstrap.ENV_FAULTS, "p1:kill@step3")
+    monkeypatch.setenv(bootstrap.ENV_PROCESS_ID, "0")
+    assert active_faults().faults == []  # not our process
+    monkeypatch.setenv(bootstrap.ENV_PROCESS_ID, "1")
+    rt = active_faults()
+    assert [f.kind for f in rt.faults] == ["kill"]
+    monkeypatch.delenv(bootstrap.ENV_FAULTS)
+    assert active_faults().faults == []  # re-parsed per call
+
+
+def test_kill_fires_at_its_step_only_and_emits_fault_event():
+    rec = Recorder()  # in-memory
+    prev = set_default(rec)
+    try:
+        kills = []
+        rt = FaultRuntime([Fault(1, "kill", step=3)], process_id=1,
+                          kill=lambda pid, sig: kills.append((pid, sig)))
+        rt.check_step(1)
+        rt.check_step(2)
+        assert kills == []
+        rt.check_step(3)
+        assert len(kills) == 1 and kills[0][0] == os.getpid()
+    finally:
+        set_default(prev)
+    faults = [e for e in rec.events if e["event"] == "fault"]
+    assert faults and faults[0]["kind"] == "kill" \
+        and faults[0]["step"] == 3 and faults[0]["fired"]
+
+
+def test_hang_sleeps_until_reaped():
+    sleeps = []
+
+    class Stop(Exception):
+        pass
+
+    def fake_sleep(s):
+        sleeps.append(s)
+        if len(sleeps) >= 3:
+            raise Stop  # stand-in for the launcher's SIGKILL
+
+    rt = FaultRuntime([Fault(0, "hang", step=2)], process_id=0,
+                      sleep=fake_sleep)
+    rt.check_step(1)
+    assert sleeps == []
+    with pytest.raises(Stop):
+        rt.check_step(2)
+    assert len(sleeps) == 3  # kept sleeping, never returned
+
+
+def test_delay_connect_sleeps_scheduled_seconds():
+    sleeps = []
+    rt = FaultRuntime([Fault(0, "delay-connect", seconds=1.5)],
+                      process_id=0, sleep=sleeps.append)
+    assert rt.delay_connect() == 1.5
+    assert sleeps == [1.5]
+    assert not rt.drop_heartbeat
+
+
+def test_drop_heartbeat_flag():
+    rt = FaultRuntime([Fault(2, "drop-heartbeat")], process_id=2)
+    assert rt.drop_heartbeat
+    rt.delay_connect()  # no delay scheduled: no sleep, returns 0
+    rt.check_step(1)    # no step faults: no-op
+
+
+# -------------------------------------------------------- backoff (fake clock)
+
+class FakeClock:
+    """Deterministic clock whose sleep() advances time — asserts the
+    bounded-total-wait contract with zero real sleeping."""
+
+    def __init__(self):
+        self.now = 0.0
+        self.slept = []
+
+    def clock(self):
+        return self.now
+
+    def sleep(self, seconds):
+        self.slept.append(seconds)
+        self.now += seconds
+
+
+def _backoff(clk, **kw):
+    import random
+
+    kw.setdefault("rng", random.Random(0))
+    return bootstrap.Backoff(clock=clk.clock, sleep=clk.sleep, **kw)
+
+
+def test_backoff_delays_are_full_jitter_bounded():
+    clk = FakeClock()
+    bo = _backoff(clk, base=0.25, cap=5.0, max_elapsed=1e9)
+    for attempt in range(12):
+        d = bo.next_delay()
+        assert 0.0 <= d <= min(5.0, 0.25 * 2 ** attempt)
+        clk.now += d
+
+
+def test_backoff_total_wait_bounded_by_max_elapsed():
+    clk = FakeClock()
+    bo = _backoff(clk, base=0.5, cap=4.0, max_elapsed=10.0)
+    while bo.pause():
+        pass
+    # every sleep happened inside the budget, and the sum can never
+    # exceed it (the last delay is clipped to the remaining window)
+    assert sum(clk.slept) <= 10.0
+    assert clk.now <= 10.0
+    assert bo.next_delay() is None  # stays exhausted
+
+
+def test_backoff_exhausts_even_when_attempts_are_slow():
+    """Time spent in the failing attempt itself (not just in sleeps)
+    counts against the budget: a 100 s connect timeout per attempt must
+    not multiply max_elapsed."""
+    clk = FakeClock()
+    bo = _backoff(clk, base=0.1, cap=1.0, max_elapsed=5.0)
+    assert bo.pause()
+    clk.now += 100.0  # a glacial attempt
+    assert not bo.pause()
+
+
+def test_backoff_jitter_decorrelates_processes():
+    import random
+
+    clk = FakeClock()
+    a = bootstrap.Backoff(rng=random.Random(1), clock=clk.clock,
+                          sleep=clk.sleep, max_elapsed=1e9)
+    b = bootstrap.Backoff(rng=random.Random(2), clock=clk.clock,
+                          sleep=clk.sleep, max_elapsed=1e9)
+    da = [a.next_delay() for _ in range(8)]
+    db = [b.next_delay() for _ in range(8)]
+    assert da != db  # full jitter: two workers never retry in lockstep
+
+
+# -------------------------------------------------- exit classification
+
+def test_classify_exit_all_classes():
+    assert classify_exit(0, False) == EXIT_CLEAN
+    assert classify_exit(RESUMABLE_EXIT_CODE, False) == EXIT_RESUMABLE
+    assert classify_exit(None, True) == EXIT_DEADLINE
+    assert classify_exit(-6, False) == EXIT_SIGABRT
+    assert classify_exit(-9, False, kill_injected=True) == \
+        EXIT_INJECTED_KILL
+    # an unscheduled SIGKILL is NOT attributed to the harness
+    assert classify_exit(-9, False, kill_injected=False) == EXIT_ERROR
+    assert classify_exit(1, False) == EXIT_ERROR
+    # deadline wins over any code the reaper observed afterwards
+    assert classify_exit(-15, True) == EXIT_DEADLINE
+
+
+_STEP_LOOP = (
+    "import sys\n"
+    "sys.path.insert(0, {root!r})\n"
+    "from deeplearning4j_tpu.distributed.faults import active_faults\n"
+    "rt = active_faults()\n"
+    "for step in range(1, 6):\n"
+    "    print('step', step, flush=True)\n"
+    "    rt.check_step(step)\n"
+    "print('done', flush=True)\n")
+
+
+def test_launcher_applies_faults_and_classifies_exits(tmp_path):
+    """The spawned proof (no jax: bare interpreters running a 5-step
+    loop): p0 finishes clean, p1 dies by injected kill@step3, p2 hangs
+    at step4 until the deadline reaps it — and the launcher classifies
+    all three, appends the [pN] epilogue, and leaves the full
+    fault→exit record in telemetry."""
+    rec = Recorder(str(tmp_path / "sup.jsonl"))
+    prev = set_default(rec)
+    echoed = []
+    try:
+        results = launch_local(
+            [sys.executable, "-c", _STEP_LOOP.format(root=ROOT)],
+            n_processes=3, local_device_count=None,
+            timeout=20.0, grace=2.0,
+            faults="p1:kill@step3;p2:hang@step4", echo=echoed.append)
+    finally:
+        set_default(prev)
+
+    classes = [r.exit_class for r in results]
+    assert classes == [EXIT_CLEAN, EXIT_INJECTED_KILL, EXIT_DEADLINE]
+    assert "done" in results[0].output
+    assert "step 3" in results[1].output  # died after its step-3 line
+    assert "done" not in results[1].output
+    assert "step 4" in results[2].output and "done" not in results[2].output
+    # the [pN] epilogue names the classification
+    assert any(line.startswith("[p1] -- exit: injected-kill")
+               for line in echoed)
+    # telemetry: injected faults + every observed exit class
+    faults = [e for e in rec.events if e["event"] == "fault"]
+    injected = {(e["kind"], e["process_id"]) for e in faults
+                if e.get("injected")}
+    assert injected == {("kill", 1), ("hang", 2)}
+    observed = {e["process_id"]: e["kind"] for e in faults
+                if e.get("observed_exit")}
+    assert observed == {0: EXIT_CLEAN, 1: EXIT_INJECTED_KILL,
+                        2: EXIT_DEADLINE}
+
+
+def test_resumable_exit_classifies_without_schedule():
+    results = launch_local(
+        [sys.executable, "-c", f"raise SystemExit({RESUMABLE_EXIT_CODE})"],
+        n_processes=1, local_device_count=None, timeout=15.0)
+    assert results[0].exit_class == EXIT_RESUMABLE
+
+
+def test_death_grace_reaps_survivors_early():
+    """Responsive teardown: once one member dies, the rest get
+    `death_grace` seconds — not the whole wall-clock deadline — before
+    the launcher reaps them (the elastic supervisor's fast path on jax
+    generations where survivors block forever in the dead collective)."""
+    import time as _time
+
+    t0 = _time.monotonic()
+    results = launch_local(
+        [sys.executable, "-c",
+         "import os, sys, time\n"
+         "if os.environ['DL4J_TPU_PROCESS_ID'] == '0':\n"
+         "    sys.exit(1)\n"
+         "time.sleep(600)\n"],
+        n_processes=2, local_device_count=None,
+        timeout=60.0, grace=1.0, death_grace=2.0)
+    elapsed = _time.monotonic() - t0
+    assert results[0].exit_class == EXIT_ERROR
+    assert results[1].exit_class == EXIT_DEADLINE
+    assert elapsed < 30.0, f"death_grace did not shortcut ({elapsed:.1f}s)"
+
+
+def test_resumable_exit_does_not_trip_death_grace():
+    """A worker exiting RESUMABLE is a survivor, not a death: the rest
+    of the fleet keeps its full deadline."""
+    results = launch_local(
+        [sys.executable, "-c",
+         "import os, sys, time\n"
+         "if os.environ['DL4J_TPU_PROCESS_ID'] == '0':\n"
+         f"    sys.exit({RESUMABLE_EXIT_CODE})\n"
+         "time.sleep(4)\n"],
+        n_processes=2, local_device_count=None,
+        timeout=30.0, grace=1.0, death_grace=0.5)
+    assert results[0].exit_class == EXIT_RESUMABLE
+    assert results[1].exit_class == EXIT_CLEAN  # outlived the grace: ran

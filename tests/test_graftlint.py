@@ -1,5 +1,5 @@
 """Tier-1 gate for graftlint (ISSUE 2 + the ISSUE 5 SPMD rules): every
-AST rule G001-G013 proven on a positive AND a negative fixture, the
+AST rule G001-G014 proven on a positive AND a negative fixture, the
 suppression + baseline machinery, the stage-2 jaxpr audit over every
 public entry point, and the package itself held lint-clean (zero
 non-baselined findings). The stage-3 collective audit has its own gate
@@ -363,6 +363,42 @@ def sync(x, loss, process_id):
     jax.block_until_ready(x)
     return x
 """),
+    ("G014", """\
+def sync(x, axis_name):
+    try:
+        return jax.lax.psum(x, axis_name)
+    except Exception:
+        return x
+""", """\
+def sync(x, axis_name):
+    try:
+        return jax.lax.psum(x, axis_name)
+    except ConnectionError:
+        raise RuntimeError("fleet lost")
+
+
+def sync_cleanup(x, axis_name):
+    try:
+        return jax.lax.psum(x, axis_name)
+    except Exception:
+        raise
+
+
+def teardown():
+    try:
+        jax.distributed.shutdown()
+    except Exception:
+        pass
+
+
+def retry_outside_distributed():
+    while True:
+        try:
+            connect()
+            break
+        except OSError:
+            time.sleep(0.1)
+"""),
 ]
 
 
@@ -376,7 +412,32 @@ def test_rule_fires_on_positive_not_negative(rule, pos, neg):
 
 def test_every_rule_has_fixture_coverage():
     assert {r for r, _, _ in FIXTURES} == set(RULE_DOCS) == {
-        f"G{i:03d}" for i in range(1, 14)}
+        f"G{i:03d}" for i in range(1, 15)}
+
+
+def test_g014_retry_loop_scoped_to_distributed():
+    """The uncapped-retry half of G014 applies to distributed/ only
+    (the elastic rejoin path); a bounded Backoff loop stays clean."""
+    uncapped = ("def retry():\n"
+                "    while True:\n"
+                "        try:\n"
+                "            connect()\n"
+                "            break\n"
+                "        except OSError:\n"
+                "            time.sleep(0.1)\n")
+    capped = ("def retry(backoff):\n"
+              "    while True:\n"
+              "        try:\n"
+              "            connect()\n"
+              "            break\n"
+              "        except OSError:\n"
+              "            if not backoff.pause():\n"
+              "                raise\n")
+    dist = "deeplearning4j_tpu/distributed/x.py"
+    assert "G014" in rules_in(uncapped, dist)
+    assert "G014" not in rules_in(capped, dist)
+    assert "G014" not in rules_in(uncapped,
+                                  "deeplearning4j_tpu/parallel/x.py")
 
 
 def test_g002_scoped_to_hot_paths():
@@ -536,7 +597,7 @@ def test_cli_check_fails_on_findings_and_emits_json(tmp_path):
 
 
 def test_ast_stage_completes_without_importing_jax(tmp_path):
-    """The pre-commit fast path: --stage ast (G001-G013 included) must
+    """The pre-commit fast path: --stage ast (G001-G014 included) must
     never import jax. A poisoned `jax` module on PYTHONPATH turns any
     violation into a hard failure."""
     shim = tmp_path / "shim"

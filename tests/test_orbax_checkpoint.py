@@ -105,3 +105,33 @@ def test_restore_bridges_optimizer_layouts(tmp_path):
         np.asarray(src.output(toks)[0], np.float32), atol=1e-6)
     dst.fit(ds)  # training continues with the restored (flat) state
     assert np.isfinite(float(dst.score_value))
+
+
+def test_host_mode_round_trip_and_resume_entry(tmp_path):
+    """host=True writes host-materialized values (the elastic-fleet
+    checkpoint form: process-count-portable) that restore bit-identically
+    through the containers' `resume_from` entry; an empty directory is a
+    cold start (step 0), not an error."""
+    from deeplearning4j_tpu.util.orbax_checkpoint import host_materialize
+    from tests.cluster_worker import build_net, full_data
+
+    net = build_net().init()
+    assert net.resume_from(str(tmp_path / "empty")) == 0  # cold start
+    x, y = full_data()
+    net.fit(x, y)
+    ref = np.asarray(net.params_flat())
+
+    host = host_materialize({"params": net.params})
+    assert all(isinstance(l, np.ndarray)
+               for l in jax.tree.leaves(host))
+
+    ck = ShardedCheckpointer(str(tmp_path / "ck"))
+    ck.save(net, host=True)
+
+    net2 = build_net()
+    assert net2.resume_from(str(tmp_path / "ck")) == net.iteration_count
+    assert np.array_equal(np.asarray(net2.params_flat()), ref)
+    # a NAMED missing step still raises (only the latest-of-none case
+    # maps to a cold start)
+    with pytest.raises(FileNotFoundError):
+        net2.resume_from(str(tmp_path / "ck"), step=999)

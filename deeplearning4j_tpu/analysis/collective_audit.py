@@ -87,6 +87,7 @@ def entry_names() -> list[str]:
     without jax — used for test parametrization."""
     return [
         "distributed/allreduce_step_2x4",
+        "distributed/overlap_step_2x4",
         "ring_attention/seq4",
         "sequence_parallel/sp_step_seq2",
     ]
@@ -255,6 +256,43 @@ def _build_allreduce_step():
                   jax.random.PRNGKey(0), batch)
 
 
+def _build_overlap_step():
+    """The ISSUE 7 bucketed-overlap train step on the same 8-device
+    mesh/net as the allreduce entry, with a bucket size that forces
+    MULTIPLE buckets on the tiny net: the frozen signature IS the
+    per-rank bucket sequence (one psum@data per bucket, reverse layer
+    order, then the loss/state pmeans) — identical on every simulated
+    rank or the fleet deadlocks. shard_map carries its collectives in
+    the jaxpr, so no HLO extraction is needed."""
+    import jax
+    import numpy as np
+
+    _ensure_devices()
+    from deeplearning4j_tpu.datasets.api import DataSet
+    from deeplearning4j_tpu.nn.conf import (DenseLayer,
+                                            NeuralNetConfiguration,
+                                            OutputLayer)
+    from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+    from deeplearning4j_tpu.parallel.mesh import make_mesh
+
+    conf = (NeuralNetConfiguration.builder()
+            .seed(7).learning_rate(0.1).updater("sgd").list()
+            .layer(DenseLayer(n_in=6, n_out=8, activation="tanh"))
+            .layer(OutputLayer(n_in=8, n_out=3, activation="softmax",
+                               loss_function="mcxent"))
+            .build())
+    net = MultiLayerNetwork(conf).init()
+    # 128-byte buckets split the 83-param net into several buckets
+    net.set_mesh(make_mesh({"data": 8}), overlap=128)
+    rng = np.random.default_rng(0)
+    x = rng.random((32, 6), dtype=np.float32)
+    y = np.eye(3, dtype=np.float32)[rng.integers(0, 3, 32)]
+    batch = net._batch_dict(DataSet(x, y))
+    step = net._get_train_step()
+    return step, (net.params, net.opt_state, net.state,
+                  jax.random.PRNGKey(0), batch)
+
+
 def _build_ring_attention():
     """ring_self_attention over a 4-way seq mesh (einsum fallback at
     Tl=2): the ppermute ring is the jaxpr-level collective workload."""
@@ -298,6 +336,7 @@ def _build_sp_step():
 # extraction; shard_map entries carry them in the jaxpr
 _BUILDERS = {
     "distributed/allreduce_step_2x4": (_build_allreduce_step, True),
+    "distributed/overlap_step_2x4": (_build_overlap_step, False),
     "ring_attention/seq4": (_build_ring_attention, False),
     "sequence_parallel/sp_step_seq2": (_build_sp_step, False),
 }

@@ -1,4 +1,4 @@
-"""The G010-G014 SPMD-divergence / fleet-robustness AST rules
+"""The G010-G015 SPMD-divergence / fleet-robustness AST rules
 (graftlint stage 3, AST side).
 
 PR 4's multi-process runtime made rank-divergence the most expensive bug
@@ -41,6 +41,7 @@ Each rule errs toward precision over recall, same contract as G001-G009:
 from __future__ import annotations
 
 import ast
+import re
 
 # Collective-issuing calls, canonical (the per-file import table resolves
 # `from jax import lax` / `import jax.lax as lax` spellings to these).
@@ -85,7 +86,8 @@ _NONDET_EXEMPT_TAILS = frozenset({"seed", "default_rng", "RandomState",
 _BLOCKING_ATTRS = frozenset({"block_until_ready", "item"})
 _BLOCKING_CALLS = frozenset({"jax.block_until_ready", "jax.device_get"})
 
-SPMD_RULE_IDS = frozenset({"G010", "G011", "G012", "G013", "G014"})
+SPMD_RULE_IDS = frozenset({"G010", "G011", "G012", "G013", "G014",
+                           "G015"})
 
 
 def _env_rank_var() -> str:
@@ -467,9 +469,72 @@ def g014_swallowed_fleet_errors(tree, imports, path):
     return out
 
 
+# --------------------------------------------------------------- G015
+
+# The two files allowed to issue collectives on gradient pytrees: the
+# bucket planner (parallel/overlap.py — bucketed_reduce and the
+# unbucketed reduce_gradients routing) and the train-step assembly that
+# consumes it. Everything else must route through them, so the bucket
+# schedule stays the single source of the per-rank gradient-collective
+# sequence the stage-3 audit freezes.
+_G015_BLESSED = ("deeplearning4j_tpu/parallel/overlap.py",
+                 "deeplearning4j_tpu/nn/training.py")
+
+# Identifier shapes that mean "this value is a gradient pytree" —
+# precision over recall: `g`, `delta`, or `update` alone do not flag.
+_G015_GRAD_NAME = re.compile(r"(?:^|_)(d?grads?|gradients?)(?:_|$|\d)",
+                             re.IGNORECASE)
+
+
+def _names_gradients(expr: ast.AST) -> bool:
+    for node in ast.walk(expr):
+        ident = None
+        if isinstance(node, ast.Name):
+            ident = node.id
+        elif isinstance(node, ast.Attribute):
+            ident = node.attr
+        if ident is not None and _G015_GRAD_NAME.search(ident):
+            return True
+    return False
+
+
+def g015_handrolled_gradient_collective(tree, imports, path):
+    """A collective call whose operand is a gradient pytree, outside the
+    blessed bucket-planner sites (parallel/overlap.py, nn/training.py):
+    hand-rolled gradient reductions fork the per-rank collective
+    sequence away from the frozen bucket schedule — the C001/C003 drift
+    class at its source. Detection is name-based (an operand expression
+    mentioning grads/gradients); collectives on losses, params, or
+    activations never flag."""
+    norm = path.replace("\\", "/")
+    if any(norm.endswith(b) for b in _G015_BLESSED):
+        return []
+    out = []
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        name = imports.canon(node.func) or ""
+        if name not in COLLECTIVE_CALLS:
+            continue
+        operands = list(node.args) + [k.value for k in node.keywords
+                                      if k.arg not in ("axis_name",)]
+        if any(_names_gradients(arg) for arg in operands):
+            out.append(("G015", node,
+                        f"hand-rolled collective `{name}` on a gradient "
+                        "pytree outside parallel/overlap.py / "
+                        "nn/training.py — gradient reductions must route "
+                        "through the bucket planner so every rank issues "
+                        "the frozen per-bucket collective sequence",
+                        "call parallel/overlap.bucketed_reduce (or "
+                        "reduce_gradients for the unbucketed tree mean) "
+                        "instead of issuing the collective directly"))
+    return out
+
+
 SPMD_RULES = [g010_rank_divergent_control_flow, g011_host_nondeterminism,
               g012_unbound_axis_name, g013_rank_conditional_host_sync,
-              g014_swallowed_fleet_errors]
+              g014_swallowed_fleet_errors,
+              g015_handrolled_gradient_collective]
 
 SPMD_RULE_DOCS = {
     "G010": "rank-dependent control flow guarding collectives/jit/mesh "
@@ -482,4 +547,7 @@ SPMD_RULE_DOCS = {
             "inside rank-conditional blocks",
     "G014": "overbroad except swallowing collective/rendezvous errors; "
             "uncapped retry loops in distributed/",
+    "G015": "hand-rolled collective on a gradient pytree outside "
+            "parallel/overlap.py / nn/training.py (the blessed bucket-"
+            "planner sites)",
 }

@@ -157,21 +157,35 @@ def transformer_flops_per_token(vocab_size, d_model, n_layers, d_ff, seq_len,
     return int(3 * fwd)  # fwd + bwd(2x)
 
 
+def causal_attention_factor(seq_len: int) -> float:
+    """Executed fraction of the dense [T, T] attention matrix under a
+    causal mask: T(T+1)/2 visible (query, key) pairs out of T*T —
+    (T+1)/(2T), approaching 1/2 from above as T grows. The exact pair
+    count, not the 0.5 approximation (VERDICT r5 #4 asked for the
+    honest number; at T=512 the two differ by ~0.1% of the attention
+    term, at 32k by ~0.003%)."""
+    return (seq_len + 1) / (2.0 * seq_len)
+
+
 def transformer_flops_per_token_executed(vocab_size, d_model, n_layers,
                                          d_ff, seq_len, causal=True):
     """FLOPs per token counting only work the kernels EXECUTE (VERDICT
     r5 #4): the causal flash kernels iterate key blocks to the diagonal
     (ops/flash_attention.py `hi = qi*block_q//block_k + 1`) and the
-    chunked loop skips above-diagonal tile pairs outright, so ~half the
-    dense-accounted attention FLOPs never run. At seq 512 the dense
-    convention inflates MFU ~12%; at seq 32k attention dominates and the
-    inflation approaches 2x — `mfu_executed` derived from this is the
-    number comparable to the hardware's causal-attention roofline.
-    (Counted at factor exactly 1/2; the executed diagonal tiles' masked
-    upper halves slightly over-count the skip, <= one block's worth.)"""
+    chunked loop skips above-diagonal tile pairs outright, so the dense
+    convention credits ~2x the attention work that runs. The attention
+    term is counted at exactly T(T+1)/2 causal pairs
+    (`causal_attention_factor`). At seq 512 the dense convention
+    inflates MFU ~12%; at seq 32k attention dominates and the inflation
+    approaches 2x — `mfu_executed` derived from this is the number
+    comparable to the hardware's causal-attention roofline. (The
+    executed diagonal tiles' masked upper halves slightly over-count
+    the skip, <= one block's worth — the kernels run marginally MORE
+    than this count, so the executed MFU is conservative.)"""
     return transformer_flops_per_token(
         vocab_size, d_model, n_layers, d_ff, seq_len,
-        attention_factor=0.5 if causal else 1.0)
+        attention_factor=causal_attention_factor(seq_len) if causal
+        else 1.0)
 
 
 def transformer_moe_flops_per_token(vocab_size, d_model, n_layers,

@@ -112,15 +112,18 @@ class ComputationGraph:
         self.listeners = list(listeners)
 
     def set_mesh(self, mesh, zero1: bool = False, axes=None,
-                 n_microbatches=None, tp_rules=None):
+                 n_microbatches=None, tp_rules=None, overlap=None):
         """Single distributed entry point: axes maps parallelism roles
         ("data"/"model"/"pipe"/"expert") to mesh axis names — see
-        parallel/placement.py. Without axes: round-1 pure DP over 'data'."""
+        parallel/placement.py. Without axes: round-1 pure DP over 'data'.
+        overlap: True / bucket bytes / a BucketPlan — bucketed gradient
+        allreduce with compute/communication overlap (parallel/overlap.py;
+        pure DP only, composes with zero1)."""
         from deeplearning4j_tpu.parallel.placement import configure_mesh
 
         return configure_mesh(self, mesh, zero1=zero1, axes=axes,
                               n_microbatches=n_microbatches,
-                              tp_rules=tp_rules)
+                              tp_rules=tp_rules, overlap=overlap)
 
     def _canonical_params(self):
         """Params in the per-layer layout regardless of an active pipeline
@@ -490,7 +493,8 @@ class ComputationGraph:
                     self._loss, self.tx, confs, mesh=self._mesh,
                     zero1_opt_state=(self.opt_state if self._zero1 else None),
                     data_axis=(axes or {}).get("data", "data"),
-                    param_sharding=getattr(self, "_param_sh", None))
+                    param_sharding=getattr(self, "_param_sh", None),
+                    overlap=getattr(self, "_overlap_plan", None))
         return self._train_step
 
     def fit_scanned(self, data, labels=None, epochs: int = 1):

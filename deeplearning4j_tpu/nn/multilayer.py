@@ -118,17 +118,19 @@ class MultiLayerNetwork:
         self.listeners = list(listeners)
 
     def set_mesh(self, mesh, zero1: bool = False, axes=None,
-                 n_microbatches=None, tp_rules=None):
+                 n_microbatches=None, tp_rules=None, overlap=None):
         """Enable distributed training over a jax.sharding.Mesh (replaces
         the Spark parameter-averaging master). axes maps parallelism roles
         ("data"/"model"/"expert"; "pipe" needs the graph container) to mesh
         axis names — see parallel/placement.py. Without axes: pure DP over
-        a 'data' axis."""
+        a 'data' axis. overlap: True / bucket bytes / a BucketPlan —
+        bucketed gradient allreduce with compute/communication overlap
+        (parallel/overlap.py; pure DP only, composes with zero1)."""
         from deeplearning4j_tpu.parallel.placement import configure_mesh
 
         return configure_mesh(self, mesh, zero1=zero1, axes=axes,
                               n_microbatches=n_microbatches,
-                              tp_rules=tp_rules)
+                              tp_rules=tp_rules, overlap=overlap)
 
     # --------------------------------------------------------------- forward
     def _next_rng(self):
@@ -255,7 +257,8 @@ class MultiLayerNetwork:
                 self._loss, self.tx, confs, mesh=self._mesh,
                 zero1_opt_state=(self.opt_state if self._zero1 else None),
                 data_axis=(axes or {}).get("data", "data"),
-                param_sharding=getattr(self, "_param_sh", None))
+                param_sharding=getattr(self, "_param_sh", None),
+                overlap=getattr(self, "_overlap_plan", None))
         return self._train_step
 
     def _batch_dict(self, ds: DataSet):

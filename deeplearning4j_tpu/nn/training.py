@@ -46,9 +46,48 @@ def zero1_opt_shardings(opt_state, mesh, axis: str = "data"):
     return jax.tree.map(leaf, opt_state)
 
 
+def _make_overlap_core(loss_fn, mesh, plan, data_axis):
+    """The shard_map heart of the overlap train step: per-shard backward
+    on the local batch slice, then the bucketed per-bucket collectives of
+    `parallel/overlap.bucketed_reduce` in reverse layer order. Each
+    bucket's psum depends only on its own grad leaves, so XLA's
+    async-collective scheduler overlaps reduction with the remaining
+    backward + the already-reduced buckets' update dataflow — the
+    arXiv:1810.11112 design, with XLA as the progress engine."""
+    from jax.sharding import PartitionSpec as P
+
+    from deeplearning4j_tpu.parallel.overlap import (
+        bucketed_reduce,
+        pmean_float_leaves,
+    )
+    from deeplearning4j_tpu.util.compat import shard_map
+
+    def local_grads(params, state, rng, batch):
+        # decorrelate per-shard dropout streams (same idiom as the SP
+        # step); dropout-free steps are unaffected — their parity with
+        # the monolithic formulation is the test_overlap contract
+        rng = jax.random.fold_in(rng, jax.lax.axis_index(data_axis))
+        (loss, aux), grads = jax.value_and_grad(loss_fn, has_aux=True)(
+            params, state, rng, batch
+        )
+        new_state, _extras = aux if isinstance(aux, tuple) else (aux, {})
+        grads = bucketed_reduce(grads, plan, axis_name=data_axis)
+        loss = jax.lax.pmean(loss, data_axis)
+        # per-shard mutable state (BatchNorm running stats over the local
+        # batch slice) leaves the step as the cross-replica average
+        new_state = pmean_float_leaves(new_state, data_axis)
+        return loss, grads, new_state
+
+    return shard_map(
+        local_grads, mesh=mesh,
+        in_specs=(P(), P(), P(), P(data_axis)),
+        out_specs=(P(), P(), P()),
+        check_vma=False, axis_names={data_axis})
+
+
 def make_train_step(loss_fn, tx, layer_confs_by_name, mesh=None,
                     donate=True, zero1_opt_state=None, data_axis="data",
-                    param_sharding=None):
+                    param_sharding=None, overlap=None):
     """loss_fn(params, state, rng, batch) -> (loss, (new_state, extras)).
 
     batch is a dict pytree {features, labels, features_mask?, labels_mask?,
@@ -64,17 +103,46 @@ def make_train_step(loss_fn, tx, layer_confs_by_name, mesh=None,
     NamedShardings for the params (TP/EP placement from
     parallel/tensor_parallel.py) — optimizer-state moments then inherit
     their committed placement instead of being forced replicated.
-    """
 
-    def step(params, opt_state, state, rng, batch):
-        (loss, aux), grads = jax.value_and_grad(loss_fn, has_aux=True)(
-            params, state, rng, batch
-        )
-        new_state, extras = aux if isinstance(aux, tuple) else (aux, {})
-        grads = normalize_gradients(grads, layer_confs_by_name)
-        updates, opt_state = tx.update(grads, opt_state, params)
-        params = optax.apply_updates(params, updates)
-        return params, opt_state, new_state, loss, extras
+    overlap: a `parallel/overlap.BucketPlan` — gradients are computed
+    per-shard under shard_map and reduced bucket-by-bucket (reverse
+    layer order) instead of through GSPMD's single end-of-backward
+    allreduce, letting XLA overlap the collectives with the remaining
+    backward/update compute. Pure-DP only (the `set_mesh(overlap=...)`
+    entry validates roles); composes with zero1_opt_state — the
+    optimizer update stays in the enclosing jit, so the reduce-scatter
+    weight-update placement is unchanged. The overlap step does not
+    thread TBPTT carries (extras is always empty).
+    """
+    if overlap is not None:
+        if mesh is None:
+            raise ValueError("overlap=BucketPlan requires a mesh")
+        if param_sharding is not None:
+            raise ValueError(
+                "overlap composes with the 'data' role only; TP/EP "
+                "param placement keeps the GSPMD step")
+        if not data_axis or data_axis not in mesh.axis_names:
+            raise ValueError(
+                f"overlap needs data_axis bound to a mesh axis (got "
+                f"{data_axis!r}; mesh has {mesh.axis_names})")
+        core = _make_overlap_core(loss_fn, mesh, overlap, data_axis)
+
+        def step(params, opt_state, state, rng, batch):
+            loss, grads, new_state = core(params, state, rng, batch)
+            grads = normalize_gradients(grads, layer_confs_by_name)
+            updates, opt_state = tx.update(grads, opt_state, params)
+            params = optax.apply_updates(params, updates)
+            return params, opt_state, new_state, loss, {}
+    else:
+        def step(params, opt_state, state, rng, batch):
+            (loss, aux), grads = jax.value_and_grad(loss_fn, has_aux=True)(
+                params, state, rng, batch
+            )
+            new_state, extras = aux if isinstance(aux, tuple) else (aux, {})
+            grads = normalize_gradients(grads, layer_confs_by_name)
+            updates, opt_state = tx.update(grads, opt_state, params)
+            params = optax.apply_updates(params, updates)
+            return params, opt_state, new_state, loss, extras
 
     donate_argnums = (0, 1, 2) if donate else ()
     if mesh is not None:

@@ -35,14 +35,18 @@ from deeplearning4j_tpu.datasets.iterators import DataSetIterator, ListDataSetIt
 class DataParallelTrainer:
     """Allreduce DP wrapper around a network (MultiLayerNetwork or
     ComputationGraph): `trainer.fit(iterator)` == network.fit with the step
-    compiled over the mesh."""
+    compiled over the mesh. `overlap` (True / bucket bytes / a
+    parallel/overlap.BucketPlan) routes the gradient reduction through
+    the bucketed shard_map step — per-bucket collectives in reverse
+    layer order overlapping backward/update compute — instead of GSPMD's
+    monolithic end-of-backward allreduce (the bench's `overlap` arm)."""
 
-    def __init__(self, net, mesh: Mesh):
+    def __init__(self, net, mesh: Mesh, overlap=None):
         if "data" not in mesh.axis_names:
             raise ValueError("mesh needs a 'data' axis")
         self.net = net
         self.mesh = mesh
-        net.set_mesh(mesh)
+        net.set_mesh(mesh, overlap=overlap)
 
     def fit(self, data, epochs: int = 1):
         return self.net.fit(data, epochs=epochs)

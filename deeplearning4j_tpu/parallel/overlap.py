@@ -1,0 +1,257 @@
+"""Bucketed gradient reduction with compute/communication overlap.
+
+BENCH_r05 measured the monolithic DP formulation *losing* to the
+reference's own coarse-sync parameter averaging
+(`resnet20_dp_allreduce_vs_paramavg_speedup` = 0.9597): GSPMD emits the
+gradient allreduce as one barrier at the end of backward, so every step
+pays full latency for every gradient leaf before the update can start.
+This module implements the classic overlap design characterized for
+TF/CUDA-aware-MPI clusters in arXiv:1810.11112 — break the gradient
+pytree into size-targeted **buckets**, ordered by *reverse layer order*
+(the gradients backward produces first reduce first), and issue one
+collective per bucket:
+
+- each bucket's collective depends only on that bucket's grad leaves, so
+  XLA's async-collective scheduler can launch it while backward compute
+  for earlier layers is still in flight, and the optimizer update for a
+  reduced bucket can start while later buckets are still reducing — the
+  per-leaf dataflow of the update gives the scheduler that freedom;
+- on chatty interconnects (the 8-virtual-device CPU mesh the DP bench
+  runs on; DCN fleets) bucketing also amortizes per-collective dispatch
+  latency: ~65 per-leaf allreduces become a handful of flat ones.
+
+`BucketPlan` is pure metadata derived from the param pytree structure —
+identical on every process by construction (no host nondeterminism; the
+collective-consistency stage re-traces it under simulated ranks), and
+`bucketed_reduce` below is the repo's ONE blessed site for collectives
+on gradient pytrees (graftlint G015; `nn/training.py` consumes it).
+
+The train-step integration (`nn/training.make_train_step(...,
+overlap=BucketPlan)`) computes per-shard gradients under `shard_map` and
+reduces them here; the optimizer update runs in the enclosing jit, so
+the formulation composes with `zero1_opt_shardings` (the reduce-scatter
+weight-update placement) unchanged.
+
+jax imports stay inside functions: the module must remain importable
+under graftlint's no-jax package stubs.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Optional, Sequence, Tuple
+
+# TPU-oriented default (a few fused allreduces per step for O(100M)-param
+# models). The DP bench sweeps much smaller sizes: on the virtual-CPU
+# mesh the per-collective dispatch cost is low enough that finer buckets
+# win (r7 sweep: 64-256KB beat a single fused vector by ~8%).
+DEFAULT_BUCKET_BYTES = 4 << 20
+
+_REDUCE_MODES = ("psum", "psum_scatter")
+
+
+@dataclass(frozen=True)
+class Bucket:
+    """One reduction unit: a contiguous run of grad leaves (in reverse
+    layer order) reduced as a single flat vector."""
+
+    index: int
+    paths: Tuple[str, ...]        # jax.tree_util.keystr leaf paths
+    leaf_ids: Tuple[int, ...]     # positions in the canonical flatten order
+    n_elements: int
+    n_bytes: int                  # at the reduction dtype
+
+
+@dataclass(frozen=True)
+class BucketPlan:
+    """Deterministic partition of a grads pytree into reduction buckets.
+
+    Derived purely from the pytree structure + static sizes, so every
+    process computes the identical plan (and therefore issues the
+    identical per-bucket collective sequence — the property the
+    stage-3 `distributed/overlap_step_2x4` entry freezes)."""
+
+    buckets: Tuple[Bucket, ...]
+    bucket_bytes: int
+    reduce_dtype: str = "float32"
+    mode: str = "psum"            # or "psum_scatter"
+
+    @property
+    def n_leaves(self) -> int:
+        return sum(len(b.paths) for b in self.buckets)
+
+    @property
+    def n_elements(self) -> int:
+        return sum(b.n_elements for b in self.buckets)
+
+    def leaf_paths(self) -> Tuple[str, ...]:
+        return tuple(p for b in self.buckets for p in b.paths)
+
+    def summary(self) -> dict:
+        """Telemetry-ready description (the `bucket_plan` event)."""
+        return {
+            "n_buckets": len(self.buckets),
+            "bucket_bytes": self.bucket_bytes,
+            "mode": self.mode,
+            "reduce_dtype": self.reduce_dtype,
+            "n_leaves": self.n_leaves,
+            "n_elements": self.n_elements,
+            "buckets": [{"index": b.index, "n_leaves": len(b.paths),
+                         "bytes": b.n_bytes} for b in self.buckets],
+        }
+
+
+def _keystr(path) -> str:
+    import jax
+
+    return jax.tree_util.keystr(path)
+
+
+def plan_buckets(tree, bucket_bytes: int = DEFAULT_BUCKET_BYTES, *,
+                 layer_order: Optional[Sequence[str]] = None,
+                 reduce_dtype: str = "float32",
+                 mode: str = "psum") -> BucketPlan:
+    """Partition `tree` (params or grads — same structure) into
+    size-targeted buckets by REVERSE layer order.
+
+    Greedy pack over the reversed leaf sequence: a bucket closes when
+    adding the next leaf would exceed `bucket_bytes` (a single oversized
+    leaf still gets its own bucket). `layer_order` — the network's
+    top-level layer names in forward order — pins "layer order" to the
+    model's actual topology; without it the pytree flatten order (sorted
+    dict keys) stands in. Deterministic: equal trees -> equal plans on
+    every process.
+    """
+    import numpy as np
+
+    import jax
+
+    if mode not in _REDUCE_MODES:
+        raise ValueError(f"mode must be one of {_REDUCE_MODES}, got {mode!r}")
+    if bucket_bytes <= 0:
+        raise ValueError(f"bucket_bytes must be positive, got {bucket_bytes}")
+    flat, _treedef = jax.tree_util.tree_flatten_with_path(tree)
+    if not flat:
+        raise ValueError("cannot plan buckets over an empty pytree")
+    itemsize = np.dtype(reduce_dtype).itemsize
+    order = list(range(len(flat)))
+    if layer_order is not None:
+        pos = {name: i for i, name in enumerate(layer_order)}
+
+        def layer_pos(i):
+            path = flat[i][0]
+            key = getattr(path[0], "key", getattr(path[0], "name", None)) \
+                if path else None
+            return pos.get(key, len(pos))
+
+        order.sort(key=lambda i: (layer_pos(i), i))
+    order.reverse()  # last-computed gradients reduce first
+
+    buckets = []
+    cur_ids, cur_elems = [], 0
+    for i in order:
+        size = int(flat[i][1].size)
+        if cur_ids and (cur_elems + size) * itemsize > bucket_bytes:
+            buckets.append((tuple(cur_ids), cur_elems))
+            cur_ids, cur_elems = [], 0
+        cur_ids.append(i)
+        cur_elems += size
+    if cur_ids:
+        buckets.append((tuple(cur_ids), cur_elems))
+    return BucketPlan(
+        buckets=tuple(
+            Bucket(index=bi, paths=tuple(_keystr(flat[i][0]) for i in ids),
+                   leaf_ids=ids, n_elements=elems,
+                   n_bytes=elems * itemsize)
+            for bi, (ids, elems) in enumerate(buckets)),
+        bucket_bytes=int(bucket_bytes), reduce_dtype=reduce_dtype,
+        mode=mode)
+
+
+def bucketed_reduce(grads, plan: BucketPlan, axis_name: str, *,
+                    mean: bool = True):
+    """Cross-replica reduction of a grads pytree, one collective per
+    bucket in plan order (reverse layer order). Call inside `shard_map`
+    with `axis_name` bound.
+
+    THE blessed site for collectives on gradient pytrees (G015): every
+    bucket is flattened into one `reduce_dtype` vector and reduced with
+    `psum` (or `psum_scatter` + `all_gather` in reduce-scatter mode —
+    same math, the decomposed collective), then sliced back to the leaf
+    shapes/dtypes. Exact cover is asserted against the plan at trace
+    time, so a plan built for a different tree fails loudly.
+    """
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+
+    flat, treedef = jax.tree_util.tree_flatten_with_path(grads)
+    paths = tuple(_keystr(p) for p, _ in flat)
+    if sorted(paths) != sorted(plan.leaf_paths()):
+        raise ValueError(
+            f"bucket plan does not cover this grads pytree: plan has "
+            f"{plan.n_leaves} leaves, grads have {len(paths)} "
+            f"(first mismatch: "
+            f"{sorted(set(paths) ^ set(plan.leaf_paths()))[:3]})")
+    leaves = [l for _, l in flat]
+    n = lax.psum(1, axis_name)
+    dtype = jnp.dtype(plan.reduce_dtype)
+    out = [None] * len(leaves)
+    for bucket in plan.buckets:
+        segs = [jnp.ravel(leaves[i]).astype(dtype) for i in bucket.leaf_ids]
+        vec = segs[0] if len(segs) == 1 else jnp.concatenate(segs)
+        if plan.mode == "psum_scatter":
+            pad = (-vec.size) % n
+            if pad:
+                vec = jnp.concatenate([vec, jnp.zeros((pad,), dtype)])
+            shard = lax.psum_scatter(vec, axis_name, scatter_dimension=0,
+                                     tiled=True)
+            vec = lax.all_gather(shard, axis_name, tiled=True)
+            if pad:
+                vec = vec[:bucket.n_elements]
+        else:
+            vec = lax.psum(vec, axis_name)
+        if mean:
+            vec = vec / n
+        off = 0
+        for i in bucket.leaf_ids:
+            leaf = leaves[i]
+            out[i] = (vec[off:off + leaf.size].reshape(leaf.shape)
+                      .astype(leaf.dtype))
+            off += leaf.size
+    return jax.tree_util.tree_unflatten(treedef, out)
+
+
+def reduce_gradients(grads, axis_names, *, mean: bool = True):
+    """Unbucketed cross-replica gradient mean over one or more bound
+    axes — the blessed routing for manual-collective train steps that do
+    not bucket (sequence parallelism). Per-axis tree-level pmean, same
+    primitive sequence the SP step always issued (frozen stage-3
+    signature unchanged)."""
+    from jax import lax
+
+    if isinstance(axis_names, str):
+        axis_names = (axis_names,)
+    for ax in axis_names:
+        # whole-tree pmean: ONE multi-operand psum eqn per axis — the
+        # exact eqn sequence the callers always issued
+        grads = lax.pmean(grads, ax) if mean else lax.psum(grads, ax)
+    return grads
+
+
+def pmean_float_leaves(tree, axis_name: str):
+    """Average float leaves over `axis_name`, pass integer leaves (step
+    counters) through — the replicated-output contract for per-shard
+    mutable layer state (BatchNorm running stats computed on local batch
+    shards leave the step as the cross-replica average; the same
+    averaging the SP step and the param-averaging trainer apply)."""
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+
+    def avg(a):
+        if jnp.issubdtype(jnp.asarray(a).dtype, jnp.floating):
+            return lax.pmean(a, axis_name)
+        return a
+
+    return jax.tree.map(avg, tree)

@@ -107,9 +107,64 @@ def _ensure_tree_optimizer(net, axes, zero1):
         net.opt_state = net.tx.init(net.params)
 
 
+def _configure_overlap(net, mesh, axes, overlap):
+    """Validate + build the bucketed-reduction plan for set_mesh(
+    overlap=...): pure-DP only, no TBPTT (the overlap step does not
+    thread carries), plan derived from the params pytree in the net's
+    actual layer topology. Emits a `bucket_plan` telemetry event so the
+    bucket layout every rank will issue is on the record."""
+    from deeplearning4j_tpu.parallel.overlap import BucketPlan, plan_buckets
+
+    if mesh is None:
+        raise ValueError("overlap=... requires a mesh")
+    roles = set(axes) if axes else {"data"}
+    if roles - {"data"}:
+        raise ValueError(
+            f"overlap composes with the 'data' role only (got "
+            f"{sorted(roles)}); model/expert/pipe/seq placement keeps "
+            "the GSPMD/manual steps — see ARCHITECTURE.md "
+            "§Data-parallel overlap")
+    data_ax = (axes or {}).get("data", "data")
+    if data_ax not in mesh.axis_names:
+        raise ValueError(
+            f"overlap needs the data axis {data_ax!r} on the mesh "
+            f"(mesh has {mesh.axis_names})")
+    from deeplearning4j_tpu.nn.conf.enums import BackpropType
+
+    if str(getattr(net.conf, "backprop_type", "")) in (
+            str(BackpropType.TRUNCATED_BPTT), "truncated_bptt"):
+        raise ValueError(
+            "overlap does not support TRUNCATED_BPTT (the bucketed step "
+            "does not thread carries) — drop overlap or the TBPTT config")
+    if net.params is None:
+        net.init()
+    if isinstance(overlap, BucketPlan):
+        plan = overlap
+    else:
+        from deeplearning4j_tpu.parallel.overlap import DEFAULT_BUCKET_BYTES
+
+        bucket_bytes = (DEFAULT_BUCKET_BYTES if overlap is True
+                        else int(overlap))
+        layer_order = (list(net.layer_vertices)
+                       if hasattr(net, "layer_vertices")
+                       else list(net.layer_names))
+        plan = plan_buckets(net.params, bucket_bytes,
+                            layer_order=layer_order)
+    from deeplearning4j_tpu.telemetry import get_default as _telemetry
+
+    _telemetry().event("bucket_plan", axis=data_ax, **plan.summary())
+    return plan
+
+
 def configure_mesh(net, mesh, *, zero1=False, axes=None, n_microbatches=None,
-                   tp_rules=None):
-    """Shared body of MultiLayerNetwork/ComputationGraph.set_mesh."""
+                   tp_rules=None, overlap=None):
+    """Shared body of MultiLayerNetwork/ComputationGraph.set_mesh.
+
+    overlap: True / bucket-size-bytes / a prebuilt
+    `parallel/overlap.BucketPlan` — route the DP gradient reduction
+    through the bucketed shard_map step (compute/communication overlap)
+    instead of GSPMD's monolithic allreduce. Data role only; composes
+    with zero1."""
     from deeplearning4j_tpu.parallel.tensor_parallel import (
         param_shardings,
         resolve_rules,
@@ -136,6 +191,8 @@ def configure_mesh(net, mesh, *, zero1=False, axes=None, n_microbatches=None,
     net._scan_fit = None
     net._output_jit = None
     net._score_examples_jit = {}
+    net._overlap_plan = (None if overlap is None
+                         else _configure_overlap(net, mesh, axes, overlap))
     if mesh is not None:
         _ensure_tree_optimizer(net, axes, zero1)
     if mesh is None or axes is None:

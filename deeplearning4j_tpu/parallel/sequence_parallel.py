@@ -89,7 +89,12 @@ def make_sp_train_step(net, mesh: Mesh, seq_axis: str = "seq",
         new_state = jax.tree.map(_avg_state, new_state)
         for ax in axes:
             loss = lax.pmean(loss, ax)
-            grads = lax.pmean(grads, ax)
+        # gradient collectives route through the blessed site (G015);
+        # per-axis tree pmean — the identical primitive sequence this
+        # step always issued (frozen stage-3 signature unchanged)
+        from deeplearning4j_tpu.parallel.overlap import reduce_gradients
+
+        grads = reduce_gradients(grads, axes)
         updates, new_opt = net.tx.update(grads, opt_state, params)
         import optax
 

@@ -23,6 +23,7 @@ Event schema — one JSON object per line, every event carrying
 | `memory` | device-memory snapshot: `live_array_bytes`, `live_array_count`, per-device `memory_stats` when the backend exposes them |
 | `error`  | `where`, `error` (repr), `traceback` (FULL string — never truncated at the source) |
 | `fault`  | fault-injection / elastic-recovery record: `kind` (an injected fault kind from distributed/faults.py or a launcher exit class), `process_id`, `step`, free-form fields — written BEFORE the fault acts, so even a SIGKILL leaves its line |
+| `bucket_plan` | the DP-overlap bucket schedule a net was configured with (parallel/placement.py): `axis`, `n_buckets`, `bucket_bytes`, `mode`, per-bucket `{index, n_leaves, bytes}` — the per-rank collective sequence on the record before any step runs; the bench's per-bucket micro-timings ride `span` events named `bucket_reduce` (`bucket`, `bytes`, `n_leaves`, `seconds`) |
 
 The file format is append-only JSONL so concurrent writers (bench runs
 every mode in a subprocess) can share one log: each process appends

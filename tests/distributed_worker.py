@@ -1,4 +1,4 @@
-"""One member of the 2-process x 4-virtual-device pjit fleet spawned by
+"""One member of the N-process x K-virtual-device pjit fleet spawned by
 tests/test_distributed.py via `distributed.launch_local`.
 
 Run: python tests/distributed_worker.py <out_dir>
@@ -8,9 +8,12 @@ The launcher provides the whole rendezvous env contract
 the virtual-CPU XLA flags); this script only has to call
 `bootstrap.initialize()`, build the global mesh, and run ONE jitted
 allreduce train step through the ordinary `set_mesh` + `fit` path on its
-local batch shard. It saves the resulting flat parameter vector so the
-test can assert bit-identical replicas across processes and parity with
-the single-process full-batch reference.
+local batch shard — TWICE: once with the monolithic GSPMD formulation
+and once with the ISSUE 7 bucketed-overlap step (`set_mesh(mesh,
+overlap=...)`, per-bucket psums under shard_map). It saves both
+resulting flat parameter vectors so the test can assert bit-identical
+replicas across processes for BOTH formulations, plus overlap parity
+with the unbucketed step at tight atol.
 """
 
 import os
@@ -37,18 +40,29 @@ def main() -> int:
 
     mesh = make_global_mesh({"data": -1})
     assert spans_processes(mesh), "mesh does not span processes"
-    net = build_net().init()  # same seed everywhere -> identical replicas
-    net.set_mesh(mesh)
-
+    pid = info["process_id"]
     x, y = full_data()
     ds = DataSet(local_shard(x), local_shard(y))  # this process's rows
-    net.fit(ds)  # ONE jitted allreduce train step over the global mesh
 
-    pid = info["process_id"]
-    flat = np.asarray(net.params_flat())
-    np.save(os.path.join(out_dir, f"params_p{pid}.npy"), flat)
-    print(f"p{pid}: step done, score={net.score_value:.6f}, "
+    net = build_net().init()  # same seed everywhere -> identical replicas
+    net.set_mesh(mesh)
+    net.fit(ds)  # ONE jitted allreduce train step over the global mesh
+    np.save(os.path.join(out_dir, f"params_p{pid}.npy"),
+            np.asarray(net.params_flat()))
+    print(f"p{pid}: monolithic step done, score={net.score_value:.6f}, "
           f"devices={info['global_devices']}", flush=True)
+
+    # the bucketed-overlap formulation of the SAME step: tiny bucket
+    # size -> several per-bucket psums (the frozen
+    # distributed/overlap_step_2x4 collective sequence), executed live
+    # across processes
+    net_ov = build_net().init()
+    net_ov.set_mesh(mesh, overlap=128)
+    net_ov.fit(ds)
+    np.save(os.path.join(out_dir, f"params_overlap_p{pid}.npy"),
+            np.asarray(net_ov.params_flat()))
+    print(f"p{pid}: overlap step done, score={net_ov.score_value:.6f}",
+          flush=True)
     return 0
 
 

@@ -1,5 +1,5 @@
 """Tier-1 gate for graftlint (ISSUE 2 + the ISSUE 5 SPMD rules): every
-AST rule G001-G014 proven on a positive AND a negative fixture, the
+AST rule G001-G015 proven on a positive AND a negative fixture, the
 suppression + baseline machinery, the stage-2 jaxpr audit over every
 public entry point, and the package itself held lint-clean (zero
 non-baselined findings). The stage-3 collective audit has its own gate
@@ -399,6 +399,17 @@ def retry_outside_distributed():
         except OSError:
             time.sleep(0.1)
 """),
+    ("G015", """\
+def reduce_step(grads, axis_name):
+    return jax.lax.pmean(grads, axis_name)
+""", """\
+def reduce_params(params, axis_name):
+    return jax.lax.pmean(params, axis_name)
+
+
+def reduce_loss(loss, acts, axis_name):
+    return jax.lax.psum(loss, axis_name), jax.lax.pmean(acts, axis_name)
+"""),
 ]
 
 
@@ -412,7 +423,22 @@ def test_rule_fires_on_positive_not_negative(rule, pos, neg):
 
 def test_every_rule_has_fixture_coverage():
     assert {r for r, _, _ in FIXTURES} == set(RULE_DOCS) == {
-        f"G{i:03d}" for i in range(1, 15)}
+        f"G{i:03d}" for i in range(1, 16)}
+
+
+def test_g015_blessed_sites_are_exempt():
+    """The bucket planner and the train-step assembly are the two
+    blessed gradient-collective sites; the same source flags anywhere
+    else in the package."""
+    src = ("def reduce_step(grads, axis_name):\n"
+           "    return jax.lax.psum(grads, axis_name)\n")
+    assert "G015" not in rules_in(
+        src, "deeplearning4j_tpu/parallel/overlap.py")
+    assert "G015" not in rules_in(
+        src, "deeplearning4j_tpu/nn/training.py")
+    assert "G015" in rules_in(
+        src, "deeplearning4j_tpu/parallel/sequence_parallel.py")
+    assert "G015" in rules_in(src)  # the default fixture path
 
 
 def test_g014_retry_loop_scoped_to_distributed():

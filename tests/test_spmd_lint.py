@@ -54,6 +54,20 @@ def test_allreduce_entry_signature_is_nonempty():
     assert all(item.startswith("hlo:all-reduce") for item in sig)
 
 
+def test_overlap_entry_freezes_the_per_rank_bucket_sequence():
+    """ISSUE 7: the bucketed-overlap train step's frozen signature IS
+    the per-rank bucket schedule — one psum@data per bucket in reverse
+    layer order (the 128-byte plan splits the 83-param net into three
+    gradient buckets), then the loss pmean. The parametrized audit test
+    above already proves it identical under simulated ranks (zero
+    C003); here the shape of the deliberate refreeze is pinned."""
+    sig = collective_audit.load_budget()["distributed/overlap_step_2x4"]
+    assert sig, "the overlap entry's frozen signature is empty"
+    assert all(item.startswith("psum@data") for item in sig)
+    grad_psums = [item for item in sig if not item.endswith("[]")]
+    assert len(grad_psums) == 3  # the bucket count of the frozen plan
+
+
 def test_shard_map_entries_carry_jaxpr_collectives():
     frozen = collective_audit.load_budget()
     ring = frozen["ring_attention/seq4"]

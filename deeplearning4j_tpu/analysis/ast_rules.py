@@ -1,4 +1,4 @@
-"""The G001-G009 AST rules (G010-G014 live in spmd_rules.py and
+"""The G001-G009 + G016 AST rules (G010-G015 live in spmd_rules.py and
 register into ALL_RULES/RULE_DOCS at the bottom of this module).
 
 Every rule errs toward PRECISION over recall: a lint gate that cries
@@ -717,6 +717,112 @@ def g008_import_time(tree, imports, path):
     return out
 
 
+# --------------------------------------------------------------- G016
+
+# The one module allowed to hold tunable Pallas block-size knobs: the
+# tuning layer (table + heuristics + override hook). Kernels resolve
+# their grids through it; a literal elsewhere re-freezes a knob the
+# kerneltune sweep can no longer reach.
+_TUNING_LAYER = ("ops/autotune.py",)
+
+_PALLAS_BLOCKSPEC = {"jax.experimental.pallas.BlockSpec",
+                     "jax.experimental.pallas.tpu.BlockSpec"}
+_PALLAS_CALL = {"jax.experimental.pallas.pallas_call",
+                "jax.experimental.pallas.tpu.pallas_call"}
+
+# 128 is the hardware lane/sublane tile (MXU 128x128, VPU 8x128) —
+# structural, not tunable; anything larger in a block/grid position is a
+# swept knob that belongs in the tuning layer.
+_G016_STRUCTURAL_MAX = 128
+
+# module-level constant names that denote block/tile knobs (kernel files
+# only): BLOCK_Q_MAX, _ROW_BLOCK, CHUNK_TILES, ...
+_G016_CONST_RE = re.compile(r"BLOCK|TILE")
+
+
+def _g016_literal_over(node: ast.AST):
+    """Int literals > 128 anywhere inside a (possibly nested) tuple/list
+    expression."""
+    for sub in ast.walk(node):
+        if isinstance(sub, ast.Constant) and isinstance(sub.value, int) \
+                and not isinstance(sub.value, bool) \
+                and sub.value > _G016_STRUCTURAL_MAX:
+            yield sub
+
+
+def g016_hardcoded_block_literals(tree, imports, path):
+    """Pallas block-size/grid literals hardcoded outside the tuning
+    layer (ops/autotune.py): (a) int literals > 128 inside a
+    pl.BlockSpec block shape or a pallas_call grid= — the grid must be a
+    function of the autotune-resolved block params, not a re-frozen
+    constant; (b) module-level UPPERCASE BLOCK/TILE constants in ops/
+    kernel files bound to int (or int-tuple) literals > 128 — the swept
+    defaults live in autotune.py. 128 itself is the hardware lane tile
+    (structural). Not caught: literals laundered through arithmetic
+    (512 * 1) or non-BLOCK-named constants — precision over recall."""
+    norm = path.replace("\\", "/")
+    if any(norm.endswith(t) for t in _TUNING_LAYER):
+        return []
+    out = []
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        name = imports.canon(node.func)
+        if name in _PALLAS_BLOCKSPEC:
+            shape = None
+            if node.args:
+                shape = node.args[0]
+            for kw in node.keywords:
+                if kw.arg == "block_shape":
+                    shape = kw.value
+            if shape is not None and isinstance(shape, (ast.Tuple,
+                                                        ast.List)):
+                for lit in _g016_literal_over(shape):
+                    out.append(("G016", lit,
+                                f"hardcoded block-size literal "
+                                f"{lit.value} in a pl.BlockSpec outside "
+                                "the tuning layer — a knob the "
+                                "kerneltune sweep cannot reach",
+                                "resolve the block through "
+                                "ops/autotune.py (flash_blocks/ln_rows/"
+                                "xent_blocks) and pass the variable"))
+        elif name in _PALLAS_CALL:
+            for kw in node.keywords:
+                if kw.arg == "grid" and isinstance(kw.value, (ast.Tuple,
+                                                              ast.List)):
+                    for lit in _g016_literal_over(kw.value):
+                        out.append(("G016", lit,
+                                    f"hardcoded grid literal {lit.value} "
+                                    "in a pallas_call outside the tuning "
+                                    "layer",
+                                    "derive the grid from the autotune-"
+                                    "resolved block sizes"))
+    if "/ops/" in norm:
+        for stmt in getattr(tree, "body", []):
+            targets = []
+            if isinstance(stmt, ast.Assign):
+                targets = [t for t in stmt.targets
+                           if isinstance(t, ast.Name)]
+                value = stmt.value
+            elif isinstance(stmt, ast.AnnAssign) and isinstance(
+                    stmt.target, ast.Name) and stmt.value is not None:
+                targets = [stmt.target]
+                value = stmt.value
+            else:
+                continue
+            for tgt in targets:
+                if tgt.id.isupper() and _G016_CONST_RE.search(tgt.id):
+                    for lit in _g016_literal_over(value):
+                        out.append(("G016", lit,
+                                    f"block/tile constant `{tgt.id}` "
+                                    f"hardcodes {lit.value} in a kernel "
+                                    "file — the swept defaults live in "
+                                    "the tuning layer",
+                                    "move the default to ops/autotune.py "
+                                    "and alias it here"))
+    return out
+
+
 # stage-3 AST rules (G010-G014) live in spmd_rules.py and register here;
 # the import sits below every helper they borrow lazily, so importing
 # either module first resolves cleanly.
@@ -728,7 +834,8 @@ from deeplearning4j_tpu.analysis.spmd_rules import (  # noqa: E402
 ALL_RULES = [g001_traced_bool, g002_host_sync, g003_float64_drift,
              g004_rng_discipline, g005_retrace_hazards,
              g006_shard_map_arity, g007_compat_bypass, g008_import_time,
-             g009_rendezvous_routing] + SPMD_RULES
+             g009_rendezvous_routing,
+             g016_hardcoded_block_literals] + SPMD_RULES
 
 RULE_DOCS = {
     "G001": "python control flow / bool()/float()/int() on traced values",
@@ -741,6 +848,8 @@ RULE_DOCS = {
     "G008": "mutable default args; module-level jnp allocations",
     "G009": "raw jax.distributed / rendezvous env plumbing bypassing "
             "distributed/bootstrap.py",
+    "G016": "Pallas block-size/grid literals hardcoded outside the "
+            "tuning layer (ops/autotune.py)",
     **SPMD_RULE_DOCS,
 }
 

@@ -209,9 +209,11 @@ class SelfAttentionImpl(LayerImpl):
             # T beyond the monolithic kernels' envelope: blockwise
             # tiles + lse merge (single-chip ring); padding masks slice
             # per kv tile and dropout hashes global coordinates (r6), so
-            # the full training feature set rides this path. Past this,
-            # the seq mesh axis shards T across chips
-            # (sequence_parallel.py)
+            # the full training feature set rides this path. Since r8
+            # the tier is D-aware (head dims past 128 use shorter proven
+            # tiles) and non-causal kv tiles scan instead of unrolling
+            # n^2 kernel calls. Past this, the seq mesh axis shards T
+            # across chips (sequence_parallel.py)
             out = chunked_flash_attention(qh, kh, vh, causal=conf.causal,
                                           mask=mask, dropout=drop_attn,
                                           dropout_rng=rng)
@@ -219,8 +221,8 @@ class SelfAttentionImpl(LayerImpl):
               and flash_supports_monolithic_fallback(
                   qh.shape, causal=conf.causal, dropout=drop_attn,
                   mask=mask)):
-            # what the tile loop can't take (masks/dropout, non-tileable
-            # T) still compiles monolithically to MONOLITHIC_COMPILE_MAX
+            # non-tileable T at D <= 128 still compiles monolithically
+            # to MONOLITHIC_COMPILE_MAX (every in-kernel feature rides)
             out = flash_attention(qh, kh, vh, causal=conf.causal, mask=mask,
                                   dropout=drop_attn, dropout_rng=rng)
         elif use_flash and T > MAX_FLASH_T:

@@ -30,8 +30,14 @@ padded to the 128-lane tile internally by Mosaic when smaller, and
 head_dim % 128 == 0 unlocks the packed-qkv no-relayout entry point
 (flash_attention_qkv).
 
+Block sizes, G-batching, and the long-context chunk tile resolve per
+config through the tuning layer (ops/autotune.py, r8): a checked-in
+TPU-only tuning table with the swept v5e defaults as the deterministic
+fallback — graftlint G016 keeps re-frozen literals out of this file.
+
 Falls back to interpret mode off-TPU so the unit tests exercise the same
-kernel code on CPU.
+kernel code on CPU (where the tuning table is inactive, so interpret
+results are bit-identical to the defaults).
 """
 
 from __future__ import annotations
@@ -42,17 +48,20 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 from jax.experimental import pallas as pl
+from deeplearning4j_tpu.ops import autotune
 from deeplearning4j_tpu.util.compat import tpu_compiler_params
 
-BLOCK = 128
-LANES = 128  # lane width (used by fused_softmax_xent block sizing)
+BLOCK = autotune.BLOCK
+LANES = autotune.LANES  # lane width (used by fused_softmax_xent sizing)
 NEG_INF = -1e30
 
-# Block-size caps (swept on v5e): larger q/k blocks amortize the per-program
-# fixed cost and feed the MXU bigger dots; the caps keep scores [bq, bk] f32
-# and the full-T K/V copies comfortably inside VMEM.
-BLOCK_Q_MAX = 512
-BLOCK_K_MAX = 512
+# Block-size caps: resolved per config through the tuning layer
+# (ops/autotune.py — table entry when tuned on TPU, else the swept v5e
+# defaults). These names remain the DISPATCH envelope (supports_qkv's
+# single-block bound); per-call grid sizing goes through
+# autotune.flash_blocks.
+BLOCK_Q_MAX = autotune.DEFAULT_BLOCK_Q_MAX
+BLOCK_K_MAX = autotune.DEFAULT_BLOCK_K_MAX
 
 # Scoped-VMEM budget a G-batched program's working set must fit. The
 # kernels raise their scoped limit to 32MB (v5e has 128MB of VMEM; the
@@ -61,17 +70,27 @@ _VMEM_LIMIT = 32 * 1024 * 1024
 _VMEM_BUDGET = 26 * 1024 * 1024
 
 
-def pick_block(n: int, cap: int, base: int = BLOCK) -> int:
-    """Largest power-of-two divisor of n up to cap (n % base == 0 assumed).
-    Shared by the flash and fused-head kernels for grid-block sizing."""
-    b = base
-    while b * 2 <= cap and n % (b * 2) == 0:
-        b *= 2
-    return min(b, n)
+# shared divisor search (moved to the tuning layer in r8; re-exported —
+# fused_softmax_xent and the tests import it from here)
+pick_block = autotune.pick_block
 
 
-def _block_sizes(T):
-    return pick_block(T, BLOCK_Q_MAX), pick_block(T, BLOCK_K_MAX)
+def _block_sizes(T, D, causal, dropout, masked, kernel):
+    """(block_q, block_k) for one monolithic kernel call, resolved
+    through the tuning layer: override > TPU table entry > the swept
+    512-cap divisor search. Off-TPU the table is inactive, so interpret
+    runs keep the deterministic defaults bit-identically."""
+    return autotune.flash_blocks(T, D, causal=causal,
+                                 dropout=bool(dropout), masked=masked,
+                                 kernel=kernel)
+
+
+def _resolve_g(kernel, BH, T, D, slice_bytes, causal, dropout, masked):
+    """Per-program G-batching: a valid tuned G (divides BH) wins, else
+    the VMEM-budget heuristic."""
+    g = autotune.flash_g(kernel, BH, T, D, causal=causal,
+                         dropout=bool(dropout), masked=masked)
+    return g if g else _pick_g(BH, T, D, slice_bytes)
 
 
 def _pick_g(BH: int, T: int, D: int, bytes_per_slice: int) -> int:
@@ -290,7 +309,11 @@ def _fwd_kernel(q_ref, k_ref, v_ref, *rest, sm_scale, causal, masked,
         lse_ref[...] = lse.reshape(lse_ref.shape)
         return
 
-    hi = (qi * block_q) // block_k + 1 if causal else nk
+    # last key block the q block's LAST row reaches — correct for any
+    # block_q/block_k ratio (the pre-r8 `qi*bq//bk + 1` silently dropped
+    # key blocks when a tuned block_q exceeded block_k; equal blocks,
+    # the default, reduce to the same value bit-for-bit)
+    hi = ((qi + 1) * block_q - 1) // block_k + 1 if causal else nk
 
     def body(j, carry):
         m, l, acc = carry
@@ -345,10 +368,13 @@ def _fwd_kernel(q_ref, k_ref, v_ref, *rest, sm_scale, causal, masked,
 def _flash_fwd(q, k, v, kmask, sm_scale, causal, dropout=0.0, seed=None,
                hash_t=None):
     BH, T, D = q.shape
-    block_q, block_k = _block_sizes(T)
     masked = kmask is not None
+    block_q, block_k = _block_sizes(T, D, causal, dropout, masked,
+                                    "flash_fwd")
     extra = int(T * T * 4) if dropout else 0  # f32 keep mask per slice
-    G = (_pick_g(BH, T, D, _fwd_slice_bytes(T, D) + extra)
+    G = (_resolve_g("flash_fwd", BH, T, D,
+                    _fwd_slice_bytes(T, D) + extra, causal, dropout,
+                    masked)
          if block_q == T and block_k == T else 1)
     grid = (BH // G, T // block_q)
     kern = functools.partial(_fwd_kernel, sm_scale=sm_scale, causal=causal,
@@ -406,7 +432,8 @@ def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, *rest,
     delta = delta_ref[:, 0]
     G = q.shape[0]
     nk = seq_len // block_k
-    hi = (qi * block_q) // block_k + 1 if causal else nk
+    # see _fwd_kernel's bound note: reach the LAST row's key block
+    hi = ((qi + 1) * block_q - 1) // block_k + 1 if causal else nk
 
     def body(j, dq):
         kb = k_ref[:, pl.ds(j * block_k, block_k), :]
@@ -599,7 +626,8 @@ def _flash_bwd_fused(q, k, v, do, o, lse, kmask, sm_scale, causal,
     BH, T, D = q.shape
     masked = kmask is not None
     extra = int(T * T * 4) if dropout else 0
-    G = _pick_g(BH, T, D, _bwd_slice_bytes(T, D) + extra)
+    G = _resolve_g("flash_bwd", BH, T, D, _bwd_slice_bytes(T, D) + extra,
+                   causal, dropout, masked)
     fullblock = pl.BlockSpec((G, T, D), lambda bh: (bh, 0, 0))
     lblock = pl.BlockSpec((G, 1, T), lambda bh: (bh, 0, 0))
     in_specs = [fullblock, fullblock, fullblock, fullblock, fullblock,
@@ -635,8 +663,9 @@ def _flash_bwd_fused(q, k, v, do, o, lse, kmask, sm_scale, causal,
 def _flash_bwd_impl(q, k, v, o, lse, do, kmask, sm_scale, causal,
                     dlse=None, dropout=0.0, seed=None, hash_t=None):
     BH, T, D = q.shape
-    block_q, block_k = _block_sizes(T)
     masked = kmask is not None
+    block_q, block_k = _block_sizes(T, D, causal, dropout, masked,
+                                    "flash_bwd")
 
     if block_q == T and block_k == T:
         # whole Q/K/V per program: one fused kernel emits dq, dk and dv
@@ -967,7 +996,9 @@ def _flash_fwd_qkv_pair(qkv, H, kmask, sm_scale, causal, dropout=0.0,
     HP = H // 2
     masked = kmask is not None
     extra = int(T * T * 4) if dropout else 0
-    G = _pick_g(B, T, 128, _fwd_slice_bytes(T, 128) + extra)
+    G = _resolve_g("flash_fwd_qkv_pair", B, T, LANES,
+                   _fwd_slice_bytes(T, LANES) + extra, causal, dropout,
+                   masked)
     kern = functools.partial(_fwd_kernel_pair, sm_scale=sm_scale,
                              causal=causal, masked=masked, seq_len=T,
                              dropout=dropout, n_heads=H)
@@ -1010,7 +1041,9 @@ def _flash_bwd_qkv_pair(qkv, o, lse, do, H, kmask, sm_scale, causal,
     HP = H // 2
     masked = kmask is not None
     extra = int(T * T * 4) if dropout else 0
-    G = _pick_g(B, T, 128, _bwd_slice_bytes(T, 128) + extra)
+    G = _resolve_g("flash_bwd_qkv_pair", B, T, LANES,
+                   _bwd_slice_bytes(T, LANES) + extra, causal, dropout,
+                   masked)
     col = pl.BlockSpec((G, T, 128), lambda b, hp: (b, 0, hp))
     in_specs = [
         col,
@@ -1050,7 +1083,9 @@ def _flash_fwd_qkv(qkv, H, kmask, sm_scale, causal, dropout=0.0, seed=None):
                                    dropout=dropout, seed=seed)
     masked = kmask is not None
     extra = int(T * T * 4) if dropout else 0  # f32 keep mask per slice
-    G = _pick_g(B, T, D, _fwd_slice_bytes(T, D) + extra)
+    G = _resolve_g("flash_fwd_qkv", B, T, D,
+                   _fwd_slice_bytes(T, D) + extra, causal, dropout,
+                   masked)
     grid = (B // G, H)
     kern = functools.partial(_fwd_kernel, sm_scale=sm_scale, causal=causal,
                              masked=masked, block_q=T, block_k=T, seq_len=T,
@@ -1095,7 +1130,9 @@ def _flash_bwd_qkv(qkv, o, lse, do, H, kmask, sm_scale, causal,
                                    causal, dropout=dropout, seed=seed)
     masked = kmask is not None
     extra = int(T * T * 4) if dropout else 0
-    G = _pick_g(B, T, D, _bwd_slice_bytes(T, D) + extra)
+    G = _resolve_g("flash_bwd_qkv", B, T, D,
+                   _bwd_slice_bytes(T, D) + extra, causal, dropout,
+                   masked)
     rows = pl.BlockSpec((G, 1, 1, T), lambda b, h: (b, h, 0, 0))
     col = pl.BlockSpec((G, T, D), lambda b, h: (b, 0, h))
     in_specs = [
@@ -1274,79 +1311,103 @@ def supports(q_shape, *, causal, dropout, mask) -> bool:
     return MIN_FLASH_SEQ <= T <= MAX_FLASH_T and T % BLOCK == 0
 
 
-# The chunk-pair loop is Python-unrolled (one kernel call per (q_i, kv_j)
-# tile pair in one jaxpr), so the UNROLL SIZE is what must be bounded —
-# and it depends on causality: n chunks unroll n*(n+1)/2 causal pairs but
-# n*n non-causal ones (ADVICE r5 #1: the raw MAX_CHUNKS=16 cap let
-# non-causal long-T unroll 256 forward calls plus their VJPs, ~2x the
-# budgeted jaxpr/compile size). The bound is therefore the PAIR count:
-# 136 = the causal 16-chunk budget the seq-131072 config measured at
-# 0.70 MFU with tolerable compile time; non-causal T gets at most 11
-# chunks (121 pairs) under the same budget. An uncapped awkward T (e.g.
-# 25088 -> 49 chunks of 512) would unroll 1200+ pallas calls and compile
-# for minutes.
+# What must be bounded is the TRACE SIZE of the chunk loop — the pallas
+# calls one jaxpr accumulates — and since r8 that depends on causality
+# STRUCTURALLY, not just in pair count: causal rows mix full and
+# diagonal-causal tiles, so the (q_i, kv_j) pairs stay Python-unrolled
+# and the budget is the PAIR count (136 = the causal 16-chunk budget the
+# seq-131072 config measured at 0.70 MFU with tolerable compile time).
+# Non-causal rows are UNIFORM (every tile full), so their kv loop is a
+# lax.scan — ONE traced kernel per q chunk — and the budget is the CHUNK
+# count. ADVICE r5 #1's n^2 unroll (16 non-causal chunks = 256 forward
+# calls + VJPs) is structurally gone; an uncapped awkward T (e.g.
+# 25088 -> 49 chunks of 512) would still unroll 1200+ causal pallas
+# calls, hence the caps.
 MAX_CHUNKS = 16
 MAX_CHUNK_PAIRS = MAX_CHUNKS * (MAX_CHUNKS + 1) // 2  # 136
 
 
 def chunk_pairs(n: int, causal: bool) -> int:
-    """Unrolled kernel calls of an n-chunk loop (the compile-size unit)."""
+    """RUNTIME tile-pair kernel launches of an n-chunk loop. For causal
+    this is also the trace size; non-causal pairs run under a scan (see
+    traced_tile_calls)."""
     return n * (n + 1) // 2 if causal else n * n
 
 
+def traced_tile_calls(n: int, causal: bool) -> int:
+    """Pallas calls the n-chunk loop traces into ONE jaxpr — the
+    compile-size unit the budgets bound. Causal unrolls every pair;
+    non-causal scans the kv tiles, so one traced kernel per q chunk."""
+    return chunk_pairs(n, True) if causal else n
+
+
+def _fits_unroll(n: int, causal: bool) -> bool:
+    if causal:
+        return chunk_pairs(n, causal) <= MAX_CHUNK_PAIRS
+    return n <= MAX_CHUNKS
+
+
 def max_chunks(causal: bool) -> int:
-    """Largest chunk count whose unroll fits MAX_CHUNK_PAIRS: 16 causal,
-    11 non-causal."""
+    """Largest chunk count whose trace size fits the budget: 16 both
+    ways since r8 (the causal 16-chunk unroll is the original 136-pair
+    budget; non-causal kv loops scan instead of unrolling)."""
     n = MAX_CHUNKS
-    while n > 1 and chunk_pairs(n, causal) > MAX_CHUNK_PAIRS:
+    while n > 1 and not _fits_unroll(n, causal):
         n -= 1
     return n
 
 
-# Kernel-proven tile lengths, largest first — the single home for the
-# tiling envelope quoted in error messages (chunked_unsupported_reason,
-# the ring hop dispatch).
-CHUNK_TILES = (8192, 4096, 2048, 1024, 512)
+# Kernel-proven tile lengths, largest first — owned by the tuning layer
+# (autotune.CHUNK_TILES), re-exported as the envelope quoted in error
+# messages (chunked_unsupported_reason, the ring hop dispatch). The
+# usable cap shrinks with head_dim (autotune.max_tile_for_dim): the
+# backward streams full-tile [T, D] K/V pairs, so D=256 proves tiles to
+# 4096, D=512 to 2048 — the D>128 long-T tier ADVICE r5 #2 asked for.
+CHUNK_TILES = autotune.CHUNK_TILES
 
 
-def pick_chunk(T: int, causal: bool = True) -> int:
-    """Largest kernel-proven tile length that divides T into 2+ chunks
-    whose pair count fits the unroll budget (0: T not chunkable). Tiles
-    are tried largest-first, so the dispatch prefers FEWER, larger
-    chunks — a non-causal T that divides into 16 small tiles picks a
-    larger tile instead of unrolling n^2 = 256 calls."""
+def pick_chunk(T: int, causal: bool = True, head_dim: int | None = None) \
+        -> int:
+    """Largest kernel-proven tile length (within the D-aware bound when
+    `head_dim` is given) that divides T into 2+ chunks fitting the trace
+    budget (0: T not chunkable). Tiles are tried largest-first, so the
+    dispatch prefers FEWER, larger chunks."""
+    cap = autotune.max_tile_for_dim(head_dim)
     for c in CHUNK_TILES:
-        if (T % c == 0 and 2 <= T // c
-                and chunk_pairs(T // c, causal) <= MAX_CHUNK_PAIRS):
+        if c > cap:
+            continue
+        if T % c == 0 and 2 <= T // c and _fits_unroll(T // c, causal):
             return c
     return 0
 
 
-def _tiles_str() -> str:
-    return "/".join(str(c) for c in reversed(CHUNK_TILES))
+def _tiles_str(head_dim=None) -> str:
+    cap = autotune.max_tile_for_dim(head_dim)
+    return "/".join(str(c) for c in reversed(CHUNK_TILES) if c <= cap)
 
 
 def supports_chunked(q_shape, *, causal, dropout, mask) -> bool:
     """Envelope of the blockwise long-context path: T beyond the
-    monolithic kernels, divisible into kernel-proven tiles whose pair
-    count fits the unroll budget (causality-aware — see chunk_pairs).
-    Padding masks ride the loop (each kv tile sees its mask slice —
-    flash_attention_lse_masked); attention dropout rides it too (r6: the
-    keep mask hashes GLOBAL (q, k) coordinates through
+    monolithic kernels, divisible into kernel-proven tiles (D-aware —
+    head dims past 128 use shorter tiles, r8) whose trace size fits the
+    budget (causality-aware — causal pairs unroll, non-causal kv tiles
+    scan). Padding masks ride the loop (each kv tile sees its mask
+    slice — flash_attention_lse_masked); attention dropout rides it too
+    (r6: the keep mask hashes GLOBAL (q, k) coordinates through
     flash_attention_lse_drop, so every tile regenerates exactly the
-    monolithic kernel's mask — the last feature exclusion on this path
-    is gone)."""
-    T = q_shape[2]
-    return T > MAX_FLASH_T and pick_chunk(T, causal) > 0
+    monolithic kernel's mask)."""
+    T, D = q_shape[2], q_shape[3]
+    return T > MAX_FLASH_T and pick_chunk(T, causal, head_dim=D) > 0
 
 
 def supports_monolithic_fallback(q_shape, *, causal, dropout, mask) -> bool:
     """T in (MAX_FLASH_T, MONOLITHIC_COMPILE_MAX] the tile loop cannot
-    take (mask/dropout configs, non-tileable lengths) still compiles on
-    the monolithic kernels with every in-kernel feature — the pre-r5
-    dispatch for those shapes, kept so they don't regress to an error.
-    Gated at D <= 128: the compile ceiling was measured there, and the
-    backward's VMEM working set scales with D."""
+    take (non-tileable lengths) still compiles on the monolithic kernels
+    with every in-kernel feature — the pre-r5 dispatch for those shapes,
+    kept so they don't regress to an error. Gated at D <= 128: the
+    compile ceiling was measured there, and the backward's VMEM working
+    set scales with D — D > 128 long-T routes through the chunked tier's
+    D-aware tiles instead (supports_chunked, r8)."""
     T, D = q_shape[2], q_shape[3]
     return (MAX_FLASH_T < T <= MONOLITHIC_COMPILE_MAX and T % BLOCK == 0
             and D <= 128)
@@ -1357,16 +1418,22 @@ def chunked_unsupported_reason(T, *, dropout, mask, causal=True,
     """Why a long-T shape has no fused path — raised by the attention
     layer so long-context misconfigurations fail with instructions
     instead of a dense-path device OOM. Dropout is NOT an exclusion
-    anymore (r6: chunk-invariant in-kernel dropout); what remains is
-    tileability (pair-count bound) and, for the monolithic fallback
-    tier, the D <= 128 gate (ADVICE r5 #2 — a head_dim-256 user must be
-    told the actual blocker)."""
+    anymore (r6) and neither are non-causal lengths up to 16 tiles (r8:
+    scanned kv loops) nor head dims past 128 (r8: D-aware tile bound);
+    what remains is tile-divisibility under those bounds, plus the
+    D <= 128 gate on the monolithic fallback tier."""
     nmax = max_chunks(causal)
+    cap = autotune.max_tile_for_dim(head_dim)
     msg = (f"attention at T={T} cannot be tiled: the chunked flash path "
            f"needs T divisible into 2-{nmax} "
            f"{'causal' if causal else 'non-causal'} tiles of "
-           f"{_tiles_str()} (unroll budget {MAX_CHUNK_PAIRS} tile pairs; "
-           f"max single-chip T = {nmax * MAX_FLASH_T})")
+           f"{_tiles_str(head_dim)}")
+    if head_dim and head_dim > 128:
+        msg += (f" (head_dim={head_dim} caps tiles at {cap}: the "
+                "backward's VMEM working set scales with head_dim)")
+    msg += (f" (causal trace budget {MAX_CHUNK_PAIRS} unrolled tile "
+            f"pairs, non-causal kv tiles scan at {MAX_CHUNKS} chunks "
+            f"max; max single-chip T here = {nmax * cap})")
     if T <= MONOLITHIC_COMPILE_MAX:
         msg += (f", and the monolithic fallback (T <= "
                 f"{MONOLITHIC_COMPILE_MAX}) requires head_dim <= 128"
@@ -1441,51 +1508,122 @@ def chunked_flash_attention_lse(q, k, v, sm_scale, causal, kmask=None,
     the mask is invariant to the chunk count AND to how the sequence is
     sharded across ring hops."""
     BH, T, D = q.shape
-    c = chunk or pick_chunk(T, causal)
+
+    # explicit/tuned chunks obey the same guards as pick_chunk:
+    # lane-legal tiles no longer than the D-aware proven envelope, with
+    # a trace size inside the budget (an uncapped hop_chunk would
+    # compile for minutes; an oversized one would hand the monolithic
+    # kernel the VMEM-busting length this path avoids)
+    def _fits(cand):
+        return (isinstance(cand, int) and cand > 0 and T % cand == 0
+                and cand % BLOCK == 0
+                and cand <= autotune.max_tile_for_dim(D)
+                and T // cand >= 2 and _fits_unroll(T // cand, causal))
+
+    c = chunk
+    if not c:
+        c = (autotune.chunk_tile(T, D, causal=causal,
+                                 dropout=bool(dropout),
+                                 masked=kmask is not None, fits=_fits)
+             or pick_chunk(T, causal, head_dim=D))
     n = T // c if c else 0
-    # explicit chunks obey the same guards as pick_chunk: lane-legal
-    # tiles no longer than the kernels' proven envelope, with a pair
-    # count inside the unroll budget (one pallas call per tile pair
-    # unrolls in one jaxpr — an uncapped hop_chunk would compile for
-    # minutes; an oversized one would hand the monolithic kernel the
-    # VMEM-busting length this path avoids)
-    if (c <= 0 or T % c or c % BLOCK or c > MAX_FLASH_T or n < 2
-            or chunk_pairs(n, causal) > MAX_CHUNK_PAIRS):
+    if not _fits(c):
         raise ValueError(
             f"T={T} not divisible into 2-{max_chunks(causal)} kernel tiles"
             + (f" of {chunk}" if chunk else "")
             + (f" ({chunk_pairs(n, causal)} unrolled tile pairs exceed "
                f"the {MAX_CHUNK_PAIRS} budget)"
-               if n >= 2 and chunk_pairs(n, causal) > MAX_CHUNK_PAIRS
-               else ""))
+               if n >= 2 and not _fits_unroll(n, causal) else "")
+            + (f" (head_dim={D} caps tiles at "
+               f"{autotune.max_tile_for_dim(D)})"
+               if c and c % BLOCK == 0 and n >= 2
+               and c > autotune.max_tile_for_dim(D) else ""))
     ht = hash_t if hash_t is not None else T
     km = kmask
     if dropout and km is None:
         # the dropout cores take kmask unconditionally (ones = unpadded)
         km = jnp.ones((BH, 1, T), jnp.float32)
+    if not causal:
+        return _chunked_noncausal(q, k, v, sm_scale, c, n, km, dropout,
+                                  seed, q_origin, k_origin, ht)
     outs, lses = [], []
     for i in range(n):
         qi = q[:, i * c:(i + 1) * c]
         o = lse = None
-        for j in range(i + 1 if causal else n):
+        for j in range(i + 1):
             kj = k[:, j * c:(j + 1) * c]
             vj = v[:, j * c:(j + 1) * c]
             if dropout:
                 ctx = _drop_ctx(seed, q_origin + i * c, k_origin + j * c)
                 o_hop, lse_hop = flash_attention_lse_drop(
                     qi, kj, vj, km[:, :, j * c:(j + 1) * c], ctx,
-                    sm_scale, causal and j == i, float(dropout), ht)
+                    sm_scale, j == i, float(dropout), ht)
             elif km is None:
                 o_hop, lse_hop = flash_attention_lse(
-                    qi, kj, vj, sm_scale, causal and j == i)
+                    qi, kj, vj, sm_scale, j == i)
             else:
                 o_hop, lse_hop = flash_attention_lse_masked(
                     qi, kj, vj, km[:, :, j * c:(j + 1) * c],
-                    sm_scale, causal and j == i)
+                    sm_scale, j == i)
             if o is None:
                 o, lse = o_hop.astype(jnp.float32), lse_hop
             else:
                 o, lse = lse_combine(o, lse, o_hop, lse_hop)
+        outs.append(o.astype(q.dtype))
+        lses.append(lse)
+    return jnp.concatenate(outs, axis=1), jnp.concatenate(lses, axis=1)
+
+
+def _chunked_noncausal(q, k, v, sm_scale, c, n, km, dropout, seed,
+                       q_origin, k_origin, hash_t):
+    """Non-causal chunk loop: kv tiles are UNIFORM (every (q_i, kv_j)
+    pair runs the full kernel — no diagonal specialization), so the
+    inner loop is a lax.scan over stacked kv tiles — ONE traced kernel
+    per q chunk instead of the n^2 Python unroll ADVICE r5 #1 flagged
+    (16 chunks would have unrolled 256 forward calls plus their VJPs).
+    Numerics match the unrolled loop bit-for-bit: the carry starts at
+    (0, NEG_INF), whose first lse_combine is exact (a = exp(NEG_INF -
+    lse_hop) underflows to 0.0, b = exp(0) = 1.0, denom = 1.0 — the old
+    direct first-hop assignment), and hops run in the same j = 0..n-1
+    order. Dropout stays chunk-invariant: the per-hop ctx hashes the
+    GLOBAL (q, k) origin computed from the scanned hop index."""
+    BH, T, D = q.shape
+    ks = jnp.moveaxis(k.reshape(BH, n, c, D), 1, 0)       # [n, BH, c, D]
+    vs = jnp.moveaxis(v.reshape(BH, n, c, D), 1, 0)
+    kms = (None if km is None
+           else jnp.moveaxis(km.reshape(BH, 1, n, c), 2, 0))
+    js = jnp.arange(n, dtype=jnp.int32)
+    outs, lses = [], []
+    for i in range(n):
+        qi = q[:, i * c:(i + 1) * c]
+
+        def hop(carry, xs, qi=qi, i=i):
+            o, lse = carry
+            if dropout:
+                kj, vj, kmj, j = xs
+                ctx = _drop_ctx(seed, q_origin + i * c, k_origin + j * c)
+                o_hop, lse_hop = flash_attention_lse_drop(
+                    qi, kj, vj, kmj, ctx, sm_scale, False,
+                    float(dropout), hash_t)
+            elif km is None:
+                kj, vj = xs
+                o_hop, lse_hop = flash_attention_lse(qi, kj, vj,
+                                                     sm_scale, False)
+            else:
+                kj, vj, kmj = xs
+                o_hop, lse_hop = flash_attention_lse_masked(
+                    qi, kj, vj, kmj, sm_scale, False)
+            return lse_combine(o, lse, o_hop, lse_hop), None
+
+        if dropout:
+            xs = (ks, vs, kms, js)
+        elif km is None:
+            xs = (ks, vs)
+        else:
+            xs = (ks, vs, kms)
+        carry0 = (jnp.zeros((BH, c, D), jnp.float32),
+                  jnp.full((BH, c), NEG_INF, jnp.float32))
+        (o, lse), _ = jax.lax.scan(hop, carry0, xs)
         outs.append(o.astype(q.dtype))
         lses.append(lse)
     return jnp.concatenate(outs, axis=1), jnp.concatenate(lses, axis=1)

@@ -28,18 +28,23 @@ import numpy as np
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
-_ROW_BLOCK = 512
+from deeplearning4j_tpu.ops import autotune
+
+# row-block cap: resolved per (N, C) config through the tuning layer
+# (ops/autotune.py); this name remains for the measured-default record
+_ROW_BLOCK = autotune.DEFAULT_LN_ROW_BLOCK
 
 
 def _use_interpret() -> bool:
     return jax.default_backend() != "tpu"
 
 
-def _pick_rows(N: int) -> int:
-    b = 8
-    while b * 2 <= _ROW_BLOCK and N % (b * 2) == 0:
-        b *= 2
-    return b
+def _pick_rows(N: int, C: int) -> int:
+    """Row block via the tuning layer: a valid table entry (TPU only)
+    wins, else the power-of-two divisor search up to the swept cap.
+    autotune.ln_rows enforces the stat-row legality rule on tuned
+    values, so fwd and bwd always agree on bn."""
+    return autotune.ln_rows(N, C)
 
 
 def supports(shape, dtype=None) -> bool:
@@ -48,7 +53,7 @@ def supports(shape, dtype=None) -> bool:
     C = shape[-1]
     N = int(np.prod(shape[:-1]))
     if C % 128 == 0 and N % 8 == 0:
-        bn = _pick_rows(N)
+        bn = _pick_rows(N, C)
         # the [1, N] stat rows use (1, bn) blocks: legal only when bn is
         # a lane-tile multiple or the whole row dim
         return bn % 128 == 0 or bn == N
@@ -88,7 +93,7 @@ def _bwd_kernel(x_ref, g_ref, mu_ref, rstd_ref, dy_ref, dx_ref, dg_ref,
 
 def _ln_fwd(x2d, gamma, beta, eps):
     N, C = x2d.shape
-    bn = _pick_rows(N)
+    bn = _pick_rows(N, C)
     grid = (N // bn,)
     y, mu, rstd = pl.pallas_call(
         functools.partial(_fwd_kernel, eps=eps),
@@ -115,7 +120,7 @@ def _ln_fwd(x2d, gamma, beta, eps):
 
 def _ln_bwd(x2d, gamma, mu, rstd, dy):
     N, C = x2d.shape
-    bn = _pick_rows(N)
+    bn = _pick_rows(N, C)
     grid = (N // bn,)
     dx, dgp, dbp = pl.pallas_call(
         _bwd_kernel,

@@ -34,33 +34,19 @@ import numpy as np
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+from deeplearning4j_tpu.ops import autotune
 from deeplearning4j_tpu.util.compat import tpu_compiler_params
 
-LANES = 128
+LANES = autotune.LANES
 NEG_INF = -1e30
 
-# Swept on v5e (N=16384, d=256, V=10240, fwd+dx+dwdb interleaved):
-# r2 found 512/2048 >> 256/1024; the r5 re-sweep at the 32MB scoped
-# limit found 1024-row blocks another ~4% faster (fewer weight-block
-# re-streams per row), while 4096-wide vocab chunks and 256-row blocks
-# both LOSE even with the headroom.
-BLOCK_N = 1024   # token-block rows per program
-BLOCK_V = 2048   # vocab-chunk columns streamed through VMEM at d=256
-
-
-def _block_v(d: int, v: int) -> int:
-    """Vocab chunk width: the VMEM working set ([bn, bv] f32 logits tile,
-    [d, bv] f32 dW scratch, double-buffered [d, bv] weight blocks) scales
-    with d·bv, so shrink the chunk as the feature dim grows to stay
-    inside the swept VMEM envelope (bn=1024 x bv=2048 at d=256 under the
-    32MB scoped limit every kernel in this file now requests — wider
-    chunks fit but LOSE, see the BLOCK_N/BLOCK_V note). The width is
-    floored to a lane multiple (128); when the whole vocab fits one chunk
-    the block equals the array dim, which Mosaic also accepts. The chunk
-    is also capped at the swept BLOCK_V so a small d (e.g. 128) cannot
-    inflate the [bn, bv] f32 logits tile past the swept envelope."""
-    bv = max(512, min(BLOCK_V, (BLOCK_V * 256 // d) // 128 * 128))
-    return min(v, bv)
+# Block caps: resolved per (V, d) config through the tuning layer
+# (ops/autotune.py — table entry when tuned on TPU, else the swept v5e
+# defaults: 1024-row blocks x 2048-wide vocab chunks at d=256 under the
+# 32MB scoped limit; see autotune.xent_blocks for the d-scaling rule).
+# The names remain as the measured-default record.
+BLOCK_N = autotune.DEFAULT_XENT_BLOCK_N
+BLOCK_V = autotune.DEFAULT_XENT_BLOCK_V
 
 # Use the fused kernel only where the dense path's [N, V] materialization
 # actually hurts; small heads fuse fine inside XLA.
@@ -74,12 +60,6 @@ FORCE_FUSED = None
 
 def _use_interpret() -> bool:
     return jax.default_backend() != "tpu"
-
-
-def _block_n(N: int) -> int:
-    from deeplearning4j_tpu.ops.flash_attention import pick_block
-
-    return pick_block(N, BLOCK_N)
 
 
 def supports(n: int, d: int, v: int) -> bool:
@@ -133,11 +113,9 @@ def _fwd_kernel(x_ref, w_ref, b_ref, lab_ref, loss_ref, lse_ref,
         loss_ref[...] = jax.lax.broadcast_in_dim(lse - ll, (bn, LANES), (0,))
 
 
-def _fused_fwd(x, w, b, labels):
+def _fused_fwd(x, w, b, labels, bn, bv):
     N, d = x.shape
     V = w.shape[1]
-    bn = _block_n(N)
-    bv = _block_v(d, V)
     n_chunks = V // bv
     lab2 = labels.astype(jnp.int32).reshape(N, 1)
     b2 = b.reshape(1, V)
@@ -231,12 +209,10 @@ def _dwdb_kernel(x_ref, w_ref, b_ref, lab_ref, lse_ref, g_ref, dw_ref,
         db_ref[...] = db_scr[...].astype(db_ref.dtype)
 
 
-def _fused_bwd(res, dloss):
+def _fused_bwd(bn, bv, res, dloss):
     x, w, b, labels, lse = res
     N, d = x.shape
     V = w.shape[1]
-    bn = _block_n(N)
-    bv = _block_v(d, V)
     n_chunks = V // bv
     n_rows = N // bn
     lab2 = labels.astype(jnp.int32).reshape(N, 1)
@@ -303,14 +279,18 @@ def _fused_bwd(res, dloss):
     return dx, dw, db2[0].astype(b.dtype), dlab
 
 
-@jax.custom_vjp
-def _fused_head(x, w, b, labels):
-    loss, _ = _fused_fwd(x, w, b, labels)
+# block sizes are resolved ONCE in softmax_xent_head (the tuning-table
+# key is the UNPADDED (V, d); re-resolving inside the vjp would look up
+# the padded vocab and could disagree with the padding bv) and ride the
+# custom_vjp as static nondiff args
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5))
+def _fused_head(x, w, b, labels, bn, bv):
+    loss, _ = _fused_fwd(x, w, b, labels, bn, bv)
     return loss
 
 
-def _fused_head_fwd(x, w, b, labels):
-    loss, lse = _fused_fwd(x, w, b, labels)
+def _fused_head_fwd(x, w, b, labels, bn, bv):
+    loss, lse = _fused_fwd(x, w, b, labels, bn, bv)
     return loss, (x, w, b, labels, lse)
 
 
@@ -339,7 +319,10 @@ def softmax_xent_head(x, w, b, labels):
         # zero cotangent so they contribute nothing to dx/dW/db
         xf = jnp.pad(xf, ((0, n_pad - n), (0, 0)))
         lf = jnp.pad(lf, (0, n_pad - n))
-    bv = _block_v(d, V)
+    # blocks resolved once against the UNPADDED vocab (the tuning-table
+    # key), then the vocab padding below is a whole number of bv chunks
+    # by construction
+    bn, bv = autotune.xent_blocks(n_pad, d, V)
     if V % bv:
         # pad the vocab to a whole number of chunks; padded columns get
         # bias NEG_INF so exp() kills them, and their dW/db rows are
@@ -347,5 +330,5 @@ def softmax_xent_head(x, w, b, labels):
         vp = (V + bv - 1) // bv * bv
         w = jnp.pad(w, ((0, 0), (0, vp - V)))
         b = jnp.pad(b, (0, vp - V), constant_values=NEG_INF)
-    loss = _fused_head(xf, w, b, lf)[:n]
+    loss = _fused_head(xf, w, b, lf, bn, bv)[:n]
     return loss.reshape(lead)

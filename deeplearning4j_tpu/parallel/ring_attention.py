@@ -90,10 +90,12 @@ def _ring_flash(q, k, v, *, axis_name: str, causal: bool, hop_chunk=None,
     perm = [(j, (j + 1) % n) for j in range(n)]
     T_global = n * Tl
     ones_km = (jnp.ones((B * H, 1, Tl), jnp.float32) if dropout else None)
-    # hop tiling obeys the NON-causal pair bound: every below-diagonal
-    # hop runs the full (non-causal) tile loop, which unrolls n_tiles^2
-    # kernel calls (ADVICE r5 #1)
-    if hop_chunk or (Tl > MAX_FLASH_T and pick_chunk(Tl, False) > 0):
+    # hop tiling is non-causal for every below-diagonal hop: since r8
+    # those tile loops SCAN their kv tiles (one traced kernel per q
+    # chunk — no n_tiles^2 unroll, ADVICE r5 #1) and the tile length is
+    # D-aware (head dims past 128 use shorter proven tiles)
+    if hop_chunk or (Tl > MAX_FLASH_T and pick_chunk(Tl, False,
+                                                     head_dim=D) > 0):
         def hop_lse(qf, kf, vf, scale, causal_hop, k0):
             if dropout:
                 return chunked_flash_attention_lse(
@@ -119,7 +121,7 @@ def _ring_flash(q, k, v, *, axis_name: str, causal: bool, hop_chunk=None,
         raise ValueError(
             f"ring attention local block Tl={Tl} (head_dim {D}) is "
             f"neither tileable (2-{max_chunks(False)} tiles of "
-            f"{_tiles_str()}, non-causal pair budget) nor within the "
+            f"{_tiles_str(D)}, D-aware scanned kv loop) nor within the "
             f"monolithic kernels' envelope (Tl <= "
             f"{MONOLITHIC_COMPILE_MAX} at head_dim <= 128) — use more "
             "'seq' shards or pad T so the per-shard block is tileable")

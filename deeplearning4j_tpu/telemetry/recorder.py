@@ -24,6 +24,7 @@ Event schema — one JSON object per line, every event carrying
 | `error`  | `where`, `error` (repr), `traceback` (FULL string — never truncated at the source) |
 | `fault`  | fault-injection / elastic-recovery record: `kind` (an injected fault kind from distributed/faults.py or a launcher exit class), `process_id`, `step`, free-form fields — written BEFORE the fault acts, so even a SIGKILL leaves its line |
 | `bucket_plan` | the DP-overlap bucket schedule a net was configured with (parallel/placement.py): `axis`, `n_buckets`, `bucket_bytes`, `mode`, per-bucket `{index, n_leaves, bytes}` — the per-rank collective sequence on the record before any step runs; the bench's per-bucket micro-timings ride `span` events named `bucket_reduce` (`bucket`, `bytes`, `n_leaves`, `seconds`) |
+| `kernel_tune` | one kernel-autotune micro-bench measurement (tools/kerneltune.py): `kernel`, `key` (the ops/autotune.py config key), `params` (the candidate block sizes), `seconds` (per-call wall clock), `role` ("default" / "candidate" / "chosen"), free-form fields — the provenance trail behind every tuning_table.json entry |
 
 The file format is append-only JSONL so concurrent writers (bench runs
 every mode in a subprocess) can share one log: each process appends
@@ -145,6 +146,19 @@ class Recorder:
         (`_write` flushes per line) so the full fault→recovery timeline
         is reconstructable from the JSONL even across SIGKILLs."""
         return self.event("fault", kind=kind, **fields)
+
+    def kernel_tune(self, kernel: str, key: str, params: dict,
+                    seconds: float | None = None, role: str = "candidate",
+                    **fields) -> dict:
+        """A `kernel_tune` event: one micro-bench measurement of a
+        kernel block-size variant (tools/kerneltune.py). The telemetry
+        log is the provenance trail behind tuning_table.json — every
+        candidate's timing survives even if the sweep crashes before
+        writing the table."""
+        if seconds is not None:
+            fields["seconds"] = round(float(seconds), 9)
+        return self.event("kernel_tune", kernel=kernel, key=key,
+                          params=dict(params), role=role, **fields)
 
     def memory(self, **fields) -> dict:
         """Device-memory snapshot: bytes held by live jax arrays plus

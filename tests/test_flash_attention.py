@@ -478,11 +478,11 @@ class TestChunkedFlash:
         # the measured ceiling: MAX_CHUNKS tiles of MAX_FLASH_T
         assert pick_chunk(MAX_CHUNKS * MAX_FLASH_T) == MAX_FLASH_T
 
-    def test_pair_count_bound_non_causal(self):
-        """ADVICE r5 #1: the unroll budget is the PAIR count, so
-        non-causal T gets fewer chunks (n*n pairs vs n*(n+1)/2). The
-        dispatch picks a larger tile (fewer chunks) instead of unrolling
-        n^2 = 256 kernel calls, and rejects what cannot fit."""
+    def test_trace_budget_non_causal(self):
+        """ADVICE r5 #1 closed structurally in r8: non-causal kv tiles
+        run under a lax.scan, so the trace budget is the CHUNK count
+        (one traced kernel per q chunk) — not n*n unrolled calls — and
+        non-causal T reaches the same 16-chunk ceiling as causal."""
         from deeplearning4j_tpu.ops.flash_attention import (
             MAX_CHUNK_PAIRS,
             MAX_CHUNKS,
@@ -491,45 +491,127 @@ class TestChunkedFlash:
             max_chunks,
             pick_chunk,
             supports_chunked,
+            traced_tile_calls,
         )
 
         assert max_chunks(True) == MAX_CHUNKS == 16
-        assert max_chunks(False) == 11  # 121 pairs <= 136 < 144
-        # a T divisible into 16 small tiles picks the LARGER tile
-        # non-causally: 16384 = 16 x 1024 (256 pairs, over budget) but
-        # also 2 x 8192 (4 pairs) — dispatch must choose the latter
+        assert max_chunks(False) == MAX_CHUNKS  # scanned kv loop (r8)
+        # dispatch still prefers FEWER, larger tiles: 16384 = 2 x 8192
         c = pick_chunk(16384, False)
         assert c == MAX_FLASH_T
-        assert chunk_pairs(16384 // c, False) <= MAX_CHUNK_PAIRS
-        # causal 16-chunk ceiling stays; its non-causal twin is rejected
-        # outright (no tile fits 16 chunks in the n*n budget)
+        # the causal 16-chunk ceiling T now has a non-causal twin — the
+        # r7 rejection (n*n = 256 unrolled pairs) is gone
         T_max = MAX_CHUNKS * MAX_FLASH_T
         assert pick_chunk(T_max, True) == MAX_FLASH_T
-        assert pick_chunk(T_max, False) == 0
-        assert supports_chunked((1, 1, T_max, 64), causal=True,
-                                dropout=0.0, mask=None)
-        assert not supports_chunked((1, 1, T_max, 64), causal=False,
+        assert pick_chunk(T_max, False) == MAX_FLASH_T
+        for causal in (True, False):
+            assert supports_chunked((1, 1, T_max, 64), causal=causal,
                                     dropout=0.0, mask=None)
-        # every pick obeys the budget across causal x tileable-T sweeps
+        # every pick keeps the TRACE size inside the budget: causal
+        # unrolls pairs, non-causal traces one kernel per q chunk
         for T in range(16384, 131072 + 1, 4096):
             for causal in (True, False):
                 c = pick_chunk(T, causal)
                 if c:
-                    assert chunk_pairs(T // c, causal) <= MAX_CHUNK_PAIRS
+                    assert traced_tile_calls(T // c, causal) <= \
+                        MAX_CHUNK_PAIRS
+                    if causal:
+                        assert chunk_pairs(T // c, True) <= MAX_CHUNK_PAIRS
+                    else:
+                        assert T // c <= MAX_CHUNKS
 
-    def test_explicit_non_causal_chunk_over_budget_raises(self):
+    def test_non_causal_scan_trace_count(self):
+        """The non-causal jaxpr contains one forward kernel per q chunk
+        (scan body traced once), not n^2: at n = 8 chunks the unrolled
+        loop would trace 64 forward pallas calls."""
         from deeplearning4j_tpu.ops.flash_attention import (
             chunked_flash_attention_lse,
         )
 
+        n = 8
+        q = jax.ShapeDtypeStruct((2, n * 128, 32), jnp.float32)
+        jaxpr = jax.make_jaxpr(lambda q: chunked_flash_attention_lse(
+            q, q, q, 1.0, False, chunk=128))(q)
+        calls = str(jaxpr).count("pallas_call")
+        assert calls <= 2 * n, f"{calls} traced pallas calls at n={n}"
+
+    def test_explicit_non_causal_chunk_budget(self):
+        from deeplearning4j_tpu.ops.flash_attention import (
+            MAX_CHUNKS,
+            chunked_flash_attention_lse,
+        )
+
         q = jnp.zeros((1, 16384, 64), jnp.float32)
-        # 16 non-causal chunks = 256 unrolled pairs: over budget
-        with pytest.raises(ValueError, match="tile pairs"):
+        # 16 non-causal chunks now fit (scanned kv loop, r8)...
+        jax.eval_shape(lambda q: chunked_flash_attention_lse(
+            q, q, q, 1.0, False, chunk=1024), q)
+        # ...but the chunk-count ceiling still binds: 32 chunks raise
+        assert 16384 // 512 > MAX_CHUNKS
+        with pytest.raises(ValueError, match="kernel tiles"):
             jax.eval_shape(lambda q: chunked_flash_attention_lse(
-                q, q, q, 1.0, False, chunk=1024), q)
+                q, q, q, 1.0, False, chunk=512), q)
         # the same chunk count is INSIDE the causal budget (136 pairs)
         jax.eval_shape(lambda q: chunked_flash_attention_lse(
             q, q, q, 1.0, True, chunk=1024), q)
+
+    def test_d_aware_tile_bound(self):
+        """ADVICE r5 #2 closed in r8: D > 128 long-T has a supported
+        chunked tier whose tile length shrinks with head_dim (the
+        backward streams full-tile [T, D] K/V pairs, so the proven
+        envelope is tile * D <= 8192 * 128 elements)."""
+        from deeplearning4j_tpu.ops import autotune
+        from deeplearning4j_tpu.ops.flash_attention import (
+            MAX_FLASH_T,
+            chunked_unsupported_reason,
+            pick_chunk,
+            supports_chunked,
+            supports_monolithic_fallback,
+        )
+
+        assert autotune.max_tile_for_dim(None) == MAX_FLASH_T
+        assert autotune.max_tile_for_dim(64) == MAX_FLASH_T
+        assert autotune.max_tile_for_dim(128) == MAX_FLASH_T
+        assert autotune.max_tile_for_dim(256) == 4096
+        assert autotune.max_tile_for_dim(512) == 2048
+        # D=256 long-T: tiles cap at 4096, so 16384 = 4 x 4096
+        assert pick_chunk(16384, True, head_dim=256) == 4096
+        big_d = (1, 2, 16384, 256)
+        assert supports_chunked(big_d, causal=True, dropout=0.0, mask=None)
+        assert supports_chunked(big_d, causal=False, dropout=0.0,
+                                mask=None)
+        # the monolithic fallback tier stays D <= 128 (measured there)
+        assert not supports_monolithic_fallback(
+            (1, 2, 12288, 256), causal=True, dropout=0.0, mask=None)
+        # ...but the same shape is now CHUNK-supported at D-aware tiles
+        assert supports_chunked((1, 2, 12288, 256), causal=True,
+                                dropout=0.0, mask=None)
+        # what remains unsupported says so with the D-aware bound named
+        msg = chunked_unsupported_reason(25088, dropout=0.0, mask=None,
+                                         causal=True, head_dim=256)
+        assert "caps tiles at 4096" in msg
+
+    def test_d_aware_chunked_executes(self):
+        """A D > 128 config runs the chunked path end to end (values +
+        grad vs the dense reference) — the shape class that had NO
+        supported path before r8, exercised at a scaled-down T."""
+        from deeplearning4j_tpu.ops.flash_attention import (
+            chunked_flash_attention,
+        )
+
+        B, H, T, D = 1, 1, 256, 160  # D > 128, T = 2 tiles of 128
+        rng = np.random.default_rng(11)
+        q, k, v = (jnp.asarray(rng.standard_normal((B, H, T, D)) * 0.3,
+                               jnp.float32) for _ in range(3))
+        o_c = chunked_flash_attention(q, k, v, causal=True, chunk=128)
+        o_d = dot_product_attention(q, k, v, causal=True)
+        np.testing.assert_allclose(np.asarray(o_c), np.asarray(o_d),
+                                   atol=2e-5)
+        g_c = jax.grad(lambda q: jnp.sum(jnp.sin(chunked_flash_attention(
+            q, k, v, causal=True, chunk=128))))(q)
+        g_d = jax.grad(lambda q: jnp.sum(jnp.sin(dot_product_attention(
+            q, k, v, causal=True))))(q)
+        np.testing.assert_allclose(np.asarray(g_c), np.asarray(g_d),
+                                   atol=2e-4)
 
     @pytest.mark.parametrize("causal", [True, False])
     def test_masked_forward_matches_dense(self, causal):

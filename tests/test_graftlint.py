@@ -410,6 +410,34 @@ def reduce_params(params, axis_name):
 def reduce_loss(loss, acts, axis_name):
     return jax.lax.psum(loss, axis_name), jax.lax.pmean(acts, axis_name)
 """),
+    ("G016", """\
+from jax.experimental import pallas as pl
+
+
+def build(kern, x):
+    return pl.pallas_call(
+        kern,
+        grid=(8, 512),
+        in_specs=[pl.BlockSpec((512, 128), lambda i, j: (i, 0))],
+        out_specs=pl.BlockSpec(block_shape=(256, 128),
+                               index_map=lambda i, j: (i, 0)),
+    )(x)
+""", """\
+from jax.experimental import pallas as pl
+from deeplearning4j_tpu.ops import autotune
+
+
+def build(kern, x, T, D):
+    bq, bk = autotune.flash_blocks(T, D, causal=True, dropout=False,
+                                   masked=False)
+    return pl.pallas_call(
+        kern,
+        grid=(T // bq, 8),
+        in_specs=[pl.BlockSpec((bq, 128), lambda i, j: (i, 0)),
+                  pl.BlockSpec((1, 3), lambda i, j: (0, 0))],
+        out_specs=pl.BlockSpec((8, 128), lambda i, j: (i, 0)),
+    )(x)
+"""),
 ]
 
 
@@ -423,7 +451,7 @@ def test_rule_fires_on_positive_not_negative(rule, pos, neg):
 
 def test_every_rule_has_fixture_coverage():
     assert {r for r, _, _ in FIXTURES} == set(RULE_DOCS) == {
-        f"G{i:03d}" for i in range(1, 16)}
+        f"G{i:03d}" for i in range(1, 17)}
 
 
 def test_g015_blessed_sites_are_exempt():
@@ -439,6 +467,32 @@ def test_g015_blessed_sites_are_exempt():
     assert "G015" in rules_in(
         src, "deeplearning4j_tpu/parallel/sequence_parallel.py")
     assert "G015" in rules_in(src)  # the default fixture path
+
+
+def test_g016_tuning_layer_and_scope():
+    """The tuning layer itself is exempt (it IS where block literals
+    live); the module-constant half applies to ops/ kernel files only,
+    and 128 (the hardware lane tile) never flags."""
+    spec = ("from jax.experimental import pallas as pl\n"
+            "def f():\n"
+            "    return pl.BlockSpec((512, 128), lambda i: (i, 0))\n")
+    assert "G016" in rules_in(spec, "deeplearning4j_tpu/ops/x.py")
+    assert "G016" in rules_in(spec)  # BlockSpec half is package-wide
+    assert "G016" not in rules_in(spec,
+                                  "deeplearning4j_tpu/ops/autotune.py")
+    const = "BLOCK_Q_MAX = 512\nCHUNK_TILES = (8192, 4096)\n"
+    assert "G016" in rules_in(const, "deeplearning4j_tpu/ops/x.py")
+    assert "G016" not in rules_in(const,
+                                  "deeplearning4j_tpu/ops/autotune.py")
+    # constants half is scoped to kernel files; non-ops code with a
+    # TILE-named constant (e.g. a plotting grid) stays clean
+    assert "G016" not in rules_in(const,
+                                  "deeplearning4j_tpu/plot/x.py")
+    lane = ("from jax.experimental import pallas as pl\n"
+            "BLOCK = 128\n"
+            "def f(bn):\n"
+            "    return pl.BlockSpec((bn, 128), lambda i: (i, 0))\n")
+    assert "G016" not in rules_in(lane, "deeplearning4j_tpu/ops/x.py")
 
 
 def test_g014_retry_loop_scoped_to_distributed():

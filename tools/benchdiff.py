@@ -12,6 +12,14 @@ whose rows are reconstructed from the gate-carrying summary line) and
 names EVERY changed metric with old/new/delta. Exit codes: 0 no
 regression, 1 regression past threshold, 2 usage error.
 
+Kernel tuning tables (deeplearning4j_tpu/ops/tuning_table.json — both
+files carrying `{"version", "entries"}`) diff entry-wise instead: every
+changed entry is named with its old/new params and best-timing delta%.
+Regressions there are (a) an entry's `best_us` growing past the
+threshold (timings are lower-is-better) and (b) a match-or-beat
+violation — `best_us` exceeding the entry's own `default_us`, which the
+kerneltune harness guarantees never happens in a healthy sweep.
+
 What counts as a regression (all bench metrics are higher-is-better):
 
 * a metric value dropping more than `--threshold` (default 10%), with
@@ -63,6 +71,68 @@ def _num(line, key):
     v = line.get(key)
     return v if isinstance(v, (int, float)) and not isinstance(v, bool) \
         else None
+
+
+# ------------------------------------------------------- tuning tables
+
+def load_tuning_table(path: str) -> dict | None:
+    """The parsed table when `path` is a kerneltune artifact, else
+    None (fall through to the bench-artifact parser)."""
+    try:
+        with open(path) as fh:
+            obj = json.load(fh)
+    except (OSError, ValueError):
+        return None
+    if isinstance(obj, dict) and "version" in obj and \
+            isinstance(obj.get("entries"), dict):
+        return obj
+    return None
+
+
+def _entry_params(entry: dict) -> dict:
+    meta = ("best_us", "default_us", "candidates", "source")
+    return {k: v for k, v in entry.items() if k not in meta}
+
+
+def diff_tables(old: dict, new: dict,
+                threshold: float = DEFAULT_THRESHOLD) -> dict:
+    """Entry-wise tuning-table diff, same result shape as diff() so
+    render()/--json consumers are shared. Timings are lower-is-better:
+    best_us GROWING past the threshold is the regression direction, and
+    a match-or-beat violation (best_us > default_us in NEW) always
+    regresses — kerneltune never writes one."""
+    o_e, n_e = old.get("entries", {}), new.get("entries", {})
+    regressions, changes = [], []
+    added = sorted(k for k in n_e if k not in o_e)
+    removed = sorted(k for k in o_e if k not in n_e)
+    for key in sorted(set(o_e) & set(n_e)):
+        oe, ne = o_e[key], n_e[key]
+        op, np_ = _entry_params(oe), _entry_params(ne)
+        if op != np_:
+            changes.append({"metric": key, "field": "params",
+                            "old": op, "new": np_, "delta_pct": None})
+        o_us, n_us = _num(oe, "best_us"), _num(ne, "best_us")
+        if o_us is not None and n_us is not None and o_us != n_us:
+            delta_pct = round(100.0 * (n_us - o_us) / abs(o_us), 2) \
+                if o_us else None
+            row = {"metric": key, "field": "best_us", "old": o_us,
+                   "new": n_us, "delta_pct": delta_pct}
+            if o_us > 0 and (n_us - o_us) / o_us > threshold:
+                row["reason"] = (f"best_us grew {delta_pct:.1f}% "
+                                 f"(> {100 * threshold:.0f}% allowed — "
+                                 "timings are lower-is-better)")
+                regressions.append(row)
+            else:
+                changes.append(row)
+        n_dflt = _num(ne, "default_us")
+        if n_us is not None and n_dflt is not None and n_us > n_dflt:
+            regressions.append({
+                "metric": key, "field": "best_us", "old": n_dflt,
+                "new": n_us, "delta_pct": None,
+                "reason": f"match-or-beat violated: best_us {n_us} > "
+                          f"default_us {n_dflt}"})
+    return {"regressions": regressions, "changes": changes,
+            "added": added, "removed": removed}
 
 
 def diff(old_lines: dict, new_lines: dict,
@@ -151,14 +221,24 @@ def main(argv=None) -> int:
     ap.add_argument("--json", action="store_true", dest="as_json")
     args = ap.parse_args(argv)
 
-    artifact = _artifact_mod()
-    try:
-        old_lines = artifact.load(args.old)
-        new_lines = artifact.load(args.new)
-    except OSError as exc:
-        print(f"benchdiff: {exc}", file=sys.stderr)
+    old_table = load_tuning_table(args.old)
+    new_table = load_tuning_table(args.new)
+    if old_table is not None and new_table is not None:
+        result = diff_tables(old_table, new_table,
+                             threshold=args.threshold)
+    elif (old_table is None) != (new_table is None):
+        print("benchdiff: cannot diff a tuning table against a bench "
+              "artifact", file=sys.stderr)
         return 2
-    result = diff(old_lines, new_lines, threshold=args.threshold)
+    else:
+        artifact = _artifact_mod()
+        try:
+            old_lines = artifact.load(args.old)
+            new_lines = artifact.load(args.new)
+        except OSError as exc:
+            print(f"benchdiff: {exc}", file=sys.stderr)
+            return 2
+        result = diff(old_lines, new_lines, threshold=args.threshold)
     if args.as_json:
         print(json.dumps(result, indent=1))
     else:

@@ -1252,6 +1252,36 @@ def bench_ringhop() -> None:
         "shape": [BH, Tl, D]})
 
 
+def bench_serving_replay() -> None:
+    """Continuous-batching serving bench (serving/replay.py): replay the
+    seeded mixed-length bursty trace against a freshly warmed engine +
+    HTTP front door, reconstruct p50/p99/QPS from the telemetry
+    `request` events alone, and leave the SERVE artifact next to the
+    BENCH one. Runs identically off-TPU (the tiny-LM forward compiles
+    anywhere); the sweep's skipped-env classification still applies if
+    the environment eats it. Latency lines carry lower_is_better for
+    benchdiff; the round gate is benchdiff vs the previous SERVE
+    artifact, not an anchor."""
+    import tempfile
+
+    from deeplearning4j_tpu.serving.replay import run_replay
+
+    here = os.path.dirname(os.path.abspath(__file__))
+    artifact = os.environ.get(
+        "DL4J_TPU_SERVE_ARTIFACT", os.path.join(here, "SERVE_r01.json"))
+    tpath = os.path.join(tempfile.mkdtemp(prefix="serving_replay_"),
+                         "telemetry.jsonl")
+    scoreboard = run_replay(
+        model="lm", seed=0, n_requests=120, burst=4, mean_gap_s=0.002,
+        lengths=(8, 16, 32), batch_sizes=(1, 2, 4), max_wait_ms=4.0,
+        replicas=2, telemetry_path=tpath, artifact_path=artifact,
+        emit=_emit_info)
+    _emit_info({"metric": "serving_replay_artifact", "path": artifact,
+                "warmed_buckets": scoreboard["warmed_buckets"],
+                "n_ok": scoreboard["n_ok"],
+                "client_failed": scoreboard["client"]["failed"]})
+
+
 MODES = {
     "lenet": bench_lenet,
     "vgg16": bench_vgg16,
@@ -1267,6 +1297,7 @@ MODES = {
     "moe": bench_moe,
     "dropout": bench_transformer_dropout,
     "ringhop": bench_ringhop,
+    "serving_replay": bench_serving_replay,
 }
 
 

@@ -823,6 +823,122 @@ def g016_hardcoded_block_literals(tree, imports, path):
     return out
 
 
+# --------------------------------------------------------------- G017
+
+# Serving hot-path discipline (serving/ only). The continuous-batching
+# contract is: requests are padded into the bucket lattice BEFORE any
+# jitted call (else every novel length is a retrace worth seconds of
+# tail latency), and results come back to host ONCE per batch (else N
+# per-request device syncs serialize the pipeline). Exemptions are
+# named, not inferred: bucket-shape dispatch (argument/function names
+# mentioning bucket/batch/padded/warmup) and the batch-boundary fetch
+# (a sync OUTSIDE a per-request loop).
+_G017_REQUESTISH = re.compile(r"(^|_)(request|req|prompt)s?($|_|\b)",
+                              re.IGNORECASE)
+_G017_BUCKETISH = re.compile(r"bucket|batch|padded|warm", re.IGNORECASE)
+_G017_SYNC_ATTRS = {"item", "block_until_ready"}
+_G017_SYNC_CALLS = {"jax.device_get"}
+
+
+def _g017_name_strings(node: ast.AST):
+    for sub in ast.walk(node):
+        if isinstance(sub, ast.Name):
+            yield sub.id
+        elif isinstance(sub, ast.Attribute):
+            yield sub.attr
+
+
+def _g017_mentions(node: ast.AST, pattern) -> bool:
+    return any(pattern.search(s) for s in _g017_name_strings(node))
+
+
+def _g017_enclosing_fn_name(node: ast.AST) -> str:
+    cur = getattr(node, "parent", None)
+    while cur is not None:
+        if isinstance(cur, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            return cur.name
+        cur = getattr(cur, "parent", None)
+    return ""
+
+
+def g017_serving_hot_path(tree, imports, path):
+    """Serving hot-path rule (serving/ files only), two halves:
+
+    (a) UNBUCKETED JIT ENTRY: a jit-wrapped callable invoked with an
+        argument that mentions a request-ish name (request/req/prompt)
+        and nothing bucket-ish (bucket/batch/padded/warm) — raw request
+        data fed straight into jit compiles one program per novel
+        length. Bucket-shape dispatch is exempt by the name carve-out;
+        so are warmup/bucket-named enclosing functions.
+    (b) PER-REQUEST HOST SYNC: `.item()` / `.block_until_ready()` /
+        `jax.device_get` inside a for-loop that iterates request-ish
+        values — N device round-trips per batch. The batch-boundary
+        fetch (one `np.asarray`/sync per BATCH, outside such loops)
+        never flags."""
+    norm = path.replace("\\", "/")
+    if "/serving/" not in norm:
+        return []
+    out = []
+    # names bound to jit results: `fwd = jax.jit(f)` / `self._jit = ...`
+    jit_bound: set[str] = set()
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Assign) and isinstance(node.value, ast.Call) \
+                and imports.canon(node.value.func) in _JIT_NAMES:
+            for tgt in node.targets:
+                if isinstance(tgt, ast.Name):
+                    jit_bound.add(tgt.id)
+                elif isinstance(tgt, ast.Attribute):
+                    jit_bound.add(tgt.attr)
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        callee = node.func
+        is_jit_entry = (
+            (isinstance(callee, ast.Name) and callee.id in jit_bound)
+            or (isinstance(callee, ast.Attribute)
+                and callee.attr in jit_bound)
+            or (isinstance(callee, ast.Call)
+                and imports.canon(callee.func) in _JIT_NAMES))
+        if not is_jit_entry:
+            continue
+        if _G017_BUCKETISH.search(_g017_enclosing_fn_name(node)):
+            continue
+        for arg in list(node.args) + [kw.value for kw in node.keywords]:
+            if _g017_mentions(arg, _G017_REQUESTISH) \
+                    and not _g017_mentions(arg, _G017_BUCKETISH):
+                out.append(("G017", node,
+                            "unbucketed jit entry: raw request data fed "
+                            "straight into a jitted callable — every "
+                            "novel request shape is a retrace worth "
+                            "seconds of tail latency",
+                            "pad the request into a bucket batch first "
+                            "(serving/batcher.py assemble) and pass the "
+                            "bucketed arrays"))
+                break
+    for loop in ast.walk(tree):
+        if not isinstance(loop, (ast.For, ast.AsyncFor)):
+            continue
+        if not (_g017_mentions(loop.target, _G017_REQUESTISH)
+                or _g017_mentions(loop.iter, _G017_REQUESTISH)):
+            continue
+        for node in ast.walk(loop):
+            if not isinstance(node, ast.Call):
+                continue
+            name = imports.canon(node.func)
+            is_sync = name in _G017_SYNC_CALLS or (
+                isinstance(node.func, ast.Attribute)
+                and node.func.attr in _G017_SYNC_ATTRS)
+            if is_sync:
+                out.append(("G017", node,
+                            "per-request host sync inside a request "
+                            "loop: one device round-trip per request "
+                            "serializes the serving pipeline",
+                            "fetch ONCE per batch (np.asarray on the "
+                            "whole padded output — the batch-boundary "
+                            "fetch) and distribute host-side rows"))
+    return out
+
+
 # stage-3 AST rules (G010-G014) live in spmd_rules.py and register here;
 # the import sits below every helper they borrow lazily, so importing
 # either module first resolves cleanly.
@@ -835,7 +951,8 @@ ALL_RULES = [g001_traced_bool, g002_host_sync, g003_float64_drift,
              g004_rng_discipline, g005_retrace_hazards,
              g006_shard_map_arity, g007_compat_bypass, g008_import_time,
              g009_rendezvous_routing,
-             g016_hardcoded_block_literals] + SPMD_RULES
+             g016_hardcoded_block_literals,
+             g017_serving_hot_path] + SPMD_RULES
 
 RULE_DOCS = {
     "G001": "python control flow / bool()/float()/int() on traced values",
@@ -850,6 +967,9 @@ RULE_DOCS = {
             "distributed/bootstrap.py",
     "G016": "Pallas block-size/grid literals hardcoded outside the "
             "tuning layer (ops/autotune.py)",
+    "G017": "serving hot-path discipline: unbucketed jit entries and "
+            "per-request host syncs in serving/ (bucket dispatch and "
+            "the batch-boundary fetch are exempt)",
     **SPMD_RULE_DOCS,
 }
 

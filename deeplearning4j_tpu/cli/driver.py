@@ -11,6 +11,15 @@ Usage:
     python -m deeplearning4j_tpu.cli predict --model out.zip --input d.csv \
         --output preds.csv
 
+Serving (the continuous-batching inference server, serving/):
+
+    python -m deeplearning4j_tpu.cli serve --model out.zip --port 9090 \
+        --buckets 1,2,4,8 --max-wait-ms 5 [--replicas 2]
+    python -m deeplearning4j_tpu.cli serve --conf conf.json \
+        --checkpoint ckpt_dir ...        # resume a trained checkpoint
+    python -m deeplearning4j_tpu.cli predict --server http://host:9090 \
+        --input d.csv --output preds.csv # rows ride the server's batcher
+
 Distributed runtimes (reference Train.java `-runtime local|spark|hadoop`
 + cli-spark/SparkTrain.java; here the TPU-native equivalents):
 
@@ -109,7 +118,55 @@ def _build_parser() -> argparse.ArgumentParser:
     pr = sub.add_parser("predict", help="write predictions for a dataset")
     pr.add_argument("--output", "-o", required=True,
                     help="predictions output CSV")
-    common(pr)
+    pr.add_argument("--server", default=None, metavar="URL",
+                    help="POST rows to a running `serve` instance "
+                         "(http://host:port) instead of loading the "
+                         "model in-process — rows ride the server's "
+                         "continuous batcher")
+    common(pr, model_required=False)
+
+    sv = sub.add_parser(
+        "serve", help="continuous-batching inference server "
+                      "(serving/: bucket lattice + dynamic batching + "
+                      "replica dispatch over HTTP)")
+    sv.add_argument("--port", type=int, default=9090)
+    sv.add_argument("--host", default="127.0.0.1")
+    sv.add_argument("--model", "-m", default=None,
+                    help="model zip to serve (ModelSerializer format)")
+    sv.add_argument("--conf", "-c", default=None,
+                    help="model configuration JSON (with --checkpoint: "
+                         "build the net, then resume its params)")
+    sv.add_argument("--type", choices=["multi_layer_network",
+                                       "computation_graph"],
+                    default="multi_layer_network")
+    sv.add_argument("--checkpoint", default=None,
+                    help="Orbax host-checkpoint dir to resume from at "
+                         "startup (train on one fleet, serve here — the "
+                         "PR 6 portable-restore path)")
+    sv.add_argument("--buckets", default="1,2,4,8",
+                    help="padding-bucket lattice: batch sizes "
+                         "('1,2,4,8') or explicit BxT pairs "
+                         "('1x64,4x64,4x256') for sequence models")
+    sv.add_argument("--max-wait-ms", type=float, default=5.0,
+                    help="batcher deadline: the longest a request waits "
+                         "for coalescing before its batch cuts")
+    sv.add_argument("--replicas", type=int, default=1,
+                    help="jitted forward workers (round-robin dispatch)")
+    sv.add_argument("--sequence", action="store_true",
+                    help="requests are variable-length sequences (padded "
+                         "to seq buckets with a validity mask)")
+    sv.add_argument("--warmup-features", default=None,
+                    help="example request row (comma floats, or ints for "
+                         "token models) to warm every bucket before "
+                         "traffic; required for the zero-retrace promise")
+    sv.add_argument("--multiprocess", type=int, default=None, metavar="N",
+                    help="dry run: print the N-process serving fleet "
+                         "plan (one engine per process on the "
+                         "distributed runtime's env contract, ports "
+                         "--port..--port+N-1) and exit")
+    sv.add_argument("--local-devices", type=int, default=4,
+                    help="virtual CPU devices per process in the "
+                         "--multiprocess plan (default 4)")
     return p
 
 
@@ -397,6 +454,140 @@ def _cmd_coordinator(args) -> int:
     return 0
 
 
+def _serve_multiprocess_plan(args) -> int:
+    """`serve --multiprocess N` dry run: one serving process per rank on
+    the distributed runtime's env contract (per-process telemetry
+    suffixes ride it), each behind its own port — the serving twin of
+    train's fleet plan. A front-end balances over the printed ports."""
+    from deeplearning4j_tpu.distributed.launcher import (free_port,
+                                                         launch_plan)
+
+    base = [sys.executable, "-m", "deeplearning4j_tpu.cli"]
+    scrubbed = _scrub_multiprocess_argv(args._raw_argv)
+    # each rank serves its own port: strip any --port from the shared
+    # argv and append the per-rank one
+    core = []
+    skip = False
+    for tok in scrubbed:
+        if skip:
+            skip = False
+            continue
+        if tok == "--port":
+            skip = True
+            continue
+        if tok.startswith("--port="):
+            continue
+        core.append(tok)
+    coordinator = f"127.0.0.1:{free_port()}"
+    print(f"# {args.multiprocess}-process serving fleet "
+          f"(ports {args.port}..{args.port + args.multiprocess - 1}); "
+          "run these lines from the repo root:")
+    lines = []
+    for i in range(args.multiprocess):
+        plan = launch_plan(base + core + ["--port", str(args.port + i)],
+                           args.multiprocess,
+                           local_device_count=args.local_devices,
+                           coordinator=coordinator)
+        lines.append(plan[i])
+    for line in lines + ["wait"]:
+        print(line)
+    return 0
+
+
+def _parse_warmup_features(spec: str, sequence: bool):
+    vals = [v.strip() for v in spec.split(",") if v.strip()]
+    try:
+        return np.asarray([int(v) for v in vals],
+                          np.int32 if sequence else np.float32)
+    except ValueError:
+        return np.asarray([float(v) for v in vals], np.float32)
+
+
+def _cmd_serve(args) -> int:
+    from deeplearning4j_tpu.serving import (BucketLattice, InferenceEngine,
+                                            ServingServer)
+    from deeplearning4j_tpu.util.model_serializer import ModelSerializer
+
+    if args.multiprocess:
+        return _serve_multiprocess_plan(args)
+    if bool(args.model) == bool(args.conf):
+        raise SystemExit("serve needs exactly one of --model (a trained "
+                         "zip) or --conf (a config JSON, optionally with "
+                         "--checkpoint to resume params)")
+    # fleet member (a printed --multiprocess plan line): bring up the
+    # rendezvous contract so the per-process telemetry suffix and any
+    # process-spanning placement are in effect before compiles
+    from deeplearning4j_tpu.distributed import bootstrap
+
+    if bootstrap.env_contract_present():
+        bootstrap.initialize()
+    if args.model:
+        net = _load_model(args.model)
+    else:
+        from deeplearning4j_tpu.nn.conf.graph_conf import (
+            ComputationGraphConfiguration,
+        )
+        from deeplearning4j_tpu.nn.conf.neural_net_configuration import (
+            MultiLayerConfiguration,
+        )
+        from deeplearning4j_tpu.nn.graph import ComputationGraph
+        from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+
+        with open(_fetch_input(args.conf)) as f:
+            conf_json = f.read()
+        if args.type == "computation_graph":
+            net = ComputationGraph(
+                ComputationGraphConfiguration.from_json(conf_json))
+        else:
+            net = MultiLayerNetwork(
+                MultiLayerConfiguration.from_json(conf_json))
+        net.init()
+    lattice = BucketLattice.from_spec(args.buckets)
+    engine = InferenceEngine(net, lattice, replicas=args.replicas,
+                             max_wait_ms=args.max_wait_ms,
+                             sequence=args.sequence,
+                             checkpoint=args.checkpoint)
+    if args.warmup_features:
+        n = engine.warmup(_parse_warmup_features(args.warmup_features,
+                                                 args.sequence))
+        print(f"warmed {n} bucket shapes")
+    server = ServingServer(engine, port=args.port, host=args.host).start()
+    print(f"serving on {server.url} "
+          f"(replicas={args.replicas}, buckets={args.buckets}, "
+          f"max-wait={args.max_wait_ms}ms)", flush=True)
+    try:
+        import threading
+
+        threading.Event().wait()
+    except KeyboardInterrupt:
+        print("draining...", flush=True)
+        server.stop()
+    return 0
+
+
+def _predict_via_server(args, feats) -> "np.ndarray":
+    """POST each row to a running `serve` instance; concurrent requests
+    let the server's batcher coalesce them (order restored by index)."""
+    import concurrent.futures
+    import json as _json
+    import urllib.request
+
+    url = args.server.rstrip("/")
+
+    def one(i):
+        body = _json.dumps({"features": np.asarray(feats[i]).tolist(),
+                            "id": f"cli-{i}"}).encode()
+        req = urllib.request.Request(
+            f"{url}/predict", data=body,
+            headers={"Content-Type": "application/json"})
+        with urllib.request.urlopen(req, timeout=120) as resp:
+            return _json.loads(resp.read())["output"]
+
+    with concurrent.futures.ThreadPoolExecutor(8) as pool:
+        rows = list(pool.map(one, range(len(feats))))
+    return np.asarray(rows, np.float32)
+
+
 def _cmd_test(args) -> int:
     net = _load_model(args.model)
     it = _make_iterator(args)
@@ -411,7 +602,10 @@ def _cmd_predict(args) -> int:
         SVMLightRecordReader,
     )
 
-    net = _load_model(args.model)
+    if bool(args.model) == bool(args.server):
+        raise SystemExit("predict needs exactly one of --model (load "
+                         "in-process) or --server URL (a running `serve` "
+                         "instance)")
     # prediction input has no label column: every CSV value is a feature
     # (svmlight rows still carry a label field; it is ignored)
     if args.format == "svmlight":
@@ -423,10 +617,14 @@ def _cmd_predict(args) -> int:
         feats = [np.asarray([float(v) for v in rec], np.float32)
                  for rec in CSVRecordReader(args.input)]
     x = np.stack(feats)
-    rows = []
-    for s in range(0, len(x), args.batch):
-        rows.append(np.asarray(net.output(x[s:s + args.batch])))
-    preds = np.concatenate(rows)
+    if args.server:
+        preds = _predict_via_server(args, x)
+    else:
+        net = _load_model(args.model)
+        rows = []
+        for s in range(0, len(x), args.batch):
+            rows.append(np.asarray(net.output(x[s:s + args.batch])))
+        preds = np.concatenate(rows)
     with open(args.output, "w") as f:
         for row in preds:
             f.write(",".join(f"{v:.8g}" for v in np.atleast_1d(row)) + "\n")
@@ -439,7 +637,7 @@ def main(argv=None) -> int:
     # the tokens behind this parse — what a --multiprocess plan re-emits
     args._raw_argv = list(sys.argv[1:] if argv is None else argv)
     return {"train": _cmd_train, "test": _cmd_test,
-            "predict": _cmd_predict,
+            "predict": _cmd_predict, "serve": _cmd_serve,
             "coordinator": _cmd_coordinator}[args.command](args)
 
 

@@ -726,6 +726,29 @@ class ComputationGraph:
             return [np.asarray(jnp.argmax(o, axis=-1)) for o in out]
         return np.asarray(jnp.argmax(out, axis=-1))
 
+    def inference_fn(self):
+        """A pure ``(params, state, x, mask=None) -> y`` inference-mode
+        forward for external jit owners (the serving engine) — the DAG
+        twin of MultiLayerNetwork.inference_fn. Serving dispatches on
+        ONE padded input/output pair, so multi-input/multi-output graphs
+        are rejected here rather than silently dropping streams."""
+        ins = self.conf.network_inputs
+        outs = self.conf.network_outputs
+        if len(ins) != 1 or len(outs) != 1:
+            raise ValueError(
+                f"serving needs a single-input/single-output graph; this "
+                f"one has inputs {list(ins)} and outputs {list(outs)}")
+        name = ins[0]
+
+        def fwd(params, state, x, mask=None):
+            if getattr(self, "_pp_plan", None) is not None:
+                params = self._pp_plan.to_canonical(params)
+            masks = {} if mask is None else {name: mask}
+            ys, _, _ = self._forward(params, state, {name: x},
+                                     train=False, rng=None, masks=masks)
+            return ys[0]
+        return fwd
+
     def score(self, ds=None, training: bool = False):
         if ds is None:
             return self.score_value

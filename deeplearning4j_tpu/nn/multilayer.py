@@ -557,6 +557,20 @@ class MultiLayerNetwork:
         """Class indices (reference predict)."""
         return np.asarray(jnp.argmax(self.output(x), axis=-1))
 
+    def inference_fn(self):
+        """A pure ``(params, state, x, mask=None) -> y`` inference-mode
+        forward for external jit owners — the serving engine
+        (serving/engine.py) wraps this per replica so IT controls the
+        compile cache (one trace per padding bucket, zero retraces after
+        warmup), which `output()`'s internal jit cannot promise. No rng,
+        no state mutation: inference forwards are row-independent, the
+        property the serving padding proof relies on."""
+        def fwd(params, state, x, mask=None):
+            y, _, _ = self._forward(params, state, x, train=False,
+                                    rng=None, mask=mask)
+            return y
+        return fwd
+
     def score(self, dataset: DataSet = None, training: bool = False):
         """Loss on a dataset (reference score()). training=False uses
         inference-mode forward (BatchNorm running stats, no dropout)."""

@@ -1413,6 +1413,24 @@ def supports_monolithic_fallback(q_shape, *, causal, dropout, mask) -> bool:
             and D <= 128)
 
 
+def servable_seq(T: int, head_dim: int, *, causal: bool = True,
+                 dropout: bool = False, mask: bool = True) -> bool:
+    """Whether a [*, H, T, head_dim] attention shape has SOME compilable
+    path — the envelope the serving bucket lattice validates against
+    (serving/buckets.py) before warmup freezes its shapes. T at or below
+    MAX_FLASH_T always compiles (fused kernels where the shape
+    qualifies, the dense einsum fallback otherwise); beyond it the shape
+    must fit the chunked tier or the monolithic-fallback tier, else the
+    attention layer raises chunked_unsupported_reason mid-traffic."""
+    if T <= MAX_FLASH_T:
+        return True
+    shape = (1, 1, T, head_dim)
+    return (supports_chunked(shape, causal=causal, dropout=dropout,
+                             mask=mask)
+            or supports_monolithic_fallback(shape, causal=causal,
+                                            dropout=dropout, mask=mask))
+
+
 def chunked_unsupported_reason(T, *, dropout, mask, causal=True,
                                head_dim=None) -> str:
     """Why a long-T shape has no fused path — raised by the attention

@@ -1,0 +1,41 @@
+"""Continuous-batching inference serving (ROADMAP "the million-user
+path"): an async HTTP front-end over the jitted forward path.
+
+The reference ships a REST UI and a CLI `predict` that loads the model
+in-process (SURVEY L9/L10); this package is the high-throughput serving
+story neither provides:
+
+* `buckets.py`  — the padding-bucket lattice: a FIXED batch x seq shape
+  grid every request is padded into, so the jitted forward never
+  retraces after warmup (validated against the ops/ attention dispatch
+  for long prompts).
+* `batcher.py`  — dynamic batching: single requests coalesce into
+  bucket-shaped batches under a max-wait deadline (injectable clock —
+  the planner is a pure function, testable without sleeps).
+* `engine.py`   — replica dispatch: one jitted forward worker per
+  replica, round-robin batch assignment, checkpoint resume at startup,
+  graceful drain on shutdown, zero-retrace accounting.
+* `server.py`   — the stdlib ThreadingHTTPServer front door
+  (`POST /predict`), same lifecycle idiom as `ui/server.py`.
+* `replay.py`   — the traffic-replay bench: a seeded mixed-length /
+  bursty trace, with p50/p99/QPS reconstructed from telemetry
+  `request` events ALONE (tools/trafficreplay.py is the CLI).
+
+Imports stay lazy/stdlib at package level so the graftlint AST stage's
+no-jax stubs can walk the files.
+"""
+
+from deeplearning4j_tpu.serving.batcher import Batcher, PendingRequest, plan_batch
+from deeplearning4j_tpu.serving.buckets import Bucket, BucketLattice
+from deeplearning4j_tpu.serving.engine import InferenceEngine
+from deeplearning4j_tpu.serving.server import ServingServer
+
+__all__ = [
+    "Batcher",
+    "Bucket",
+    "BucketLattice",
+    "InferenceEngine",
+    "PendingRequest",
+    "ServingServer",
+    "plan_batch",
+]

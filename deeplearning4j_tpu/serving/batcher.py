@@ -1,0 +1,265 @@
+"""Dynamic batching: single requests coalesce into bucket-shaped
+batches under a max-wait deadline.
+
+The state machine (documented in ARCHITECTURE §Serving):
+
+    submit() appends a PendingRequest to a FIFO ->
+    the dispatcher blocks in next_batch() ->
+      CUT a batch when the compatible FIFO prefix fills the largest
+      batch bucket, OR when the OLDEST pending request has waited
+      max_wait (latency bound beats batch efficiency), OR on drain
+      (close() flushes leftovers) ->
+    assemble() pads the group into its lattice bucket (zero padding +
+    a validity mask) and hands a Batch to the engine.
+
+`plan_batch` — the cut decision — is a pure function of (pending, now),
+so the deadline/coalescing logic is unit-tested with a fake clock and
+no real sleeps; the Batcher wraps it in a condition variable for the
+live threaded path. Assembly is host-side numpy only: the device never
+sees a per-request array, just the padded bucket batch.
+"""
+
+from __future__ import annotations
+
+import itertools
+import threading
+import time
+from collections import deque
+from dataclasses import dataclass, field
+
+import numpy as np
+
+from deeplearning4j_tpu.serving.buckets import Bucket, BucketLattice
+
+_req_counter = itertools.count()
+
+
+@dataclass
+class PendingRequest:
+    """One admitted request: the raw (unpadded) features, timing marks,
+    and the completion event the front-end blocks on."""
+
+    features: np.ndarray
+    mask: np.ndarray | None = None
+    request_id: str = ""
+    t_enqueue: float = 0.0
+    # filled by the engine on completion
+    t_assembled: float = 0.0
+    t_done: float = 0.0
+    result: np.ndarray | None = None
+    error: str | None = None
+    done: threading.Event = field(default_factory=threading.Event)
+
+    def wait(self, timeout: float | None = None) -> bool:
+        return self.done.wait(timeout)
+
+    @property
+    def length(self) -> int:
+        """Time length for sequence requests (first axis)."""
+        return int(self.features.shape[0])
+
+
+@dataclass
+class Batch:
+    """One assembled bucket batch: padded arrays plus the requests whose
+    rows they carry (row i of `features` is requests[i] for i < n_real;
+    rows beyond are padding and are sliced off after the forward)."""
+
+    bucket: Bucket
+    features: np.ndarray
+    mask: np.ndarray | None
+    requests: list
+    t_cut: float = 0.0
+    assemble_seconds: float = 0.0
+
+    @property
+    def n_real(self) -> int:
+        return len(self.requests)
+
+
+def _compatible(a: PendingRequest, b: PendingRequest,
+                sequence: bool) -> bool:
+    """Whether two requests can share a batch: same dtype and same
+    trailing feature dims (sequence models may differ in length — the
+    first axis — which padding absorbs; fixed-shape models must match
+    exactly)."""
+    if a.features.dtype != b.features.dtype:
+        return False
+    if sequence:
+        return a.features.shape[1:] == b.features.shape[1:]
+    return a.features.shape == b.features.shape
+
+
+def plan_batch(pending, now: float, max_wait_s: float,
+               lattice: BucketLattice, *, sequence: bool = False,
+               closed: bool = False) -> int:
+    """The cut decision — how many requests to take off the head of the
+    FIFO right now (0 = keep waiting). Pure function of its arguments so
+    the deadline/coalescing logic tests with a fake clock.
+
+    Cuts happen when (in priority order):
+      1. the compatible FIFO prefix fills the LARGEST batch bucket
+         (a full batch never waits);
+      2. the oldest pending request has waited `max_wait_s` — the
+         latency deadline beats batch efficiency;
+      3. the batcher is draining (`closed`): flush what's there.
+    """
+    if not pending:
+        return 0
+    head = pending[0]
+    take = 1
+    for req in itertools.islice(pending, 1, None):
+        if take >= lattice.max_batch:
+            break
+        if not _compatible(head, req, sequence):
+            break  # FIFO order preserved: an incompatible request ends
+            # the group rather than being skipped over
+        take += 1
+    if take >= lattice.max_batch:
+        return lattice.max_batch
+    if closed:
+        return take
+    if now - head.t_enqueue >= max_wait_s:
+        return take
+    return 0
+
+
+def assemble(requests: list, lattice: BucketLattice, *,
+             sequence: bool = False) -> Batch:
+    """Pad a compatible group into its bucket: zero padding on the batch
+    axis (rows sliced off after the forward — inference-mode forwards
+    are row-independent, proven at atol 0 in tier-1) and, for sequence
+    models, zero padding on the time axis with a [B, T] validity mask
+    (1 = real token) so masked attention never reads a padded key."""
+    if not requests:
+        raise ValueError("cannot assemble an empty batch")
+    n = len(requests)
+    if sequence:
+        max_len = max(r.length for r in requests)
+        bucket = lattice.select(n, max_len)
+        feat0 = requests[0].features
+        shape = (bucket.batch, bucket.seq) + feat0.shape[1:]
+        features = np.zeros(shape, dtype=feat0.dtype)
+        mask = np.zeros((bucket.batch, bucket.seq), dtype=np.float32)
+        for i, r in enumerate(requests):
+            features[i, :r.length] = r.features
+            if r.mask is not None:
+                mask[i, :r.length] = np.asarray(r.mask, np.float32)
+            else:
+                mask[i, :r.length] = 1.0
+        # padding ROWS keep an all-zero mask: a fully-masked row is a
+        # valid (if degenerate) sequence and its output is discarded
+        return Batch(bucket, features, mask, list(requests))
+    bucket = lattice.select(n, None)
+    feat0 = requests[0].features
+    features = np.zeros((bucket.batch,) + feat0.shape, dtype=feat0.dtype)
+    for i, r in enumerate(requests):
+        features[i] = r.features
+    return Batch(bucket, features, None, list(requests))
+
+
+class Batcher:
+    """The live threaded coalescer around `plan_batch`/`assemble`.
+
+    One producer side (`submit`, called from HTTP handler threads) and
+    one consumer side (`next_batch`, called by the engine's dispatcher).
+    `clock` is injectable for tests; the default is time.monotonic."""
+
+    def __init__(self, lattice: BucketLattice, max_wait_ms: float = 5.0,
+                 *, sequence: bool = False, clock=time.monotonic,
+                 recorder=None):
+        self.lattice = lattice
+        self.max_wait_s = float(max_wait_ms) / 1000.0
+        self.sequence = sequence
+        self._clock = clock
+        self._recorder = recorder
+        self._pending: deque[PendingRequest] = deque()
+        self._cv = threading.Condition()
+        self._closed = False
+
+    # ------------------------------------------------------------ producer
+    def submit(self, features, mask=None,
+               request_id: str | None = None) -> PendingRequest:
+        """Admit one request. Validates the shape against the lattice
+        up front (a too-long prompt is the CLIENT's 400, not a retrace
+        or a mid-batch crash) and wakes the dispatcher."""
+        feats = np.asarray(features)
+        if self.sequence:
+            if feats.ndim < 1:
+                raise ValueError("sequence request needs at least a "
+                                 "[T] feature array")
+            self.lattice.seq_bucket(int(feats.shape[0]))  # raises if too long
+        req = PendingRequest(
+            features=feats,
+            mask=None if mask is None else np.asarray(mask),
+            request_id=request_id or f"r{next(_req_counter)}",
+            t_enqueue=self._clock())
+        with self._cv:
+            if self._closed:
+                raise RuntimeError("batcher is draining; request refused")
+            self._pending.append(req)
+            self._cv.notify_all()
+        return req
+
+    # ------------------------------------------------------------ consumer
+    def next_batch(self, timeout: float | None = None):
+        """Block until a batch cuts (full bucket / deadline / drain
+        flush). Returns None when draining finished (closed and empty)
+        or `timeout` elapsed with nothing to cut."""
+        deadline = None if timeout is None else self._clock() + timeout
+        with self._cv:
+            while True:
+                now = self._clock()
+                take = plan_batch(self._pending, now, self.max_wait_s,
+                                  self.lattice, sequence=self.sequence,
+                                  closed=self._closed)
+                if take:
+                    group = [self._pending.popleft() for _ in range(take)]
+                    break
+                if self._closed:
+                    return None
+                waits = []
+                if self._pending:
+                    waits.append(self._pending[0].t_enqueue
+                                 + self.max_wait_s - now)
+                if deadline is not None:
+                    remaining = deadline - now
+                    if remaining <= 0:
+                        return None
+                    waits.append(remaining)
+                # bounded wait: re-plan on submit()/close() notify or when
+                # the head request's deadline arrives
+                self._cv.wait(timeout=max(min(waits), 0.0005)
+                              if waits else None)
+        t0 = time.perf_counter()
+        batch = assemble(group, self.lattice, sequence=self.sequence)
+        batch.t_cut = self._clock()
+        batch.assemble_seconds = time.perf_counter() - t0
+        for r in group:
+            r.t_assembled = batch.t_cut
+        if self._recorder is not None:
+            # span names documented in telemetry/recorder.py: `queue` is
+            # the head request's wait (the latency the deadline bounds),
+            # `batch_assemble` the host-side padding cost
+            self._recorder.event(
+                "span", name="queue", ok=True,
+                seconds=round(batch.t_cut - group[0].t_enqueue, 6),
+                n_requests=len(group))
+            self._recorder.event(
+                "span", name="batch_assemble", ok=True,
+                seconds=round(batch.assemble_seconds, 6),
+                bucket=list(batch.bucket.key()), n_real=batch.n_real)
+        return batch
+
+    # ------------------------------------------------------------- drain
+    def close(self) -> None:
+        """Begin draining: refuse new submits, flush pending groups on
+        the next next_batch() calls (which return None once empty)."""
+        with self._cv:
+            self._closed = True
+            self._cv.notify_all()
+
+    @property
+    def depth(self) -> int:
+        with self._cv:
+            return len(self._pending)

@@ -1,0 +1,171 @@
+"""The padding-bucket lattice — the shape contract between the batcher
+and the jitted forward workers.
+
+XLA compiles one program per input shape, so a serving path that feeds
+raw request shapes into jit retraces on every new (batch, length) pair —
+at mixed-length traffic that is a compile per request class, each worth
+seconds of latency. The lattice fixes a small grid of (batch, seq)
+shapes up front; every assembled batch is padded UP to the smallest
+bucket that fits, the engine warms each bucket once, and after warmup
+the compile count is provably frozen (tier-1 asserts zero retraces over
+a replayed mixed-length trace).
+
+Selection is a pure function of the request shapes (no clock, no
+state), so bucket choice is deterministic and the batcher's planning is
+unit-testable. Long-prompt buckets are validated against the ops/
+attention dispatch envelope (`flash_attention.servable_seq`) at lattice
+construction — a seq bucket the chunked flash path cannot tile fails at
+startup with the dispatch's own reason string, not mid-traffic.
+
+Pure stdlib: importable under the graftlint AST stage's no-jax stubs.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+
+@dataclass(frozen=True)
+class Bucket:
+    """One lattice point: the padded batch size and (for sequence
+    models) the padded time length. `seq is None` means the model takes
+    fixed-shape features and only the batch dimension is bucketed."""
+
+    batch: int
+    seq: int | None = None
+
+    def key(self) -> tuple:
+        return (self.batch, self.seq)
+
+
+class BucketLattice:
+    """The fixed (batch, seq) grid. `batch_sizes` sorted ascending;
+    `seq_lens` is None for fixed-shape (non-sequence) models."""
+
+    def __init__(self, batch_sizes=(1, 2, 4, 8), seq_lens=None):
+        sizes = sorted({int(b) for b in batch_sizes})
+        if not sizes or sizes[0] < 1:
+            raise ValueError(f"batch sizes must be >= 1, got {batch_sizes}")
+        self.batch_sizes = tuple(sizes)
+        self.seq_lens = None
+        if seq_lens is not None:
+            lens = sorted({int(t) for t in seq_lens})
+            if not lens or lens[0] < 1:
+                raise ValueError(f"seq lens must be >= 1, got {seq_lens}")
+            self.seq_lens = tuple(lens)
+
+    # ------------------------------------------------------- construction
+    @classmethod
+    def from_spec(cls, spec: str) -> "BucketLattice":
+        """Parse a CLI `--buckets` spec. Two grammars:
+
+        * ``"1,2,4,8"``          — batch sizes only (fixed-shape model)
+        * ``"1x64,4x64,4x256"``  — explicit BxT pairs; the lattice is the
+          cross product of the batch sizes and seq lens named.
+        """
+        entries = [e.strip() for e in spec.split(",") if e.strip()]
+        if not entries:
+            raise ValueError(f"empty bucket spec {spec!r}")
+        if any("x" in e for e in entries):
+            if not all("x" in e for e in entries):
+                raise ValueError(
+                    f"bucket spec {spec!r} mixes BxT pairs with bare batch "
+                    "sizes; use one grammar")
+            batches, seqs = [], []
+            for e in entries:
+                b, _, t = e.partition("x")
+                batches.append(int(b))
+                seqs.append(int(t))
+            return cls(batch_sizes=batches, seq_lens=seqs)
+        return cls(batch_sizes=[int(e) for e in entries])
+
+    # --------------------------------------------------------- selection
+    @property
+    def max_batch(self) -> int:
+        return self.batch_sizes[-1]
+
+    @property
+    def max_seq(self) -> int | None:
+        return None if self.seq_lens is None else self.seq_lens[-1]
+
+    def batch_bucket(self, n: int) -> int:
+        """Smallest lattice batch size >= n (n never exceeds max_batch:
+        the batcher caps a cut at max_batch)."""
+        if n > self.max_batch:
+            raise ValueError(f"batch {n} exceeds lattice max "
+                             f"{self.max_batch}")
+        for b in self.batch_sizes:
+            if b >= n:
+                return b
+        raise AssertionError  # unreachable: guarded above
+
+    def seq_bucket(self, t: int) -> int:
+        """Smallest lattice seq len >= t; a prompt longer than the
+        lattice max is a client error (HTTP 400), not a retrace."""
+        if self.seq_lens is None:
+            raise ValueError("lattice has no seq dimension (fixed-shape "
+                             "model); construct with seq_lens to serve "
+                             "sequences")
+        if t > self.seq_lens[-1]:
+            raise ValueError(f"sequence length {t} exceeds lattice max "
+                             f"{self.seq_lens[-1]}")
+        for s in self.seq_lens:
+            if s >= t:
+                return s
+        raise AssertionError  # unreachable: guarded above
+
+    def select(self, n_requests: int, max_len: int | None = None) -> Bucket:
+        """The bucket for a group of `n_requests` whose longest sequence
+        is `max_len` (None for fixed-shape models). Deterministic: a
+        pure function of the two scalars."""
+        seq = None
+        if self.seq_lens is not None:
+            if max_len is None:
+                raise ValueError("sequence lattice needs the group's "
+                                 "max length")
+            seq = self.seq_bucket(max_len)
+        return Bucket(self.batch_bucket(n_requests), seq)
+
+    def shapes(self) -> list[Bucket]:
+        """Every lattice point — the warmup set. One compile per entry;
+        after warmup the engine's trace count must not move."""
+        if self.seq_lens is None:
+            return [Bucket(b) for b in self.batch_sizes]
+        return [Bucket(b, s) for b in self.batch_sizes
+                for s in self.seq_lens]
+
+    # -------------------------------------------------------- validation
+    def validate_attention(self, head_dim: int, *, causal: bool = True,
+                           dropout: bool = False,
+                           masked: bool = True) -> None:
+        """Check every seq bucket against the ops/ attention dispatch
+        envelope so a long-prompt bucket the chunked flash path cannot
+        tile fails at server startup (with the dispatch's own reason)
+        instead of erroring mid-traffic. No-op for fixed-shape lattices
+        and a no-op import-wise until called (keeps this module
+        stdlib-only for the lint stubs)."""
+        if self.seq_lens is None:
+            return
+        from deeplearning4j_tpu.ops import flash_attention as fa
+
+        for t in self.seq_lens:
+            if not fa.servable_seq(t, head_dim, causal=causal,
+                                   dropout=dropout, mask=masked):
+                raise ValueError(
+                    f"seq bucket {t} is outside the attention dispatch "
+                    "envelope: "
+                    + fa.chunked_unsupported_reason(
+                        t, dropout=dropout, mask=masked, causal=causal,
+                        head_dim=head_dim))
+
+    def describe(self) -> dict:
+        """JSON-able summary for /healthz and telemetry meta."""
+        return {"batch_sizes": list(self.batch_sizes),
+                "seq_lens": (None if self.seq_lens is None
+                             else list(self.seq_lens))}
+
+
+# The default serving lattice: powers of two up to batch 8; sequence
+# models get their lattice from the CLI / engine config instead (seq
+# grids are model-dependent).
+DEFAULT_BATCH_SIZES = (1, 2, 4, 8)

@@ -1,0 +1,307 @@
+"""Traffic replay — the serving bench core behind tools/trafficreplay.py
+and bench.py's `serving_replay` mode.
+
+Three pieces, each usable alone:
+
+* `make_trace`  — a SEEDED mixed-length, bursty request trace: arrivals
+  come in bursts (every `burst`-th request opens a new exponential gap;
+  the burst shares its instant), lengths draw from a weighted set. Same
+  seed -> byte-identical trace, so two rounds replay the same traffic.
+* `replay_http` — drives a running ServingServer over real HTTP at the
+  trace's arrival offsets (thread pool sized past the burst width), then
+  drains. Nothing measured in-process: the replies are only checked for
+  success.
+* `reconstruct` — rebuilds the scoreboard from the telemetry JSONL
+  ALONE: p50/p99 latency from `request` events' `total_s`, sustained
+  QPS from first-enqueue to last-completion (both derivable from each
+  event's `ts` and `total_s`), and the retrace count from non-warmup
+  `compile` spans. The artifact line set ends with the gate-carrying
+  summary (telemetry/artifact.build_summary), so a tail-truncated
+  capture still reconstructs every number.
+
+Latency metrics are LOWER-is-better — their lines carry
+``lower_is_better: true`` and tools/benchdiff.py inverts its regression
+direction for them (and for `*_p50_ms`/`*_p99_ms`-shaped names
+recovered from a summary line, which drops the flag).
+"""
+
+from __future__ import annotations
+
+import concurrent.futures
+import json
+import time
+import urllib.request
+
+import numpy as np
+
+# the replay's HTTP concurrency must exceed the widest burst or the
+# client itself serializes the burst and the queue-wait numbers lie
+_CLIENT_WORKERS = 32
+
+
+def make_trace(seed: int = 0, n_requests: int = 80, *,
+               mean_gap_s: float = 0.002, burst: int = 4,
+               lengths=(8, 16, 32), weights=None) -> list:
+    """[(arrival_offset_s, seq_len), ...] sorted by offset. Bursty:
+    every `burst`-th arrival opens a fresh exponential gap scaled by the
+    burst width (keeping the MEAN rate at 1/mean_gap_s); the requests
+    inside a burst land at the same instant — the pile-up the batcher's
+    coalescing exists for."""
+    rng = np.random.default_rng(seed)
+    lengths = list(lengths)
+    if weights is not None:
+        weights = np.asarray(weights, np.float64)
+        weights = weights / weights.sum()
+    t = 0.0
+    trace = []
+    for i in range(n_requests):
+        if i % max(1, burst) == 0 and i:
+            t += float(rng.exponential(mean_gap_s * burst))
+        seq_len = int(rng.choice(lengths, p=weights))
+        trace.append((round(t, 6), seq_len))
+    return trace
+
+
+def trace_stats(trace) -> dict:
+    lens = [l for _, l in trace]
+    return {"n_requests": len(trace),
+            "span_s": trace[-1][0] if trace else 0.0,
+            "len_min": min(lens), "len_max": max(lens)}
+
+
+def replay_http(url: str, trace, *, make_features, time_scale: float = 1.0,
+                timeout_s: float = 60.0) -> dict:
+    """POST every trace entry to `url`/predict at its (scaled) arrival
+    offset. `make_features(index, seq_len)` builds the request payload
+    array — deterministic per index so reruns send identical bytes.
+    Returns client-side success counts only; the scoreboard comes from
+    `reconstruct` over the telemetry log."""
+    t_start = time.monotonic()
+
+    def one(idx_entry):
+        i, (offset, seq_len) = idx_entry
+        delay = offset * time_scale - (time.monotonic() - t_start)
+        if delay > 0:
+            time.sleep(delay)
+        feats = np.asarray(make_features(i, seq_len))
+        body = json.dumps({"features": feats.tolist(),
+                           "id": f"replay-{i}"}).encode()
+        req = urllib.request.Request(
+            f"{url}/predict", data=body,
+            headers={"Content-Type": "application/json"})
+        # one retry: a burst can race the ThreadingHTTPServer's accept
+        # backlog on a loaded host — a reset on first contact is the
+        # client environment, not a serving result
+        last = None
+        for _attempt in range(2):
+            try:
+                with urllib.request.urlopen(req, timeout=timeout_s) as resp:
+                    json.loads(resp.read())
+                    return None
+            except Exception as exc:
+                last = exc
+        return f"replay-{i}: {last!r}"
+
+    with concurrent.futures.ThreadPoolExecutor(_CLIENT_WORKERS) as pool:
+        results = list(pool.map(one, enumerate(trace)))
+    errors = [r for r in results if r is not None]
+    return {"sent": len(results), "ok": len(results) - len(errors),
+            "failed": len(errors), "errors": errors[:5],
+            "wall_s": round(time.monotonic() - t_start, 3)}
+
+
+# ---------------------------------------------------------- reconstruction
+
+def _percentile(sorted_vals, q: float) -> float:
+    """Nearest-rank percentile over an already-sorted list."""
+    if not sorted_vals:
+        return 0.0
+    k = min(len(sorted_vals) - 1,
+            max(0, int(round(q / 100.0 * (len(sorted_vals) - 1)))))
+    return float(sorted_vals[k])
+
+
+def reconstruct(telemetry_path: str) -> dict:
+    """The serving scoreboard from the telemetry JSONL ALONE — no
+    in-process timer survives into these numbers, so a crashed or
+    remote replay reconstructs identically from its log:
+
+    * latency percentiles (ms) over successful `request` events'
+      `total_s` (enqueue -> result, queue + assemble + forward);
+    * sustained QPS = completed / (last completion - first enqueue),
+      both derived from each event's `ts` (completion) and `total_s`;
+    * `recompiles_after_warmup` = `compile` spans missing the warmup
+      flag — any value above 0 means a shape escaped the bucket
+      lattice and retraced mid-traffic.
+    """
+    requests, compiles, warm_compiles = [], 0, 0
+    with open(telemetry_path) as fh:
+        for raw in fh:
+            raw = raw.strip()
+            if not raw.startswith("{"):
+                continue
+            try:
+                ev = json.loads(raw)
+            except json.JSONDecodeError:
+                continue
+            kind = ev.get("event")
+            if kind == "request":
+                requests.append(ev)
+            elif kind == "span" and ev.get("name") == "compile":
+                if ev.get("warmup"):
+                    warm_compiles += 1
+                else:
+                    compiles += 1
+    ok = [ev for ev in requests if ev.get("ok")]
+    lat_ms = sorted(1000.0 * float(ev["total_s"]) for ev in ok
+                    if "total_s" in ev)
+    out = {
+        "n_requests": len(requests),
+        "n_ok": len(ok),
+        "n_failed": len(requests) - len(ok),
+        "p50_ms": round(_percentile(lat_ms, 50), 3),
+        "p99_ms": round(_percentile(lat_ms, 99), 3),
+        "warmup_compiles": warm_compiles,
+        "recompiles_after_warmup": compiles,
+    }
+    if ok:
+        first_enqueue = min(float(ev["ts"]) - float(ev["total_s"])
+                            for ev in ok)
+        last_done = max(float(ev["ts"]) for ev in ok)
+        span = max(last_done - first_enqueue, 1e-9)
+        out["qps"] = round(len(ok) / span, 2)
+        out["span_s"] = round(span, 3)
+    else:
+        out["qps"] = 0.0
+        out["span_s"] = 0.0
+    return out
+
+
+def metric_lines(scoreboard: dict, prefix: str = "serving_replay") -> list:
+    """The bench metric lines for a reconstructed scoreboard. QPS is
+    higher-is-better (the default); the latency/retrace lines carry the
+    explicit lower_is_better flag benchdiff inverts on."""
+    return [
+        {"metric": f"{prefix}_qps", "value": scoreboard["qps"],
+         "unit": "req/sec", "n_ok": scoreboard["n_ok"],
+         "n_failed": scoreboard["n_failed"]},
+        {"metric": f"{prefix}_p50_ms", "value": scoreboard["p50_ms"],
+         "unit": "ms", "lower_is_better": True},
+        {"metric": f"{prefix}_p99_ms", "value": scoreboard["p99_ms"],
+         "unit": "ms", "lower_is_better": True},
+        {"metric": f"{prefix}_recompiles_after_warmup",
+         "value": scoreboard["recompiles_after_warmup"], "unit": "count",
+         "lower_is_better": True,
+         "warmup_compiles": scoreboard["warmup_compiles"]},
+    ]
+
+
+def write_artifact(path: str, lines: list) -> dict:
+    """Write the SERVE artifact: every metric line plus the trailing
+    gate-carrying summary (the same truncation-proof shape BENCH
+    artifacts use — telemetry/artifact.py parses both)."""
+    from deeplearning4j_tpu.telemetry.artifact import build_summary
+
+    summary = build_summary(lines)
+    with open(path, "w") as fh:
+        for line in lines:
+            fh.write(json.dumps(line) + "\n")
+        fh.write(json.dumps(summary) + "\n")
+    return summary
+
+
+# ----------------------------------------------------------- the harness
+
+def _tiny_lm(max_seq: int, vocab: int = 64):
+    from deeplearning4j_tpu.models.transformer import transformer_lm
+
+    net = transformer_lm(vocab_size=vocab, d_model=32, n_heads=2,
+                         n_layers=2, d_ff=64, max_length=max_seq)
+    net.init()
+    return net
+
+
+def _tiny_mlp(n_in: int = 8, n_out: int = 4):
+    from deeplearning4j_tpu.nn.conf import (DenseLayer,
+                                            NeuralNetConfiguration,
+                                            OutputLayer)
+    from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+
+    conf = (NeuralNetConfiguration.builder().seed(7).list()
+            .layer(DenseLayer(n_in=n_in, n_out=16, activation="relu"))
+            .layer(OutputLayer(n_in=16, n_out=n_out, activation="softmax",
+                               loss_function="mcxent"))
+            .build())
+    return MultiLayerNetwork(conf).init()
+
+
+def run_replay(*, model: str = "lm", seed: int = 0, n_requests: int = 60,
+               burst: int = 4, mean_gap_s: float = 0.002,
+               lengths=(8, 16, 32), batch_sizes=(1, 2, 4),
+               max_wait_ms: float = 4.0, replicas: int = 1,
+               telemetry_path: str, artifact_path: str | None = None,
+               checkpoint: str | None = None, emit=None) -> dict:
+    """End-to-end: build the tiny model, warm the bucket lattice, replay
+    the seeded trace over HTTP, drain, reconstruct from the telemetry
+    JSONL, optionally write the SERVE artifact. `emit` (a callable
+    taking a metric-line dict) lets bench.py mirror each line through
+    its own pipeline. rc semantics: this function raises on setup
+    errors; a zero-`n_ok` replay is reported, not raised — the caller
+    gates on the numbers."""
+    from deeplearning4j_tpu.serving.buckets import BucketLattice
+    from deeplearning4j_tpu.serving.engine import InferenceEngine
+    from deeplearning4j_tpu.serving.server import ServingServer
+    from deeplearning4j_tpu.telemetry import Recorder
+
+    sequence = model == "lm"
+    rec = Recorder(telemetry_path)
+    rec.meta(role="trafficreplay", model=model, seed=seed,
+             n_requests=n_requests, burst=burst, lengths=list(lengths))
+    if sequence:
+        lattice = BucketLattice(batch_sizes=batch_sizes,
+                                seq_lens=sorted(set(lengths)))
+        net = _tiny_lm(max_seq=max(lengths))
+        # long-prompt envelope check: every seq bucket must have a
+        # compilable attention path (ops/flash_attention.servable_seq)
+        lattice.validate_attention(head_dim=16)
+        vocab = 64
+        feat_rng = np.random.default_rng(seed + 1)
+        tokens = feat_rng.integers(0, vocab, (n_requests, max(lengths)))
+
+        def make_features(i, seq_len):
+            return tokens[i, :seq_len].astype(np.int32)
+    else:
+        lattice = BucketLattice(batch_sizes=batch_sizes)
+        net = _tiny_mlp()
+        feat_rng = np.random.default_rng(seed + 1)
+        feats = feat_rng.normal(size=(n_requests, 8)).astype(np.float32)
+
+        def make_features(i, seq_len):
+            return feats[i]
+
+    engine = InferenceEngine(net, lattice, replicas=replicas,
+                             max_wait_ms=max_wait_ms, sequence=sequence,
+                             checkpoint=checkpoint, recorder=rec)
+    example = make_features(0, max(lengths) if sequence else 0)
+    warm = engine.warmup(example)
+    server = ServingServer(engine, port=0).start()
+    trace = make_trace(seed, n_requests, mean_gap_s=mean_gap_s,
+                       burst=burst, lengths=lengths)
+    try:
+        client = replay_http(server.url, trace,
+                             make_features=make_features)
+    finally:
+        server.stop()
+        rec.close()
+    scoreboard = reconstruct(telemetry_path)
+    scoreboard["client"] = client
+    scoreboard["warmed_buckets"] = warm
+    lines = metric_lines(scoreboard)
+    if emit is not None:
+        for line in lines:
+            emit(line)
+    if artifact_path:
+        scoreboard["summary"] = write_artifact(artifact_path, lines)
+        scoreboard["artifact"] = artifact_path
+    scoreboard["lines"] = lines
+    return scoreboard

@@ -1,0 +1,167 @@
+"""The serving front door — stdlib ThreadingHTTPServer over an
+InferenceEngine (same lifecycle idiom as ui/server.py).
+
+Endpoints (all JSON):
+
+    POST /predict       {"features": [...], "mask": [...]?, "id": "..."?}
+                        -> {"id", "output", "prediction", "timing"}
+                        Each request rides the continuous batcher: it
+                        coalesces with concurrent requests into a bucket
+                        batch (serving/batcher.py) and returns when its
+                        batch completes. 400 on malformed input or a
+                        prompt longer than the lattice max; 500 when the
+                        batch's forward worker died (the error string
+                        names the cause); 503 while draining.
+    GET  /healthz       {"status", "replicas", "lattice", "served", ...}
+    GET  /stats         the engine's full counter dict
+    POST /drain         begin graceful drain (stop admitting; pending
+                        batches flush); the server keeps answering GETs
+
+Run with ``ServingServer(engine, port=0).start()``; ``.url`` gives the
+bound address. ``stop()`` drains the engine then closes the listener.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Optional
+
+import numpy as np
+
+# per-request wait bound inside the HTTP handler: far above any sane
+# max-wait + forward time; a hit means the engine lost the batch
+REQUEST_TIMEOUT_S = 60.0
+
+
+class _Handler(BaseHTTPRequestHandler):
+    server_version = "dl4jtpu-serve/1.0"
+
+    def log_message(self, fmt, *args):  # quiet, like ui/server.py
+        pass
+
+    @property
+    def serving(self) -> "ServingServer":
+        return self.server.serving_server
+
+    def _json(self, obj, code: int = 200) -> None:
+        body = json.dumps(obj).encode()
+        self.send_response(code)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def do_GET(self):  # noqa: N802
+        route = self.path.rstrip("/")
+        engine = self.serving.engine
+        if route in ("", "/healthz"):
+            stats = engine.stats()
+            stats["status"] = ("draining" if self.serving.draining
+                              else "serving")
+            self._json(stats)
+            return
+        if route == "/stats":
+            self._json(engine.stats())
+            return
+        self._json({"error": f"unknown path {self.path}"}, 404)
+
+    def do_POST(self):  # noqa: N802
+        route = self.path.rstrip("/")
+        if route == "/drain":
+            self.serving.begin_drain()
+            self._json({"status": "draining"})
+            return
+        if route != "/predict":
+            self._json({"error": f"unknown path {self.path}"}, 404)
+            return
+        if self.serving.draining:
+            self._json({"error": "draining; not admitting requests"}, 503)
+            return
+        try:
+            length = int(self.headers.get("Content-Length", 0))
+            payload = json.loads(self.rfile.read(length) or b"{}")
+            features = np.asarray(payload["features"])
+            mask = payload.get("mask")
+        except (KeyError, ValueError, TypeError) as exc:
+            self._json({"error": f"bad request body: {exc!r}"}, 400)
+            return
+        engine = self.serving.engine
+        try:
+            req = engine.submit(features, mask=mask,
+                                request_id=payload.get("id"))
+        except (ValueError, RuntimeError) as exc:
+            # lattice rejection (prompt longer than the max seq bucket)
+            # or a drain race — the client's error, not a retrace
+            self._json({"error": str(exc)}, 400)
+            return
+        if not req.wait(REQUEST_TIMEOUT_S):
+            self._json({"id": req.request_id, "error": "timed out"}, 504)
+            return
+        if req.error is not None:
+            self._json({"id": req.request_id, "error": req.error}, 500)
+            return
+        out = np.asarray(req.result)
+        self._json({
+            "id": req.request_id,
+            "output": out.tolist(),
+            "prediction": _argmax_last(out),
+            "timing": {
+                "queue_s": round(req.t_assembled - req.t_enqueue, 6),
+                "total_s": round(req.t_done - req.t_enqueue, 6),
+            },
+        })
+
+
+def _argmax_last(out: np.ndarray):
+    """Class index/indices over the last axis — the `predict` view of
+    the raw output ([V] -> int, [T, V] -> [T] ints)."""
+    if out.ndim == 0:
+        return float(out)
+    am = np.argmax(out, axis=-1)
+    return int(am) if am.ndim == 0 else am.tolist()
+
+
+class ServingServer:
+    """Facade owning the HTTP listener; the engine is constructed by the
+    caller (CLI `serve` or a test) so its lattice/replica/checkpoint
+    config stays explicit."""
+
+    def __init__(self, engine, port: int = 0, host: str = "127.0.0.1"):
+        self.engine = engine
+        self.draining = False
+        self._httpd = ThreadingHTTPServer((host, port), _Handler)
+        self._httpd.serving_server = self
+        self._thread: Optional[threading.Thread] = None
+
+    @property
+    def port(self) -> int:
+        return self._httpd.server_address[1]
+
+    @property
+    def url(self) -> str:
+        host, port = self._httpd.server_address[:2]
+        return f"http://{host}:{port}"
+
+    def start(self) -> "ServingServer":
+        self.engine.start()
+        self._thread = threading.Thread(target=self._httpd.serve_forever,
+                                        daemon=True, name="serve-http")
+        self._thread.start()
+        return self
+
+    def begin_drain(self) -> None:
+        """Stop admitting /predict requests; the engine flushes what it
+        already accepted (POST /drain, and the first phase of stop())."""
+        self.draining = True
+
+    def stop(self, drain_timeout: float = 30.0) -> None:
+        """Graceful shutdown: drain the engine (every admitted request
+        completes or fails loudly), then close the listener."""
+        self.begin_drain()
+        self.engine.drain(drain_timeout)
+        self._httpd.shutdown()
+        self._httpd.server_close()
+        if self._thread:
+            self._thread.join(timeout=5)

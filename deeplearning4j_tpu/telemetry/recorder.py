@@ -25,6 +25,14 @@ Event schema — one JSON object per line, every event carrying
 | `fault`  | fault-injection / elastic-recovery record: `kind` (an injected fault kind from distributed/faults.py or a launcher exit class), `process_id`, `step`, free-form fields — written BEFORE the fault acts, so even a SIGKILL leaves its line |
 | `bucket_plan` | the DP-overlap bucket schedule a net was configured with (parallel/placement.py): `axis`, `n_buckets`, `bucket_bytes`, `mode`, per-bucket `{index, n_leaves, bytes}` — the per-rank collective sequence on the record before any step runs; the bench's per-bucket micro-timings ride `span` events named `bucket_reduce` (`bucket`, `bytes`, `n_leaves`, `seconds`) |
 | `kernel_tune` | one kernel-autotune micro-bench measurement (tools/kerneltune.py): `kernel`, `key` (the ops/autotune.py config key), `params` (the candidate block sizes), `seconds` (per-call wall clock), `role` ("default" / "candidate" / "chosen"), free-form fields — the provenance trail behind every tuning_table.json entry |
+| `request` | one served inference request (serving/engine.py): `id`, `ok`, `bucket` ([batch, seq]), `replica`, `queue_s` (enqueue -> batch cut), `batch_assemble_s` (host-side padding), `forward_s` (jitted forward incl. batch-boundary fetch), `total_s` (enqueue -> result), `seq_len`/`padded_seq` for sequence models, `error` on a failed batch — the ONLY record serving/replay.py reconstructs p50/p99/QPS from |
+
+Serving also names three `span` events per batch: `queue` (the head
+request's wait — what the batcher's max-wait deadline bounds),
+`batch_assemble` (padding into the bucket), and `forward` (the jit call;
+its FIRST execution per bucket shape nests a span named `compile`, so
+the warmed compile count is reconstructable from telemetry alone — the
+zero-retrace gate in tests/test_serving.py counts exactly these).
 
 The file format is append-only JSONL so concurrent writers (bench runs
 every mode in a subprocess) can share one log: each process appends
@@ -159,6 +167,16 @@ class Recorder:
             fields["seconds"] = round(float(seconds), 9)
         return self.event("kernel_tune", kernel=kernel, key=key,
                           params=dict(params), role=role, **fields)
+
+    def request(self, request_id: str, *, ok: bool = True,
+                **fields) -> dict:
+        """A `request` event: one served inference request with its
+        queue/batch_assemble/forward span breakdown
+        (serving/engine.py). The traffic-replay bench reconstructs
+        p50/p99 latency and sustained QPS from these events ALONE — the
+        telemetry log, not in-process timers, is the serving
+        scoreboard's source of truth."""
+        return self.event("request", id=request_id, ok=bool(ok), **fields)
 
     def memory(self, **fields) -> dict:
         """Device-memory snapshot: bytes held by live jax arrays plus

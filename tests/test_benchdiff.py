@@ -60,6 +60,39 @@ def test_gate_scale_grants_chip_state_slack():
     assert benchdiff.diff(old, new_plain, threshold=0.1)["regressions"]
 
 
+def test_serve_latency_is_lower_is_better():
+    """SERVE artifact rows (serving/replay.py) invert the direction:
+    p99 GROWING past threshold regresses, p99 falling is a change; the
+    flag comes from the line itself or the metric-name pattern (the
+    summary reconstruction drops the flag)."""
+    old = _lines(serving_replay_p99_ms={"value": 10.0,
+                                        "lower_is_better": True})
+    worse = _lines(serving_replay_p99_ms={"value": 14.0,
+                                          "lower_is_better": True})
+    (row,) = benchdiff.diff(old, worse, threshold=0.1)["regressions"]
+    assert "lower is better" in row["reason"] and row["delta_pct"] == 40.0
+    better = _lines(serving_replay_p99_ms={"value": 6.0,
+                                           "lower_is_better": True})
+    assert benchdiff.diff(old, better, threshold=0.1)["regressions"] == []
+    # name-pattern fallback: summary-reconstructed rows keep only value
+    old_bare = _lines(serving_replay_p50_ms={"value": 10.0})
+    new_bare = _lines(serving_replay_p50_ms={"value": 14.0})
+    assert benchdiff.diff(old_bare, new_bare, threshold=0.1)["regressions"]
+    # QPS stays higher-is-better even in a SERVE artifact
+    assert benchdiff.diff(_lines(serving_replay_qps={"value": 100.0}),
+                          _lines(serving_replay_qps={"value": 80.0}),
+                          threshold=0.1)["regressions"]
+
+
+def test_serve_recompiles_rising_from_zero_always_regress():
+    """A retrace count has no ratio base at 0 — ANY rise means the
+    bucket lattice leaked and must trip regardless of threshold."""
+    old = _lines(serving_replay_recompiles_after_warmup={"value": 0})
+    new = _lines(serving_replay_recompiles_after_warmup={"value": 1})
+    (row,) = benchdiff.diff(old, new, threshold=0.5)["regressions"]
+    assert row["old"] == 0 and row["new"] == 1
+
+
 def test_new_regression_flag_trips_even_with_stable_value():
     old = _lines(vgg={"value": 100.0})
     new = _lines(vgg={"value": 99.0, "regression": True})

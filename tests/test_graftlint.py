@@ -410,6 +410,31 @@ def reduce_params(params, axis_name):
 def reduce_loss(loss, acts, axis_name):
     return jax.lax.psum(loss, axis_name), jax.lax.pmean(acts, axis_name)
 """),
+    ("G017", """\
+fwd = jax.jit(lambda p, s, x: x)
+
+
+def handle(request, params, state):
+    y = fwd(params, state, request.features)
+    outs = []
+    for req in request.siblings:
+        outs.append(req.result.item())
+    return y, outs
+""", """\
+fwd = jax.jit(lambda p, s, x, m: x)
+
+
+def run_batch(batch, params, state):
+    y = fwd(params, state, batch.features, batch.mask)
+    rows = np.asarray(y)
+    for req, row in zip(batch.requests, rows):
+        req.set_result(row)
+    return rows
+
+
+def warmup_bucket(params, state, zeros, mask):
+    return fwd(params, state, zeros, mask)
+"""),
     ("G016", """\
 from jax.experimental import pallas as pl
 
@@ -441,17 +466,25 @@ def build(kern, x, T, D):
 ]
 
 
+# rules whose scope excludes the default fixture path lint their
+# fixtures at a path inside their scope (G017: serving/ hot paths)
+RULE_FIXTURE_PATHS = {
+    "G017": "deeplearning4j_tpu/serving/_graftlint_fixture.py",
+}
+
+
 @pytest.mark.parametrize(
     "rule,pos,neg", FIXTURES,
     ids=[f"{r}-{i}" for i, (r, _, _) in enumerate(FIXTURES)])
 def test_rule_fires_on_positive_not_negative(rule, pos, neg):
-    assert rule in rules_in(pos), f"{rule} missed its positive fixture"
-    assert rule not in rules_in(neg), f"{rule} false-positive"
+    path = RULE_FIXTURE_PATHS.get(rule, FIXTURE_PATH)
+    assert rule in rules_in(pos, path), f"{rule} missed its positive fixture"
+    assert rule not in rules_in(neg, path), f"{rule} false-positive"
 
 
 def test_every_rule_has_fixture_coverage():
     assert {r for r, _, _ in FIXTURES} == set(RULE_DOCS) == {
-        f"G{i:03d}" for i in range(1, 17)}
+        f"G{i:03d}" for i in range(1, 18)}
 
 
 def test_g015_blessed_sites_are_exempt():
@@ -467,6 +500,25 @@ def test_g015_blessed_sites_are_exempt():
     assert "G015" in rules_in(
         src, "deeplearning4j_tpu/parallel/sequence_parallel.py")
     assert "G015" in rules_in(src)  # the default fixture path
+
+
+def test_g017_scope_and_carveouts():
+    """G017 is serving/-only (the same source is silent elsewhere), and
+    both named carve-outs hold: bucket-ish argument names and
+    warmup/bucket-named enclosing functions don't flag the jit-entry
+    half; a batch-boundary sync outside a request loop doesn't flag the
+    host-sync half."""
+    rule_id, pos, neg = next(f for f in FIXTURES if f[0] == "G017")
+    serving = RULE_FIXTURE_PATHS["G017"]
+    assert "G017" in rules_in(pos, serving)
+    assert "G017" not in rules_in(pos)  # parallel/ default path: out of scope
+    assert "G017" not in rules_in(pos, "deeplearning4j_tpu/nn/x.py")
+    # batch-boundary fetch: one sync per batch, outside a request loop
+    boundary = ("fwd = jax.jit(lambda p, s, x: x)\n"
+                "def run(batch, p, s):\n"
+                "    y = fwd(p, s, batch.features)\n"
+                "    return np.asarray(y).item()\n")
+    assert "G017" not in rules_in(boundary, serving)
 
 
 def test_g016_tuning_layer_and_scope():

@@ -1,0 +1,452 @@
+"""Tier-1 gate for the continuous-batching serving subsystem (ISSUE 9):
+bucket-selection determinism, padding proofs at atol 0, batcher
+deadline/coalescing on a FAKE clock (no real sleeps), HTTP round-trip
+parity vs the in-process forward, the zero-retrace promise over a
+mixed-length replay, worker-death containment, and the telemetry-only
+scoreboard reconstruction behind tools/trafficreplay.py."""
+
+import json
+import os
+import urllib.request
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.serving.batcher import (Batcher, PendingRequest,
+                                                assemble, plan_batch)
+from deeplearning4j_tpu.serving.buckets import Bucket, BucketLattice
+from deeplearning4j_tpu.serving.engine import InferenceEngine
+from deeplearning4j_tpu.serving.server import ServingServer
+from deeplearning4j_tpu.serving import replay
+from deeplearning4j_tpu.telemetry import Recorder
+
+pytestmark = pytest.mark.serving
+
+
+def _mlp():
+    return replay._tiny_mlp()
+
+
+def _req(features, t=0.0, mask=None):
+    return PendingRequest(features=np.asarray(features), mask=mask,
+                          t_enqueue=t)
+
+
+# ------------------------------------------------------------- lattice
+
+def test_bucket_selection_is_deterministic():
+    lat = BucketLattice(batch_sizes=(1, 2, 4, 8), seq_lens=(8, 16, 32))
+    picks = [lat.select(3, 11) for _ in range(5)]
+    assert picks == [Bucket(4, 16)] * 5
+    assert lat.select(1, 8) == Bucket(1, 8)
+    assert lat.select(8, 32) == Bucket(8, 32)
+    # boundary: exact fits choose the bucket itself, not the next one
+    assert lat.select(2, 16) == Bucket(2, 16)
+
+
+def test_lattice_rejects_out_of_envelope():
+    lat = BucketLattice(batch_sizes=(1, 2), seq_lens=(8,))
+    with pytest.raises(ValueError, match="exceeds lattice max"):
+        lat.seq_bucket(9)
+    with pytest.raises(ValueError, match="exceeds lattice max"):
+        lat.batch_bucket(3)
+    fixed = BucketLattice(batch_sizes=(1, 2))
+    with pytest.raises(ValueError, match="no seq dimension"):
+        fixed.seq_bucket(4)
+
+
+def test_bucket_spec_grammars():
+    lat = BucketLattice.from_spec("1,2,4")
+    assert lat.batch_sizes == (1, 2, 4) and lat.seq_lens is None
+    lat = BucketLattice.from_spec("1x64,4x64,4x256")
+    assert lat.batch_sizes == (1, 4) and lat.seq_lens == (64, 256)
+    with pytest.raises(ValueError, match="mixes"):
+        BucketLattice.from_spec("1x64,4")
+
+
+def test_seq_lattice_validated_against_ops_dispatch():
+    """Long-prompt buckets are checked against the attention dispatch
+    envelope at construction time: a tileable long T passes, an
+    un-tileable one fails with the dispatch's own reason string."""
+    from deeplearning4j_tpu.ops import flash_attention as fa
+
+    assert fa.servable_seq(512, 64)          # fused envelope
+    assert fa.servable_seq(16384, 128)       # chunked envelope
+    assert not fa.servable_seq(25000, 64)    # not tileable, > monolithic max
+    BucketLattice(batch_sizes=(1,), seq_lens=(512, 16384)) \
+        .validate_attention(head_dim=128)
+    with pytest.raises(ValueError, match="cannot be tiled"):
+        BucketLattice(batch_sizes=(1,), seq_lens=(25000,)) \
+            .validate_attention(head_dim=64)
+
+
+# ---------------------------------------------- batcher (fake clock)
+
+def test_plan_batch_waits_under_deadline():
+    lat = BucketLattice(batch_sizes=(1, 2, 4))
+    pending = [_req(np.zeros(3, np.float32), t=0.0)]
+    assert plan_batch(pending, 0.001, 0.005, lat) == 0
+
+
+def test_plan_batch_cuts_on_deadline():
+    lat = BucketLattice(batch_sizes=(1, 2, 4))
+    pending = [_req(np.zeros(3, np.float32), t=0.0),
+               _req(np.zeros(3, np.float32), t=0.004)]
+    assert plan_batch(pending, 0.0049, 0.005, lat) == 0
+    assert plan_batch(pending, 0.005, 0.005, lat) == 2
+
+
+def test_plan_batch_full_bucket_never_waits():
+    lat = BucketLattice(batch_sizes=(1, 2, 4))
+    pending = [_req(np.zeros(3, np.float32), t=0.0) for _ in range(6)]
+    # full largest bucket cuts immediately even at now == enqueue time
+    assert plan_batch(pending, 0.0, 0.005, lat) == 4
+
+
+def test_plan_batch_drain_flushes():
+    lat = BucketLattice(batch_sizes=(1, 2, 4))
+    pending = [_req(np.zeros(3, np.float32), t=0.0)]
+    assert plan_batch(pending, 0.0, 10.0, lat) == 0
+    assert plan_batch(pending, 0.0, 10.0, lat, closed=True) == 1
+
+
+def test_plan_batch_incompatible_request_ends_group():
+    lat = BucketLattice(batch_sizes=(1, 2, 4))
+    pending = [_req(np.zeros(3, np.float32), t=0.0),
+               _req(np.zeros(5, np.float32), t=0.0),  # different shape
+               _req(np.zeros(3, np.float32), t=0.0)]
+    # FIFO order preserved: the incompatible head-adjacent request caps
+    # the cut at 1 even past the deadline
+    assert plan_batch(pending, 1.0, 0.005, lat) == 1
+
+
+def test_batcher_live_coalescing_without_sleeps():
+    """The threaded Batcher on a manual clock: deadline expiry is
+    simulated by advancing the clock, not by sleeping."""
+    now = {"t": 0.0}
+    lat = BucketLattice(batch_sizes=(1, 2, 4))
+    b = Batcher(lat, max_wait_ms=5.0, clock=lambda: now["t"])
+    b.submit(np.zeros(3, np.float32))
+    b.submit(np.ones(3, np.float32))
+    assert b.next_batch(timeout=0.0) is None  # deadline not reached
+    now["t"] = 0.006
+    batch = b.next_batch(timeout=0.5)
+    assert batch is not None and batch.n_real == 2
+    assert batch.bucket == Bucket(2, None)
+    b.close()
+    assert b.next_batch(timeout=0.0) is None
+    with pytest.raises(RuntimeError, match="draining"):
+        b.submit(np.zeros(3, np.float32))
+
+
+def test_assemble_pads_shapes_and_masks():
+    lat = BucketLattice(batch_sizes=(1, 2, 4), seq_lens=(8, 16))
+    reqs = [_req(np.arange(5, dtype=np.int32)),
+            _req(np.arange(11, dtype=np.int32))]
+    batch = assemble(reqs, lat, sequence=True)
+    assert batch.bucket == Bucket(2, 16)
+    assert batch.features.shape == (2, 16)
+    assert batch.features.dtype == np.int32
+    assert batch.mask.shape == (2, 16)
+    np.testing.assert_array_equal(batch.mask[0],
+                                  ([1.0] * 5 + [0.0] * 11))
+    np.testing.assert_array_equal(batch.features[0, :5], np.arange(5))
+    assert batch.features[0, 5:].sum() == 0  # zero padding
+
+
+# ------------------------------------------------- padding correctness
+
+def test_padded_rows_do_not_change_real_rows_atol0_mlp():
+    """The row-padding proof the whole bucket scheme rests on: with the
+    SAME bucket shape, garbage in the padding rows leaves the real
+    rows' outputs BIT-identical (inference forwards are
+    row-independent)."""
+    import jax
+
+    net = _mlp()
+    fwd = jax.jit(net.inference_fn())
+    rng = np.random.default_rng(0)
+    real = rng.normal(size=(2, 8)).astype(np.float32)
+    zeros = np.concatenate([real, np.zeros((2, 8), np.float32)])
+    garbage = np.concatenate(
+        [real, 1e6 * rng.normal(size=(2, 8)).astype(np.float32)])
+    y_zero = np.asarray(fwd(net.params, net.state, zeros))
+    y_garb = np.asarray(fwd(net.params, net.state, garbage))
+    np.testing.assert_array_equal(y_zero[:2], y_garb[:2])
+
+
+def test_padded_rows_and_tail_do_not_change_real_outputs_atol0_lm():
+    """Sequence twin of the row proof, plus the causal-tail property:
+    garbage token ids in the padded ROWS and in the padded TAIL of a
+    real row (mask unchanged) leave the real row's real positions
+    bit-identical — padded batch rows are independent sequences, and
+    causal attention never reads a future (padded) key."""
+    import jax
+
+    net = replay._tiny_lm(16)
+    fwd = jax.jit(net.inference_fn())
+    rng = np.random.default_rng(1)
+    toks = rng.integers(0, 64, 10).astype(np.int32)
+    mask = np.zeros((2, 16), np.float32)
+    mask[0, :10] = 1.0
+
+    def batch_with(pad_fill):
+        feats = np.full((2, 16), 0, np.int32)
+        feats[0, :10] = toks
+        feats[0, 10:] = pad_fill[0]   # real row's padded tail
+        feats[1, :] = pad_fill[1]     # whole padding row
+        return feats
+
+    a = batch_with((0, 0))
+    b = batch_with((rng.integers(1, 64), rng.integers(1, 64)))
+    y_a = np.asarray(fwd(net.params, net.state, a, mask))
+    y_b = np.asarray(fwd(net.params, net.state, b, mask))
+    np.testing.assert_array_equal(y_a[0, :10], y_b[0, :10])
+
+
+# ------------------------------------------- engine + server round trip
+
+@pytest.fixture(scope="module")
+def mlp_stack():
+    net = _mlp()
+    rec = Recorder(path=None)
+    lat = BucketLattice(batch_sizes=(1, 2, 4))
+    engine = InferenceEngine(net, lat, max_wait_ms=2.0, recorder=rec)
+    engine.warmup(np.zeros(8, np.float32))
+    server = ServingServer(engine, port=0).start()
+    yield net, engine, server, rec
+    server.stop()
+
+
+def _post(url, payload):
+    req = urllib.request.Request(
+        f"{url}/predict", data=json.dumps(payload).encode(),
+        headers={"Content-Type": "application/json"})
+    with urllib.request.urlopen(req, timeout=30) as resp:
+        return json.loads(resp.read())
+
+
+def test_server_round_trip_parity_vs_direct_predict(mlp_stack):
+    net, engine, server, _ = mlp_stack
+    rng = np.random.default_rng(3)
+    x = rng.normal(size=(5, 8)).astype(np.float32)
+    direct_out = np.asarray(net.output(x))
+    direct_pred = net.predict(x)
+    for i in range(5):
+        resp = _post(server.url, {"features": x[i].tolist()})
+        assert resp["prediction"] == int(direct_pred[i])
+        np.testing.assert_allclose(np.asarray(resp["output"]),
+                                   direct_out[i], atol=1e-5)
+        assert resp["timing"]["total_s"] >= resp["timing"]["queue_s"] >= 0
+
+
+def test_healthz_and_stats(mlp_stack):
+    _, engine, server, _ = mlp_stack
+    with urllib.request.urlopen(f"{server.url}/healthz", timeout=10) as r:
+        health = json.loads(r.read())
+    assert health["status"] == "serving"
+    assert health["replicas"] == 1
+    assert health["lattice"]["batch_sizes"] == [1, 2, 4]
+    with urllib.request.urlopen(f"{server.url}/stats", timeout=10) as r:
+        stats = json.loads(r.read())
+    assert stats["served"] >= 5
+
+
+def test_server_rejects_malformed_and_oversized(mlp_stack):
+    _, _, server, _ = mlp_stack
+    with pytest.raises(urllib.error.HTTPError) as e:
+        _post(server.url, {"nope": 1})
+    assert e.value.code == 400
+
+
+# ------------------------------------------------- zero-retrace promise
+
+def test_zero_recompiles_after_warmup_across_mixed_lengths():
+    """THE acceptance property: warm the lattice once, then a
+    mixed-length request stream adds ZERO compiles — asserted on both
+    the telemetry compile-span count and the trace-time counter."""
+    net = replay._tiny_lm(16)
+    rec = Recorder(path=None)
+    lat = BucketLattice(batch_sizes=(1, 2), seq_lens=(8, 16))
+    engine = InferenceEngine(net, lat, max_wait_ms=1.0, sequence=True,
+                             recorder=rec)
+    warmed = engine.warmup(np.zeros(16, np.int32))
+    assert warmed == 4  # 2 batch x 2 seq buckets, 1 replica
+    assert engine.trace_count == 4
+
+    def compile_spans():
+        return [e for e in rec.events
+                if e.get("event") == "span" and e.get("name") == "compile"]
+
+    assert len(compile_spans()) == 4
+    assert all(e.get("warmup") for e in compile_spans())
+    engine.start()
+    rng = np.random.default_rng(5)
+    for seq_len in (3, 8, 11, 16, 5, 1, 13, 16, 2, 7):
+        out = engine.predict(rng.integers(0, 64, seq_len).astype(np.int32),
+                             timeout=30)
+        assert np.asarray(out).shape[0] == seq_len  # padding sliced off
+    assert engine.trace_count == 4, "a request escaped the bucket lattice"
+    assert len(compile_spans()) == 4
+    # the per-request telemetry breakdown is on the record
+    reqs = [e for e in rec.events if e.get("event") == "request"]
+    assert len(reqs) == 10
+    for ev in reqs:
+        assert ev["ok"] and ev["total_s"] >= 0
+        assert {"queue_s", "batch_assemble_s", "forward_s",
+                "bucket", "seq_len", "padded_seq"} <= set(ev)
+    engine.drain()
+
+
+# --------------------------------------------- worker death containment
+
+def test_worker_dying_mid_batch_fails_requests_not_replica():
+    net = _mlp()
+    rec = Recorder(path=None)
+    engine = InferenceEngine(net, BucketLattice(batch_sizes=(1, 2)),
+                             max_wait_ms=1.0, recorder=rec)
+    engine.warmup(np.zeros(8, np.float32))
+    replica = engine._replicas[0]
+    orig = replica._jit
+    state = {"bombs": 1}
+
+    def flaky(*args, **kwargs):
+        if state["bombs"]:
+            state["bombs"] -= 1
+            raise RuntimeError("injected worker death")
+        return orig(*args, **kwargs)
+
+    replica._jit = flaky
+    engine.start()
+    x = np.zeros(8, np.float32)
+    with pytest.raises(RuntimeError, match="injected worker death"):
+        engine.predict(x, timeout=30)
+    # the replica survived its batch dying: the next request serves
+    out = engine.predict(x, timeout=30)
+    assert np.asarray(out).shape == (4,)
+    errors = [e for e in rec.events if e.get("event") == "error"]
+    assert any("injected worker death" in e.get("error", "")
+               for e in errors)
+    failed = [e for e in rec.events
+              if e.get("event") == "request" and not e.get("ok")]
+    assert failed and "injected worker death" in failed[0]["error"]
+    engine.drain()
+
+
+# ------------------------------------------------ trace + reconstruction
+
+def test_make_trace_is_seeded_and_bursty():
+    t1 = replay.make_trace(7, 40, burst=4, lengths=(8, 16, 32))
+    t2 = replay.make_trace(7, 40, burst=4, lengths=(8, 16, 32))
+    assert t1 == t2
+    t3 = replay.make_trace(8, 40, burst=4, lengths=(8, 16, 32))
+    assert t1 != t3
+    offsets = [t for t, _ in t1]
+    assert offsets == sorted(offsets)
+    # bursts share their arrival instant
+    assert offsets[0] == offsets[1] == offsets[2] == offsets[3]
+    assert offsets[4] > offsets[3]
+    assert {l for _, l in t1} <= {8, 16, 32}
+
+
+def test_reconstruct_from_telemetry_alone(tmp_path):
+    """The scoreboard math, from a synthesized JSONL with known
+    latencies — no serving stack involved."""
+    path = str(tmp_path / "t.jsonl")
+    lat_ms = [10.0, 20.0, 30.0, 40.0, 1000.0]
+    with open(path, "w") as fh:
+        for i, ms in enumerate(lat_ms):
+            fh.write(json.dumps({
+                "event": "request", "id": f"r{i}", "ok": True,
+                "ts": 100.0 + i, "total_s": ms / 1000.0}) + "\n")
+        fh.write(json.dumps({"event": "request", "id": "bad",
+                             "ok": False, "ts": 105.0,
+                             "total_s": 0.5}) + "\n")
+        fh.write(json.dumps({"event": "span", "name": "compile",
+                             "warmup": True, "seconds": 1.0}) + "\n")
+        fh.write(json.dumps({"event": "span", "name": "compile",
+                             "seconds": 1.0}) + "\n")
+    sb = replay.reconstruct(path)
+    assert sb["n_requests"] == 6 and sb["n_ok"] == 5 and sb["n_failed"] == 1
+    assert sb["p50_ms"] == 30.0
+    assert sb["p99_ms"] == 1000.0
+    assert sb["warmup_compiles"] == 1
+    assert sb["recompiles_after_warmup"] == 1
+    # QPS span: first enqueue (ts - total_s) to last completion (ts)
+    first = min(100.0 + i - ms / 1000.0 for i, ms in enumerate(lat_ms))
+    assert sb["qps"] == round(5 / (104.0 - first), 2)
+
+
+def test_end_to_end_replay_truncation_proof(tmp_path):
+    """The full rc=0 path at small scale: replay over real HTTP,
+    reconstruct from telemetry alone, write the SERVE artifact — then
+    truncate the artifact to its LAST LINE and recover every metric
+    from the summary (the BENCH truncation contract)."""
+    from deeplearning4j_tpu.telemetry import artifact as art
+
+    tpath = str(tmp_path / "telemetry.jsonl")
+    apath = str(tmp_path / "SERVE_test.json")
+    sb = replay.run_replay(model="mlp", seed=0, n_requests=20,
+                           telemetry_path=tpath, artifact_path=apath)
+    assert sb["n_ok"] == 20
+    assert sb["recompiles_after_warmup"] == 0
+    assert sb["qps"] > 0 and sb["p99_ms"] >= sb["p50_ms"] > 0
+    full = art.load(apath)
+    assert full["serving_replay_qps"]["value"] == sb["qps"]
+    # tail-truncate to the summary line alone: every number survives
+    with open(apath) as fh:
+        last = fh.read().splitlines()[-1]
+    cut = str(tmp_path / "cut.json")
+    with open(cut, "w") as fh:
+        fh.write(last + "\n")
+    recovered = art.load(cut)
+    for metric in ("serving_replay_qps", "serving_replay_p50_ms",
+                   "serving_replay_p99_ms",
+                   "serving_replay_recompiles_after_warmup"):
+        assert recovered[metric]["value"] == full[metric]["value"]
+
+
+# ---------------------------------------------------------------- CLI
+
+def test_cli_predict_via_server(tmp_path, mlp_stack):
+    from deeplearning4j_tpu.cli.driver import main
+
+    net, _, server, _ = mlp_stack
+    rng = np.random.default_rng(11)
+    x = rng.normal(size=(6, 8)).astype(np.float32)
+    csv_in = str(tmp_path / "in.csv")
+    with open(csv_in, "w") as fh:
+        for row in x:
+            fh.write(",".join(f"{v:.8g}" for v in row) + "\n")
+    out_csv = str(tmp_path / "preds.csv")
+    rc = main(["predict", "--server", server.url, "--input", csv_in,
+               "--output", out_csv])
+    assert rc == 0
+    preds = np.loadtxt(out_csv, delimiter=",", dtype=np.float32)
+    np.testing.assert_allclose(preds, np.asarray(net.output(x)), atol=1e-4)
+
+
+def test_cli_predict_requires_one_source(tmp_path):
+    from deeplearning4j_tpu.cli.driver import main
+
+    csv_in = str(tmp_path / "in.csv")
+    with open(csv_in, "w") as fh:
+        fh.write("1,2\n")
+    with pytest.raises(SystemExit, match="exactly one"):
+        main(["predict", "--input", csv_in, "--output",
+              str(tmp_path / "o.csv")])
+
+
+def test_cli_serve_multiprocess_plan(capsys):
+    from deeplearning4j_tpu.cli.driver import main
+
+    rc = main(["serve", "--model", "unused.zip", "--multiprocess", "2",
+               "--port", "9300"])
+    assert rc == 0
+    out = capsys.readouterr().out
+    lines = [l for l in out.splitlines() if l.endswith("&")]
+    assert len(lines) == 2
+    assert all("DL4J_TPU_" in l and "serve" in l for l in lines)
+    assert "--port 9300" in lines[0] and "--port 9301" in lines[1]
+    # the plan flags themselves are scrubbed from the worker argv
+    assert "--multiprocess" not in lines[0]

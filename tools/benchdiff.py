@@ -20,7 +20,16 @@ threshold (timings are lower-is-better) and (b) a match-or-beat
 violation — `best_us` exceeding the entry's own `default_us`, which the
 kerneltune harness guarantees never happens in a healthy sweep.
 
-What counts as a regression (all bench metrics are higher-is-better):
+SERVE artifacts (tools/trafficreplay.py / bench.py serving_replay —
+the same metric-line + summary shape) diff through the same path with
+INVERTED direction for their latency rows: a line carrying
+`lower_is_better: true`, or a `*_p50_ms`/`*_p99_ms`/`*recompiles`-shaped
+name recovered from a summary line, regresses when its value GROWS past
+the threshold (and a retrace count rising from 0 always regresses).
+QPS stays higher-is-better.
+
+What counts as a regression (bench metrics are higher-is-better unless
+flagged lower-is-better as above):
 
 * a metric value dropping more than `--threshold` (default 10%), with
   chip-state slack: when the new line carries `gate_scale` (the bench's
@@ -39,12 +48,25 @@ import argparse
 import importlib
 import json
 import os
+import re
 import sys
 import types
 
 ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 DEFAULT_THRESHOLD = 0.10
+
+# Serving latency metrics are LOWER-is-better: their lines carry
+# `lower_is_better: true` (serving/replay.py), and the name pattern
+# covers rows reconstructed from a summary line (which keeps only the
+# value) — p50/p99/_ms latency and retrace counts from SERVE artifacts.
+_LOWER_IS_BETTER_RE = re.compile(r"(_p\d+_ms$|_ms$|latency|recompiles)")
+
+
+def _lower_is_better(metric: str, old: dict, new: dict) -> bool:
+    if old.get("lower_is_better") or new.get("lower_is_better"):
+        return True
+    return bool(_LOWER_IS_BETTER_RE.search(str(metric)))
 
 # gate fields that are themselves higher-is-better measurements worth
 # diffing (context fields like gate_scale/floors are reported, not judged)
@@ -150,6 +172,7 @@ def diff(old_lines: dict, new_lines: dict,
         old, new = old_lines[metric], new_lines[metric]
         gate_scale = _num(new, "gate_scale")
         slack = max(0.0, 1.0 - gate_scale) if gate_scale is not None else 0.0
+        lower_better = _lower_is_better(metric, old, new)
         for field in ("value",) + _JUDGED_GATE_FIELDS:
             o, n = _num(old, field), _num(new, field)
             if o is None or n is None or o == n:
@@ -157,6 +180,25 @@ def diff(old_lines: dict, new_lines: dict,
             delta_pct = round(100.0 * (n - o) / abs(o), 2) if o else None
             row = {"metric": metric, "field": field, "old": o, "new": n,
                    "delta_pct": delta_pct}
+            if lower_better and field == "value":
+                # lower-is-better (SERVE latency/retraces): GROWTH past
+                # the threshold is the regression direction; a retrace
+                # count rising from 0 always regresses (no ratio exists
+                # for a zero base — any retrace means the bucket lattice
+                # leaked)
+                grew_past = ((o > 0 and (n - o) / o > threshold + slack)
+                             or (o == 0 and n > 0))
+                if grew_past:
+                    row["reason"] = (
+                        f"{field} grew"
+                        + (f" {delta_pct:.1f}%" if delta_pct is not None
+                           else f" {o} -> {n}")
+                        + f" (> {100 * (threshold + slack):.0f}% allowed "
+                          "— lower is better)")
+                    regressions.append(row)
+                else:
+                    changes.append(row)
+                continue
             dropped_past = (o > 0 and (o - n) / o > threshold + slack)
             if field == "value" and slack and o > 0 and (o - n) / o > threshold:
                 row["gate_scale"] = gate_scale

@@ -86,6 +86,14 @@ ROWS = [
      lambda l: f"{l['value']:.0f} TFLOP/s"
                + (f" ({l['speedup_vs_einsum_hop']:.1f}x the einsum hop)"
                   if "speedup_vs_einsum_hop" in l else "")),
+    # serving rows (SERVE artifacts / the bench serving_replay mode's
+    # lines in a BENCH artifact) — latency is lower-is-better, quoted
+    # with QPS so the table reads as one serving line
+    ("serving_replay_qps",
+     "continuous-batching serving, mixed-length bursty replay",
+     lambda l: f"{l['value']:.0f} req/s sustained"),
+    ("serving_replay_p99_ms", "same replay, tail latency",
+     lambda l: f"p99 {l['value']:.1f} ms (lower is better)"),
 ]
 
 

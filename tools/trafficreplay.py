@@ -1,0 +1,92 @@
+#!/usr/bin/env python
+"""trafficreplay — the continuous-batching serving bench.
+
+    python tools/trafficreplay.py                      # tiny-LM replay
+    python tools/trafficreplay.py --model mlp --requests 200
+    python tools/trafficreplay.py --artifact SERVE_r01.json
+    python tools/trafficreplay.py --checkpoint ckpt_dir  # serve a real net
+
+Replays a SEEDED mixed-length / bursty request trace against a freshly
+started serving stack (engine + HTTP front door, serving/), drains, and
+reports sustained QPS plus p50/p99 latency reconstructed from the
+telemetry `request` events ALONE — the JSONL log, not any in-process
+timer, is the source of truth, so the same numbers rebuild from the
+artifact after a crash or a stdout truncation.
+
+Output: one JSON metric line per number (the bench.py idiom) ending
+with the gate-carrying summary line; `--artifact` also writes them as a
+SERVE_r*.json file that tools/benchdiff.py diffs across rounds
+(latency and retrace lines carry `lower_is_better: true` — benchdiff
+inverts its regression direction for them; QPS stays higher-is-better).
+Exit code 0 unless the replay could not run at all; regression gating
+happens in benchdiff, off the artifacts.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import tempfile
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, ROOT)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(prog="trafficreplay", description=__doc__)
+    ap.add_argument("--model", choices=("lm", "mlp"), default="lm",
+                    help="tiny transformer LM (mixed-length sequences; "
+                         "default) or fixed-shape MLP")
+    ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument("--requests", type=int, default=60)
+    ap.add_argument("--burst", type=int, default=4,
+                    help="requests per arrival burst")
+    ap.add_argument("--mean-gap-ms", type=float, default=2.0,
+                    help="mean inter-burst gap (the trace's rate knob)")
+    ap.add_argument("--lens", default="8,16,32",
+                    help="comma list of request sequence lengths "
+                         "(lm model; also the seq bucket lattice)")
+    ap.add_argument("--buckets", default="1,2,4",
+                    help="comma list of batch-size buckets")
+    ap.add_argument("--max-wait-ms", type=float, default=4.0)
+    ap.add_argument("--replicas", type=int, default=1)
+    ap.add_argument("--checkpoint", default=None,
+                    help="Orbax host-checkpoint dir to resume the net "
+                         "from before serving")
+    ap.add_argument("--artifact", default=None,
+                    help="write the SERVE artifact (metric lines + "
+                         "summary) here")
+    ap.add_argument("--telemetry", default=None,
+                    help="telemetry JSONL path (default: a temp file; "
+                         "the scoreboard is reconstructed from it)")
+    args = ap.parse_args(argv)
+
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    from deeplearning4j_tpu.serving.replay import run_replay
+
+    tpath = args.telemetry or os.path.join(
+        tempfile.mkdtemp(prefix="trafficreplay_"), "telemetry.jsonl")
+    scoreboard = run_replay(
+        model=args.model, seed=args.seed, n_requests=args.requests,
+        burst=args.burst, mean_gap_s=args.mean_gap_ms / 1000.0,
+        lengths=tuple(int(t) for t in args.lens.split(",")),
+        batch_sizes=tuple(int(b) for b in args.buckets.split(",")),
+        max_wait_ms=args.max_wait_ms, replicas=args.replicas,
+        telemetry_path=tpath, artifact_path=args.artifact,
+        checkpoint=args.checkpoint,
+        emit=lambda line: print(json.dumps(line), flush=True))
+    from deeplearning4j_tpu.telemetry.artifact import build_summary
+
+    summary = build_summary(scoreboard["lines"])
+    summary["telemetry"] = tpath
+    print(json.dumps(summary), flush=True)
+    if scoreboard["n_ok"] == 0:
+        sys.stderr.write("trafficreplay: no request completed\n")
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

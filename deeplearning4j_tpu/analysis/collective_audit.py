@@ -88,6 +88,7 @@ def entry_names() -> list[str]:
     return [
         "distributed/allreduce_step_2x4",
         "distributed/overlap_step_2x4",
+        "reshard/live_transpose_2x4",
         "ring_attention/seq4",
         "sequence_parallel/sp_step_seq2",
     ]
@@ -293,6 +294,37 @@ def _build_overlap_step():
                   jax.random.PRNGKey(0), batch)
 
 
+def _build_reshard_live():
+    """The portable resharding engine's live executor
+    (reshard/executor.live_identity): a TP-placed param tree moved
+    across a dp<->tp role transpose on the SAME 8 virtual devices — the
+    set_mesh re-placement / elastic re-form shape. The jit identity is
+    collective-free in the jaxpr; GSPMD lowers the move to the
+    collective-permute/all-gather program this entry freezes, so a
+    reordered transfer (the C001 drift class) is caught before it can
+    desync a live re-form."""
+    import jax
+    import numpy as np
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+    _ensure_devices()
+    from deeplearning4j_tpu.reshard.executor import live_identity
+
+    devs = np.asarray(jax.devices()[:8])
+    mesh_a = Mesh(devs.reshape(2, 4), ("data", "model"))
+    mesh_b = Mesh(devs.reshape(4, 2), ("data", "model"))
+    tree = {
+        "w": jax.device_put(
+            np.arange(64, dtype=np.float32).reshape(8, 8),
+            NamedSharding(mesh_a, P(None, "model"))),
+        "b": jax.device_put(np.arange(8, dtype=np.float32),
+                            NamedSharding(mesh_a, P("model"))),
+    }
+    shardings = {"w": NamedSharding(mesh_b, P("model", None)),
+                 "b": NamedSharding(mesh_b, P())}
+    return live_identity(shardings), (tree,)
+
+
 def _build_ring_attention():
     """ring_self_attention over a 4-way seq mesh (einsum fallback at
     Tl=2): the ppermute ring is the jaxpr-level collective workload."""
@@ -337,6 +369,7 @@ def _build_sp_step():
 _BUILDERS = {
     "distributed/allreduce_step_2x4": (_build_allreduce_step, True),
     "distributed/overlap_step_2x4": (_build_overlap_step, False),
+    "reshard/live_transpose_2x4": (_build_reshard_live, True),
     "ring_attention/seq4": (_build_ring_attention, False),
     "sequence_parallel/sp_step_seq2": (_build_sp_step, False),
 }

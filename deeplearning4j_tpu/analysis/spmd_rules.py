@@ -1,4 +1,4 @@
-"""The G010-G015 SPMD-divergence / fleet-robustness AST rules
+"""The G010-G015 + G018 SPMD-divergence / fleet-robustness AST rules
 (graftlint stage 3, AST side).
 
 PR 4's multi-process runtime made rank-divergence the most expensive bug
@@ -87,7 +87,7 @@ _BLOCKING_ATTRS = frozenset({"block_until_ready", "item"})
 _BLOCKING_CALLS = frozenset({"jax.block_until_ready", "jax.device_get"})
 
 SPMD_RULE_IDS = frozenset({"G010", "G011", "G012", "G013", "G014",
-                           "G015"})
+                           "G015", "G018"})
 
 
 def _env_rank_var() -> str:
@@ -531,10 +531,102 @@ def g015_handrolled_gradient_collective(tree, imports, path):
     return out
 
 
+# --------------------------------------------------------------- G018
+
+# The blessed full-tree host-materialization sites: the portable
+# resharding engine and the two checkpoint formats. Everywhere else, a
+# whole-param-tree host materialization defeats the resharding engine's
+# guarantee (spanning-mesh restores never materialize full params on
+# host) and reintroduces the gather-everything-to-host scaling wall the
+# reshard/ subsystem exists to remove.
+_G018_BLESSED = ("deeplearning4j_tpu/reshard/",
+                 "deeplearning4j_tpu/util/orbax_checkpoint.py",
+                 "deeplearning4j_tpu/util/model_serializer.py")
+
+# identifiers that denote a WHOLE param/optimizer tree (a bare name or
+# a terminal attribute like `net.params`); subscripts (`params["W"]`)
+# and calls are single leaves / derived values and never flag.
+_G018_TREE_NAMES = frozenset({"params", "opt_state", "param_tree",
+                              "params_tree", "opt_tree"})
+
+_G018_MATERIALIZERS = frozenset({"numpy.asarray", "numpy.array",
+                                 "jax.device_get"})
+_G018_TREE_MAP = frozenset({"jax.tree.map", "jax.tree_util.tree_map"})
+
+
+def _g018_is_whole_tree(expr: ast.AST) -> bool:
+    if isinstance(expr, ast.Name):
+        return expr.id in _G018_TREE_NAMES
+    if isinstance(expr, ast.Attribute):
+        return expr.attr in _G018_TREE_NAMES
+    return False
+
+
+def _g018_is_materializer(expr: ast.AST, imports) -> bool:
+    name = imports.canon(expr) or ""
+    if name in _G018_MATERIALIZERS:
+        return True
+    return isinstance(expr, ast.Attribute) and expr.attr in ("asarray",
+                                                             "device_get")
+
+
+def g018_full_tree_host_materialization(tree, imports, path):
+    """Full-parameter host materialization outside the blessed
+    reshard/ + checkpoint paths: (a) any `host_materialize(...)` call,
+    (b) `jax.device_get`/`np.asarray` whose operand IS a whole
+    params/opt_state tree (a bare name or `net.params`-style attribute),
+    (c) `jax.tree.map(np.asarray | jax.device_get, <tree>)` — the
+    leaf-at-a-time spelling of the same gather. Single-leaf reads
+    (`params["W"]`), derived values, and per-leaf loops are deliberately
+    not caught (precision over recall); route tree-level moves through
+    `reshard/` (live) or `ShardedCheckpointer.restore(target_mesh=...)`
+    (checkpoint) instead."""
+    norm = path.replace("\\", "/")
+    if any(b in norm for b in _G018_BLESSED):
+        return []
+    out = []
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        name = imports.canon(node.func) or ""
+        is_hm = (name.endswith(".host_materialize")
+                 or name == "host_materialize")
+        if is_hm:
+            out.append(("G018", node,
+                        "`host_materialize` outside the blessed reshard/"
+                        " + checkpoint paths gathers the full param tree "
+                        "to host — the scaling wall the portable "
+                        "resharding engine removes",
+                        "restore/move through reshard/ (ShardedCheck"
+                        "pointer.restore(net, target_mesh=...) or "
+                        "reshard.executor.reshard_net_live)"))
+            continue
+        if name in _G018_MATERIALIZERS:
+            if any(_g018_is_whole_tree(a) for a in node.args):
+                out.append(("G018", node,
+                            f"`{name}` over a whole param/optimizer tree "
+                            "materializes every shard on host",
+                            "keep the tree on device; reshard through "
+                            "reshard/ or read single leaves explicitly"))
+            continue
+        if name in _G018_TREE_MAP and len(node.args) >= 2 \
+                and _g018_is_materializer(node.args[0], imports) \
+                and any(_g018_is_whole_tree(a) for a in node.args[1:]):
+            out.append(("G018", node,
+                        "tree-mapped host materialization "
+                        "(`jax.tree.map(np.asarray, <param tree>)`) — "
+                        "the leaf-at-a-time spelling of a full-tree "
+                        "host gather",
+                        "keep the tree on device; reshard through "
+                        "reshard/ instead of materializing"))
+    return out
+
+
 SPMD_RULES = [g010_rank_divergent_control_flow, g011_host_nondeterminism,
               g012_unbound_axis_name, g013_rank_conditional_host_sync,
               g014_swallowed_fleet_errors,
-              g015_handrolled_gradient_collective]
+              g015_handrolled_gradient_collective,
+              g018_full_tree_host_materialization]
 
 SPMD_RULE_DOCS = {
     "G010": "rank-dependent control flow guarding collectives/jit/mesh "
@@ -550,4 +642,7 @@ SPMD_RULE_DOCS = {
     "G015": "hand-rolled collective on a gradient pytree outside "
             "parallel/overlap.py / nn/training.py (the blessed bucket-"
             "planner sites)",
+    "G018": "full-parameter host materialization (host_materialize / "
+            "device_get / np.asarray over whole param trees) outside "
+            "the blessed reshard/ + checkpoint paths",
 }

@@ -20,6 +20,13 @@ Serving (the continuous-batching inference server, serving/):
     python -m deeplearning4j_tpu.cli predict --server http://host:9090 \
         --input d.csv --output preds.csv # rows ride the server's batcher
 
+Resharding (the portable resharding engine, reshard/ — train on one
+mesh, restore and serve on any other):
+
+    python -m deeplearning4j_tpu.cli reshard --checkpoint ckpt_dir \
+        --target-mesh data=1            # dry-run: print the plan +
+                                        # bytes moved vs lower bound
+
 Distributed runtimes (reference Train.java `-runtime local|spark|hadoop`
 + cli-spark/SparkTrain.java; here the TPU-native equivalents):
 
@@ -140,9 +147,13 @@ def _build_parser() -> argparse.ArgumentParser:
                                        "computation_graph"],
                     default="multi_layer_network")
     sv.add_argument("--checkpoint", default=None,
-                    help="Orbax host-checkpoint dir to resume from at "
-                         "startup (train on one fleet, serve here — the "
-                         "PR 6 portable-restore path)")
+                    help="Orbax checkpoint dir to resume from at "
+                         "startup. The checkpoint may have been written "
+                         "under ANY training mesh (2x4 TP fleet, zero1 "
+                         "DP, ...) — the portable resharding engine "
+                         "(reshard/) plans its placement onto this "
+                         "serving process and reads only the slices it "
+                         "needs")
     sv.add_argument("--buckets", default="1,2,4,8",
                     help="padding-bucket lattice: batch sizes "
                          "('1,2,4,8') or explicit BxT pairs "
@@ -167,6 +178,32 @@ def _build_parser() -> argparse.ArgumentParser:
     sv.add_argument("--local-devices", type=int, default=4,
                     help="virtual CPU devices per process in the "
                          "--multiprocess plan (default 4)")
+
+    rs = sub.add_parser(
+        "reshard", help="dry-run the portable resharding planner: map a "
+                        "checkpoint's recorded placement onto a target "
+                        "mesh and print the per-action plan with bytes "
+                        "moved vs the lower bound (reshard/planner.py; "
+                        "nothing is restored or written)")
+    rs.add_argument("--checkpoint", required=True,
+                    help="Orbax checkpoint dir (ShardedCheckpointer "
+                         "layout; the step's meta.json carries the "
+                         "source placement)")
+    rs.add_argument("--target-mesh", required=True,
+                    help="target mesh axes, e.g. data=1 or "
+                         "data=2,model=2 (same role grammar as train "
+                         "--mesh; purely planned — no devices needed)")
+    rs.add_argument("--step", type=int, default=None,
+                    help="checkpoint step (default: latest)")
+    rs.add_argument("--processes", type=int, default=1,
+                    help="target process count (default 1 — the serve "
+                         "case)")
+    rs.add_argument("--zero1", action="store_true",
+                    help="plan zero1 optimizer-state shardings on the "
+                         "target data axis")
+    rs.add_argument("--artifact", default=None,
+                    help="also write the metric lines + summary as a "
+                         "RESHARD artifact (JSONL) for tools/benchdiff")
     return p
 
 
@@ -588,6 +625,105 @@ def _predict_via_server(args, feats) -> "np.ndarray":
     return np.asarray(rows, np.float32)
 
 
+def _cmd_reshard(args) -> int:
+    """`reshard --checkpoint --target-mesh` dry run: plan the
+    checkpoint->mesh redistribution through reshard/planner.py and
+    print it — per-action leaf counts, bytes moved vs the collective
+    lower bound, and benchdiff-consumable metric lines (bytes_moved /
+    plan_us are lower-is-better rows). Nothing moves: the planner is a
+    pure function and no target devices are required."""
+    import json as _json
+    import time
+
+    from deeplearning4j_tpu.reshard.executor import plan_for_placements
+    from deeplearning4j_tpu.reshard.planner import Placement, PlacementError
+    from deeplearning4j_tpu.telemetry.artifact import build_summary
+
+    step_dir, meta = _load_checkpoint_meta(args.checkpoint, args.step)
+    net = _net_from_checkpoint_config(step_dir, meta)
+    src = (Placement.from_json(meta["placement"])
+           if meta.get("placement") else Placement.solo())
+    try:
+        axes = _parse_mesh(args.target_mesh)
+        dst = Placement.of(axes, {r: r for r in axes},
+                           process_count=args.processes, zero1=args.zero1)
+        t0 = time.perf_counter()
+        plan, _, _ = plan_for_placements(net, src, dst)
+    except PlacementError as exc:
+        # the planner refuses (target-mesh-larger-than-checkpoint and
+        # friends) BEFORE anything moves — surface it as a usage error
+        raise SystemExit(f"reshard: {exc}") from None
+    plan_us = round((time.perf_counter() - t0) * 1e6, 1)
+
+    s = plan.summary()
+    print(f"# reshard plan: {s['src']} -> {s['dst']} "
+          f"(step {meta.get('iteration')})")
+    for action, n in sorted(s["actions"].items()):
+        moved = sum(l.bytes_moved for l in plan.leaves
+                    if l.action == action)
+        print(f"#   {action:<16} {n:>4} leaves  {moved:>12} bytes")
+    lines = [
+        {"metric": "reshard_plan_leaves", "value": s["n_leaves"]},
+        {"metric": "reshard_bytes_total", "value": s["bytes_total"]},
+        {"metric": "reshard_bytes_moved", "value": s["bytes_moved"],
+         "lower_is_better": True},
+        {"metric": "reshard_bytes_lower_bound",
+         "value": s["bytes_lower_bound"], "lower_is_better": True},
+        {"metric": "reshard_plan_us", "value": plan_us,
+         "lower_is_better": True},
+    ]
+    out = [_json.dumps(line) for line in lines]
+    out.append(_json.dumps(build_summary(lines)))
+    for line in out:
+        print(line)
+    if args.artifact:
+        with open(args.artifact, "w") as fh:
+            fh.write("\n".join(out) + "\n")
+        print(f"# wrote RESHARD artifact to {args.artifact}")
+    return 0
+
+
+def _load_checkpoint_meta(directory: str, step):
+    """(step_dir, meta dict) for the latest (or named) committed step."""
+    import json as _json
+
+    steps = sorted(
+        int(d.split("_", 1)[1]) for d in os.listdir(directory)
+        if d.startswith("step_") and d.split("_", 1)[1].isdigit()
+        and os.path.exists(os.path.join(directory, d, "meta.json")))
+    if not steps:
+        raise SystemExit(f"no committed checkpoints under {directory}")
+    if step is None:
+        step = steps[-1]
+    elif step not in steps:
+        raise SystemExit(f"no checkpoint for step {step} (have {steps})")
+    step_dir = os.path.join(directory, f"step_{step}")
+    with open(os.path.join(step_dir, "meta.json")) as fh:
+        return step_dir, _json.load(fh)
+
+
+def _net_from_checkpoint_config(step_dir: str, meta: dict):
+    """Rebuild the checkpointed net (init'd, for leaf shapes only) from
+    the step's config.json; meta's `kind` picks the container."""
+    from deeplearning4j_tpu.nn.conf.graph_conf import (
+        ComputationGraphConfiguration,
+    )
+    from deeplearning4j_tpu.nn.conf.neural_net_configuration import (
+        MultiLayerConfiguration,
+    )
+    from deeplearning4j_tpu.nn.graph import ComputationGraph
+    from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+
+    with open(os.path.join(step_dir, "config.json")) as fh:
+        conf_json = fh.read()
+    if meta.get("kind") == "ComputationGraph":
+        net = ComputationGraph(
+            ComputationGraphConfiguration.from_json(conf_json))
+    else:
+        net = MultiLayerNetwork(MultiLayerConfiguration.from_json(conf_json))
+    return net.init()
+
+
 def _cmd_test(args) -> int:
     net = _load_model(args.model)
     it = _make_iterator(args)
@@ -638,6 +774,7 @@ def main(argv=None) -> int:
     args._raw_argv = list(sys.argv[1:] if argv is None else argv)
     return {"train": _cmd_train, "test": _cmd_test,
             "predict": _cmd_predict, "serve": _cmd_serve,
+            "reshard": _cmd_reshard,
             "coordinator": _cmd_coordinator}[args.command](args)
 
 

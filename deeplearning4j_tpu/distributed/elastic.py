@@ -29,11 +29,14 @@ stays off the hot collective path (arXiv:1810.11112):
    min_processes) — topping up with *replacement* workers when the
    floor requires it (control-plane rank adoption:
    `ClusterClient(replace_dead=True)`).
-4. **Rejoining workers** restore the latest checkpoint
-   (`net.resume_from`) before `set_mesh`, so the continuous step
-   counter and `batch_for_step` (`nn/training.fit_steps`) make the
-   resumed run optimize the identical batch sequence an uninterrupted
-   run would have seen.
+4. **Rejoining workers** restore the latest checkpoint through the
+   portable resharding engine (`net.resume_from(ckpt,
+   target_mesh=mesh)` — `reshard/` plans the recorded checkpoint
+   placement onto this generation's N'-process mesh and each process
+   reads only the slices its devices need; no full-tree host gathers),
+   so the continuous step counter and `batch_for_step`
+   (`nn/training.fit_steps`) make the resumed run optimize the
+   identical batch sequence an uninterrupted run would have seen.
 
 jax is imported lazily: the module must stay importable under
 graftlint's no-jax package stubs.
@@ -65,7 +68,8 @@ ENV_TOTAL_STEPS = "DL4J_TPU_ELASTIC_TOTAL_STEPS"
 def run_elastic_steps(net, batch_for_step, total_steps: int, *,
                       checkpoint_dir: str, checkpoint_every: int = 1):
     """The worker-side elastic fit loop (call after `bootstrap.initialize`,
-    `net.resume_from(checkpoint_dir)`, and `set_mesh` on the global mesh).
+    `net.resume_from(checkpoint_dir, target_mesh=mesh)` — the resharded
+    restore — and `set_mesh` on the global mesh).
 
     Runs `nn/training.fit_steps` from the net's restored step to
     ``total_steps``; after each completed step the post-step host values

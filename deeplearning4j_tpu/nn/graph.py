@@ -372,17 +372,21 @@ class ComputationGraph:
         return globalize_batch(b, self._mesh,
                                (axes or {}).get("data", "data"))
 
-    def resume_from(self, checkpoint_dir: str, step=None):
+    def resume_from(self, checkpoint_dir: str, step=None, *,
+                    target_mesh=None, target_axes=None):
         """Elastic-recovery resume entry (same contract as
-        `MultiLayerNetwork.resume_from`): restore the latest (or given)
-        Orbax checkpoint into this graph, returning the restored step —
-        0 when the directory holds no checkpoint yet."""
+        `MultiLayerNetwork.resume_from`, including the `reshard/`
+        target-mesh routing): restore the latest (or given) Orbax
+        checkpoint into this graph, returning the restored step — 0
+        when the directory holds no checkpoint yet."""
         from deeplearning4j_tpu.util.orbax_checkpoint import (
             ShardedCheckpointer,
         )
 
         try:
-            ShardedCheckpointer(checkpoint_dir).restore(self, step=step)
+            ShardedCheckpointer(checkpoint_dir).restore(
+                self, step=step, target_mesh=target_mesh,
+                target_axes=target_axes)
         except FileNotFoundError:
             if step is not None:  # a NAMED step missing is a real error
                 raise

@@ -303,21 +303,30 @@ class MultiLayerNetwork:
             data = ListDataSetIterator([data])
         return fused_fit(self, [self._batch_dict(ds) for ds in data], epochs)
 
-    def resume_from(self, checkpoint_dir: str, step=None):
+    def resume_from(self, checkpoint_dir: str, step=None, *,
+                    target_mesh=None, target_axes=None):
         """Elastic-recovery resume entry: restore params / optimizer
         state / step counter from an Orbax checkpoint directory
         (`util/orbax_checkpoint.ShardedCheckpointer` layout) INTO this
-        net, keeping its runtime configuration (mesh, listeners). Call
-        before `set_mesh` when rejoining a re-formed fleet — the
-        restored host values ride jit's replicated placement on the
-        next `fit`. Returns the restored step (0 when the directory has
-        no checkpoint yet: a cold start, not an error)."""
+        net, keeping its runtime configuration (mesh, listeners).
+        Returns the restored step (0 when the directory has no
+        checkpoint yet: a cold start, not an error).
+
+        target_mesh/target_axes route the restore through the portable
+        resharding engine (`reshard/`): the checkpoint may have been
+        written under ANY mesh shape / axis roles / process count, and
+        each process reads only the shard slices its target placement
+        needs. Without a target mesh, call before `set_mesh` when
+        rejoining a re-formed fleet — the restored host values ride
+        jit's replicated placement on the next `fit`."""
         from deeplearning4j_tpu.util.orbax_checkpoint import (
             ShardedCheckpointer,
         )
 
         try:
-            ShardedCheckpointer(checkpoint_dir).restore(self, step=step)
+            ShardedCheckpointer(checkpoint_dir).restore(
+                self, step=step, target_mesh=target_mesh,
+                target_axes=target_axes)
         except FileNotFoundError:
             if step is not None:  # a NAMED step missing is a real error
                 raise

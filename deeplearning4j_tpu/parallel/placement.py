@@ -173,6 +173,13 @@ def configure_mesh(net, mesh, *, zero1=False, axes=None, n_microbatches=None,
 
     if getattr(net, "_pp_plan", None) is not None:
         exit_pipeline(net)
+    # re-placement detection: a net whose params were already PLACED by
+    # an earlier set_mesh routes the new placement through the portable
+    # resharding engine (reshard/) instead of raw host-side device_puts
+    # — same plans, same telemetry, as checkpoint/elastic resharding
+    prev_mesh = getattr(net, "_mesh", None)
+    prev_axes = getattr(net, "_mesh_axes", None)
+    prev_placed = getattr(net, "_param_sh", None) is not None
     net._mesh = mesh
     net._zero1 = zero1
     # process-spanning mesh (distributed/bootstrap + global_mesh): host
@@ -329,10 +336,26 @@ def configure_mesh(net, mesh, *, zero1=False, axes=None, n_microbatches=None,
     elif "model" in axes or "expert" in axes:
         if net.params is None:
             net.init()  # placement needs materialized params — same as pipe
-        net.params = shard_params(net.params, mesh, rules)
-        net._param_sh = param_shardings(net.params, mesh, rules)
-        if net.opt_state is not None:
-            net.opt_state = _map_param_shaped(
-                net.opt_state, net.params,
-                lambda t: jax.tree.map(jax.device_put, t, net._param_sh))
+        if prev_mesh is not None and prev_placed:
+            # an already-placed net: mesh-to-mesh move through the
+            # resharding planner (reshard_plan event + reshard span on
+            # the record; collective identity on the same device set,
+            # device_put transfer otherwise)
+            from deeplearning4j_tpu.reshard.executor import (
+                mesh_placement,
+                reshard_net_live,
+            )
+
+            reshard_net_live(net, mesh, axes,
+                             src=mesh_placement(prev_mesh, prev_axes),
+                             tp_rules=tp_rules)
+            net._param_sh = param_shardings(net.params, mesh, rules)
+        else:
+            net.params = shard_params(net.params, mesh, rules)
+            net._param_sh = param_shardings(net.params, mesh, rules)
+            if net.opt_state is not None:
+                net.opt_state = _map_param_shaped(
+                    net.opt_state, net.params,
+                    lambda t: jax.tree.map(jax.device_put, t,
+                                           net._param_sh))
     return net

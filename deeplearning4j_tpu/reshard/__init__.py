@@ -1,0 +1,40 @@
+"""Portable resharding engine: train on one mesh, restore and serve on
+any other (ROADMAP; arXiv:2112.01075 + the zero1 composition of
+arXiv:2004.13336).
+
+Three layers:
+
+1. `reshard.planner` — a PURE function mapping (source placement,
+   target placement, leaf layouts) to a deterministic per-leaf plan
+   (keep / slice_exchange / allgather_shard / host_fallback) with a
+   bytes-moved cost model and its lower bound. Stdlib-only, rank- and
+   clock-independent: every process derives the identical plan.
+2. `reshard.executor` — the live path (jitted collective identity /
+   device_put when the meshes coexist: `set_mesh` re-placement, elastic
+   re-form on survivors) and the checkpoint path (target-sharded orbax
+   templates: each process reads only the shard slices it needs —
+   `ShardedCheckpointer.restore(net, target_mesh=...)`).
+3. integration — `parallel/placement.py` routes re-placement of an
+   already-placed net through the plans, `distributed/elastic.py`
+   restores re-formed fleets through the planner, and
+   `serving/engine.py` accepts checkpoints written under any training
+   mesh.
+
+Importing this package is jax-free (planner is pure stdlib; executor
+imports jax lazily) so tools and the graftlint stubs stay cheap.
+"""
+
+from deeplearning4j_tpu.reshard.planner import (  # noqa: F401
+    ACTIONS,
+    ALLGATHER_SHARD,
+    HOST_FALLBACK,
+    KEEP,
+    SLICE_EXCHANGE,
+    LeafLayout,
+    LeafPlan,
+    Placement,
+    PlacementError,
+    ReshardPlan,
+    plan_leaf,
+    plan_reshard,
+)

@@ -177,7 +177,23 @@ class InferenceEngine:
             net.init()
         self.restored_step = 0
         if checkpoint is not None:
-            self.restored_step = int(net.resume_from(checkpoint))
+            # any-mesh checkpoint restore: the checkpoint may have been
+            # written by a 2x4 training fleet; the portable resharding
+            # engine (reshard/) plans its placement onto this serving
+            # process's one-device mesh and orbax reads only the slices
+            # it needs — the train-anywhere/serve-here handoff, with the
+            # reshard_plan on the telemetry record
+            import jax
+
+            from deeplearning4j_tpu.parallel.mesh import make_mesh
+
+            # this process's OWN first device: in a serving fleet
+            # (serve --multiprocess) jax.devices()[0] belongs to rank 0
+            # and is not addressable here
+            self.restored_step = int(net.resume_from(
+                checkpoint,
+                target_mesh=make_mesh({"data": 1},
+                                      devices=jax.local_devices())))
         self.net = net
         self.lattice = lattice or BucketLattice()
         self.batcher = Batcher(self.lattice, max_wait_ms,

@@ -32,32 +32,51 @@ def host_materialize(tree):
     """The tree with every leaf as a host numpy array — the
     process-count-portable checkpoint form for the elastic fleet
     (distributed/elastic.py): a checkpoint written as host values under
-    an N-process mesh restores onto N' processes (or one) with no
-    resharding machinery.
+    an N-process mesh restores onto N' processes (or one). Restore-side
+    mesh changes now route through the portable resharding engine
+    (`reshard/`, via ``restore(net, target_mesh=...)``) instead of
+    relying on host values alone.
 
     A process can only read its addressable shards, so this supports the
     leaves a data-parallel fleet actually holds: fully-addressable
     arrays, and process-spanning REPLICATED arrays (each process's first
     addressable shard is the whole value). Cross-process *sharded* state
-    (ZeRO-1 moments over a spanning mesh) needs the ROADMAP's portable
-    resharding engine and raises until that lands.
+    (ZeRO-1 moments over a spanning mesh) must reshard through the
+    checkpoint path (`reshard/executor.checkpoint_template`) and raises
+    here.
+
+    Telemetry: materializing a leaf that is genuinely SHARDED across
+    devices (not replicated) is a full-value host gather — one
+    `host_gather` event records the count and bytes, so the elastic
+    timeline test can assert the resharded paths never did it.
     """
     import numpy as np
+
+    gathered = {"n": 0, "bytes": 0}
 
     def leaf(x):
         if not isinstance(x, jax.Array):
             return np.asarray(x) if hasattr(x, "shape") else x
         if x.is_fully_addressable:
+            if len(x.sharding.device_set) > 1 and not x.is_fully_replicated:
+                gathered["n"] += 1
+                gathered["bytes"] += int(getattr(x, "nbytes", 0) or 0)
             return np.asarray(x)
         if x.is_fully_replicated:
             return np.asarray(x.addressable_data(0))
         raise NotImplementedError(
             f"cannot host-materialize a cross-process sharded leaf "
-            f"{x.shape} ({x.sharding}) — the portable resharding engine "
-            "(ROADMAP) is the planned path; until then elastic "
-            "checkpoints support replicated params/optimizer state only")
+            f"{x.shape} ({x.sharding}) — restore through the portable "
+            "resharding engine instead (ShardedCheckpointer.restore("
+            "net, target_mesh=...), reshard/)")
 
-    return jax.tree.map(leaf, tree)
+    out = jax.tree.map(leaf, tree)
+    if gathered["n"]:
+        from deeplearning4j_tpu.telemetry import get_default as _telemetry
+
+        _telemetry().event("host_gather", n_leaves=gathered["n"],
+                           bytes=gathered["bytes"])
+    return out
 
 
 class ShardedCheckpointer:
@@ -148,6 +167,7 @@ class ShardedCheckpointer:
         # commit: restore() only selects steps whose meta.json exists, so
         # a crash mid-save can never surface a partial step as "latest"
         from deeplearning4j_tpu.nn.updater import FLAT_LAYOUT_VERSION
+        from deeplearning4j_tpu.reshard.executor import net_placement
 
         self._pending = (d, {
             "iteration": net.iteration_count,
@@ -156,6 +176,10 @@ class ShardedCheckpointer:
             # layout of flat-view optimizer vectors (see
             # nn/updater.upgrade_flat_layout)
             "flat_layout": FLAT_LAYOUT_VERSION,
+            # the SOURCE placement this checkpoint was written under —
+            # what restore(target_mesh=...) plans the redistribution
+            # from (reshard/planner.Placement)
+            "placement": net_placement(net).to_json(),
         }, serde.to_json(net.conf))
         ckptr.save(os.path.join(d, "model"), tree, force=True)
         if not self.use_async:
@@ -187,9 +211,20 @@ class ShardedCheckpointer:
         self._commit_pending()
 
     # ------------------------------------------------------------- restore
-    def restore(self, net, step: Optional[int] = None):
+    def restore(self, net, step: Optional[int] = None, *,
+                target_mesh=None, target_axes=None):
         """Load a step into `net` (which must be built with a matching
-        config and init()'d so the target structure/shardings exist)."""
+        config and init()'d so the target structure/shardings exist).
+
+        target_mesh/target_axes: restore THROUGH the portable resharding
+        engine (`reshard/`) onto a mesh different from (or identically
+        shaped to) the one that wrote the checkpoint. The plan maps the
+        checkpoint's recorded source placement (meta.json "placement")
+        to the target placement; orbax then reads only the shard slices
+        each target process's addressable devices need — a spanning-mesh
+        restore never materializes full params on host. Emits a
+        `reshard_plan` telemetry event and wraps the read in a `reshard`
+        span (bytes moved vs the plan's lower bound)."""
         import orbax.checkpoint as ocp
 
         self.wait()
@@ -205,6 +240,9 @@ class ShardedCheckpointer:
         d = self._step_dir(step)
         if net.params is None:
             net.init()
+        with open(os.path.join(d, "meta.json")) as f:
+            meta = json.load(f)
+
         def _abstract(tree):
             return jax.tree.map(
                 lambda x: jax.ShapeDtypeStruct(x.shape, x.dtype,
@@ -217,30 +255,33 @@ class ShardedCheckpointer:
         # default checkpointer would barrier-sync the restore instead
         ckptr = self._solo() if jax.process_count() > 1 \
             else ocp.StandardCheckpointer()
-        try:
-            restored = ckptr.restore(os.path.join(d, "model"),
-                                     _abstract(_tree(net)))
-        except ValueError:
-            # optimizer-layout bridge (updater.rebuild_other_layout): the
-            # checkpoint may hold the OTHER updater-state layout (per-leaf
-            # tree vs the flat-view fused state). Retry against the
-            # opposite layout's template WITHOUT touching the net — only
-            # on success does set_optimizer swap the transform in (which
-            # also invalidates any cached jitted train step built over
-            # the old one); a genuinely corrupt checkpoint re-raises with
-            # the net unchanged.
-            from deeplearning4j_tpu.nn.updater import rebuild_other_layout
+        if target_mesh is not None:
+            restored = self._restore_resharded(
+                net, d, meta, ckptr, target_mesh, target_axes, _abstract)
+        else:
+            try:
+                restored = ckptr.restore(os.path.join(d, "model"),
+                                         _abstract(_tree(net)))
+            except ValueError:
+                # optimizer-layout bridge (updater.rebuild_other_layout):
+                # the checkpoint may hold the OTHER updater-state layout
+                # (per-leaf tree vs the flat-view fused state). Retry
+                # against the opposite layout's template WITHOUT touching
+                # the net — only on success does set_optimizer swap the
+                # transform in (which also invalidates any cached jitted
+                # train step built over the old one); a genuinely corrupt
+                # checkpoint re-raises with the net unchanged.
+                from deeplearning4j_tpu.nn.updater import \
+                    rebuild_other_layout
 
-            alt_tx = rebuild_other_layout(net)
-            tmpl = dict(_tree(net), opt_state=alt_tx.init(net.params))
-            restored = ckptr.restore(os.path.join(d, "model"),
-                                     _abstract(tmpl))
-            net.set_optimizer(alt_tx)
+                alt_tx = rebuild_other_layout(net)
+                tmpl = dict(_tree(net), opt_state=alt_tx.init(net.params))
+                restored = ckptr.restore(os.path.join(d, "model"),
+                                         _abstract(tmpl))
+                net.set_optimizer(alt_tx)
         net.params = restored["params"]
         net.opt_state = restored["opt_state"]
         net.state = restored["state"]
-        with open(os.path.join(d, "meta.json")) as f:
-            meta = json.load(f)
         if meta.get("flat_layout", 1) < 2:
             # pre-r5 flat vectors were all-row-major; reorder to the v2
             # (lane-rotated) layout so resumed moments stay aligned
@@ -261,3 +302,48 @@ class ShardedCheckpointer:
         if hasattr(net, "epoch_count"):
             net.epoch_count = meta.get("epoch", 0)
         return net
+
+    def _restore_resharded(self, net, d, meta, ckptr, target_mesh,
+                           target_axes, _abstract):
+        """The reshard/ checkpoint executor: plan source->target, put
+        the plan on the telemetry record, hand orbax an abstract tree
+        carrying TARGET shardings (it reads only the byte ranges each
+        target shard needs), bridging optimizer layouts like the legacy
+        path."""
+        from deeplearning4j_tpu.reshard.executor import checkpoint_template
+        from deeplearning4j_tpu.reshard.planner import Placement
+        from deeplearning4j_tpu.telemetry import get_default as _telemetry
+
+        src = (Placement.from_json(meta["placement"])
+               if meta.get("placement") else Placement.solo())
+        plan, tmpl = checkpoint_template(
+            net, src, target_mesh, target_axes,
+            zero1=bool(getattr(net, "_zero1", False)))
+        rec = _telemetry()
+        rec.event("reshard_plan", path="checkpoint",
+                  step=meta.get("iteration"), **plan.summary())
+        with rec.span("reshard", path="checkpoint",
+                      bytes_moved=plan.bytes_moved,
+                      bytes_lower_bound=plan.bytes_lower_bound):
+            try:
+                return ckptr.restore(os.path.join(d, "model"), tmpl)
+            except ValueError:
+                # optimizer-layout bridge, reshard flavor: the moments in
+                # the checkpoint use the other updater layout. zero1/TP
+                # placements always use the tree layout on both sides, so
+                # a bridged restore is a plain-DP/serving case — the alt
+                # moments restore replicated on the target mesh.
+                from deeplearning4j_tpu.nn.updater import \
+                    rebuild_other_layout
+                from jax.sharding import NamedSharding, PartitionSpec as P
+
+                alt_tx = rebuild_other_layout(net)
+                repl = NamedSharding(target_mesh, P())
+                alt_opt = jax.tree.map(
+                    lambda x: jax.ShapeDtypeStruct(x.shape, x.dtype,
+                                                   sharding=repl),
+                    alt_tx.init(net.params))
+                restored = ckptr.restore(os.path.join(d, "model"),
+                                         dict(tmpl, opt_state=alt_opt))
+                net.set_optimizer(alt_tx)
+                return restored

@@ -57,11 +57,15 @@ def main() -> int:
     from tests.cluster_worker import build_net
 
     net = build_net()
-    start = net.resume_from(ckpt_dir)  # restore BEFORE set_mesh
-    print(f"p{pid}: resuming from step {start}/{total_steps}", flush=True)
-
     mesh = make_global_mesh({"data": -1})
     assert spans_processes(mesh), "mesh does not span processes"
+    # restore THROUGH the portable resharding engine: the checkpoint may
+    # have been written by a different fleet size (N=3 -> N'=2 re-form),
+    # and the planner maps its recorded placement onto this generation's
+    # mesh — each process reads only what its devices need, no full-tree
+    # host gathers (tests/test_elastic.py asserts both from telemetry)
+    start = net.resume_from(ckpt_dir, target_mesh=mesh)
+    print(f"p{pid}: resuming from step {start}/{total_steps}", flush=True)
     net.set_mesh(mesh)
 
     def local_batch(step):

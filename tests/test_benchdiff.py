@@ -84,6 +84,34 @@ def test_serve_latency_is_lower_is_better():
                           threshold=0.1)["regressions"]
 
 
+def test_reshard_artifact_rows_are_lower_is_better():
+    """RESHARD artifact rows (cli reshard --artifact): bytes_moved /
+    bytes_lower_bound / plan_us GROWING past threshold regresses — a
+    plan that moves more bytes for the same placement pair lost
+    collective efficiency. The name patterns also cover rows
+    reconstructed from a summary line (flag dropped)."""
+    old = _lines(reshard_bytes_moved={"value": 57312,
+                                      "lower_is_better": True})
+    worse = _lines(reshard_bytes_moved={"value": 229248,
+                                        "lower_is_better": True})
+    (row,) = benchdiff.diff(old, worse, threshold=0.1)["regressions"]
+    assert "lower is better" in row["reason"]
+    better = _lines(reshard_bytes_moved={"value": 40000,
+                                         "lower_is_better": True})
+    assert benchdiff.diff(old, better, threshold=0.1)["regressions"] == []
+    # name-pattern fallback for summary-reconstructed rows
+    assert benchdiff.diff(_lines(reshard_bytes_moved={"value": 100.0}),
+                          _lines(reshard_bytes_moved={"value": 200.0}),
+                          threshold=0.1)["regressions"]
+    assert benchdiff.diff(_lines(reshard_plan_us={"value": 100.0}),
+                          _lines(reshard_plan_us={"value": 200.0}),
+                          threshold=0.1)["regressions"]
+    # leaf/total counts stay direction-neutral higher-is-better rows
+    assert benchdiff.diff(_lines(reshard_plan_leaves={"value": 89}),
+                          _lines(reshard_plan_leaves={"value": 91}),
+                          threshold=0.1)["regressions"] == []
+
+
 def test_serve_recompiles_rising_from_zero_always_regress():
     """A retrace count has no ratio base at 0 — ANY rise means the
     bucket lattice leaked and must trip regardless of threshold."""

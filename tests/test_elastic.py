@@ -152,6 +152,18 @@ def test_kill_one_worker_fleet_reforms_and_resumes(tmp_path):
     assert [r["start_step"] for r in resumes] == [0, 3]
     assert resumes[-1]["num_processes"] == 2
     assert len({e["run"] for e in p0_events}) == 2  # two generations
+    # 6. the re-formed generation restored THROUGH the portable
+    # resharding engine: the gen-1 resume plans the checkpoint's
+    # recorded 3-process placement onto the N'=2 mesh (a reshard_plan
+    # event per resuming worker), and NO path in the whole run
+    # host-gathered a full sharded tree
+    plans = [e for e in p0_events if e["event"] == "reshard_plan"]
+    assert plans and all(e["path"] == "checkpoint" for e in plans)
+    assert any(e["src"].endswith("p3") and e["dst"].endswith("p2")
+               for e in plans), plans
+    all_fleet = [e for p in range(3) for e in _events(f"{fleet_log}.p{p}")]
+    assert not [e for e in all_fleet + sup_events
+                if e["event"] == "host_gather"]
 
 
 def test_checkpoint_under_spanning_mesh_restores_on_one_process(tmp_path):

@@ -463,6 +463,22 @@ def build(kern, x, T, D):
         out_specs=pl.BlockSpec((8, 128), lambda i, j: (i, 0)),
     )(x)
 """),
+    ("G018", """\
+from deeplearning4j_tpu.util.orbax_checkpoint import host_materialize
+
+
+def snapshot(net):
+    tree = host_materialize(net.params)
+    flat = jax.device_get(net.opt_state)
+    moments = jax.tree.map(np.asarray, net.opt_state)
+    return tree, flat, moments
+""", """\
+def read_one(net, params):
+    w = np.asarray(params["W"])        # single leaf, not the tree
+    s = np.asarray(net.score_value)    # a derived scalar
+    placed = jax.tree.map(jax.device_put, net.params, net._param_sh)
+    return w, s, placed
+"""),
 ]
 
 
@@ -484,7 +500,7 @@ def test_rule_fires_on_positive_not_negative(rule, pos, neg):
 
 def test_every_rule_has_fixture_coverage():
     assert {r for r, _, _ in FIXTURES} == set(RULE_DOCS) == {
-        f"G{i:03d}" for i in range(1, 18)}
+        f"G{i:03d}" for i in range(1, 19)}
 
 
 def test_g015_blessed_sites_are_exempt():
@@ -519,6 +535,22 @@ def test_g017_scope_and_carveouts():
                 "    y = fwd(p, s, batch.features)\n"
                 "    return np.asarray(y).item()\n")
     assert "G017" not in rules_in(boundary, serving)
+
+
+def test_g018_blessed_paths_are_exempt():
+    """The resharding engine and the two checkpoint formats ARE the
+    places full-tree host materialization is allowed; the same source
+    flags anywhere else in the package."""
+    src = ("def snap(net):\n"
+           "    return jax.device_get(net.params)\n")
+    assert "G018" not in rules_in(
+        src, "deeplearning4j_tpu/reshard/executor.py")
+    assert "G018" not in rules_in(
+        src, "deeplearning4j_tpu/util/orbax_checkpoint.py")
+    assert "G018" not in rules_in(
+        src, "deeplearning4j_tpu/util/model_serializer.py")
+    assert "G018" in rules_in(src)  # the default parallel/ fixture path
+    assert "G018" in rules_in(src, "deeplearning4j_tpu/serving/engine.py")
 
 
 def test_g016_tuning_layer_and_scope():

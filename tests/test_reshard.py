@@ -1,0 +1,449 @@
+"""Portable resharding engine (ISSUE 10): planner purity + the
+mesh-transfer matrix.
+
+Planner tests treat the plan as pure data — no fleet spawn, no devices:
+the same placements must yield the byte-identical plan under simulated
+process_index 0 vs 1, the cost model must hold gather >= slice (so
+preferring collectives over host gathers is structural, not tuned), and
+malformed placements (target-mesh-larger-than-checkpoint and friends)
+must be refused before a plan exists.
+
+The matrix is the acceptance arc: params AND optimizer state saved
+under one placement restore BIT-identically under another —
+2x4 -> 1x1 (train TP, serve solo), 1x1 -> 2x2 (grow onto a TP mesh),
+2x2 -> 3x2 (a non-power-of-two fleet), a dp<->tp role transpose, and a
+zero1 8-way -> 4-way optimizer-moment reshard — each verified against
+the uninterrupted single-mesh reference values and leaving a
+`reshard_plan` telemetry event (and zero `host_gather` events) behind.
+
+TP *training* on this container's CPU jax hits the known donation-alias
+XlaRuntimeError (the pre-existing test_unified_mesh failure class), so
+the matrix warms optimizer moments with a dense fit and applies the TP
+placement via set_mesh — the save/restore path under test is identical.
+"""
+
+import json
+import os
+import sys
+
+import numpy as np
+import pytest
+
+import jax
+from jax.sharding import Mesh
+
+from deeplearning4j_tpu.reshard.planner import (
+    ALLGATHER_SHARD,
+    HOST_FALLBACK,
+    KEEP,
+    SLICE_EXCHANGE,
+    LeafLayout,
+    Placement,
+    PlacementError,
+    plan_leaf,
+    plan_reshard,
+)
+
+pytestmark = pytest.mark.reshard
+
+DEVS = np.asarray(jax.devices())
+
+SRC = Placement.of({"data": 2, "model": 4},
+                   {"data": "data", "model": "model"})
+DST = Placement.of({"data": 2, "model": 2},
+                   {"data": "data", "model": "model"})
+LEAVES = [
+    LeafLayout("w", (8, 24), 4, (None, "model"), (None, "model")),
+    LeafLayout("b", (24,), 4, ("model",), ()),
+    LeafLayout("r", (8, 8), 4, (), ()),
+]
+
+
+# ------------------------------------------------------------ pure planner
+
+def test_plan_is_deterministic_under_simulated_rank():
+    """The same placements yield the byte-identical plan on every
+    process — what lets a fleet execute its plan slices without
+    coordination. Simulated via the stage-3 rank harness (env contract
+    + patched jax.process_index; no fleet)."""
+    from deeplearning4j_tpu.analysis.collective_audit import \
+        simulated_process_index
+
+    plans = []
+    for pid in (0, 1):
+        with simulated_process_index(pid):
+            plans.append(plan_reshard(SRC, DST, LEAVES))
+    assert plans[0] == plans[1]
+    assert plans[0].summary() == plans[1].summary()
+
+
+def test_bytes_monotonicity_gather_ge_slice():
+    """For every leaf: the gather plan costs at least the slice plan
+    (which IS the reported lower bound), the host fallback never beats
+    the lower bound either, and the chosen action's bytes never beat
+    it — preferring collective plans is structural, not tuned."""
+    placements = [SRC, DST, Placement.of({"data": 1}, {"data": "data"}),
+                  Placement.of({"data": 8}, {"data": "data"}, zero1=True)]
+    for a in placements:
+        for b in placements:
+            for leaf in LEAVES:
+                specs_ok = all(
+                    ax is None or ax in a.axis_sizes
+                    for ax in leaf.src_spec) and all(
+                    ax is None or ax in b.axis_sizes
+                    for ax in leaf.dst_spec)
+                if not specs_ok:
+                    continue
+                lp = plan_leaf(leaf, a, b)
+                assert lp.bytes_slice <= lp.bytes_gather
+                assert lp.bytes_slice <= lp.bytes_host
+                assert lp.bytes_lower_bound == lp.bytes_slice
+                assert lp.bytes_moved >= lp.bytes_lower_bound
+                forced = plan_leaf(leaf, a, b, force_host=True)
+                assert forced.action == HOST_FALLBACK
+                assert forced.bytes_moved >= lp.bytes_lower_bound
+
+
+def test_plan_actions_cover_the_vocabulary():
+    # identical placement -> keep, zero bytes
+    kp = plan_leaf(LEAVES[0], SRC, SRC)
+    assert kp.action == KEEP and kp.bytes_moved == 0
+    # pure refinement (replicated -> sharded) -> slice exchange at bound
+    solo = Placement.of({"data": 1}, {"data": "data"})
+    se = plan_leaf(LeafLayout("w", (8, 24), 4, (), (None, "model")),
+                   solo, SRC)
+    assert se.action == SLICE_EXCHANGE
+    assert se.bytes_moved == se.bytes_lower_bound
+    # coarsening (sharded -> replicated) gathers
+    ag = plan_leaf(LeafLayout("w", (8, 24), 4, (None, "model"), ()),
+                   SRC, solo)
+    assert ag.action == ALLGATHER_SHARD
+    s = plan_reshard(SRC, DST, LEAVES).summary()
+    assert s["n_leaves"] == 3 and s["bytes_total"] == sum(
+        l.bytes for l in LEAVES)
+    assert set(s["actions"]) <= set((KEEP, SLICE_EXCHANGE,
+                                     ALLGATHER_SHARD, HOST_FALLBACK))
+
+
+@pytest.mark.parametrize("bad", [
+    lambda: Placement.of({}, {}),
+    lambda: Placement.of({"data": 0}, {"data": "data"}),
+    lambda: Placement.of({"data": 2}, {"bogus": "data"}),
+    lambda: Placement.of({"data": 2}, {"model": "absent"}),
+    lambda: Placement.of({"data": 2}, {"data": "data"}, process_count=3),
+    lambda: Placement.of({"data": 2, "model": 2},
+                         {"data": "data", "model": "model"}, zero1=True),
+])
+def test_malformed_placements_are_rejected(bad):
+    with pytest.raises(PlacementError):
+        bad()
+
+
+def test_malformed_leaf_layouts_are_rejected():
+    # target-mesh-larger-than-checkpoint: a dim that cannot split
+    with pytest.raises(PlacementError, match="does not divide"):
+        plan_reshard(SRC, SRC,
+                     [LeafLayout("w", (9, 7), 4, (None, "model"), ())])
+    # spec naming an axis the mesh lacks
+    with pytest.raises(PlacementError, match="absent from the mesh"):
+        plan_reshard(SRC, SRC, [LeafLayout("w", (8, 8), 4, ("seq",), ())])
+    # more spec entries than dims
+    with pytest.raises(PlacementError, match="more entries than dims"):
+        plan_reshard(SRC, SRC,
+                     [LeafLayout("w", (8,), 4, (None, "model"), ())])
+
+
+def test_placement_json_round_trip():
+    for p in (SRC, Placement.of({"data": 8}, {"data": "data"},
+                                process_count=2, zero1=True)):
+        assert Placement.from_json(p.to_json()) == p
+    assert SRC.describe() == "2x4 (data=data,model=model) p1"
+    assert Placement.solo().describe() == "1 (data=data) p1"
+
+
+def test_planner_is_importable_without_jax():
+    """The planner is pure stdlib (CLI dry-runs and lint stubs import
+    it without a backend) — proven in a jax-poisoned subprocess."""
+    import subprocess
+
+    code = (
+        "import os, sys, types\n"
+        "poison = types.ModuleType('jax')\n"
+        "def _boom(*a, **k): raise AssertionError('jax imported')\n"
+        "poison.__getattr__ = lambda n: _boom()\n"
+        "sys.modules['jax'] = poison\n"
+        # the graftlint stub idiom: namespace-stub the package parents
+        # so planner.py loads without the root __init__'s jax imports
+        "for name in ('deeplearning4j_tpu', 'deeplearning4j_tpu.reshard'):\n"
+        "    mod = types.ModuleType(name)\n"
+        "    mod.__path__ = [os.path.join(os.getcwd(),\n"
+        "                                 *name.split('.'))]\n"
+        "    sys.modules[name] = mod\n"
+        "from deeplearning4j_tpu.reshard.planner import (Placement,\n"
+        "    LeafLayout, plan_reshard)\n"
+        "p = plan_reshard(\n"
+        "    Placement.of({'data': 2}, {'data': 'data'}),\n"
+        "    Placement.of({'data': 1}, {'data': 'data'}),\n"
+        "    [LeafLayout('w', (8, 8), 4, (), ())])\n"
+        "print(p.summary()['n_leaves'])\n")
+    out = subprocess.run([sys.executable, "-c", code],
+                         capture_output=True, text=True,
+                         cwd=os.path.dirname(os.path.dirname(
+                             os.path.abspath(__file__))), timeout=60)
+    assert out.returncode == 0 and out.stdout.strip() == "1", out.stderr
+
+
+# -------------------------------------------------------- matrix helpers
+
+V, D, H, L, FF, T, B = 64, 16, 2, 2, 32, 8, 8
+
+
+def _lm_data():
+    from deeplearning4j_tpu.datasets.api import DataSet
+
+    rng = np.random.default_rng(0)
+    toks = np.asarray(rng.integers(0, V, (B, T)), np.int32)
+    labs = np.eye(V, dtype=np.float32)[np.roll(toks, -1, axis=1)]
+    return DataSet(toks, labs)
+
+
+def _build_lm():
+    from deeplearning4j_tpu.models.transformer import transformer_lm
+
+    net = transformer_lm(vocab_size=V, d_model=D, n_heads=H, n_layers=L,
+                         d_ff=FF, max_length=T)
+    return net.init()
+
+
+@pytest.fixture(scope="module")
+def dense_ckpt(tmp_path_factory):
+    """One dense-trained step, checkpointed solo: every matrix case
+    rebuilds its source net from this (no per-case refit/compile)."""
+    from deeplearning4j_tpu.util.orbax_checkpoint import ShardedCheckpointer
+
+    d = str(tmp_path_factory.mktemp("dense") / "ckpt")
+    net = _build_lm()
+    net.fit(_lm_data())
+    ShardedCheckpointer(d).save(net)
+    return d
+
+
+def _host_leaves(tree):
+    return [np.asarray(l) for l in jax.tree.leaves(tree)]
+
+
+def _mesh(shape, names, n=None):
+    count = int(np.prod(shape))
+    return Mesh(DEVS[:count].reshape(shape), names)
+
+
+def _events_of(rec, kind):
+    return [e for e in rec.events if e.get("event") == kind]
+
+
+def _run_case(dense_ckpt, tmp_path, src_mesh, src_axes, dst_mesh,
+              dst_axes, *, zero1=False):
+    """Save under the source placement, restore through the planner
+    under the target placement, and prove params + optimizer state are
+    bit-identical to the uninterrupted reference values."""
+    from deeplearning4j_tpu.telemetry.recorder import Recorder, set_default
+    from deeplearning4j_tpu.util.orbax_checkpoint import ShardedCheckpointer
+
+    net = _build_lm()
+    if dense_ckpt is not None:
+        net.resume_from(dense_ckpt)
+    if src_mesh is not None:
+        net.set_mesh(src_mesh, axes=src_axes, zero1=zero1)
+        if zero1:
+            net.fit(_lm_data())  # one DP step so moments SHARD on disk
+    ref_p = _host_leaves(net.params)
+    ref_o = _host_leaves(net.opt_state)
+    ckpt = str(tmp_path / "ckpt")
+    ShardedCheckpointer(ckpt).save(net)
+
+    net2 = _build_lm()
+    if zero1:
+        net2.set_mesh(dst_mesh, zero1=True)
+    rec = Recorder()
+    prev = set_default(rec)
+    try:
+        step = net2.resume_from(ckpt, target_mesh=dst_mesh,
+                                target_axes=dst_axes)
+    finally:
+        set_default(prev)
+    assert step == net.iteration_count
+    got_p = _host_leaves(net2.params)
+    got_o = _host_leaves(net2.opt_state)
+    assert len(ref_p) == len(got_p)
+    assert all(np.array_equal(a, b) for a, b in zip(ref_p, got_p)), \
+        "params not bit-identical across the mesh transfer"
+    assert len(ref_o) == len(got_o)
+    assert all(np.array_equal(a, b) for a, b in zip(ref_o, got_o)), \
+        "optimizer state not bit-identical across the mesh transfer"
+    plans = _events_of(rec, "reshard_plan")
+    assert plans and plans[0]["path"] == "checkpoint"
+    assert not _events_of(rec, "host_gather")
+    return net2, plans[0]
+
+
+# ----------------------------------------------------------- the matrix
+
+def test_matrix_2x4_to_1x1(dense_ckpt, tmp_path):
+    """Train 2x4 (dp x tp) -> serve 1x1: the ROADMAP headline case."""
+    net2, plan = _run_case(
+        dense_ckpt, tmp_path,
+        _mesh((2, 4), ("data", "model")),
+        {"data": "data", "model": "model"},
+        _mesh((1,), ("data",)), {"data": "data"})
+    assert plan["src"].startswith("2x4") and plan["dst"].startswith("1 ")
+    # everything landed on the single target device
+    assert all(len(l.sharding.device_set) == 1
+               for l in jax.tree.leaves(net2.params))
+
+
+def test_matrix_1x1_to_2x2(dense_ckpt, tmp_path):
+    """Solo checkpoint grows onto a 2x2 dp x tp mesh: restored TP-rule
+    leaves arrive SHARDED (the restore read slices, not the whole)."""
+    net2, plan = _run_case(
+        dense_ckpt, tmp_path, None, None,
+        _mesh((2, 2), ("data", "model")),
+        {"data": "data", "model": "model"})
+    assert plan["src"].startswith("1 ")
+    sharded = [l for l in jax.tree.leaves(net2.params)
+               if not l.sharding.is_fully_replicated]
+    assert sharded, "no leaf took a TP sharding on the target mesh"
+
+
+def test_matrix_2x2_to_3x2(dense_ckpt, tmp_path):
+    """A non-power-of-two re-form (the elastic N'=3 shape, in-process)."""
+    _run_case(
+        dense_ckpt, tmp_path,
+        _mesh((2, 2), ("data", "model")),
+        {"data": "data", "model": "model"},
+        _mesh((3, 2), ("data", "model")),
+        {"data": "data", "model": "model"})
+
+
+def test_matrix_dp_tp_role_transpose(dense_ckpt, tmp_path):
+    """Same device grid, dp and tp roles swapped across the transfer."""
+    net2, plan = _run_case(
+        dense_ckpt, tmp_path,
+        _mesh((2, 4), ("data", "model")),
+        {"data": "data", "model": "model"},
+        _mesh((4, 2), ("data", "model")),
+        {"data": "data", "model": "model"})
+    assert plan["src"].startswith("2x4") and plan["dst"].startswith("4x2")
+
+
+def test_matrix_zero1_moments_reshard_8_to_4(tmp_path):
+    """zero1 optimizer moments written SHARDED over an 8-way data axis
+    restore bit-identically resharded over a 4-way axis — the
+    arXiv:2004.13336 composition the ISSUE names. (Trains from scratch
+    under the zero1 mesh: a restored net's committed single-device
+    arrays cannot feed the zero1-sharded pjit inputs.)"""
+    net2, _ = _run_case(
+        None, tmp_path,
+        _mesh((8,), ("data",)), {"data": "data"},
+        _mesh((4,), ("data",)), {"data": "data"}, zero1=True)
+    sharded = [l for l in jax.tree.leaves(net2.opt_state)
+               if hasattr(l, "sharding")
+               and not l.sharding.is_fully_replicated]
+    assert sharded, "no zero1 moment leaf took the target data sharding"
+
+
+def test_set_mesh_replacement_routes_through_plans(dense_ckpt, tmp_path):
+    """Re-placing an already-placed net (set_mesh after set_mesh) goes
+    through the live executor: bit-identical values, a `reshard_plan`
+    telemetry event with path=live, and the new placement applied."""
+    from deeplearning4j_tpu.telemetry.recorder import Recorder, set_default
+
+    net = _build_lm()
+    net.resume_from(dense_ckpt)
+    net.set_mesh(_mesh((2, 4), ("data", "model")),
+                 axes={"data": "data", "model": "model"})
+    ref = _host_leaves(net.params)
+    rec = Recorder()
+    prev = set_default(rec)
+    try:
+        net.set_mesh(_mesh((4, 2), ("data", "model")),
+                     axes={"data": "data", "model": "model"})
+    finally:
+        set_default(prev)
+    got = _host_leaves(net.params)
+    assert all(np.array_equal(a, b) for a, b in zip(ref, got))
+    plans = _events_of(rec, "reshard_plan")
+    assert plans and plans[0]["path"] == "live"
+    assert not _events_of(rec, "host_gather")
+
+
+# -------------------------------------------------- serving + CLI rides
+
+def test_engine_accepts_any_mesh_checkpoint(tmp_path):
+    """serve --checkpoint: a checkpoint written under an 8-way training
+    mesh restores into a solo serving engine through the planner (plan
+    on the record) and predictions match the source net."""
+    from deeplearning4j_tpu.serving import BucketLattice, InferenceEngine
+    from deeplearning4j_tpu.telemetry.recorder import Recorder, set_default
+    from deeplearning4j_tpu.util.orbax_checkpoint import ShardedCheckpointer
+    from tests.cluster_worker import C, F, build_net
+
+    rng = np.random.default_rng(7)
+    x = rng.random((8, F), dtype=np.float32)
+    y = np.eye(C, dtype=np.float32)[rng.integers(0, C, 8)]
+    net = build_net().init()
+    net.set_mesh(_mesh((8,), ("data",)))
+    net.fit(x, y)
+    ckpt = str(tmp_path / "ckpt")
+    ShardedCheckpointer(ckpt).save(net)
+    expected = np.asarray(net.output(x[:1]))
+
+    net2 = build_net()
+    rec = Recorder()
+    prev = set_default(rec)
+    try:
+        engine = InferenceEngine(net2, BucketLattice([1, 2]),
+                                 checkpoint=ckpt, recorder=rec)
+        engine.start()
+        got = np.asarray(engine.predict(x[0]))
+        engine.drain()
+    finally:
+        set_default(prev)
+    assert engine.restored_step == net.iteration_count
+    plans = _events_of(rec, "reshard_plan")
+    assert plans and plans[0]["path"] == "checkpoint"
+    assert plans[0]["src"].startswith("8 ")
+    np.testing.assert_allclose(got, expected.reshape(got.shape),
+                               rtol=0, atol=0)
+
+
+def test_cli_reshard_dry_run(tmp_path, capsys):
+    """`cli reshard --checkpoint --target-mesh` prints the plan with
+    bytes moved and writes a benchdiff-consumable RESHARD artifact;
+    an impossible target mesh is refused with the planner's message."""
+    from deeplearning4j_tpu.cli import driver
+    from deeplearning4j_tpu.telemetry import artifact
+    from deeplearning4j_tpu.util.orbax_checkpoint import ShardedCheckpointer
+
+    net = _build_lm()
+    net.fit(_lm_data())
+    net.set_mesh(_mesh((2, 4), ("data", "model")),
+                 axes={"data": "data", "model": "model"})
+    ckpt = str(tmp_path / "ckpt")
+    ShardedCheckpointer(ckpt).save(net)
+
+    art = str(tmp_path / "RESHARD_r01.json")
+    rc = driver.main(["reshard", "--checkpoint", ckpt,
+                      "--target-mesh", "data=1", "--artifact", art])
+    out = capsys.readouterr().out
+    assert rc == 0
+    assert "reshard plan:" in out and "bytes" in out
+    rows = artifact.load(art)
+    assert rows["reshard_bytes_moved"]["value"] > 0
+    assert rows["reshard_bytes_moved"].get("lower_is_better")
+    assert rows["reshard_plan_us"]["value"] > 0
+    assert rows["reshard_bytes_lower_bound"]["value"] <= \
+        rows["reshard_bytes_moved"]["value"]
+    # planner refusal surfaces as a usage error, not a traceback
+    with pytest.raises(SystemExit, match="does not divide"):
+        driver.main(["reshard", "--checkpoint", ckpt,
+                     "--target-mesh", "data=1,model=3"])

@@ -59,8 +59,12 @@ DEFAULT_THRESHOLD = 0.10
 # Serving latency metrics are LOWER-is-better: their lines carry
 # `lower_is_better: true` (serving/replay.py), and the name pattern
 # covers rows reconstructed from a summary line (which keeps only the
-# value) — p50/p99/_ms latency and retrace counts from SERVE artifacts.
-_LOWER_IS_BETTER_RE = re.compile(r"(_p\d+_ms$|_ms$|latency|recompiles)")
+# value) — p50/p99/_ms latency and retrace counts from SERVE artifacts,
+# plus RESHARD artifact rows (cli reshard dry run): bytes_moved /
+# bytes_lower_bound / plan-time _us growth is the regression direction.
+_LOWER_IS_BETTER_RE = re.compile(
+    r"(_p\d+_ms$|_ms$|latency|recompiles|bytes_moved$|bytes_lower_bound$"
+    r"|_us$)")
 
 
 def _lower_is_better(metric: str, old: dict, new: dict) -> bool:

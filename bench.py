@@ -1282,6 +1282,39 @@ def bench_serving_replay() -> None:
                 "client_failed": scoreboard["client"]["failed"]})
 
 
+def bench_serving_generate() -> None:
+    """Autoregressive generation serving bench (serving/replay.py
+    run_generation_replay): the seeded prompt-length x output-length
+    trace streams through POST /generate against a warmed
+    GenerationEngine — prefill/decode split over the paged KV cache —
+    and the scoreboard reconstructs from telemetry alone: tokens/sec
+    (higher-is-better), TTFT p50/p99 and peak cache-page occupancy
+    (lower-is-better; benchdiff inverts), and the zero-retrace row. The
+    SERVE_r02 artifact lands next to the BENCH one; the round gate is
+    benchdiff vs the previous generation artifact."""
+    import tempfile
+
+    from deeplearning4j_tpu.serving.replay import run_generation_replay
+
+    here = os.path.dirname(os.path.abspath(__file__))
+    artifact = os.environ.get(
+        "DL4J_TPU_SERVE_GEN_ARTIFACT", os.path.join(here,
+                                                    "SERVE_r02.json"))
+    tpath = os.path.join(tempfile.mkdtemp(prefix="serving_generate_"),
+                         "telemetry.jsonl")
+    scoreboard = run_generation_replay(
+        seed=0, n_requests=48, burst=2, mean_gap_s=0.004,
+        prompt_lengths=(8, 16, 32), output_lengths=(4, 8, 16),
+        slots=4, page_size=16, replicas=2, telemetry_path=tpath,
+        artifact_path=artifact, emit=_emit_info)
+    _emit_info({"metric": "serving_generate_artifact", "path": artifact,
+                "warmed_shapes": scoreboard["warmed_shapes"],
+                "n_ok": scoreboard["n_ok"],
+                "total_tokens": scoreboard["total_tokens"],
+                "decode_steps": scoreboard["decode_steps"],
+                "client_failed": scoreboard["client"]["failed"]})
+
+
 MODES = {
     "lenet": bench_lenet,
     "vgg16": bench_vgg16,
@@ -1298,6 +1331,7 @@ MODES = {
     "dropout": bench_transformer_dropout,
     "ringhop": bench_ringhop,
     "serving_replay": bench_serving_replay,
+    "serving_generate": bench_serving_generate,
 }
 
 

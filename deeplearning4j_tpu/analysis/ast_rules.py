@@ -939,6 +939,55 @@ def g017_serving_hot_path(tree, imports, path):
     return out
 
 
+# --------------------------------------------------------------- G019
+
+# Decode-loop discipline (serving/ only) — the generation-side twin of
+# G017's host-sync half. The decode loop emits ONE token per active
+# slot per step; the contract is ONE batch-boundary fetch of the whole
+# next-token vector per step (np.asarray on the [n_slots] array), then
+# host-side distribution. A `.item()` / `jax.device_get` /
+# `.block_until_ready()` inside a loop over token-ish values is a
+# device round-trip PER EMITTED TOKEN — at decode rates that serializes
+# the whole generation pipeline behind host latency.
+_G019_TOKENISH = re.compile(r"(^|_)(token|tok)s?($|_|\b)|decode",
+                            re.IGNORECASE)
+
+
+def g019_decode_loop_sync(tree, imports, path):
+    """Per-token host syncs inside decode loops (serving/ files only):
+    a for-loop whose target or iterable mentions token-ish names
+    (token/tok/decode) containing `.item()` / `jax.device_get` /
+    `.block_until_ready()`. The batch-boundary fetch — one sync for the
+    whole step's token vector, OUTSIDE such loops — never flags."""
+    norm = path.replace("\\", "/")
+    if "/serving/" not in norm:
+        return []
+    out = []
+    for loop in ast.walk(tree):
+        if not isinstance(loop, (ast.For, ast.AsyncFor)):
+            continue
+        if not (_g017_mentions(loop.target, _G019_TOKENISH)
+                or _g017_mentions(loop.iter, _G019_TOKENISH)):
+            continue
+        for node in ast.walk(loop):
+            if not isinstance(node, ast.Call):
+                continue
+            name = imports.canon(node.func)
+            is_sync = name in _G017_SYNC_CALLS or (
+                isinstance(node.func, ast.Attribute)
+                and node.func.attr in _G017_SYNC_ATTRS)
+            if is_sync:
+                out.append(("G019", node,
+                            "per-token host sync inside a decode loop: "
+                            "one device round-trip per emitted token "
+                            "serializes the generation pipeline behind "
+                            "host latency",
+                            "fetch the step's whole next-token vector "
+                            "ONCE (np.asarray at the batch boundary) "
+                            "and distribute host-side values"))
+    return out
+
+
 # stage-3 AST rules (G010-G014) live in spmd_rules.py and register here;
 # the import sits below every helper they borrow lazily, so importing
 # either module first resolves cleanly.
@@ -952,7 +1001,7 @@ ALL_RULES = [g001_traced_bool, g002_host_sync, g003_float64_drift,
              g006_shard_map_arity, g007_compat_bypass, g008_import_time,
              g009_rendezvous_routing,
              g016_hardcoded_block_literals,
-             g017_serving_hot_path] + SPMD_RULES
+             g017_serving_hot_path, g019_decode_loop_sync] + SPMD_RULES
 
 RULE_DOCS = {
     "G001": "python control flow / bool()/float()/int() on traced values",
@@ -970,6 +1019,10 @@ RULE_DOCS = {
     "G017": "serving hot-path discipline: unbucketed jit entries and "
             "per-request host syncs in serving/ (bucket dispatch and "
             "the batch-boundary fetch are exempt)",
+    "G019": "decode-loop discipline: per-token host syncs "
+            "(.item/device_get/block_until_ready) inside token-ish "
+            "loops in serving/ — the generation pipeline's per-step "
+            "batch-boundary fetch is the blessed pattern",
     **SPMD_RULE_DOCS,
 }
 

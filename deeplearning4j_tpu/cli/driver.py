@@ -19,6 +19,11 @@ Serving (the continuous-batching inference server, serving/):
         --checkpoint ckpt_dir ...        # resume a trained checkpoint
     python -m deeplearning4j_tpu.cli predict --server http://host:9090 \
         --input d.csv --output preds.csv # rows ride the server's batcher
+    python -m deeplearning4j_tpu.cli serve --conf lm.json \
+        --buckets 1x64,1x256 --generate-slots 4 --max-new-tokens 64 \
+        ...                              # autoregressive generation:
+                                         # prefill/decode split, paged
+                                         # KV cache, POST /generate
 
 Resharding (the portable resharding engine, reshard/ — train on one
 mesh, restore and serve on any other):
@@ -170,6 +175,23 @@ def _build_parser() -> argparse.ArgumentParser:
                     help="example request row (comma floats, or ints for "
                          "token models) to warm every bucket before "
                          "traffic; required for the zero-retrace promise")
+    sv.add_argument("--generate-slots", type=int, default=0, metavar="N",
+                    help="serve autoregressive generation instead of "
+                         "one-shot predict: a GenerationEngine with N "
+                         "decode slots per replica (prefill/decode "
+                         "split over a paged KV cache; POST /generate "
+                         "streams tokens). Needs a BxT --buckets "
+                         "lattice; warmup is automatic")
+    sv.add_argument("--max-new-tokens", type=int, default=64,
+                    help="generation output budget per request (also "
+                         "sizes the KV cache: capacity = max prompt "
+                         "bucket + this, page-quantized)")
+    sv.add_argument("--page-size", type=int, default=16,
+                    help="KV-cache page size in tokens "
+                         "(serving/kvcache.py accounting grid)")
+    sv.add_argument("--prefill-chunk", type=int, default=None,
+                    help="prompt chunk length for interleaved prefill "
+                         "(a lattice seq bucket; default: the largest)")
     sv.add_argument("--multiprocess", type=int, default=None, metavar="N",
                     help="dry run: print the N-process serving fleet "
                          "plan (one engine per process on the "
@@ -580,18 +602,33 @@ def _cmd_serve(args) -> int:
                 MultiLayerConfiguration.from_json(conf_json))
         net.init()
     lattice = BucketLattice.from_spec(args.buckets)
-    engine = InferenceEngine(net, lattice, replicas=args.replicas,
-                             max_wait_ms=args.max_wait_ms,
-                             sequence=args.sequence,
-                             checkpoint=args.checkpoint)
-    if args.warmup_features:
-        n = engine.warmup(_parse_warmup_features(args.warmup_features,
-                                                 args.sequence))
-        print(f"warmed {n} bucket shapes")
+    if args.generate_slots > 0:
+        from deeplearning4j_tpu.serving import GenerationEngine
+
+        engine = GenerationEngine(
+            net, lattice, slots=args.generate_slots,
+            max_new_tokens=args.max_new_tokens,
+            page_size=args.page_size,
+            prefill_chunk=args.prefill_chunk,
+            replicas=args.replicas, checkpoint=args.checkpoint)
+        n = engine.warmup()
+        print(f"warmed {n} prefill/decode shapes")
+    else:
+        engine = InferenceEngine(net, lattice, replicas=args.replicas,
+                                 max_wait_ms=args.max_wait_ms,
+                                 sequence=args.sequence,
+                                 checkpoint=args.checkpoint)
+        if args.warmup_features:
+            n = engine.warmup(_parse_warmup_features(args.warmup_features,
+                                                     args.sequence))
+            print(f"warmed {n} bucket shapes")
     server = ServingServer(engine, port=args.port, host=args.host).start()
     print(f"serving on {server.url} "
           f"(replicas={args.replicas}, buckets={args.buckets}, "
-          f"max-wait={args.max_wait_ms}ms)", flush=True)
+          f"max-wait={args.max_wait_ms}ms"
+          + (f", generate-slots={args.generate_slots}"
+             if args.generate_slots > 0 else "")
+          + ")", flush=True)
     try:
         import threading
 

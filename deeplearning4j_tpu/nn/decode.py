@@ -1,0 +1,422 @@
+"""Incremental autoregressive decode with an explicit KV cache.
+
+The reference's `rnnTimeStep` (MultiLayerNetwork.java:2147) is a
+stateful streaming-inference contract that our SelfAttention layers
+reject — attention "needs the full sequence" — so until r11 serving
+re-ran the whole forward per generated token: N tokens cost N
+full-sequence forwards. This module is the productionized incremental
+contract for transformer stacks, on BOTH containers:
+
+* ``make_decode_fn(net)`` — a pure jitted-step body
+  ``(params, state, cache, token, pos) -> (probs, cache)``: one new
+  token per cache row, positions per row (continuous batching mixes
+  rows at different depths), the KV cache threaded as explicit state.
+  Attention is single-query against the cache
+  (ops/decode_attention.py, `decode_attn` autotune family), so the
+  step's cost is independent of how much prompt each row has.
+* ``make_prefill_fn(net)`` — the chunked-prefill body
+  ``(params, state, cache, tokens, kmask, rows, start, last_idx) ->
+  (probs_last, cache)``: fills cache rows with a prompt chunk's K/V and
+  returns the last real token's output row. Within-chunk attention
+  reuses the autotuned flash kernels when the chunk is inside their
+  envelope (flash_attention_lse_masked — the same dispatch discipline
+  as training); the cross-chunk half (chunk queries against the
+  already-written cache prefix) runs through `cache_attention`, and the
+  two merge by the standard two-way LSE combine. `start` is per-row, so
+  a long prompt prefills in several bucket-shaped calls — the serving
+  engine interleaves decode steps between them.
+* ``init_cache(net, batch, capacity)`` — zeroed per-attention-layer
+  K/V pytree ``{layer: {"k": [B, S, H, D], "v": ...}}`` (key position
+  on axis 1 so per-position scatter writes are contiguous).
+
+Both fns are pure (no net mutation, no rng) so an external jit owner —
+the serving engine — controls the compile cache, exactly like
+`inference_fn`. Supported graphs: single-input/single-output stacks of
+time-pointwise layers (dense / embedding / layernorm / output heads /
+activation / dropout) plus causal SelfAttention and PositionalEncoding;
+elementwise/merge/scale/subset vertices ride along. Anything that mixes
+time any other way (LSTMs, convolutions over time, bidirectional
+attention) raises at build time with the offending layer named.
+
+Equivalence contract (tier-1, tests/test_generation.py): greedy decode
+through prefill + K incremental steps matches argmax over K
+full-sequence forwards at atol 1e-5.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from deeplearning4j_tpu.nn.conf.layers import (
+    ActivationLayer,
+    BaseOutputLayer,
+    DenseLayer,
+    DropoutLayer,
+    EmbeddingLayer,
+    LayerNormalization,
+    PositionalEncodingLayer,
+    SelfAttentionLayer,
+)
+from deeplearning4j_tpu.nn.training import tree_cast
+from deeplearning4j_tpu.ops.activations import get_activation
+from deeplearning4j_tpu.ops.decode_attention import cache_attention
+
+_POINTWISE = (DenseLayer, EmbeddingLayer, LayerNormalization,
+              BaseOutputLayer, ActivationLayer, DropoutLayer)
+
+_NEG_INF = -1e30
+
+
+# ------------------------------------------------------------- model plan
+
+class _Op:
+    """One traversal step: a layer or a non-layer vertex."""
+
+    __slots__ = ("kind", "name", "conf", "impl", "preproc", "inputs")
+
+    def __init__(self, kind, name, conf, impl, preproc, inputs):
+        self.kind = kind
+        self.name = name
+        self.conf = conf
+        self.impl = impl
+        self.preproc = preproc
+        self.inputs = inputs
+
+
+def _plan(net):
+    """-> (input_name, output_name, [ _Op ]) for either container,
+    validating every layer/vertex is incrementally decodable."""
+    from deeplearning4j_tpu.nn.graph import ComputationGraph
+
+    problems, ops = [], []
+    if isinstance(net, ComputationGraph):
+        from deeplearning4j_tpu.nn.conf.graph_conf import (
+            ElementWiseVertexConf,
+            LayerVertexConf,
+            MergeVertexConf,
+            ScaleVertexConf,
+            SubsetVertexConf,
+        )
+
+        ins, outs = net.conf.network_inputs, net.conf.network_outputs
+        if len(ins) != 1 or len(outs) != 1:
+            raise ValueError(
+                "incremental decode needs a single-input/single-output "
+                f"graph; this one has inputs {list(ins)} and outputs "
+                f"{list(outs)}")
+        for name in net.topo:
+            if name in ins:
+                continue
+            vconf = net.conf.vertices[name]
+            inputs = list(net.conf.vertex_inputs[name])
+            if isinstance(vconf, LayerVertexConf):
+                lc = vconf.layer
+                if not _decodable_layer(lc):
+                    problems.append(f"{name} ({type(lc).__name__})")
+                ops.append(_Op("layer", name, lc, net.impls[name],
+                               vconf.preprocessor, inputs))
+            elif isinstance(vconf, (ElementWiseVertexConf, MergeVertexConf,
+                                    ScaleVertexConf, SubsetVertexConf)):
+                ops.append(_Op("vertex", name, vconf, None, None, inputs))
+            else:
+                problems.append(f"{name} ({type(vconf).__name__})")
+        in_name, out_name = ins[0], outs[0]
+    else:
+        prev = "__input__"
+        for i, (name, lc, impl) in enumerate(zip(
+                net.layer_names, net.layer_confs, net.impls)):
+            if not _decodable_layer(lc):
+                problems.append(f"{name} ({type(lc).__name__})")
+            ops.append(_Op("layer", name, lc, impl,
+                           net.conf.get_preprocessor(i), [prev]))
+            prev = name
+        in_name, out_name = "__input__", prev
+    if problems:
+        raise ValueError(
+            "incremental decode supports transformer stacks (pointwise "
+            "layers + causal SelfAttention + PositionalEncoding); these "
+            "cannot stream one token at a time: " + ", ".join(problems))
+    return in_name, out_name, ops
+
+
+def _decodable_layer(lc) -> bool:
+    if isinstance(lc, SelfAttentionLayer):
+        return bool(lc.causal)  # non-causal attention reads the future
+    if isinstance(lc, PositionalEncodingLayer):
+        return True
+    return isinstance(lc, _POINTWISE)
+
+
+def attention_specs(net):
+    """[(layer_name, n_heads, head_dim)] for every attention layer —
+    the cache layout contract init_cache allocates by."""
+    _, _, ops = _plan(net)
+    return [(op.name, op.conf.n_heads, op.conf.n_out // op.conf.n_heads)
+            for op in ops
+            if op.kind == "layer" and isinstance(op.conf,
+                                                 SelfAttentionLayer)]
+
+
+def init_cache(net, batch: int, capacity: int):
+    """Zeroed KV cache: {layer: {"k": [batch, capacity, H, D], "v":
+    ...}} in the net's compute dtype. `capacity` is the per-row key
+    budget (prompt + generated, page-quantized by the serving layer)."""
+    dtype = net.compute_dtype
+    return {name: {"k": jnp.zeros((batch, capacity, H, D), dtype),
+                   "v": jnp.zeros((batch, capacity, H, D), dtype)}
+            for name, H, D in attention_specs(net)}
+
+
+# ------------------------------------------------------------ shared math
+
+def _sinusoidal_at(positions, d, dtype):
+    """Sinusoidal encodings at explicit positions [...] -> [..., d] —
+    the per-position twin of PositionalEncodingImpl._sinusoidal (same
+    f32 math, cast at the end, so decode matches the full forward)."""
+    pos = positions.astype(jnp.float32)[..., None]
+    dim = jnp.arange(0, d, 2).astype(jnp.float32)
+    angle = pos / jnp.power(10000.0, dim / d)
+    pe = jnp.zeros(positions.shape + (d,), jnp.float32)
+    pe = pe.at[..., 0::2].set(jnp.sin(angle))
+    pe = pe.at[..., 1::2].set(jnp.cos(angle[..., : d // 2]))
+    return pe.astype(dtype)
+
+
+def _dense_lse(qh, kh, vh, kmask):
+    """Within-chunk causal attention with (out, lse) — the fallback for
+    chunk shapes outside the flash envelope (tiny serving buckets, CPU
+    tier-1). qh/kh/vh [b, H, T, D]; kmask [b, T]. f32 softmax like
+    every other attention path."""
+    D, T = qh.shape[-1], qh.shape[2]
+    s = jnp.einsum("bhqd,bhkd->bhqk", qh, kh,
+                   preferred_element_type=jnp.float32) / jnp.sqrt(
+                       jnp.float32(D))
+    cm = jnp.tril(jnp.ones((T, T), bool))
+    s = jnp.where(cm, s, _NEG_INF)
+    s = jnp.where(kmask[:, None, None, :].astype(bool), s, _NEG_INF)
+    m = s.max(-1)
+    p = jnp.exp(s - m[..., None])
+    l = p.sum(-1)
+    o = jnp.einsum("bhqk,bhkd->bhqd", p, vh.astype(jnp.float32))
+    o = o / jnp.maximum(l, 1e-30)[..., None]
+    return o.astype(qh.dtype), m + jnp.log(jnp.maximum(l, 1e-30))
+
+
+def _chunk_self_lse(qh, kh, vh, kmask):
+    """Within-chunk causal attention (out, lse), through the autotuned
+    flash kernels when the chunk is inside their envelope — the prefill
+    half of the "reuse the flash kernels" contract."""
+    from deeplearning4j_tpu.ops import flash_attention as fa
+
+    b, H, T, D = qh.shape
+    if fa.supports(qh.shape, causal=True, dropout=0.0, mask=kmask):
+        # flat [b*H, T, D] layout is b-major, so the key mask repeats
+        # per head within each batch row
+        km = jnp.repeat(jnp.asarray(kmask, jnp.float32), H,
+                        axis=0)[:, None, :]
+        o, lse = fa.flash_attention_lse_masked(
+            qh.reshape(b * H, T, D), kh.reshape(b * H, T, D),
+            vh.reshape(b * H, T, D), km, 1.0 / float(D) ** 0.5, True)
+        return (o.reshape(b, H, T, D),
+                lse.reshape(b, H, T).astype(jnp.float32))
+    return _dense_lse(qh, kh, vh, kmask)
+
+
+def _merge_lse(o1, lse1, o2, lse2):
+    """Two-way blockwise softmax merge (the ring/chunk-loop combine):
+    each part carries its own lse; fully-masked parts (lse at the mask
+    floor) weigh to zero."""
+    m = jnp.maximum(lse1, lse2)
+    w1 = jnp.exp(lse1 - m)
+    w2 = jnp.exp(lse2 - m)
+    denom = jnp.maximum(w1 + w2, 1e-30)[..., None]
+    o = (o1.astype(jnp.float32) * w1[..., None]
+         + o2.astype(jnp.float32) * w2[..., None]) / denom
+    return o.astype(o1.dtype)
+
+
+# -------------------------------------------------------------- the walk
+
+def _walk(net, ops, in_name, out_name, params, state, x0, attn, posenc):
+    """Topo traversal with inference semantics (train=False, no rng),
+    attention/posenc routed to the supplied handlers. Mirrors the
+    containers' _forward dtype policy: float inputs and per-layer params
+    cast to the compute dtype."""
+    cdtype = net.compute_dtype
+    pdtype = net.param_dtype
+    x0 = jnp.asarray(x0)
+    if jnp.issubdtype(x0.dtype, jnp.floating):
+        x0 = x0.astype(cdtype)
+    acts = {in_name: x0}
+    for op in ops:
+        inputs = [acts[i] for i in op.inputs]
+        if op.kind == "layer":
+            x = inputs[0]
+            if op.preproc is not None:
+                x = op.preproc.pre_process(x)
+            p = params.get(op.name, {})
+            if cdtype != pdtype:
+                p = tree_cast(p, cdtype)
+            if isinstance(op.conf, SelfAttentionLayer):
+                y = attn(op.name, op.conf, p, x)
+            elif isinstance(op.conf, PositionalEncodingLayer):
+                y = posenc(op.name, op.conf, p, x)
+            else:
+                y, _ = op.impl.apply(op.conf, p, state.get(op.name, {}),
+                                     x, train=False, rng=None)
+            acts[op.name] = y
+        else:
+            acts[op.name] = _vertex(op.conf, inputs)
+    return acts[out_name]
+
+
+def _vertex(vconf, inputs):
+    from deeplearning4j_tpu.nn.conf.graph_conf import (
+        ElementWiseVertexConf,
+        MergeVertexConf,
+        ScaleVertexConf,
+        SubsetVertexConf,
+    )
+
+    if isinstance(vconf, MergeVertexConf):
+        return jnp.concatenate(inputs, axis=-1)
+    if isinstance(vconf, ScaleVertexConf):
+        return inputs[0] * vconf.scale
+    if isinstance(vconf, SubsetVertexConf):
+        return inputs[0][..., vconf.from_idx:vconf.to_idx + 1]
+    if isinstance(vconf, ElementWiseVertexConf):
+        op = vconf.op
+        out = inputs[0]
+        for x in inputs[1:]:
+            if op == "add":
+                out = out + x
+            elif op == "subtract":
+                out = out - x
+            elif op == "product":
+                out = out * x
+            elif op == "max":
+                out = jnp.maximum(out, x)
+            elif op == "average":
+                out = out + x
+            else:
+                raise ValueError(f"elementwise op {op}")
+        if op == "average":
+            out = out / len(inputs)
+        return out
+    raise ValueError(f"unhandled vertex {type(vconf).__name__}")
+
+
+def _split_heads(t, H):
+    b, T, n = t.shape
+    return t.reshape(b, T, H, n // H)
+
+
+# ------------------------------------------------------------ entry fns
+
+def make_decode_fn(net):
+    """-> pure ``step(params, state, cache, token, pos) -> (probs,
+    cache)``. token [B] int32; pos [B] int32 is the position the token
+    OCCUPIES (0-based — a row whose prompt filled [0, L) decodes its
+    first generated token at pos=L). probs [B, V] is the output layer's
+    activation row for that token; cache comes back with the token's
+    K/V written at (row, pos)."""
+    in_name, out_name, ops = _plan(net)
+
+    def step(params, state, cache, token, pos):
+        B = token.shape[0]
+        new_cache = dict(cache)
+        rows = jnp.arange(B)
+
+        def attn(name, conf, p, x):
+            H, n = conf.n_heads, conf.n_out
+            qkv = x[:, 0, :] @ p["Wqkv"] + p["bqkv"]       # [B, 3n]
+            q, k_new, v_new = jnp.split(qkv, 3, axis=-1)
+            Dh = n // H
+            entry = new_cache[name]
+            ck = entry["k"].at[rows, pos].set(
+                k_new.reshape(B, H, Dh).astype(entry["k"].dtype))
+            cv = entry["v"].at[rows, pos].set(
+                v_new.reshape(B, H, Dh).astype(entry["v"].dtype))
+            new_cache[name] = {"k": ck, "v": cv}
+            qh = q.reshape(B, H, 1, Dh)
+            o, _ = cache_attention(qh, ck, cv, (pos + 1)[:, None])
+            y = o[:, :, 0, :].reshape(B, n) @ p["Wo"] + p["bo"]
+            return get_activation(conf.activation or "identity")(
+                y)[:, None, :]
+
+        def posenc(name, conf, p, x):
+            d = x.shape[-1]
+            if conf.learned:
+                pe = jnp.take(p["pe"], pos, axis=0)        # [B, d]
+            else:
+                pe = _sinusoidal_at(pos, d, x.dtype)
+            return x + pe[:, None, :]
+
+        probs = _walk(net, ops, in_name, out_name, params, state,
+                      token[:, None], attn, posenc)
+        return probs[:, 0, :], new_cache
+
+    return step
+
+
+def make_prefill_fn(net):
+    """-> pure ``prefill(params, state, cache, tokens, kmask, rows,
+    start, last_idx) -> (probs_last, cache)``. tokens [b, Tc] int32 (a
+    bucket-shaped prompt chunk, zero-padded); kmask [b, Tc] (1 = real
+    token); rows [b] — which cache rows this chunk fills; start [b] —
+    the global position of the chunk's first token (0 for the first
+    chunk; later chunks of a long prompt attend the cache prefix they
+    already wrote); last_idx [b] — the LOCAL index of the last real
+    token in this chunk (its output row is gathered device-side so only
+    [b, V] comes home; pass Tc-1 for non-final chunks and ignore the
+    result). Padded positions write ZERO K/V (masked) and are
+    overwritten as decode advances."""
+    in_name, out_name, ops = _plan(net)
+
+    def prefill(params, state, cache, tokens, kmask, rows, start,
+                last_idx):
+        b, Tc = tokens.shape
+        new_cache = dict(cache)
+        local = jnp.arange(Tc)
+        positions = start[:, None] + local[None, :]        # [b, Tc]
+
+        def attn(name, conf, p, x):
+            H, n = conf.n_heads, conf.n_out
+            Dh = n // H
+            qkv = x @ p["Wqkv"] + p["bqkv"]                # [b, Tc, 3n]
+            q, k, v = jnp.split(qkv, 3, axis=-1)
+            entry = new_cache[name]
+            keep = kmask[..., None, None]
+            k_w = (_split_heads(k, H) * keep).astype(entry["k"].dtype)
+            v_w = (_split_heads(v, H) * keep).astype(entry["v"].dtype)
+            ck = entry["k"].at[rows[:, None], positions].set(k_w)
+            cv = entry["v"].at[rows[:, None], positions].set(v_w)
+            new_cache[name] = {"k": ck, "v": cv}
+            qh = _split_heads(q, H).transpose(0, 2, 1, 3)  # [b, H, Tc, Dh]
+            kh = _split_heads(k, H).transpose(0, 2, 1, 3)
+            vh = _split_heads(v, H).transpose(0, 2, 1, 3)
+            o1, lse1 = _chunk_self_lse(qh, kh, vh, kmask)
+            # cross-chunk half: queries against the cache prefix this
+            # row wrote before `start` (empty on the first chunk — its
+            # lse sits at the mask floor and merges to weight zero)
+            limit = jnp.broadcast_to(start[:, None], (b, Tc))
+            o2, lse2 = cache_attention(qh, ck[rows], cv[rows], limit)
+            o = _merge_lse(o1, lse1, o2, lse2)
+            y = o.transpose(0, 2, 1, 3).reshape(b, Tc, n)
+            y = y @ p["Wo"] + p["bo"]
+            return get_activation(conf.activation or "identity")(y)
+
+        def posenc(name, conf, p, x):
+            d = x.shape[-1]
+            if conf.learned:
+                pe = jnp.take(p["pe"], positions, axis=0)  # [b, Tc, d]
+            else:
+                pe = _sinusoidal_at(positions, d, x.dtype)
+            return x + pe
+
+        probs = _walk(net, ops, in_name, out_name, params, state,
+                      tokens, attn, posenc)
+        return probs[jnp.arange(b), last_idx, :], new_cache
+
+    return prefill

@@ -753,6 +753,36 @@ class ComputationGraph:
             return ys[0]
         return fwd
 
+    def incremental_decode_fn(self):
+        """A pure jitted-step body ``(params, state, cache, token, pos)
+        -> (probs, cache)`` — autoregressive decode with the KV cache as
+        explicit threaded state (nn/decode.py). The productionized
+        `rnn_time_step` contract for attention stacks: one new token per
+        cache row at its own position, single-query attention against
+        the cache, step cost independent of prompt length. External jit
+        owners (serving/engine.py GenerationEngine) control the compile
+        cache, exactly like `inference_fn`."""
+        from deeplearning4j_tpu.nn.decode import make_decode_fn
+
+        return make_decode_fn(self)
+
+    def prefill_fn(self):
+        """The chunked-prefill twin of `incremental_decode_fn`:
+        ``(params, state, cache, tokens, kmask, rows, start, last_idx)
+        -> (probs_last, cache)`` fills cache rows from a bucket-shaped
+        prompt chunk, reusing the autotuned flash kernels for the
+        within-chunk attention (nn/decode.py)."""
+        from deeplearning4j_tpu.nn.decode import make_prefill_fn
+
+        return make_prefill_fn(self)
+
+    def init_kv_cache(self, batch: int, capacity: int):
+        """Zeroed decode cache for `batch` rows of `capacity` key slots
+        (nn/decode.init_cache)."""
+        from deeplearning4j_tpu.nn.decode import init_cache
+
+        return init_cache(self, batch, capacity)
+
     def score(self, ds=None, training: bool = False):
         if ds is None:
             return self.score_value

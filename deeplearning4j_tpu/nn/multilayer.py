@@ -580,6 +580,32 @@ class MultiLayerNetwork:
             return y
         return fwd
 
+    def incremental_decode_fn(self):
+        """A pure jitted-step body ``(params, state, cache, token, pos)
+        -> (probs, cache)`` — autoregressive decode with the KV cache as
+        explicit threaded state (nn/decode.py; same contract as
+        ComputationGraph.incremental_decode_fn). This is the
+        productionized rnnTimeStep:2147 for attention stacks, which
+        `rnn_time_step` rejects as unable to stream causally."""
+        from deeplearning4j_tpu.nn.decode import make_decode_fn
+
+        return make_decode_fn(self)
+
+    def prefill_fn(self):
+        """The chunked-prefill twin of `incremental_decode_fn`:
+        ``(params, state, cache, tokens, kmask, rows, start, last_idx)
+        -> (probs_last, cache)`` — see nn/decode.make_prefill_fn."""
+        from deeplearning4j_tpu.nn.decode import make_prefill_fn
+
+        return make_prefill_fn(self)
+
+    def init_kv_cache(self, batch: int, capacity: int):
+        """Zeroed decode cache for `batch` rows of `capacity` key slots
+        (nn/decode.init_cache)."""
+        from deeplearning4j_tpu.nn.decode import init_cache
+
+        return init_cache(self, batch, capacity)
+
     def score(self, dataset: DataSet = None, training: bool = False):
         """Loss on a dataset (reference score()). training=False uses
         inference-mode forward (BatchNorm running stats, no dropout)."""

@@ -35,7 +35,10 @@ Entry params are kernel-specific: flash_fwd/flash_bwd take
 (+_pair) take ``g``; flash_chunk takes ``chunk``; fused_layer_norm takes
 ``rows``; softmax_xent takes ``block_n``/``block_v`` (caps — the row
 count varies per call while the key is (V, d), so the caps feed the same
-divisor search the defaults do). Every resolved value is validated
+divisor search the defaults do); decode_attn takes ``block_k`` (the key
+block a decode step streams the paged KV cache in — page multiples
+dividing the cache capacity S, keyed on (S, head_dim)). Every resolved
+value is validated
 against the kernel's structural constraints (divisibility, lane tiling,
 unroll budget) before use; an invalid entry falls back to the
 heuristics rather than producing an uncompilable grid.
@@ -83,6 +86,14 @@ DEFAULT_XENT_BLOCK_V = 2048
 # Fused layer-norm row block (r3).
 DEFAULT_LN_ROW_BLOCK = 512
 
+# Decode-attention key block (r11): single-query attention against a
+# paged KV cache streams the cache in blocks of block_k key positions
+# (page multiples) with a running-max/lse merge. The default cap keeps
+# a block's [bk, D] K/V slice plus the f32 score strip well inside
+# VMEM at every served head dim; the cap feeds a divisor search over
+# the cache capacity S (which is page-quantized, so divisors exist).
+DEFAULT_DECODE_BLOCK_K = 512
+
 # Kernel-proven chunk-tile lengths for the long-context loop, largest
 # first (the single home for the tiling envelope quoted in error
 # messages). 8192 is the monolithic kernels' VMEM envelope at
@@ -116,6 +127,7 @@ KERNEL_PARAMS = {
     "flash_chunk": ("chunk",),
     "fused_layer_norm": ("rows",),
     "softmax_xent": ("block_n", "block_v"),
+    "decode_attn": ("block_k",),
 }
 
 # Timing/provenance fields an entry may carry alongside its params.
@@ -348,6 +360,26 @@ def chunk_tile(T: int, D: int | None, *, causal: bool, dropout: bool,
                 and c <= max_tile_for_dim(D) and fits(c)):
             return c
     return None
+
+
+def decode_block(S: int, D: int) -> int:
+    """Key-block length for single-query decode attention against a
+    cache of capacity S (ops/decode_attention.py). The tuned value must
+    divide S (the cache capacity is page-quantized, so page-multiple
+    candidates always divide); any miss falls back to the largest
+    divisor of S within the swept cap — deterministic, so off-TPU runs
+    (table inactive) are bit-identical to the fallback."""
+    e = lookup("decode_attn", S, D)
+    if e:
+        bk = e.get("block_k")
+        if isinstance(bk, int) and 1 <= bk <= S and S % bk == 0:
+            return bk
+    if S <= DEFAULT_DECODE_BLOCK_K:
+        return S
+    for bk in range(DEFAULT_DECODE_BLOCK_K, 0, -1):
+        if S % bk == 0:
+            return bk
+    return S  # unreachable: 1 divides S
 
 
 def ln_rows(N: int, C: int) -> int:

@@ -1,0 +1,104 @@
+"""Attention against a paged KV cache — the decode-side op family.
+
+The autoregressive serving path (serving/engine.py GenerationEngine)
+threads a per-slot KV cache through a jitted step; its attention reads
+are structurally different from training attention:
+
+* `decode_attention` — SINGLE-query attention: one new token's query
+  per cache row against everything written so far (`pos` keys). The
+  [T, T] score matrix of the training kernels collapses to a [1, S]
+  strip, so the cost driver is streaming the cache out of HBM, not the
+  MXU — the knob is the key-block length `block_k` the cache is
+  streamed in (page multiples), resolved through the ops/autotune.py
+  tuning table under the `decode_attn` kernel family.
+* `cache_attention` — the general (multi-query) form behind it, also
+  the cross-chunk half of chunked prefill (nn/decode.py): chunk queries
+  against the already-written cache prefix, returning (out, lse) so the
+  caller can LSE-merge with the within-chunk flash result.
+
+Implementation is a blocked lax.scan over key blocks with the standard
+flash running-max/sum merge — an XLA-level kernel whose block_k is the
+tuning knob (a hand-written Pallas single-query kernel would slot in
+behind the same dispatch). Off-TPU the tuning table is inactive
+(autotune.table_active), so interpret/CPU runs always use the
+deterministic divisor-search default — bit-identical to the fallback by
+construction. Scores accumulate in f32 regardless of cache dtype.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+from deeplearning4j_tpu.ops import autotune
+
+_NEG_INF = -1e30
+
+
+@functools.partial(jax.jit, static_argnames=("block_k",))
+def _cache_attention_blocked(q, k, v, key_limit, block_k):
+    """q [B, H, Tq, D]; k, v [B, S, H, D] (cache layout: key position is
+    the second axis so per-position scatter writes are contiguous);
+    key_limit [B, Tq] — key j is visible to query (b, t) iff
+    j < key_limit[b, t]. Returns (out [B, H, Tq, D] in q.dtype,
+    lse [B, H, Tq] f32). All-masked rows produce a zero block and an
+    lse at the mask floor, which a downstream lse merge weighs away."""
+    B, S, H, D = k.shape
+    Tq = q.shape[2]
+    nb = S // block_k
+    sm_scale = 1.0 / jnp.sqrt(jnp.float32(D))
+    qf = q.astype(jnp.float32)
+    # [B, S, H, D] -> [nb, B, H, bk, D] so scan carries one block per step
+    kb = jnp.moveaxis(k.reshape(B, nb, block_k, H, D), 1, 0)
+    kb = kb.transpose(0, 1, 3, 2, 4)
+    vb = jnp.moveaxis(v.reshape(B, nb, block_k, H, D), 1, 0)
+    vb = vb.transpose(0, 1, 3, 2, 4)
+
+    m0 = jnp.full((B, H, Tq), _NEG_INF, jnp.float32)
+    l0 = jnp.zeros((B, H, Tq), jnp.float32)
+    acc0 = jnp.zeros((B, H, Tq, D), jnp.float32)
+
+    def body(carry, blk):
+        m, l, acc, j0 = carry
+        k_j, v_j = blk
+        s = jnp.einsum("bhqd,bhkd->bhqk", qf, k_j.astype(jnp.float32),
+                       preferred_element_type=jnp.float32) * sm_scale
+        idx = j0 + jnp.arange(block_k)
+        visible = idx[None, None, None, :] < key_limit[:, None, :, None]
+        s = jnp.where(visible, s, _NEG_INF)
+        m_new = jnp.maximum(m, s.max(-1))
+        alpha = jnp.exp(m - m_new)
+        p = jnp.exp(s - m_new[..., None])
+        l_new = l * alpha + p.sum(-1)
+        acc_new = acc * alpha[..., None] + jnp.einsum(
+            "bhqk,bhkd->bhqd", p, v_j.astype(jnp.float32))
+        return (m_new, l_new, acc_new, j0 + block_k), None
+
+    (m, l, acc, _), _ = jax.lax.scan(
+        body, (m0, l0, acc0, jnp.int32(0)), (kb, vb))
+    out = jnp.where(l[..., None] > 0.0, acc / jnp.maximum(l, 1e-30)[..., None],
+                    0.0)
+    lse = m + jnp.log(jnp.maximum(l, 1e-30))
+    return out.astype(q.dtype), lse
+
+
+def cache_attention(q, k, v, key_limit):
+    """Multi-query attention over a KV cache with a per-query visible-key
+    bound. Shapes as `_cache_attention_blocked`; block_k resolves through
+    the `decode_attn` tuning-table family (off-TPU: the deterministic
+    divisor-search default — bit-identical fallback)."""
+    S, D = k.shape[1], k.shape[3]
+    bk = autotune.decode_block(S, D)
+    return _cache_attention_blocked(q, k, v, key_limit, bk)
+
+
+def decode_attention(q, k, v, pos):
+    """Single-query decode attention: q [B, H, D] is the new token's
+    query at position pos [B] per cache row; the token's own K/V must
+    already be written at `pos`, so keys j <= pos are visible. Returns
+    [B, H, D] in q.dtype."""
+    out, _ = cache_attention(q[:, :, None, :], k, v,
+                             (pos + 1)[:, None])
+    return out[:, :, 0, :]

@@ -11,31 +11,59 @@ story neither provides:
   for long prompts).
 * `batcher.py`  — dynamic batching: single requests coalesce into
   bucket-shaped batches under a max-wait deadline (injectable clock —
-  the planner is a pure function, testable without sleeps).
+  the planner is a pure function, testable without sleeps); plus the
+  generation-side `GenRequest`/`DecodeSlots` decode-slot state machine.
+* `kvcache.py`  — page-block KV-cache accounting on the bucket lattice:
+  capacities quantized to the (seq-bucket, page) grid, per-replica
+  `PagePool` budgets with occupancy accounting and freed-on-completion
+  semantics (exhaustion queues or 503s, never crashes).
 * `engine.py`   — replica dispatch: one jitted forward worker per
   replica, round-robin batch assignment, checkpoint resume at startup,
-  graceful drain on shutdown, zero-retrace accounting.
+  graceful drain on shutdown, zero-retrace accounting. Since r11 also
+  `GenerationEngine`: prefill/decode-split autoregressive serving —
+  chunked prefills interleaved into running decode batches over the
+  KV cache (nn/decode.py steps), same zero-retrace discipline.
 * `server.py`   — the stdlib ThreadingHTTPServer front door
-  (`POST /predict`), same lifecycle idiom as `ui/server.py`.
+  (`POST /predict`, streaming `POST /generate`), same lifecycle idiom
+  as `ui/server.py`.
 * `replay.py`   — the traffic-replay bench: a seeded mixed-length /
   bursty trace, with p50/p99/QPS reconstructed from telemetry
-  `request` events ALONE (tools/trafficreplay.py is the CLI).
+  `request` events ALONE (tools/trafficreplay.py is the CLI); the
+  generation replay adds tokens/sec, TTFT percentiles, and cache-page
+  occupancy.
 
 Imports stay lazy/stdlib at package level so the graftlint AST stage's
 no-jax stubs can walk the files.
 """
 
-from deeplearning4j_tpu.serving.batcher import Batcher, PendingRequest, plan_batch
+from deeplearning4j_tpu.serving.batcher import (
+    Batcher,
+    DecodeSlots,
+    GenRequest,
+    PendingRequest,
+    plan_batch,
+)
 from deeplearning4j_tpu.serving.buckets import Bucket, BucketLattice
-from deeplearning4j_tpu.serving.engine import InferenceEngine
+from deeplearning4j_tpu.serving.engine import (
+    GenerationEngine,
+    InferenceEngine,
+    QueueFullError,
+)
+from deeplearning4j_tpu.serving.kvcache import CachePlan, PagePool
 from deeplearning4j_tpu.serving.server import ServingServer
 
 __all__ = [
     "Batcher",
     "Bucket",
     "BucketLattice",
+    "CachePlan",
+    "DecodeSlots",
+    "GenRequest",
+    "GenerationEngine",
     "InferenceEngine",
+    "PagePool",
     "PendingRequest",
+    "QueueFullError",
     "ServingServer",
     "plan_batch",
 ]

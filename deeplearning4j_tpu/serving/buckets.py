@@ -134,6 +134,22 @@ class BucketLattice:
         return [Bucket(b, s) for b in self.batch_sizes
                 for s in self.seq_lens]
 
+    def prefill_buckets(self, chunk: int) -> list[int]:
+        """The generation engine's prefill warmup set: every seq bucket
+        up to the chunk length (a long prompt arrives as a sequence of
+        exactly these shapes, so warming them freezes the prefill trace
+        count — the decode-side zero-retrace contract). The chunk must
+        itself be a lattice point: an unwarmed chunk shape would be a
+        guaranteed mid-traffic retrace."""
+        if self.seq_lens is None:
+            raise ValueError("generation needs a sequence lattice "
+                             "(construct with seq_lens)")
+        if chunk not in self.seq_lens:
+            raise ValueError(
+                f"prefill chunk {chunk} must be a lattice seq bucket "
+                f"{list(self.seq_lens)} — chunks are warmed shapes")
+        return [t for t in self.seq_lens if t <= chunk]
+
     # -------------------------------------------------------- validation
     def validate_attention(self, head_dim: int, *, causal: bool = True,
                            dropout: bool = False,

@@ -33,11 +33,19 @@ import queue
 import threading
 import time
 import traceback
+from collections import deque
 
 import numpy as np
 
-from deeplearning4j_tpu.serving.batcher import Batch, Batcher
+from deeplearning4j_tpu.serving.batcher import (Batch, Batcher, DecodeSlots,
+                                                GenRequest)
 from deeplearning4j_tpu.serving.buckets import Bucket, BucketLattice
+from deeplearning4j_tpu.serving.kvcache import CachePlan
+
+
+class QueueFullError(RuntimeError):
+    """Generation admission refused: the page pool and the pending queue
+    are both full — the front door's graceful 503, never a crash."""
 
 
 class _Replica:
@@ -330,4 +338,491 @@ class InferenceEngine:
             "restored_step": self.restored_step,
             "lattice": self.lattice.describe(),
             "sequence": self.sequence,
+        }
+
+
+# --------------------------------------------------------------- generation
+
+class _GenWorker:
+    """One generation replica: its own KV-cache allocation, page pool,
+    decode-slot state machine, and jit wrappers (own compile cache, own
+    trace counter) for the prefill and decode steps.
+
+    The loop interleaves chunked prefills into the running decode batch:
+    each iteration admits what the pool allows, runs at most ONE prompt
+    chunk (so a long prefill never starves decoding slots), then one
+    decode step over all slots. The decode step's shape is FIXED —
+    [n_slots] tokens and positions against the [n_slots, capacity]
+    cache — so it compiles exactly once; inactive rows decode a dummy
+    token whose K/V write is routed to the scratch position
+    (capacity - 1), which any real tenant overwrites before it can ever
+    be attended (a token's own K/V lands at its position in the same
+    step that reads it)."""
+
+    def __init__(self, index: int, net, lattice: BucketLattice,
+                 plan: CachePlan, prefill_chunk: int, max_queue: int,
+                 recorder):
+        import jax
+        import jax.numpy as jnp
+
+        self.index = index
+        self.net = net
+        self.lattice = lattice
+        self.plan = plan
+        self.prefill_chunk = prefill_chunk
+        self.max_queue = max_queue
+        self.recorder = recorder
+        self.pool = plan.make_pool()
+        self.slots = DecodeSlots(plan.n_slots)
+        self.cache = net.init_kv_cache(plan.n_slots, plan.capacity)
+        self.trace_count = 0
+        self.served = 0
+        self.failed = 0
+        self.tokens_out = 0
+        self._seen_shapes: set = set()
+        self.pending: deque[GenRequest] = deque()
+        self._cv = threading.Condition()
+        self._closed = False
+        self._thread: threading.Thread | None = None
+
+        prefill_raw = net.prefill_fn()
+        step_raw = net.incremental_decode_fn()
+
+        def counted_prefill(params, state, cache, padded_tokens,
+                            bucket_kmask, rows, start, last_idx):
+            self.trace_count += 1  # trace-time bump: the retrace tell
+            probs, cache = prefill_raw(params, state, cache,
+                                       padded_tokens, bucket_kmask,
+                                       rows, start, last_idx)
+            return jnp.argmax(probs, axis=-1).astype(jnp.int32), cache
+
+        def counted_step(params, state, cache, padded_tokens, pos):
+            self.trace_count += 1
+            probs, cache = step_raw(params, state, cache, padded_tokens,
+                                    pos)
+            return jnp.argmax(probs, axis=-1).astype(jnp.int32), cache
+
+        self._prefill_jit = jax.jit(counted_prefill)
+        self._decode_jit = jax.jit(counted_step)
+
+    # ---------------------------------------------------------- planning
+    def chunk_buckets(self) -> list:
+        """The prefill shapes this worker ever compiles (the lattice
+        owns the set — buckets.prefill_buckets)."""
+        return self.lattice.prefill_buckets(self.prefill_chunk)
+
+    def _next_chunk_len(self, remaining: int) -> int:
+        """Bucket-shaped length of the next prompt chunk: full chunks
+        while more than a chunk remains, the bucketed remainder last."""
+        if remaining >= self.prefill_chunk:
+            return self.prefill_chunk
+        return self.lattice.seq_bucket(remaining)
+
+    # ------------------------------------------------------------ warmup
+    def warmup(self, clock) -> int:
+        """Compile every (prefill-bucket) shape plus the decode step
+        once, before traffic. After this the trace counter is frozen —
+        a mixed prompt/output-length replay must add zero."""
+        compiles = 0
+        rows = np.zeros(1, np.int32)
+        start = np.zeros(1, np.int32)
+        for Tb in self.chunk_buckets():
+            key = ("prefill", Tb)
+            if key in self._seen_shapes:
+                continue
+            with self.recorder.span("compile", kind="prefill",
+                                    bucket=[1, Tb], replica=self.index,
+                                    warmup=True):
+                tok, cache = self._prefill_jit(
+                    self.net.params, self.net.state, self.cache,
+                    np.zeros((1, Tb), np.int32),
+                    np.zeros((1, Tb), np.float32), rows, start,
+                    np.asarray([Tb - 1], np.int32))
+                np.asarray(tok)  # batch-boundary fetch
+                self.cache = cache
+            self._seen_shapes.add(key)
+            compiles += 1
+        if "decode" not in self._seen_shapes:
+            B = self.plan.n_slots
+            scratch = np.full(B, self.plan.capacity - 1, np.int32)
+            with self.recorder.span("compile", kind="decode",
+                                    shape=[B, self.plan.capacity],
+                                    replica=self.index, warmup=True):
+                tok, cache = self._decode_jit(
+                    self.net.params, self.net.state, self.cache,
+                    np.zeros(B, np.int32), scratch)
+                np.asarray(tok)  # batch-boundary fetch
+                self.cache = cache
+            self._seen_shapes.add("decode")
+            compiles += 1
+        return compiles
+
+    # --------------------------------------------------------- admission
+    def submit(self, req: GenRequest) -> None:
+        pages = self.plan.request_pages(
+            self.lattice.seq_bucket(req.prompt_len), req.max_new_tokens)
+        if pages > self.pool.n_pages:
+            raise ValueError(
+                f"request needs {pages} cache pages but the replica "
+                f"pool holds {self.pool.n_pages} — prompt + "
+                "max_new_tokens exceed the cache geometry")
+        with self._cv:
+            if self._closed:
+                raise RuntimeError("engine is draining; request refused")
+            if len(self.pending) >= self.max_queue:
+                raise QueueFullError(
+                    "generation queue full (page pool saturated and "
+                    f"{self.max_queue} requests already waiting) — "
+                    "retry later")
+            self.pending.append(req)
+            self._cv.notify_all()
+
+    def _admit(self, clock) -> None:
+        with self._cv:
+            while self.pending:
+                idx = self.slots.free_index()
+                if idx is None:
+                    return
+                req = self.pending[0]
+                pages = self.plan.request_pages(
+                    self.lattice.seq_bucket(req.prompt_len),
+                    req.max_new_tokens)
+                if not self.pool.try_reserve(pages):
+                    return  # pool exhausted: stays queued, not dropped
+                self.pending.popleft()
+                req.t_admitted = clock()
+                self.slots.admit(idx, req, pages)
+                self.recorder.event("page_pool", replica=self.index,
+                                    **self.pool.describe())
+
+    # ----------------------------------------------------------- compute
+    def _run_prefill_chunk_bucketed(self, slot_idx: int, clock) -> None:
+        """One bucket-shaped prompt chunk for one slot. The argument
+        names and the enclosing span keep the G017/G019 contract
+        visible: the jit sees only padded bucket arrays, and the only
+        host fetch is the one batch-boundary np.asarray of the
+        next-token id."""
+        slot = self.slots.slots[slot_idx]
+        req = slot.request
+        L = req.prompt_len
+        Tc = self._next_chunk_len(L - slot.start)
+        n_real = min(Tc, L - slot.start)
+        padded_tokens = np.zeros((1, Tc), np.int32)
+        padded_tokens[0, :n_real] = req.tokens[slot.start:slot.start
+                                               + n_real]
+        bucket_kmask = np.zeros((1, Tc), np.float32)
+        bucket_kmask[0, :n_real] = 1.0
+        final = slot.start + n_real >= L
+        key = ("prefill", Tc)
+        first = key not in self._seen_shapes
+        try:
+            with self.recorder.span("prefill_chunk", bucket=[1, Tc],
+                                    start=slot.start, replica=self.index,
+                                    final=final):
+                args = (self.net.params, self.net.state, self.cache,
+                        padded_tokens, bucket_kmask,
+                        np.asarray([slot_idx], np.int32),
+                        np.asarray([slot.start], np.int32),
+                        np.asarray([n_real - 1], np.int32))
+                if first:
+                    with self.recorder.span("compile", kind="prefill",
+                                            bucket=[1, Tc],
+                                            replica=self.index):
+                        tok, cache = self._prefill_jit(*args)
+                        toks = np.asarray(tok)  # batch-boundary fetch
+                    self._seen_shapes.add(key)
+                else:
+                    tok, cache = self._prefill_jit(*args)
+                    toks = np.asarray(tok)  # batch-boundary fetch
+        except Exception as exc:
+            self._fail_slot(slot_idx, exc, clock)
+            return
+        self.cache = cache
+        slot.start += n_real
+        if final:
+            # the prompt's last forward row IS the first generated
+            # token: TTFT is this chunk's completion
+            slot.pos = L
+            slot.last_token = int(toks[0])
+            now = clock()
+            req.emit(slot.last_token, now)
+            self.tokens_out += 1
+            self._maybe_complete(slot_idx, clock)
+
+    def _decode_batch_step(self, active: list, clock) -> None:
+        """One fixed-shape decode step over every slot row; `active`
+        names the rows whose outputs are real. One np.asarray for the
+        whole [n_slots] next-token vector — the batch-boundary fetch —
+        then host-side distribution to the slots."""
+        B = self.plan.n_slots
+        padded_tokens = np.zeros(B, np.int32)
+        pos = np.full(B, self.plan.capacity - 1, np.int32)  # scratch
+        for i in active:
+            slot = self.slots.slots[i]
+            padded_tokens[i] = slot.last_token
+            pos[i] = slot.pos
+        try:
+            with self.recorder.span("decode_step", replica=self.index,
+                                    n_active=len(active)):
+                tok, cache = self._decode_jit(
+                    self.net.params, self.net.state, self.cache,
+                    padded_tokens, pos)
+                toks = np.asarray(tok)  # batch-boundary fetch
+        except Exception as exc:
+            for i in active:
+                self._fail_slot(i, exc, clock)
+            return
+        self.cache = cache
+        now = clock()
+        for i in active:
+            slot = self.slots.slots[i]
+            slot.pos += 1
+            slot.last_token = int(toks[i])
+            slot.request.emit(slot.last_token, now)
+            self.tokens_out += 1
+            self._maybe_complete(i, clock)
+
+    # -------------------------------------------------------- lifecycle
+    def _maybe_complete(self, slot_idx: int, clock) -> None:
+        slot = self.slots.slots[slot_idx]
+        req = slot.request
+        if len(req.emitted) < req.max_new_tokens:
+            return
+        self.pool.release(self.slots.release(slot_idx))
+        self.recorder.event("page_pool", replica=self.index,
+                            **self.pool.describe())
+        req.finish(clock())
+        self.served += 1
+        self._request_event(req, ok=True)
+
+    def _fail_slot(self, slot_idx: int, exc: Exception, clock) -> None:
+        """Mid-decode death containment: the slot's request fails
+        loudly, its PAGES ARE RELEASED, and the worker keeps serving —
+        mirror of the predict replica's worker-death contract."""
+        slot = self.slots.slots[slot_idx]
+        req = slot.request
+        self.pool.release(self.slots.release(slot_idx))
+        self.recorder.event("page_pool", replica=self.index,
+                            **self.pool.describe())
+        self.recorder.error(f"gen-replica:{self.index}", exc=exc)
+        err = "".join(traceback.format_exception_only(type(exc),
+                                                      exc)).strip()
+        req.finish(clock(), error=err)
+        self.failed += 1
+        self._request_event(req, ok=False, error=err)
+
+    def _request_event(self, req: GenRequest, *, ok,
+                       error: str | None = None) -> None:
+        fields = dict(
+            ok=ok, kind="generate", replica=self.index,
+            prompt_len=req.prompt_len,
+            prompt_bucket=self.lattice.seq_bucket(req.prompt_len),
+            new_tokens=len(req.emitted),
+            queue_s=round(req.t_admitted - req.t_enqueue, 6),
+            total_s=round(req.t_done - req.t_enqueue, 6))
+        if req.t_first_token:
+            fields["ttft_s"] = round(req.t_first_token - req.t_enqueue, 6)
+        if error:
+            fields["error"] = error
+        self.recorder.request(req.request_id, **fields)
+
+    def start(self, clock) -> None:
+        def loop():
+            while True:
+                self._admit(clock)
+                progressed = False
+                pi = self.slots.next_prefill()
+                if pi is not None:
+                    self._run_prefill_chunk_bucketed(pi, clock)
+                    progressed = True
+                active = self.slots.decoding()
+                if active:
+                    self._decode_batch_step(active, clock)
+                    progressed = True
+                if progressed:
+                    continue
+                with self._cv:
+                    if self._closed and not self.pending \
+                            and not self.slots.busy():
+                        return
+                    if not self.pending or self.slots.free_index() is None:
+                        self._cv.wait(timeout=0.05)
+
+        self._thread = threading.Thread(target=loop, daemon=True,
+                                        name=f"gen-replica-{self.index}")
+        self._thread.start()
+
+    def close(self) -> None:
+        with self._cv:
+            self._closed = True
+            self._cv.notify_all()
+
+    def join(self, timeout: float | None = None) -> None:
+        if self._thread is not None:
+            self._thread.join(timeout)
+
+    @property
+    def depth(self) -> int:
+        with self._cv:
+            return len(self.pending)
+
+
+class GenerationEngine:
+    """Autoregressive generation serving: prefill/decode split over a
+    paged KV cache, continuous batching across decode slots.
+
+    Where `InferenceEngine` answers one forward per request, this
+    engine holds each admitted request in a decode SLOT: its prompt
+    prefills the slot's cache rows chunk-by-chunk (interleaved into the
+    running decode batch so long prompts don't stall everyone else's
+    tokens), then every decode step extends all active slots by one
+    token — N generated tokens cost prefill + N single-token steps, not
+    N full-sequence forwards. Shapes are lattice/page-grid points only:
+    warmup compiles each (replica, prefill-bucket) and the (replica,
+    decode-shape) once, and the trace counters stay frozen under mixed
+    traffic (tier-1 asserts it). Page accounting and the
+    exhaustion-queues-not-crashes contract live in serving/kvcache.py."""
+
+    def __init__(self, net, lattice: BucketLattice, *, slots: int = 4,
+                 max_new_tokens: int = 16, page_size: int = 16,
+                 pool_pages: int | None = None,
+                 prefill_chunk: int | None = None, max_queue: int = 64,
+                 replicas: int = 1, checkpoint: str | None = None,
+                 recorder=None):
+        if recorder is None:
+            from deeplearning4j_tpu.telemetry import get_default
+
+            recorder = get_default()
+        self.recorder = recorder
+        if lattice.seq_lens is None:
+            raise ValueError("generation needs a sequence lattice "
+                             "(BucketLattice with seq_lens)")
+        if net.params is None:
+            net.init()
+        self.restored_step = 0
+        if checkpoint is not None:
+            import jax
+
+            from deeplearning4j_tpu.parallel.mesh import make_mesh
+
+            self.restored_step = int(net.resume_from(
+                checkpoint,
+                target_mesh=make_mesh({"data": 1},
+                                      devices=jax.local_devices())))
+        self.net = net
+        self.lattice = lattice
+        chunk = (lattice.max_seq if prefill_chunk is None
+                 else int(prefill_chunk))
+        lattice.prefill_buckets(chunk)  # raises on a non-lattice chunk
+        self.plan = CachePlan(lattice.max_seq, max_new_tokens,
+                              max(1, int(slots)), page_size,
+                              pool_pages=pool_pages)
+        self._clock = time.monotonic
+        self._workers = [
+            _GenWorker(i, net, lattice, self.plan, chunk, max_queue,
+                       recorder)
+            for i in range(max(1, int(replicas)))]
+        self._rr = 0
+        self._started = False
+        recorder.meta(role="generation-engine",
+                      replicas=len(self._workers),
+                      lattice=lattice.describe(),
+                      cache=self.plan.describe(),
+                      prefill_chunk=chunk,
+                      restored_step=self.restored_step)
+
+    # ------------------------------------------------------------- warmup
+    def warmup(self) -> int:
+        """Compile every (replica, prefill-bucket) and (replica,
+        decode-shape) once. Returns the compile count; after this the
+        trace counters are frozen."""
+        return sum(w.warmup(self._clock) for w in self._workers)
+
+    # ------------------------------------------------------------ serving
+    def start(self) -> "GenerationEngine":
+        if self._started:
+            return self
+        self._started = True
+        for w in self._workers:
+            w.start(self._clock)
+        return self
+
+    def submit_generate(self, tokens, max_new_tokens: int | None = None,
+                        request_id: str | None = None) -> GenRequest:
+        """Admit one generation request. Validates the prompt against
+        the lattice (a too-long prompt is the client's 400) and the
+        output budget against the cache geometry; a saturated pool +
+        full queue raises QueueFullError (HTTP 503), never a crash."""
+        toks = np.asarray(tokens)
+        if toks.ndim != 1:
+            raise ValueError(
+                f"generation takes a [T] token prompt; got {toks.shape}")
+        self.lattice.seq_bucket(int(toks.shape[0]))  # raises if too long
+        max_new = (self.plan.max_new_tokens if max_new_tokens is None
+                   else int(max_new_tokens))
+        if not 1 <= max_new <= self.plan.max_new_tokens:
+            raise ValueError(
+                f"max_new_tokens must be in [1, "
+                f"{self.plan.max_new_tokens}]; got {max_new}")
+        from deeplearning4j_tpu.serving.batcher import _req_counter
+
+        req = GenRequest(tokens=toks.astype(np.int32),
+                         max_new_tokens=max_new,
+                         request_id=request_id
+                         or f"g{next(_req_counter)}",
+                         t_enqueue=self._clock())
+        worker = self._workers[self._rr % len(self._workers)]
+        self._rr += 1
+        worker.submit(req)
+        return req
+
+    def generate(self, tokens, max_new_tokens: int | None = None,
+                 timeout: float = 60.0) -> list:
+        """Synchronous convenience: submit + wait; returns the emitted
+        token list. Raises on failure or timeout."""
+        req = self.submit_generate(tokens, max_new_tokens)
+        if not req.wait(timeout):
+            raise TimeoutError(f"request {req.request_id} timed out "
+                               f"after {timeout}s")
+        if req.error is not None:
+            raise RuntimeError(f"request {req.request_id} failed: "
+                               f"{req.error}")
+        return list(req.emitted)
+
+    # -------------------------------------------------------------- drain
+    def drain(self, timeout: float = 30.0) -> None:
+        for w in self._workers:
+            w.close()
+        for w in self._workers:
+            w.join(timeout)
+        self.recorder.event("span", name="drain", ok=True, seconds=0.0,
+                            served=self.served, failed=self.failed)
+
+    # -------------------------------------------------------------- stats
+    @property
+    def trace_count(self) -> int:
+        return sum(w.trace_count for w in self._workers)
+
+    @property
+    def served(self) -> int:
+        return sum(w.served for w in self._workers)
+
+    @property
+    def failed(self) -> int:
+        return sum(w.failed for w in self._workers)
+
+    def stats(self) -> dict:
+        pools = [w.pool.describe() for w in self._workers]
+        return {
+            "replicas": len(self._workers),
+            "served": self.served,
+            "failed": self.failed,
+            "tokens_out": sum(w.tokens_out for w in self._workers),
+            "queue_depth": sum(w.depth for w in self._workers),
+            "trace_count": self.trace_count,
+            "restored_step": self.restored_step,
+            "lattice": self.lattice.describe(),
+            "cache": self.plan.describe(),
+            "page_pools": pools,
+            "generate": True,
         }

@@ -23,6 +23,15 @@ Latency metrics are LOWER-is-better — their lines carry
 ``lower_is_better: true`` and tools/benchdiff.py inverts its regression
 direction for them (and for `*_p50_ms`/`*_p99_ms`-shaped names
 recovered from a summary line, which drops the flag).
+
+The GENERATION replay (r11) is the same triple for the prefill/decode
+path: `make_generation_trace` (prompt-length x output-length mix),
+`replay_generate_http` (streaming /generate reads), and
+`reconstruct_generation` — tokens/sec, TTFT p50/p99, peak cache-page
+occupancy (from `page_pool` events), and the decode-step span medians
+that prove decode cost independent of prompt length. Artifact:
+SERVE_r02-style, written by `run_generation_replay` /
+tools/trafficreplay.py --generate / bench.py serving_generate.
 """
 
 from __future__ import annotations
@@ -30,6 +39,7 @@ from __future__ import annotations
 import concurrent.futures
 import json
 import time
+import urllib.error
 import urllib.request
 
 import numpy as np
@@ -208,6 +218,260 @@ def write_artifact(path: str, lines: list) -> dict:
             fh.write(json.dumps(line) + "\n")
         fh.write(json.dumps(summary) + "\n")
     return summary
+
+
+# ----------------------------------------------------- generation replay
+
+def make_generation_trace(seed: int = 0, n_requests: int = 24, *,
+                          mean_gap_s: float = 0.01, burst: int = 2,
+                          prompt_lengths=(8, 16, 32),
+                          output_lengths=(4, 8, 16),
+                          weights=None) -> list:
+    """[(arrival_offset_s, prompt_len, output_len), ...] — the
+    generation twin of `make_trace`: seeded, bursty arrivals with a
+    prompt-length x output-length mix, so two rounds replay identical
+    traffic and the prefill buckets AND decode budgets both get
+    exercised."""
+    rng = np.random.default_rng(seed)
+    plens = list(prompt_lengths)
+    olens = list(output_lengths)
+    if weights is not None:
+        weights = np.asarray(weights, np.float64)
+        weights = weights / weights.sum()
+    t = 0.0
+    trace = []
+    for i in range(n_requests):
+        if i % max(1, burst) == 0 and i:
+            t += float(rng.exponential(mean_gap_s * burst))
+        plen = int(rng.choice(plens, p=weights))
+        olen = int(rng.choice(olens))
+        trace.append((round(t, 6), plen, olen))
+    return trace
+
+
+def replay_generate_http(url: str, trace, *, make_prompt,
+                         time_scale: float = 1.0,
+                         timeout_s: float = 120.0) -> dict:
+    """POST every trace entry to `url`/generate at its arrival offset
+    and drain the STREAMING body (each token line arrives as the decode
+    loop emits it). `make_prompt(index, prompt_len)` builds the token
+    prompt — deterministic per index. Client-side counts only; the
+    scoreboard reconstructs from telemetry."""
+    t_start = time.monotonic()
+
+    def one(idx_entry):
+        i, (offset, plen, olen) = idx_entry
+        delay = offset * time_scale - (time.monotonic() - t_start)
+        if delay > 0:
+            time.sleep(delay)
+        toks = np.asarray(make_prompt(i, plen))
+        body = json.dumps({"tokens": toks.tolist(),
+                           "max_new_tokens": olen,
+                           "id": f"gen-{i}"}).encode()
+        req = urllib.request.Request(
+            f"{url}/generate", data=body,
+            headers={"Content-Type": "application/json"})
+        last = None
+        for _attempt in range(2):
+            try:
+                with urllib.request.urlopen(req, timeout=timeout_s) as resp:
+                    lines = [json.loads(l)
+                             for l in resp.read().splitlines() if l]
+                if not lines or not lines[-1].get("done"):
+                    return f"gen-{i}: stream ended without summary"
+                if lines[-1].get("error"):
+                    return f"gen-{i}: {lines[-1]['error']}"
+                return None
+            except urllib.error.HTTPError as exc:
+                # 503 = pool saturated + queue full: the graceful
+                # refusal contract, reported distinctly from transport
+                # errors
+                return f"gen-{i}: HTTP {exc.code}"
+            except Exception as exc:
+                last = exc
+        return f"gen-{i}: {last!r}"
+
+    with concurrent.futures.ThreadPoolExecutor(_CLIENT_WORKERS) as pool:
+        results = list(pool.map(one, enumerate(trace)))
+    errors = [r for r in results if r is not None]
+    return {"sent": len(results), "ok": len(results) - len(errors),
+            "failed": len(errors), "errors": errors[:5],
+            "wall_s": round(time.monotonic() - t_start, 3)}
+
+
+def reconstruct_generation(telemetry_path: str) -> dict:
+    """The generation scoreboard from the telemetry JSONL alone:
+
+    * tokens/sec — total generated tokens over the serving span (first
+      enqueue to last completion), from `request` events with
+      kind="generate";
+    * time-to-first-token p50/p99 (ms) — the `ttft_s` field (enqueue to
+      the prefill's final chunk emitting the first token);
+    * cache-page occupancy — the PEAK pages_in_use/pages_total across
+      `page_pool` events (lower = the same traffic held fewer resident
+      pages);
+    * `recompiles_after_warmup` — non-warmup `compile` spans, exactly
+      the predict path's zero-retrace gate;
+    * decode-step timing per prompt bucket — median `decode_step` span
+      seconds, the flatness evidence for "decode cost is independent of
+      prompt length".
+    """
+    requests, compiles, warm_compiles = [], 0, 0
+    occupancy_peak = 0.0
+    decode_spans = []
+    with open(telemetry_path) as fh:
+        for raw in fh:
+            raw = raw.strip()
+            if not raw.startswith("{"):
+                continue
+            try:
+                ev = json.loads(raw)
+            except json.JSONDecodeError:
+                continue
+            kind = ev.get("event")
+            if kind == "request" and ev.get("kind") == "generate":
+                requests.append(ev)
+            elif kind == "span" and ev.get("name") == "compile":
+                if ev.get("warmup"):
+                    warm_compiles += 1
+                else:
+                    compiles += 1
+            elif kind == "span" and ev.get("name") == "decode_step":
+                decode_spans.append(ev)
+            elif kind == "page_pool":
+                total = ev.get("pages_total") or 0
+                if total:
+                    occupancy_peak = max(
+                        occupancy_peak,
+                        float(ev.get("pages_in_use", 0)) / total)
+    ok = [ev for ev in requests if ev.get("ok")]
+    ttft_ms = sorted(1000.0 * float(ev["ttft_s"]) for ev in ok
+                     if "ttft_s" in ev)
+    total_tokens = sum(int(ev.get("new_tokens", 0)) for ev in ok)
+    out = {
+        "n_requests": len(requests),
+        "n_ok": len(ok),
+        "n_failed": len(requests) - len(ok),
+        "total_tokens": total_tokens,
+        "ttft_p50_ms": round(_percentile(ttft_ms, 50), 3),
+        "ttft_p99_ms": round(_percentile(ttft_ms, 99), 3),
+        "page_occupancy_peak": round(occupancy_peak, 4),
+        "warmup_compiles": warm_compiles,
+        "recompiles_after_warmup": compiles,
+        "decode_steps": len(decode_spans),
+    }
+    if decode_spans:
+        secs = sorted(float(ev.get("seconds", 0.0))
+                      for ev in decode_spans)
+        out["decode_step_ms_p50"] = round(
+            1000.0 * _percentile(secs, 50), 3)
+    if ok:
+        first_enqueue = min(float(ev["ts"]) - float(ev["total_s"])
+                            for ev in ok)
+        last_done = max(float(ev["ts"]) for ev in ok)
+        span = max(last_done - first_enqueue, 1e-9)
+        out["tokens_per_sec"] = round(total_tokens / span, 2)
+        out["span_s"] = round(span, 3)
+    else:
+        out["tokens_per_sec"] = 0.0
+        out["span_s"] = 0.0
+    return out
+
+
+def generation_metric_lines(scoreboard: dict,
+                            prefix: str = "serving_generate") -> list:
+    """Bench metric lines for the generation scoreboard. tokens/sec is
+    higher-is-better (the default); TTFT latency, cache-page occupancy,
+    and the retrace count carry the explicit lower_is_better flag
+    benchdiff inverts on."""
+    return [
+        {"metric": f"{prefix}_tokens_per_sec",
+         "value": scoreboard["tokens_per_sec"], "unit": "tok/sec",
+         "n_ok": scoreboard["n_ok"], "n_failed": scoreboard["n_failed"],
+         "total_tokens": scoreboard["total_tokens"]},
+        {"metric": f"{prefix}_ttft_p50_ms",
+         "value": scoreboard["ttft_p50_ms"], "unit": "ms",
+         "lower_is_better": True},
+        {"metric": f"{prefix}_ttft_p99_ms",
+         "value": scoreboard["ttft_p99_ms"], "unit": "ms",
+         "lower_is_better": True},
+        {"metric": f"{prefix}_page_occupancy",
+         "value": scoreboard["page_occupancy_peak"], "unit": "fraction",
+         "lower_is_better": True},
+        {"metric": f"{prefix}_recompiles_after_warmup",
+         "value": scoreboard["recompiles_after_warmup"], "unit": "count",
+         "lower_is_better": True,
+         "warmup_compiles": scoreboard["warmup_compiles"]},
+    ]
+
+
+def run_generation_replay(*, seed: int = 0, n_requests: int = 24,
+                          burst: int = 2, mean_gap_s: float = 0.01,
+                          prompt_lengths=(8, 16, 32),
+                          output_lengths=(4, 8, 16),
+                          slots: int = 4, page_size: int = 16,
+                          replicas: int = 1,
+                          prefill_chunk: int | None = None,
+                          max_queue: int = 256,
+                          telemetry_path: str,
+                          artifact_path: str | None = None,
+                          checkpoint: str | None = None,
+                          emit=None) -> dict:
+    """End-to-end generation replay: tiny LM, GenerationEngine warmed
+    over the prompt-bucket lattice, the seeded generation trace over
+    real HTTP with streaming reads, drain, scoreboard from telemetry
+    alone, optional SERVE artifact (the SERVE_r02 shape). Same rc
+    semantics as `run_replay`."""
+    from deeplearning4j_tpu.serving.buckets import BucketLattice
+    from deeplearning4j_tpu.serving.engine import GenerationEngine
+    from deeplearning4j_tpu.serving.server import ServingServer
+    from deeplearning4j_tpu.telemetry import Recorder
+
+    rec = Recorder(telemetry_path)
+    rec.meta(role="trafficreplay-generate", seed=seed,
+             n_requests=n_requests, burst=burst,
+             prompt_lengths=list(prompt_lengths),
+             output_lengths=list(output_lengths))
+    lattice = BucketLattice(batch_sizes=(1,),
+                            seq_lens=sorted(set(prompt_lengths)))
+    lattice.validate_attention(head_dim=16)
+    net = _tiny_lm(max_seq=max(prompt_lengths) + max(output_lengths))
+    vocab = 64
+    prompt_rng = np.random.default_rng(seed + 1)
+    prompts = prompt_rng.integers(0, vocab,
+                                  (n_requests, max(prompt_lengths)))
+
+    def make_prompt(i, plen):
+        return prompts[i, :plen].astype(np.int32)
+
+    engine = GenerationEngine(
+        net, lattice, slots=slots, max_new_tokens=max(output_lengths),
+        page_size=page_size, prefill_chunk=prefill_chunk,
+        max_queue=max_queue, replicas=replicas, checkpoint=checkpoint,
+        recorder=rec)
+    warm = engine.warmup()
+    server = ServingServer(engine, port=0).start()
+    trace = make_generation_trace(
+        seed, n_requests, mean_gap_s=mean_gap_s, burst=burst,
+        prompt_lengths=prompt_lengths, output_lengths=output_lengths)
+    try:
+        client = replay_generate_http(server.url, trace,
+                                      make_prompt=make_prompt)
+    finally:
+        server.stop()
+        rec.close()
+    scoreboard = reconstruct_generation(telemetry_path)
+    scoreboard["client"] = client
+    scoreboard["warmed_shapes"] = warm
+    lines = generation_metric_lines(scoreboard)
+    if emit is not None:
+        for line in lines:
+            emit(line)
+    if artifact_path:
+        scoreboard["summary"] = write_artifact(artifact_path, lines)
+        scoreboard["artifact"] = artifact_path
+    scoreboard["lines"] = lines
+    return scoreboard
 
 
 # ----------------------------------------------------------- the harness

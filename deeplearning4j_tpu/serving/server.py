@@ -12,6 +12,17 @@ Endpoints (all JSON):
                         prompt longer than the lattice max; 500 when the
                         batch's forward worker died (the error string
                         names the cause); 503 while draining.
+    POST /generate      {"tokens": [...], "max_new_tokens": N?, "id"?}
+                        -> STREAMING NDJSON (one {"token": t, "i": k}
+                        line per generated token as it decodes, then a
+                        {"done": true, "tokens": [...], "timing": ...}
+                        summary line; close-delimited body). Requires a
+                        GenerationEngine (serving/engine.py). 400 on
+                        malformed/oversized prompts, 503 while draining
+                        or when the KV-cache page pool and pending
+                        queue are saturated (kvcache.py — exhaustion
+                        queues or refuses, never crashes), 404 when the
+                        engine has no generation path.
     GET  /healthz       {"status", "replicas", "lattice", "served", ...}
     GET  /stats         the engine's full counter dict
     POST /drain         begin graceful drain (stop admitting; pending
@@ -73,6 +84,9 @@ class _Handler(BaseHTTPRequestHandler):
             self.serving.begin_drain()
             self._json({"status": "draining"})
             return
+        if route == "/generate":
+            self._generate()
+            return
         if route != "/predict":
             self._json({"error": f"unknown path {self.path}"}, 404)
             return
@@ -112,6 +126,75 @@ class _Handler(BaseHTTPRequestHandler):
                 "total_s": round(req.t_done - req.t_enqueue, 6),
             },
         })
+
+
+    def _generate(self):
+        """Streaming generation: tokens flow to the client line-by-line
+        as the decode loop emits them (queue → NDJSON; the body is
+        close-delimited, so plain urllib readers see each line as it
+        flushes). The summary line carries the full token list and the
+        TTFT/total timing so a client that only reads the tail still
+        gets everything."""
+        engine = self.serving.engine
+        if not hasattr(engine, "submit_generate"):
+            self._json({"error": "this engine does not serve "
+                                 "generation (start a "
+                                 "GenerationEngine)"}, 404)
+            return
+        if self.serving.draining:
+            self._json({"error": "draining; not admitting requests"}, 503)
+            return
+        try:
+            length = int(self.headers.get("Content-Length", 0))
+            payload = json.loads(self.rfile.read(length) or b"{}")
+            tokens = np.asarray(payload["tokens"])
+            max_new = payload.get("max_new_tokens")
+        except (KeyError, ValueError, TypeError) as exc:
+            self._json({"error": f"bad request body: {exc!r}"}, 400)
+            return
+        from deeplearning4j_tpu.serving.engine import QueueFullError
+
+        try:
+            req = engine.submit_generate(tokens, max_new,
+                                         request_id=payload.get("id"))
+        except QueueFullError as exc:
+            self._json({"error": str(exc)}, 503)
+            return
+        except (ValueError, RuntimeError) as exc:
+            code = 503 if "draining" in str(exc) else 400
+            self._json({"error": str(exc)}, code)
+            return
+        self.send_response(200)
+        self.send_header("Content-Type", "application/x-ndjson")
+        self.end_headers()
+        i = 0
+        while True:
+            try:
+                tok = req.stream.get(timeout=REQUEST_TIMEOUT_S)
+            except Exception:
+                self._line({"id": req.request_id, "error": "timed out"})
+                return
+            if tok is None:
+                break
+            self._line({"token": int(tok), "i": i})
+            i += 1
+        summary = {"done": True, "id": req.request_id,
+                   "tokens": list(req.emitted),
+                   "timing": {
+                       "queue_s": round(req.t_admitted - req.t_enqueue, 6),
+                       "ttft_s": (round(req.t_first_token - req.t_enqueue,
+                                        6) if req.t_first_token else None),
+                       "total_s": round(req.t_done - req.t_enqueue, 6)}}
+        if req.error is not None:
+            summary["error"] = req.error
+        self._line(summary)
+
+    def _line(self, obj) -> None:
+        try:
+            self.wfile.write((json.dumps(obj) + "\n").encode())
+            self.wfile.flush()
+        except (BrokenPipeError, ConnectionResetError):
+            pass  # client went away mid-stream; the engine finishes anyway
 
 
 def _argmax_last(out: np.ndarray):

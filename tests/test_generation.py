@@ -1,0 +1,494 @@
+"""Tier-1 gate for autoregressive generation serving (ISSUE 11):
+decode-vs-full-forward parity (the incremental step IS the forward),
+chunked-prefill parity, the page-pool accounting contract (exhaustion
+queues or refuses, never crashes), the zero-retrace promise across a
+mixed prompt/output-length replay, decode-step cost independent of
+prompt length (telemetry span timings), and the generation scoreboard
+reconstruction behind tools/trafficreplay.py --generate."""
+
+import json
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.serving import replay
+from deeplearning4j_tpu.serving.batcher import DecodeSlots, GenRequest
+from deeplearning4j_tpu.serving.buckets import BucketLattice
+from deeplearning4j_tpu.serving.engine import (GenerationEngine,
+                                               QueueFullError)
+from deeplearning4j_tpu.serving.kvcache import (CachePlan, PagePool,
+                                                pages_for, quantize)
+from deeplearning4j_tpu.serving.server import ServingServer
+from deeplearning4j_tpu.telemetry import Recorder
+
+pytestmark = pytest.mark.serving
+
+
+def _greedy_full_forward(net, prompt, k):
+    """Reference decode: argmax over k FULL-sequence forwards."""
+    toks = [int(t) for t in prompt]
+    out = []
+    for _ in range(k):
+        probs = np.asarray(net.output(np.asarray(toks, np.int32)[None, :]))
+        nxt = int(np.argmax(probs[0, -1]))
+        out.append(nxt)
+        toks.append(nxt)
+    return out
+
+
+def _greedy_incremental(net, prompt, k, *, capacity=32, bucket=8,
+                        chunk=None):
+    """Incremental decode: one prefill (optionally chunked) + k-1
+    single-token steps through the container's decode entries."""
+    import jax
+
+    prefill = jax.jit(net.prefill_fn())
+    step = jax.jit(net.incremental_decode_fn())
+    cache = net.init_kv_cache(1, capacity)
+    L = len(prompt)
+    starts = ([0] if chunk is None
+              else list(range(0, L, chunk)))
+    tok = None
+    for s in starts:
+        n_real = min((chunk or L), L - s)
+        Tb = chunk if (chunk and n_real == chunk) else max(
+            bucket, 1 << (n_real - 1).bit_length())
+        tokens = np.zeros((1, Tb), np.int32)
+        tokens[0, :n_real] = prompt[s:s + n_real]
+        kmask = np.zeros((1, Tb), np.float32)
+        kmask[0, :n_real] = 1.0
+        probs, cache = prefill(net.params, net.state, cache, tokens,
+                               kmask, np.zeros(1, np.int32),
+                               np.asarray([s], np.int32),
+                               np.asarray([n_real - 1], np.int32))
+        tok = int(np.argmax(np.asarray(probs)[0]))
+    out = [tok]
+    pos = L
+    for _ in range(k - 1):
+        probs, cache = step(net.params, net.state, cache,
+                            np.asarray([tok], np.int32),
+                            np.asarray([pos], np.int32))
+        tok = int(np.argmax(np.asarray(probs)[0]))
+        out.append(tok)
+        pos += 1
+    return out, np.asarray(probs)[0]
+
+
+# ------------------------------------------------------ decode parity
+
+def test_incremental_decode_matches_full_forward_graph_lm():
+    """THE tentpole property: greedy decode of K tokens from the
+    incremental step (prefill + KV-cache decode) matches argmax over K
+    full-sequence forwards — same tokens, probs at atol 1e-5."""
+    net = replay._tiny_lm(32)
+    rng = np.random.default_rng(3)
+    prompt = rng.integers(0, 64, 6).astype(np.int32)
+    k = 6
+    ref = _greedy_full_forward(net, prompt, k)
+    inc, last_probs = _greedy_incremental(net, prompt, k)
+    assert inc == ref
+    # the final step's probs match the full forward's last row
+    toks = list(prompt) + ref
+    full = np.asarray(net.output(np.asarray(toks[:-1], np.int32)[None]))
+    np.testing.assert_allclose(last_probs, full[0, -1], atol=1e-5)
+
+
+def test_chunked_prefill_matches_single_shot():
+    """A long prompt prefilled in bucket-shaped chunks (the interleave
+    unit) fills the cache identically to one-shot prefill: the decode
+    that follows produces the same tokens."""
+    net = replay._tiny_lm(32)
+    rng = np.random.default_rng(4)
+    prompt = rng.integers(0, 64, 13).astype(np.int32)
+    one_shot, _ = _greedy_incremental(net, prompt, 5, bucket=16)
+    chunked, _ = _greedy_incremental(net, prompt, 5, chunk=8)
+    ref = _greedy_full_forward(net, prompt, 5)
+    assert one_shot == ref
+    assert chunked == ref
+
+
+def test_incremental_decode_matches_full_forward_mln():
+    """Both containers carry the contract: a sequential MultiLayerNetwork
+    transformer stack decodes incrementally to the same greedy tokens
+    as its full forward."""
+    from deeplearning4j_tpu.nn.conf import NeuralNetConfiguration
+    from deeplearning4j_tpu.nn.conf.layers import (EmbeddingLayer,
+                                                   LayerNormalization,
+                                                   PositionalEncodingLayer,
+                                                   RnnOutputLayer,
+                                                   SelfAttentionLayer)
+    from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+
+    conf = (NeuralNetConfiguration.builder().seed(9).list()
+            .layer(EmbeddingLayer(n_in=32, n_out=16,
+                                  activation="identity", has_bias=False))
+            .layer(PositionalEncodingLayer(max_length=32, n_features=16))
+            .layer(SelfAttentionLayer(n_in=16, n_out=16, n_heads=2,
+                                      causal=True, activation="identity"))
+            .layer(LayerNormalization(n_in=16, n_out=16))
+            .layer(RnnOutputLayer(n_in=16, n_out=32, activation="softmax",
+                                  loss_function="mcxent"))
+            .build())
+    net = MultiLayerNetwork(conf).init()
+    rng = np.random.default_rng(5)
+    prompt = rng.integers(0, 32, 5).astype(np.int32)
+    ref = _greedy_full_forward(net, prompt, 4)
+    inc, _ = _greedy_incremental(net, prompt, 4, capacity=16)
+    assert inc == ref
+
+
+def test_non_causal_attention_is_rejected():
+    from deeplearning4j_tpu.nn.decode import make_decode_fn
+    from deeplearning4j_tpu.models.transformer import transformer_lm
+
+    net = transformer_lm(vocab_size=32, d_model=16, n_heads=2,
+                        n_layers=1, d_ff=16, max_length=8)
+    for v in net.conf.vertices.values():
+        lc = getattr(v, "layer", None)
+        if lc is not None and hasattr(lc, "causal"):
+            lc.causal = False
+    net.init()
+    with pytest.raises(ValueError, match="cannot stream"):
+        make_decode_fn(net)
+
+
+def test_prefill_bucket_set_is_lattice_owned():
+    """The prefill warmup set lives on the lattice: every seq bucket up
+    to the chunk, and a chunk off the lattice is rejected (an unwarmed
+    chunk shape would be a guaranteed mid-traffic retrace)."""
+    lat = BucketLattice(batch_sizes=(1,), seq_lens=(8, 16, 32))
+    assert lat.prefill_buckets(16) == [8, 16]
+    assert lat.prefill_buckets(32) == [8, 16, 32]
+    with pytest.raises(ValueError, match="lattice seq bucket"):
+        lat.prefill_buckets(12)
+    with pytest.raises(ValueError, match="sequence lattice"):
+        BucketLattice(batch_sizes=(1, 2)).prefill_buckets(8)
+
+
+# ----------------------------------------------------- page accounting
+
+def test_page_math_quantizes_to_grid():
+    assert pages_for(0, 16) == 0
+    assert pages_for(1, 16) == 1
+    assert pages_for(16, 16) == 1
+    assert pages_for(17, 16) == 2
+    assert quantize(17, 16) == 32
+    plan = CachePlan(max_seq_bucket=32, max_new_tokens=16, n_slots=4,
+                     page_size=16)
+    assert plan.capacity == 48 and plan.pages_per_slot == 3
+    assert plan.pool_pages == 12
+    assert plan.request_pages(8, 4) == 1
+    assert plan.request_pages(32, 16) == 3
+
+
+def test_page_pool_reserve_release_occupancy():
+    pool = PagePool(4, page_size=8)
+    assert pool.try_reserve(3)
+    assert not pool.try_reserve(2)  # all-or-nothing, no partial grant
+    assert pool.try_reserve(1)
+    assert pool.occupancy == 1.0 and pool.peak_occupancy == 1.0
+    pool.release(3)
+    assert pool.in_use == 1
+    assert pool.peak_in_use == 4  # high-water mark survives release
+    with pytest.raises(ValueError, match="double release"):
+        pool.release(2)
+
+
+def test_decode_slots_state_machine():
+    slots = DecodeSlots(2)
+    assert slots.free_index() == 0 and not slots.busy()
+    r1 = GenRequest(tokens=np.arange(4), max_new_tokens=2, t_enqueue=0.0)
+    r1.t_admitted = 1.0
+    s1 = slots.admit(0, r1, pages=2)
+    r2 = GenRequest(tokens=np.arange(6), max_new_tokens=2, t_enqueue=0.0)
+    r2.t_admitted = 2.0
+    slots.admit(1, r2, pages=2)
+    assert slots.free_index() is None
+    # oldest-first prefill; a slot starts decoding once its prompt is in
+    assert slots.next_prefill() == 0
+    s1.start = 4
+    assert slots.next_prefill() == 1
+    assert slots.decoding() == [0]
+    r1.emitted = [1, 2]  # budget spent: no longer decoding
+    assert slots.decoding() == []
+    assert slots.release(0) == 2
+    assert slots.free_index() == 0
+
+
+def test_pool_exhaustion_queues_then_503_never_crashes():
+    """The acceptance failure mode: a saturated page pool queues
+    admissions; a full queue is a graceful QueueFullError (HTTP 503) —
+    and every ACCEPTED request still completes after the pool frees."""
+    net = replay._tiny_lm(16)
+    rec = Recorder(path=None)
+    lat = BucketLattice(batch_sizes=(1,), seq_lens=(8,))
+    engine = GenerationEngine(net, lat, slots=1, max_new_tokens=8,
+                              page_size=8, max_queue=2, recorder=rec)
+    engine.warmup()
+    rng = np.random.default_rng(7)
+    prompts = [rng.integers(0, 64, 5).astype(np.int32) for _ in range(6)]
+    accepted, refused = [], 0
+    for p in prompts:  # engine not started: the queue can only grow
+        try:
+            accepted.append(engine.submit_generate(p, 4))
+        except QueueFullError:
+            refused += 1
+    # engine not started, so nothing drains: exactly max_queue admitted
+    assert len(accepted) == 2 and refused == 4
+    engine.start()
+    for req in accepted:
+        assert req.wait(60), "accepted request starved after exhaustion"
+        assert req.error is None and len(req.emitted) == 4
+    # a request that can NEVER fit the pool is refused outright
+    big = GenerationEngine(net, lat, slots=1, max_new_tokens=8,
+                           page_size=8, pool_pages=1, recorder=rec)
+    with pytest.raises(ValueError, match="exceed the cache geometry"):
+        big.submit_generate(prompts[0], 8)
+    engine.drain()
+
+
+# ---------------------------------------------------- zero-retrace gate
+
+def test_zero_retrace_across_mixed_generation_replay():
+    """Warmup compiles each (replica, prefill-bucket) and the decode
+    shape ONCE; a mixed prompt-length x output-length stream adds zero
+    — on both the telemetry compile-span count and the trace counter."""
+    net = replay._tiny_lm(24)
+    rec = Recorder(path=None)
+    lat = BucketLattice(batch_sizes=(1,), seq_lens=(8, 16))
+    engine = GenerationEngine(net, lat, slots=2, max_new_tokens=8,
+                              page_size=8, recorder=rec)
+    warmed = engine.warmup()
+    assert warmed == 3  # 2 prefill buckets + 1 decode shape, 1 replica
+    assert engine.trace_count == 3
+
+    def compile_spans():
+        return [e for e in rec.events
+                if e.get("event") == "span" and e.get("name") == "compile"]
+
+    assert len(compile_spans()) == 3
+    assert all(e.get("warmup") for e in compile_spans())
+    engine.start()
+    rng = np.random.default_rng(11)
+    for plen, olen in ((3, 2), (8, 5), (11, 1), (16, 8), (5, 3),
+                       (1, 4), (13, 2), (16, 1), (2, 6), (7, 8)):
+        out = engine.generate(rng.integers(0, 64, plen).astype(np.int32),
+                              olen, timeout=60)
+        assert len(out) == olen
+    assert engine.trace_count == 3, "a shape escaped the page grid"
+    assert len(compile_spans()) == 3
+    reqs = [e for e in rec.events if e.get("event") == "request"]
+    assert len(reqs) == 10
+    for ev in reqs:
+        assert ev["ok"] and ev["kind"] == "generate"
+        assert {"ttft_s", "total_s", "queue_s", "prompt_len",
+                "prompt_bucket", "new_tokens"} <= set(ev)
+    # page accounting is on the record and returns to empty
+    pools = [e for e in rec.events if e.get("event") == "page_pool"]
+    assert pools and pools[-1]["pages_in_use"] == 0
+    assert max(p["pages_in_use"] for p in pools) > 0
+    engine.drain()
+
+
+def test_decode_step_cost_independent_of_prompt_length():
+    """Decode always attends the full (page-quantized) cache with a
+    position mask, so step shape — and cost — is identical whether the
+    prompt filled one page or all of them. Asserted on telemetry
+    decode_step span medians across the shortest and longest prompt
+    buckets (generous 3x bound: the computation is literally the same
+    jit executable, only scheduler noise differs)."""
+    net = replay._tiny_lm(40)
+    rec = Recorder(path=None)
+    lat = BucketLattice(batch_sizes=(1,), seq_lens=(8, 32))
+    engine = GenerationEngine(net, lat, slots=1, max_new_tokens=16,
+                              page_size=8, recorder=rec)
+    engine.warmup()
+    engine.start()
+    rng = np.random.default_rng(13)
+
+    def decode_medians(prompt_len):
+        mark = len(rec.events)
+        out = engine.generate(
+            rng.integers(0, 64, prompt_len).astype(np.int32), 16,
+            timeout=60)
+        assert len(out) == 16
+        spans = [e["seconds"] for e in list(rec.events)[mark:]
+                 if e.get("event") == "span"
+                 and e.get("name") == "decode_step"]
+        assert len(spans) == 15  # token 1 comes from prefill
+        return float(np.median(spans))
+
+    short = decode_medians(4)    # bucket 8: one page of prompt
+    long = decode_medians(30)    # bucket 32: four pages of prompt
+    assert long < 3.0 * short, (
+        f"decode step grew with prompt length: {short:.6f}s -> "
+        f"{long:.6f}s — the step is reading prompt-dependent state")
+    engine.drain()
+
+
+# ------------------------------------------------- trace + scoreboard
+
+def test_generation_trace_is_seeded_with_length_mix():
+    t1 = replay.make_generation_trace(7, 30, prompt_lengths=(8, 16),
+                                      output_lengths=(2, 4))
+    t2 = replay.make_generation_trace(7, 30, prompt_lengths=(8, 16),
+                                      output_lengths=(2, 4))
+    assert t1 == t2
+    t3 = replay.make_generation_trace(8, 30, prompt_lengths=(8, 16),
+                                      output_lengths=(2, 4))
+    assert t1 != t3
+    offsets = [t for t, _, _ in t1]
+    assert offsets == sorted(offsets)
+    assert {p for _, p, _ in t1} <= {8, 16}
+    assert {o for _, _, o in t1} <= {2, 4}
+
+
+def test_reconstruct_generation_from_telemetry_alone(tmp_path):
+    path = str(tmp_path / "g.jsonl")
+    with open(path, "w") as fh:
+        for i, (ttft, total, ntok) in enumerate(
+                [(0.01, 0.05, 4), (0.02, 0.10, 8), (0.5, 1.0, 8)]):
+            fh.write(json.dumps({
+                "event": "request", "id": f"g{i}", "ok": True,
+                "kind": "generate", "ts": 100.0 + i, "ttft_s": ttft,
+                "total_s": total, "new_tokens": ntok}) + "\n")
+        fh.write(json.dumps({"event": "request", "id": "bad", "ok": False,
+                             "kind": "generate", "ts": 103.0,
+                             "total_s": 0.2, "new_tokens": 0}) + "\n")
+        fh.write(json.dumps({"event": "request", "id": "pred", "ok": True,
+                             "ts": 104.0, "total_s": 0.2}) + "\n")
+        fh.write(json.dumps({"event": "span", "name": "compile",
+                             "warmup": True, "seconds": 1.0}) + "\n")
+        fh.write(json.dumps({"event": "span", "name": "compile",
+                             "seconds": 1.0}) + "\n")
+        fh.write(json.dumps({"event": "span", "name": "decode_step",
+                             "seconds": 0.002}) + "\n")
+        fh.write(json.dumps({"event": "page_pool", "pages_in_use": 3,
+                             "pages_total": 4}) + "\n")
+        fh.write(json.dumps({"event": "page_pool", "pages_in_use": 0,
+                             "pages_total": 4}) + "\n")
+    sb = replay.reconstruct_generation(path)
+    assert sb["n_ok"] == 3 and sb["n_failed"] == 1  # predict row excluded
+    assert sb["total_tokens"] == 20
+    assert sb["ttft_p50_ms"] == 20.0
+    assert sb["ttft_p99_ms"] == 500.0
+    assert sb["page_occupancy_peak"] == 0.75
+    assert sb["recompiles_after_warmup"] == 1
+    assert sb["decode_steps"] == 1
+    first = min(100.0 + i - t for i, (_, t, _) in enumerate(
+        [(0.01, 0.05, 4), (0.02, 0.10, 8), (0.5, 1.0, 8)]))
+    assert sb["tokens_per_sec"] == round(20 / (102.0 - first), 2)
+
+
+def test_generation_metric_lines_direction_flags():
+    sb = dict(tokens_per_sec=100.0, ttft_p50_ms=1.0, ttft_p99_ms=2.0,
+              page_occupancy_peak=0.5, recompiles_after_warmup=0,
+              warmup_compiles=3, n_ok=5, n_failed=0, total_tokens=40)
+    lines = {l["metric"]: l for l in replay.generation_metric_lines(sb)}
+    assert not lines["serving_generate_tokens_per_sec"].get(
+        "lower_is_better")
+    for m in ("serving_generate_ttft_p50_ms",
+              "serving_generate_ttft_p99_ms",
+              "serving_generate_page_occupancy",
+              "serving_generate_recompiles_after_warmup"):
+        assert lines[m]["lower_is_better"]
+
+
+def test_benchdiff_inverts_generation_rows(tmp_path):
+    """TTFT/occupancy growth regresses; tokens/sec growth doesn't —
+    including rows recovered from a bare summary line (no flags)."""
+    import sys
+    sys.path.insert(0, "tools")
+    import benchdiff
+
+    old = {"serving_generate_tokens_per_sec": {"value": 100.0},
+           "serving_generate_ttft_p99_ms": {"value": 10.0},
+           "serving_generate_page_occupancy": {"value": 0.5}}
+    new = {"serving_generate_tokens_per_sec": {"value": 150.0},
+           "serving_generate_ttft_p99_ms": {"value": 20.0},
+           "serving_generate_page_occupancy": {"value": 0.9}}
+    result = benchdiff.diff(old, new, threshold=0.10)
+    regressed = {r["metric"] for r in result["regressions"]}
+    assert regressed == {"serving_generate_ttft_p99_ms",
+                         "serving_generate_page_occupancy"}
+
+
+# ------------------------------------------------------- HTTP round trip
+
+@pytest.fixture(scope="module")
+def gen_stack():
+    net = replay._tiny_lm(24)
+    rec = Recorder(path=None)
+    lat = BucketLattice(batch_sizes=(1,), seq_lens=(8, 16))
+    engine = GenerationEngine(net, lat, slots=2, max_new_tokens=8,
+                              page_size=8, recorder=rec)
+    engine.warmup()
+    server = ServingServer(engine, port=0).start()
+    yield net, engine, server, rec
+    server.stop()
+
+
+def test_generate_http_streams_tokens_and_summary(gen_stack):
+    net, engine, server, _ = gen_stack
+    rng = np.random.default_rng(17)
+    prompt = rng.integers(0, 64, 6).astype(np.int32)
+    body = json.dumps({"tokens": prompt.tolist(),
+                       "max_new_tokens": 5}).encode()
+    req = urllib.request.Request(
+        f"{server.url}/generate", data=body,
+        headers={"Content-Type": "application/json"})
+    with urllib.request.urlopen(req, timeout=60) as resp:
+        lines = [json.loads(l) for l in resp.read().splitlines() if l]
+    assert [l["token"] for l in lines[:-1]] == lines[-1]["tokens"]
+    summary = lines[-1]
+    assert summary["done"] and len(summary["tokens"]) == 5
+    assert summary["timing"]["total_s"] >= summary["timing"]["ttft_s"] > 0
+    # HTTP tokens match the engine's own greedy decode
+    assert summary["tokens"] == _greedy_full_forward(net, prompt, 5)
+
+
+def test_generate_http_rejects_oversized_and_post_drain(gen_stack):
+    _, _, server, _ = gen_stack
+    too_long = {"tokens": list(range(17))}  # lattice max seq is 16
+    req = urllib.request.Request(
+        f"{server.url}/generate", data=json.dumps(too_long).encode(),
+        headers={"Content-Type": "application/json"})
+    with pytest.raises(urllib.error.HTTPError) as e:
+        urllib.request.urlopen(req, timeout=30)
+    assert e.value.code == 400
+
+
+def test_end_to_end_generation_replay_artifact(tmp_path):
+    """The full rc=0 path at small scale: generation replay over real
+    HTTP with streaming reads, scoreboard from telemetry alone, SERVE
+    artifact written, truncation-proof via the summary line."""
+    from deeplearning4j_tpu.telemetry import artifact as art
+
+    tpath = str(tmp_path / "telemetry.jsonl")
+    apath = str(tmp_path / "SERVE_gen.json")
+    sb = replay.run_generation_replay(
+        seed=0, n_requests=10, prompt_lengths=(8, 16),
+        output_lengths=(2, 4), slots=2, page_size=8,
+        telemetry_path=tpath, artifact_path=apath)
+    assert sb["n_ok"] == 10
+    assert sb["recompiles_after_warmup"] == 0
+    assert sb["tokens_per_sec"] > 0
+    assert sb["ttft_p99_ms"] >= sb["ttft_p50_ms"] > 0
+    assert 0 < sb["page_occupancy_peak"] <= 1
+    full = art.load(apath)
+    assert full["serving_generate_tokens_per_sec"]["value"] == \
+        sb["tokens_per_sec"]
+    with open(apath) as fh:
+        last = fh.read().splitlines()[-1]
+    cut = str(tmp_path / "cut.json")
+    with open(cut, "w") as fh:
+        fh.write(last + "\n")
+    recovered = art.load(cut)
+    for metric in ("serving_generate_tokens_per_sec",
+                   "serving_generate_ttft_p50_ms",
+                   "serving_generate_ttft_p99_ms",
+                   "serving_generate_page_occupancy",
+                   "serving_generate_recompiles_after_warmup"):
+        assert recovered[metric]["value"] == full[metric]["value"]

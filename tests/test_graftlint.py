@@ -463,6 +463,16 @@ def build(kern, x, T, D):
         out_specs=pl.BlockSpec((8, 128), lambda i, j: (i, 0)),
     )(x)
 """),
+    ("G019", """\
+def stream_decoded(emitted_tokens, sink):
+    for tok in emitted_tokens:
+        sink.write(tok.item())
+""", """\
+def decode_step_fetch(step_out, slots):
+    toks = np.asarray(step_out)  # ONE batch-boundary fetch per step
+    for slot, value in zip(slots, toks.tolist()):
+        slot.emit(value)
+"""),
     ("G018", """\
 from deeplearning4j_tpu.util.orbax_checkpoint import host_materialize
 
@@ -486,6 +496,7 @@ def read_one(net, params):
 # fixtures at a path inside their scope (G017: serving/ hot paths)
 RULE_FIXTURE_PATHS = {
     "G017": "deeplearning4j_tpu/serving/_graftlint_fixture.py",
+    "G019": "deeplearning4j_tpu/serving/_graftlint_fixture.py",
 }
 
 
@@ -500,7 +511,7 @@ def test_rule_fires_on_positive_not_negative(rule, pos, neg):
 
 def test_every_rule_has_fixture_coverage():
     assert {r for r, _, _ in FIXTURES} == set(RULE_DOCS) == {
-        f"G{i:03d}" for i in range(1, 19)}
+        f"G{i:03d}" for i in range(1, 20)}
 
 
 def test_g015_blessed_sites_are_exempt():
@@ -535,6 +546,22 @@ def test_g017_scope_and_carveouts():
                 "    y = fwd(p, s, batch.features)\n"
                 "    return np.asarray(y).item()\n")
     assert "G017" not in rules_in(boundary, serving)
+
+
+def test_g019_scope_and_batch_boundary_carveout():
+    """G019 is serving/-only, and the decode loop's blessed pattern —
+    ONE np.asarray of the step's whole next-token vector, host-side
+    distribution after — never flags; the per-token `.item()` does."""
+    _, pos, neg = next(f for f in FIXTURES if f[0] == "G019")
+    serving = RULE_FIXTURE_PATHS["G019"]
+    assert "G019" in rules_in(pos, serving)
+    assert "G019" not in rules_in(pos)  # parallel/ default path: out of scope
+    assert "G019" not in rules_in(pos, "deeplearning4j_tpu/nn/decode.py")
+    # a sync on a non-token loop stays G019-silent (G017 owns requests)
+    other = ("def collect(results):\n"
+             "    for r in results:\n"
+             "        r.block_until_ready()\n")
+    assert "G019" not in rules_in(other, serving)
 
 
 def test_g018_blessed_paths_are_exempt():

@@ -20,13 +20,14 @@ threshold (timings are lower-is-better) and (b) a match-or-beat
 violation — `best_us` exceeding the entry's own `default_us`, which the
 kerneltune harness guarantees never happens in a healthy sweep.
 
-SERVE artifacts (tools/trafficreplay.py / bench.py serving_replay —
-the same metric-line + summary shape) diff through the same path with
-INVERTED direction for their latency rows: a line carrying
-`lower_is_better: true`, or a `*_p50_ms`/`*_p99_ms`/`*recompiles`-shaped
+SERVE artifacts (tools/trafficreplay.py / bench.py serving_replay /
+serving_generate — the same metric-line + summary shape) diff through
+the same path with INVERTED direction for their latency rows: a line
+carrying `lower_is_better: true`, or a
+`*_p50_ms`/`*_p99_ms`/`*_ttft_*_ms`/`*recompiles`/`*occupancy`-shaped
 name recovered from a summary line, regresses when its value GROWS past
 the threshold (and a retrace count rising from 0 always regresses).
-QPS stays higher-is-better.
+QPS and tokens/sec stay higher-is-better.
 
 What counts as a regression (bench metrics are higher-is-better unless
 flagged lower-is-better as above):
@@ -64,7 +65,7 @@ DEFAULT_THRESHOLD = 0.10
 # bytes_lower_bound / plan-time _us growth is the regression direction.
 _LOWER_IS_BETTER_RE = re.compile(
     r"(_p\d+_ms$|_ms$|latency|recompiles|bytes_moved$|bytes_lower_bound$"
-    r"|_us$)")
+    r"|_us$|_ttft_|occupancy)")
 
 
 def _lower_is_better(metric: str, old: dict, new: dict) -> bool:

@@ -77,6 +77,7 @@ def sweep_configs(quick: bool) -> list[dict]:
         ]
         xent = [dict(N=256, d=128, V=2560)]
         ln = [dict(N=512, C=256)]
+        decode = [dict(B=4, H=2, S=256, D=32, page=16)]
     else:
         flash_shapes = [
             # the T=512 flagship (transformer mode, D=64 head pairs)
@@ -95,6 +96,12 @@ def sweep_configs(quick: bool) -> list[dict]:
         ]
         xent = [dict(N=2048, d=256, V=10240)]
         ln = [dict(N=2048, C=512)]
+        decode = [
+            # serving decode-step shapes: slots x heads single-query
+            # against a page-quantized cache (serving/kvcache.py grid)
+            dict(B=8, H=4, S=1024, D=64, page=16),
+            dict(B=8, H=2, S=2048, D=128, page=16),
+        ]
     out = []
     for s in flash_shapes:
         out.append(dict(family="flash_fwd", **s))
@@ -103,6 +110,8 @@ def sweep_configs(quick: bool) -> list[dict]:
         out.append(dict(family="fused_layer_norm", **s))
     for s in xent:
         out.append(dict(family="softmax_xent", **s))
+    for s in decode:
+        out.append(dict(family="decode_attn", **s))
     return out
 
 
@@ -138,6 +147,15 @@ def candidates(cfg: dict) -> list[dict]:
         for bn, bv in itertools.product((256, 512, 1024, 2048),
                                         (1024, 2048, 4096)):
             outs.append({"block_n": bn, "block_v": bv})
+    elif fam == "decode_attn":
+        # block_k over pages: page-multiple divisors of the quantized
+        # cache capacity (the only blocks the serving grid ever needs)
+        S, page = cfg["S"], cfg["page"]
+        bk = page
+        while bk <= S:
+            if S % bk == 0:
+                outs.append({"block_k": bk})
+            bk *= 2
     else:
         raise KeyError(fam)
     default = default_params(cfg)
@@ -156,6 +174,8 @@ def config_key(cfg: dict) -> str:
         return autotune.config_key(fam, cfg["N"], cfg["C"])
     if fam == "softmax_xent":
         return autotune.config_key(fam, cfg["V"], cfg["d"])
+    if fam == "decode_attn":
+        return autotune.config_key(fam, cfg["S"], cfg["D"])
     raise KeyError(fam)
 
 
@@ -187,6 +207,8 @@ def default_params(cfg: dict) -> dict:
         if fam == "softmax_xent":
             bn, bv = autotune.xent_blocks(cfg["N"], cfg["d"], cfg["V"])
             return {"block_n": bn, "block_v": bv}
+        if fam == "decode_attn":
+            return {"block_k": autotune.decode_block(cfg["S"], cfg["D"])}
     finally:
         if prev is None:
             os.environ.pop(autotune.ENV_TUNING, None)
@@ -235,6 +257,19 @@ def _build_call(cfg: dict):
             lambda x, g, b: jnp.sum(fused_layer_norm(x, g, b) ** 2),
             argnums=(0, 1, 2)))
         return lambda: f(x, g, b)
+
+    if fam == "decode_attn":
+        from deeplearning4j_tpu.ops.decode_attention import decode_attention
+        B, H, S, D = cfg["B"], cfg["H"], cfg["S"], cfg["D"]
+        q = jnp.asarray(rng.standard_normal((B, H, D)) * 0.2, jnp.float32)
+        k = jnp.asarray(rng.standard_normal((B, S, H, D)) * 0.2,
+                        jnp.float32)
+        v = jnp.asarray(rng.standard_normal((B, S, H, D)) * 0.2,
+                        jnp.float32)
+        # mixed fill depths, like a continuous batch mid-flight
+        pos = jnp.asarray(rng.integers(0, S, (B,)), jnp.int32)
+        f = jax.jit(lambda q, k, v, pos: decode_attention(q, k, v, pos))
+        return lambda: f(q, k, v, pos)
 
     if fam == "softmax_xent":
         from deeplearning4j_tpu.ops.fused_softmax_xent import (

@@ -5,6 +5,9 @@
     python tools/trafficreplay.py --model mlp --requests 200
     python tools/trafficreplay.py --artifact SERVE_r01.json
     python tools/trafficreplay.py --checkpoint ckpt_dir  # serve a real net
+    python tools/trafficreplay.py --generate --artifact SERVE_r02.json
+    python tools/trafficreplay.py --generate --prompt-lens 8,32 \
+        --output-lens 4,16 --slots 4                   # generation replay
 
 Replays a SEEDED mixed-length / bursty request trace against a freshly
 started serving stack (engine + HTTP front door, serving/), drains, and
@@ -12,6 +15,13 @@ reports sustained QPS plus p50/p99 latency reconstructed from the
 telemetry `request` events ALONE — the JSONL log, not any in-process
 timer, is the source of truth, so the same numbers rebuild from the
 artifact after a crash or a stdout truncation.
+
+`--generate` replays the AUTOREGRESSIVE trace instead (serving/
+GenerationEngine: prefill/decode split over the paged KV cache): a
+seeded prompt-length x output-length mix streamed through POST
+/generate, with headline tokens/sec (higher-is-better), time-to-first-
+token p50/p99 and peak cache-page occupancy (both lower-is-better —
+benchdiff inverts), and the same zero-retrace row.
 
 Output: one JSON metric line per number (the bench.py idiom) ending
 with the gate-carrying summary line; `--artifact` also writes them as a
@@ -61,22 +71,49 @@ def main(argv=None) -> int:
     ap.add_argument("--telemetry", default=None,
                     help="telemetry JSONL path (default: a temp file; "
                          "the scoreboard is reconstructed from it)")
+    ap.add_argument("--generate", action="store_true",
+                    help="replay the autoregressive generation trace "
+                         "(prefill/decode split, paged KV cache) "
+                         "instead of one-shot predict")
+    ap.add_argument("--prompt-lens", default="8,16,32",
+                    help="generation trace prompt lengths (also the "
+                         "prefill bucket lattice)")
+    ap.add_argument("--output-lens", default="4,8,16",
+                    help="generation trace output-token budgets")
+    ap.add_argument("--slots", type=int, default=4,
+                    help="decode slots per generation replica")
+    ap.add_argument("--page-size", type=int, default=16,
+                    help="KV-cache page size (tokens per page)")
     args = ap.parse_args(argv)
 
     os.environ.setdefault("JAX_PLATFORMS", "cpu")
-    from deeplearning4j_tpu.serving.replay import run_replay
+    from deeplearning4j_tpu.serving.replay import (run_generation_replay,
+                                                   run_replay)
 
     tpath = args.telemetry or os.path.join(
         tempfile.mkdtemp(prefix="trafficreplay_"), "telemetry.jsonl")
-    scoreboard = run_replay(
-        model=args.model, seed=args.seed, n_requests=args.requests,
-        burst=args.burst, mean_gap_s=args.mean_gap_ms / 1000.0,
-        lengths=tuple(int(t) for t in args.lens.split(",")),
-        batch_sizes=tuple(int(b) for b in args.buckets.split(",")),
-        max_wait_ms=args.max_wait_ms, replicas=args.replicas,
-        telemetry_path=tpath, artifact_path=args.artifact,
-        checkpoint=args.checkpoint,
-        emit=lambda line: print(json.dumps(line), flush=True))
+    if args.generate:
+        scoreboard = run_generation_replay(
+            seed=args.seed, n_requests=args.requests, burst=args.burst,
+            mean_gap_s=args.mean_gap_ms / 1000.0,
+            prompt_lengths=tuple(int(t)
+                                 for t in args.prompt_lens.split(",")),
+            output_lengths=tuple(int(t)
+                                 for t in args.output_lens.split(",")),
+            slots=args.slots, page_size=args.page_size,
+            replicas=args.replicas, telemetry_path=tpath,
+            artifact_path=args.artifact, checkpoint=args.checkpoint,
+            emit=lambda line: print(json.dumps(line), flush=True))
+    else:
+        scoreboard = run_replay(
+            model=args.model, seed=args.seed, n_requests=args.requests,
+            burst=args.burst, mean_gap_s=args.mean_gap_ms / 1000.0,
+            lengths=tuple(int(t) for t in args.lens.split(",")),
+            batch_sizes=tuple(int(b) for b in args.buckets.split(",")),
+            max_wait_ms=args.max_wait_ms, replicas=args.replicas,
+            telemetry_path=tpath, artifact_path=args.artifact,
+            checkpoint=args.checkpoint,
+            emit=lambda line: print(json.dumps(line), flush=True))
     from deeplearning4j_tpu.telemetry.artifact import build_summary
 
     summary = build_summary(scoreboard["lines"])

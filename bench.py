@@ -1315,6 +1315,75 @@ def bench_serving_generate() -> None:
                 "client_failed": scoreboard["client"]["failed"]})
 
 
+def bench_input_pipeline() -> None:
+    """Async input-pipeline bench (data/bench_worker.py) on the 2x4
+    fleet matrix: a 2-process x 4-virtual-device fleet trains the same
+    MLP through the stock fit() path with the input pipeline ON
+    (depth-2 prefetch of device-resident batches) vs OFF (depth 0 — the
+    pre-ISSUE-12 synchronous conversion), interleaved A/B per repeat.
+    Headlines: pipelined/sync wall ratio on the INPUT-bound workload
+    (record fetch+decode > step; the fetch's IO-latency component is
+    what prefetch provably hides on a contended host) and steady-state
+    `input_wait` p99 on the COMPUTE-bound workload (~0: the dequeue
+    never stalls once the producer is ahead). Latency rows carry
+    lower_is_better for benchdiff; the round gate is benchdiff vs the
+    previous INPUT artifact."""
+    from deeplearning4j_tpu.distributed.launcher import launch_local
+    from deeplearning4j_tpu.serving.replay import write_artifact
+
+    here = os.path.dirname(os.path.abspath(__file__))
+    artifact = os.environ.get(
+        "DL4J_TPU_INPUT_ARTIFACT", os.path.join(here, "INPUT_r01.json"))
+    results = launch_local(
+        [sys.executable, "-m", "deeplearning4j_tpu.data.bench_worker"],
+        n_processes=2, local_device_count=4, timeout=600.0)
+    bad = [r for r in results if r.returncode != 0]
+    if bad:
+        raise RuntimeError(
+            "input-pipeline fleet failed: "
+            + "; ".join(f"p{r.process_id} rc={r.returncode} "
+                        f"({r.exit_class})" for r in bad)
+            + "\n" + bad[0].output[-2000:])
+    payload = None
+    for line in results[0].lines:
+        if line.startswith("RESULT "):
+            payload = json.loads(line[len("RESULT "):])
+    if payload is None:
+        raise RuntimeError("worker p0 printed no RESULT line:\n"
+                           + results[0].output[-2000:])
+    ib, cb = payload["input_bound"], payload["compute_bound"]
+    lines = [
+        {"metric": "input_pipeline_speedup", "value": ib["speedup"],
+         "unit": "x", "ratio_spread": ib["ratio_spread"],
+         "sync_step_ms": ib["sync_step_ms"],
+         "pipelined_step_ms": ib["pipelined_step_ms"],
+         "n_processes": payload["n_processes"],
+         "depth": payload["depth"], "workload": "input_bound"},
+        {"metric": "input_pipeline_compute_bound_speedup",
+         "value": cb["speedup"], "unit": "x",
+         "ratio_spread": cb["ratio_spread"],
+         "sync_step_ms": cb["sync_step_ms"],
+         "pipelined_step_ms": cb["pipelined_step_ms"],
+         "workload": "compute_bound"},
+        {"metric": "input_pipeline_input_wait_p99_ms",
+         "value": cb["input_wait_p99_ms"], "unit": "ms",
+         "lower_is_better": True,
+         "input_wait_p50_ms": cb["input_wait_p50_ms"],
+         "n_wait_spans": cb["n_wait_spans"],
+         "workload": "compute_bound"},
+        {"metric": "input_pipeline_input_bound_wait_p99_ms",
+         "value": ib["input_wait_p99_ms"], "unit": "ms",
+         "lower_is_better": True,
+         "input_wait_p50_ms": ib["input_wait_p50_ms"],
+         "workload": "input_bound"},
+    ]
+    for line in lines:
+        _emit_info(line)
+    summary = write_artifact(artifact, lines)
+    _emit_info({"metric": "input_pipeline_artifact", "path": artifact,
+                "regressions": summary.get("regressions", 0)})
+
+
 MODES = {
     "lenet": bench_lenet,
     "vgg16": bench_vgg16,
@@ -1332,6 +1401,7 @@ MODES = {
     "ringhop": bench_ringhop,
     "serving_replay": bench_serving_replay,
     "serving_generate": bench_serving_generate,
+    "input_pipeline": bench_input_pipeline,
 }
 
 

@@ -988,6 +988,66 @@ def g019_decode_loop_sync(tree, imports, path):
     return out
 
 
+# --------------------------------------------------------------- G020
+
+# Input-pipeline discipline: the fit step loops ride
+# data/pipeline.iter_prefetched, which runs batch conversion
+# (`_batch_dict` / `globalize_batch`) and device placement on a
+# prefetch thread. A synchronous conversion INSIDE a step loop — the
+# `while it.has_next():` shape every fit loop had before ISSUE 12 —
+# serializes host input work in front of every step: at N fleet
+# processes that's a per-step input tax the pipeline exists to hide.
+_G020_CONVERTERS = frozenset({"_batch_dict", "_globalize_batch",
+                              "globalize_batch", "globalize_full"})
+_G020_DEVICE_PUTS = frozenset({"jax.device_put"})
+# blessed: the pipeline's own synchronous fallback (depth 0 /
+# async-unsupported iterators) and the host-prefetch adapter
+_G020_BLESSED = ("deeplearning4j_tpu/data/",
+                 "deeplearning4j_tpu/datasets/async_iterator.py")
+
+
+def g020_sync_input_in_step_loop(tree, imports, path):
+    """Synchronous batch conversion / device placement inside a fit
+    step loop: a `while <x>.has_next():` loop containing a call to
+    `_batch_dict` / `_globalize_batch` / `globalize_batch` /
+    `globalize_full` or `jax.device_put`. Whole-epoch staging
+    (`fit_scanned`'s list comprehension), per-window TBPTT conversion
+    (a `for` over range), and batch-boundary fetches never flag — the
+    rule keys on the step-loop shape itself."""
+    norm = path.replace("\\", "/")
+    if any(b in norm for b in _G020_BLESSED):
+        return []
+    out = []
+    for loop in ast.walk(tree):
+        if not isinstance(loop, ast.While):
+            continue
+        has_next = any(
+            isinstance(n, ast.Call) and isinstance(n.func, ast.Attribute)
+            and n.func.attr == "has_next"
+            for n in ast.walk(loop.test))
+        if not has_next:
+            continue
+        for node in ast.walk(loop):
+            if not isinstance(node, ast.Call):
+                continue
+            is_converter = (isinstance(node.func, ast.Attribute)
+                            and node.func.attr in _G020_CONVERTERS) or \
+                imports.canon(node.func) in _G020_CONVERTERS
+            is_put = imports.canon(node.func) in _G020_DEVICE_PUTS
+            if is_converter or is_put:
+                out.append(("G020", node,
+                            "synchronous batch conversion/device put "
+                            "inside a fit step loop: host input work "
+                            "runs serially in front of every step "
+                            "instead of overlapping compute",
+                            "route the loop through data/pipeline."
+                            "iter_prefetched so conversion and the "
+                            "device put run on the prefetch thread "
+                            "(the depth-k bounded queue of device-"
+                            "resident batches)"))
+    return out
+
+
 # stage-3 AST rules (G010-G014) live in spmd_rules.py and register here;
 # the import sits below every helper they borrow lazily, so importing
 # either module first resolves cleanly.
@@ -1001,7 +1061,8 @@ ALL_RULES = [g001_traced_bool, g002_host_sync, g003_float64_drift,
              g006_shard_map_arity, g007_compat_bypass, g008_import_time,
              g009_rendezvous_routing,
              g016_hardcoded_block_literals,
-             g017_serving_hot_path, g019_decode_loop_sync] + SPMD_RULES
+             g017_serving_hot_path, g019_decode_loop_sync,
+             g020_sync_input_in_step_loop] + SPMD_RULES
 
 RULE_DOCS = {
     "G001": "python control flow / bool()/float()/int() on traced values",
@@ -1023,6 +1084,10 @@ RULE_DOCS = {
             "(.item/device_get/block_until_ready) inside token-ish "
             "loops in serving/ — the generation pipeline's per-step "
             "batch-boundary fetch is the blessed pattern",
+    "G020": "synchronous globalize_batch/_batch_dict/device-put inside "
+            "fit step loops (while has_next) bypassing the data/ input "
+            "pipeline — the pipeline's own sync fallback and the "
+            "AsyncDataSetIterator adapter are the blessed sites",
     **SPMD_RULE_DOCS,
 }
 

@@ -115,6 +115,12 @@ def _build_parser() -> argparse.ArgumentParser:
                    help="local steps between cluster averaging rounds")
     t.add_argument("--checkpoint", default=None,
                    help="worker checkpoint path (elastic restart resumes)")
+    t.add_argument("--prefetch-depth", type=int, default=None,
+                   metavar="K",
+                   help="input-pipeline queue depth (device-resident "
+                        "batches prefetched ahead of the step loop; "
+                        "0 = synchronous, default 2 — "
+                        "data/pipeline.py)")
     common(t, model_required=False)
 
     co = sub.add_parser("coordinator",
@@ -449,6 +455,10 @@ def _cmd_train(args) -> int:
     net.set_listeners(ScoreIterationListener(10, printer=print))
     if args.mesh:
         _apply_mesh(net, args)
+    if getattr(args, "prefetch_depth", None) is not None:
+        from deeplearning4j_tpu.data.pipeline import set_prefetch_depth
+
+        set_prefetch_depth(args.prefetch_depth)
 
     it = _make_iterator(args)
     if args.cluster:
@@ -483,16 +493,22 @@ def _cmd_train(args) -> int:
 def _shard_batches_by_process(it):
     """Slice every DataSet to this process's rows (process-spanning mesh:
     all members must step in lockstep over the same batch COUNT, so the
-    split is within each batch, not across batches)."""
+    split is within each batch, not across batches). The split rule is
+    `data/sharding.process_slice` — identical to what the input
+    pipeline's `ShardAssignment` and `global_mesh.local_shard` apply."""
+    import jax
+
+    from deeplearning4j_tpu.data.sharding import local_rows
     from deeplearning4j_tpu.datasets.api import DataSet
     from deeplearning4j_tpu.datasets.iterators import ListDataSetIterator
-    from deeplearning4j_tpu.distributed.global_mesh import local_shard
+
+    p, n = jax.process_index(), jax.process_count()
 
     def cut(a):
-        return None if a is None else local_shard(a)
+        return None if a is None else local_rows(a, p, n)
 
     return ListDataSetIterator([
-        DataSet(local_shard(ds.features), local_shard(ds.labels),
+        DataSet(cut(ds.features), cut(ds.labels),
                 cut(ds.features_mask), cut(ds.labels_mask))
         for ds in it])
 

@@ -1,0 +1,46 @@
+"""`data/` — the async sharded input subsystem (ISSUE 12).
+
+The reference ships a dedicated input layer (AsyncDataSetIterator +
+Canova record readers, SURVEY §1 L4); this package is its TPU-native
+replacement, built from three pieces:
+
+- ``prefetcher``: the ONE background-prefetch implementation in the
+  tree — an event-driven bounded channel (zero CPU while idle; no
+  polling timeouts) plus the producer-thread wrapper every other
+  prefetching façade (datasets/async_iterator.py,
+  nlp/text.PrefetchingSentenceIterator) adapts onto.
+- ``sharding``: deterministic global example→process assignment keyed
+  off ``(process_index, process_count, epoch, seed)`` — the global
+  batch sequence is process-count-INDEPENDENT, so an elastic re-form
+  at N→N' resumes with no example skipped or duplicated.
+- ``pipeline``: the pipelined loader the fit loops ride —
+  ``_batch_dict`` conversion and ``globalize_batch`` device placement
+  run on a prefetch thread feeding a depth-k bounded queue of
+  *device-resident* batches, overlapping host input work with step
+  compute; every dequeue is timed under an ``input_wait`` telemetry
+  span (the starvation proof).
+
+Everything here imports jax lazily (or not at all): the package must
+stay importable under graftlint's no-jax stubs.
+"""
+
+from deeplearning4j_tpu.data.prefetcher import EOS, Channel, Prefetcher
+from deeplearning4j_tpu.data.sharding import (
+    ShardAssignment,
+    epoch_permutation,
+    local_rows,
+    process_slice,
+)
+from deeplearning4j_tpu.data.pipeline import (
+    ShardedDataSetIterator,
+    iter_prefetched,
+    prefetch_depth,
+    set_prefetch_depth,
+)
+
+__all__ = [
+    "EOS", "Channel", "Prefetcher",
+    "ShardAssignment", "epoch_permutation", "local_rows", "process_slice",
+    "ShardedDataSetIterator", "iter_prefetched", "prefetch_depth",
+    "set_prefetch_depth",
+]

@@ -1,20 +1,28 @@
 """AsyncDataSetIterator — background host prefetch.
 
 Reference: datasets/iterator/AsyncDataSetIterator.java:30-105 (producer
-thread + blocking queue; MultiLayerNetwork.fit wraps every iterator in one,
-MultiLayerNetwork.java:1014). Same design here: a daemon thread fills a
-bounded queue so host data prep overlaps device compute — the TPU infeed
-double-buffering idiom.
+thread + blocking queue; MultiLayerNetwork.fit wraps every iterator in
+one, MultiLayerNetwork.java:1014).
+
+Since ISSUE 12 this is a THIN ADAPTER over the one background-prefetch
+implementation in the tree (`data/prefetcher.Prefetcher`): the r6
+hand-rolled queue had polling waits (`put(timeout=0.1)` /
+`get(timeout=0.5)` spin loops that burned a core while idle) and a
+shutdown hole — a producer dying after ``put_nowait(_SENTINEL)`` hit
+``queue.Full`` left ``reset()``'s drain loop spinning forever. The
+Channel underneath is event-driven (condition variables, no timeouts)
+and signals EOS/error out-of-band, so neither failure mode exists.
+
+The fit loops themselves now ride `data/pipeline.iter_prefetched`
+(which also moves `_batch_dict` conversion and the device put off the
+step thread); this class remains the public API for callers that want
+plain host-side DataSet prefetch.
 """
 
 from __future__ import annotations
 
-import queue
-import threading
-
+from deeplearning4j_tpu.data.prefetcher import EOS, Prefetcher
 from deeplearning4j_tpu.datasets.iterators import DataSetIterator
-
-_SENTINEL = object()
 
 
 class AsyncDataSetIterator(DataSetIterator):
@@ -22,83 +30,42 @@ class AsyncDataSetIterator(DataSetIterator):
         super().__init__()
         self._under = underlying
         self._size = queue_size
-        self._queue: queue.Queue = queue.Queue(maxsize=queue_size)
-        self._thread = None
         self._peek = None
-        self._error = None
-        self._stop = threading.Event()
         self._start()
 
     def _start(self):
-        self._queue = queue.Queue(maxsize=self._size)
-        self._error = None
+        under = self._under
+
+        def source():
+            while under.has_next():
+                yield under.next()
+
         self._peek = None
-        self._stop = threading.Event()
-        stop = self._stop
-        q = self._queue
-
-        def worker():
-            try:
-                while not stop.is_set() and self._under.has_next():
-                    item = self._under.next()
-                    # bounded put that aborts promptly on stop
-                    while not stop.is_set():
-                        try:
-                            q.put(item, timeout=0.1)
-                            break
-                        except queue.Full:
-                            continue
-            except Exception as e:  # surfaced on the consumer side
-                self._error = e
-            finally:
-                try:
-                    q.put_nowait(_SENTINEL)
-                except queue.Full:
-                    # consumer is draining; it treats a dead thread as EOS
-                    pass
-
-        self._thread = threading.Thread(target=worker, daemon=True)
-        self._thread.start()
+        self._pf = Prefetcher(source, depth=self._size,
+                              name="async-dataset-iterator")
 
     def _fill_peek(self):
         if self._peek is None:
-            while True:
-                try:
-                    item = self._queue.get(timeout=0.5)
-                    break
-                except queue.Empty:
-                    if not self._thread.is_alive():
-                        item = _SENTINEL
-                        break
-            if item is _SENTINEL:
-                if self._error is not None:
-                    raise self._error
-                self._peek = _SENTINEL
-            else:
-                self._peek = item
+            # blocks event-driven; raises the producer's exception here,
+            # on the consumer thread, if iteration failed
+            self._peek = self._pf.get()
 
     def has_next(self):
         self._fill_peek()
-        return self._peek is not _SENTINEL
+        return self._peek is not EOS
 
     def next(self, num=None):
         self._fill_peek()
-        if self._peek is _SENTINEL:
+        if self._peek is EOS:
             raise StopIteration
         ds, self._peek = self._peek, None
         return self._apply_pre(ds)
 
     def reset(self):
-        # signal the producer to stop, drain whatever is queued, restart
-        self._stop.set()
-        if self._thread is not None and self._thread.is_alive():
-            while True:
-                try:
-                    self._queue.get(timeout=0.2)
-                except queue.Empty:
-                    if not self._thread.is_alive():
-                        break
-            self._thread.join(timeout=5)
+        # stop() wakes a producer blocked on a full channel, discards
+        # buffered items under the lock, and joins the thread — drain is
+        # immune to any producer death mode (EOS, error, mid-put)
+        self._pf.stop()
         self._under.reset()
         self._start()
 

@@ -102,16 +102,13 @@ def globalize_full(x, mesh, data_axis: str = "data"):
 def local_shard(x, axis: int = 0):
     """This process's contiguous slice of a full host array: the
     process-major split matching ``make_global_mesh``'s device order
-    (process i gets rows [i*B/N, (i+1)*B/N))."""
+    (process i gets rows [i*B/N, (i+1)*B/N)) — the ONE split rule,
+    shared with the input pipeline's shard assignment
+    (`data/sharding.process_slice`), so iterator sharding and host-array
+    sharding can never disagree about which rows a process owns."""
     import jax
 
-    n = jax.process_count()
-    i = jax.process_index()
-    arr = np.asarray(x)
-    if arr.shape[axis] % n:
-        raise ValueError(
-            f"dim {axis} of {arr.shape} does not split over {n} processes")
-    size = arr.shape[axis] // n
-    idx = [slice(None)] * arr.ndim
-    idx[axis] = slice(i * size, (i + 1) * size)
-    return arr[tuple(idx)]
+    from deeplearning4j_tpu.data.sharding import local_rows
+
+    return local_rows(x, jax.process_index(), jax.process_count(),
+                      axis=axis)

@@ -17,11 +17,11 @@ Host-side pure Python — corpus ingestion never touches the device.
 from __future__ import annotations
 
 import os
-import queue
 import re
-import threading
 import unicodedata
 from typing import Callable, Iterable, Iterator, List, Optional, Sequence
+
+from deeplearning4j_tpu.data.prefetcher import EOS, Prefetcher
 
 
 # --------------------------------------------------------------------------
@@ -279,9 +279,15 @@ class LineSentenceIterator(BasicLineIterator):
 
 class PrefetchingSentenceIterator(SentenceIterator):
     """Background-thread prefetch wrapper (reference
-    PrefetchingSentenceIterator) — overlaps disk IO with vocab/training."""
+    PrefetchingSentenceIterator) — overlaps disk IO with vocab/training.
 
-    _DONE = object()
+    An adapter over `data/prefetcher.Prefetcher` (ISSUE 12 deduped the
+    hand-rolled polling queue this class carried onto the one
+    event-driven prefetch implementation in the tree): the backend's
+    ``reset()`` runs inside the producer thread via the callable-source
+    form, and `Prefetcher.stop` joins the superseded producer before a
+    successor starts — both generations share the backend iterator, so
+    they must never run concurrently."""
 
     def __init__(self, backend: SentenceIterator, buffer_size: int = 10000):
         super().__init__(None)
@@ -290,37 +296,20 @@ class PrefetchingSentenceIterator(SentenceIterator):
         self._start()
 
     def _start(self):
-        self._q: "queue.Queue" = queue.Queue(maxsize=self._size)
-        self._stop = threading.Event()
-        self._next = None
-        q, stop, backend = self._q, self._stop, self._backend
+        backend = self._backend
 
-        def produce():
-            # locals only — a superseded producer can never touch the
-            # successor's queue
+        def source():
             backend.reset()
-            while backend.has_next() and not stop.is_set():
-                item = backend.next_sentence()
-                while not stop.is_set():
-                    try:
-                        q.put(item, timeout=0.05)
-                        break
-                    except queue.Full:
-                        continue
-            while not stop.is_set():
-                try:
-                    q.put(self._DONE, timeout=0.05)
-                    break
-                except queue.Full:
-                    continue
+            while backend.has_next():
+                yield backend.next_sentence()
 
-        self._thread = threading.Thread(target=produce, daemon=True)
-        self._thread.start()
+        self._pf = Prefetcher(source, depth=self._size,
+                              name="sentence-prefetch")
         self._advance()
 
     def _advance(self):
-        item = self._q.get()
-        self._next = None if item is self._DONE else item
+        item = self._pf.get()
+        self._next = None if item is EOS else item
 
     def has_next(self) -> bool:
         return self._next is not None
@@ -331,15 +320,9 @@ class PrefetchingSentenceIterator(SentenceIterator):
         return s
 
     def reset(self):
-        # stop the old producer FULLY before restarting: both generations
-        # share the backend iterator, so they must never run concurrently
-        self._stop.set()
-        while self._thread.is_alive():
-            try:  # unblock a producer stuck on a full queue
-                self._q.get_nowait()
-            except queue.Empty:
-                pass
-            self._thread.join(timeout=0.01)
+        # stop() joins the old producer FULLY (waking it if blocked on a
+        # full channel) before the successor touches the shared backend
+        self._pf.stop()
         self._start()
 
 

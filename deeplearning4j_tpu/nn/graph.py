@@ -19,8 +19,7 @@ import numpy as np
 import optax
 
 from deeplearning4j_tpu.datasets.api import DataSet, MultiDataSet
-from deeplearning4j_tpu.datasets.async_iterator import AsyncDataSetIterator
-from deeplearning4j_tpu.datasets.iterators import DataSetIterator, ListDataSetIterator
+from deeplearning4j_tpu.datasets.iterators import ListDataSetIterator
 from deeplearning4j_tpu.nn.conf.graph_conf import (
     ComputationGraphConfiguration,
     DuplicateToTimeSeriesVertexConf,
@@ -400,12 +399,12 @@ class ComputationGraph:
             self.init()
         if labels is not None:
             data = DataSet(data, labels)
-        if isinstance(data, (DataSet, MultiDataSet)):
+        single_batch = isinstance(data, (DataSet, MultiDataSet))
+        if single_batch:
+            # single batch: the pipeline's synchronous fallback skips
+            # the per-call producer thread (fit_steps lands here)
             data = ListDataSetIterator([data])
         it = data
-        if isinstance(it, DataSetIterator) and it.async_supported() and not isinstance(
-                it, AsyncDataSetIterator):
-            it = AsyncDataSetIterator(it)
         if self.conf.pretrain:
             self.pretrain(it)
             it.reset()
@@ -418,14 +417,26 @@ class ComputationGraph:
         self._get_train_step()
         tbptt = self.conf.backprop_type in (BackpropType.TRUNCATED_BPTT,
                                             "truncated_bptt")
+
+        def convert(ds):
+            # prefetch-thread work (data/pipeline.py): MultiDataSet
+            # coercion + device conversion + globalization overlap the
+            # step. batch None = a TBPTT sequence (per-window conversion
+            # happens on the step thread).
+            mds = self._to_mds(ds)
+            if tbptt and self._needs_tbptt(mds):
+                return mds, None
+            return mds, self._batch_dict(mds)
+
+        from deeplearning4j_tpu.data.pipeline import iter_prefetched
+
         for _ in range(epochs):
             it.reset()
-            while it.has_next():
-                mds = self._to_mds(it.next())
-                if tbptt and self._needs_tbptt(mds):
+            for _ds, (mds, batch) in iter_prefetched(
+                    it, convert, depth=0 if single_batch else None):
+                if batch is None:
                     self._fit_tbptt(mds)
                     continue
-                batch = self._batch_dict(mds)
                 for _i in range(max(1, g.iterations)):
                     self.params, self.opt_state, self.state, loss, _ = self._train_step(
                         self.params, self.opt_state, self.state, self._next_rng(),
@@ -527,11 +538,14 @@ class ComputationGraph:
                 "TRUNCATED_BPTT requires STOCHASTIC_GRADIENT_DESCENT; "
                 "second-order solvers would differentiate the full sequence")
         solver = Solver(self)
+
+        from deeplearning4j_tpu.data.pipeline import iter_prefetched
+
         for _ in range(epochs):
             it.reset()
-            while it.has_next():
-                mds = self._to_mds(it.next())
-                solver.optimize(self._batch_dict(mds), rng=self._next_rng())
+            for _ds, batch in iter_prefetched(
+                    it, lambda ds: self._batch_dict(self._to_mds(ds))):
+                solver.optimize(batch, rng=self._next_rng())
                 for lst in self.listeners:
                     lst.iteration_done(self, self.iteration_count)
         return self

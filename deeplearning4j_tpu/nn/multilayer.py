@@ -12,8 +12,11 @@ TPU-native redesign:
   parameter-averaging parity and serialization)
 - forward/backward/update is ONE jitted donated XLA computation
   (SURVEY.md §3.1 TPU mapping); jax.grad replaces calcBackpropGradients
-- every data iterator is wrapped in AsyncDataSetIterator for host prefetch
-  (reference MultiLayerNetwork.fit:1014)
+- fit rides the async input pipeline (data/pipeline.iter_prefetched):
+  batch conversion + device placement run on a prefetch thread feeding
+  a bounded queue of device-resident batches, replacing the reference's
+  AsyncDataSetIterator wrap (MultiLayerNetwork.fit:1014) with
+  conversion overlap, not just host-IO overlap
 - TBPTT runs the jitted step per truncation segment with explicit RNN
   carries (stop-gradient between segments)
 - rnnTimeStep keeps a carry pytree on the host between calls
@@ -30,8 +33,7 @@ import numpy as np
 import optax
 
 from deeplearning4j_tpu.datasets.api import DataSet
-from deeplearning4j_tpu.datasets.async_iterator import AsyncDataSetIterator
-from deeplearning4j_tpu.datasets.iterators import DataSetIterator, ListDataSetIterator
+from deeplearning4j_tpu.datasets.iterators import ListDataSetIterator
 from deeplearning4j_tpu.nn.conf.enums import BackpropType, OptimizationAlgorithm
 from deeplearning4j_tpu.nn.conf.layers import (
     BaseOutputLayer,
@@ -340,12 +342,13 @@ class MultiLayerNetwork:
             self.init()
         if labels is not None:
             data = DataSet(data, labels)
-        if isinstance(data, DataSet):
+        single_batch = isinstance(data, DataSet)
+        if single_batch:
+            # nothing to prefetch ahead of one batch: the pipeline's
+            # synchronous fallback skips the per-call producer thread
+            # (fit_steps — the elastic engine — lands here every step)
             data = ListDataSetIterator([data])
         it = data
-        if isinstance(it, DataSetIterator) and it.async_supported() and not isinstance(
-                it, AsyncDataSetIterator):
-            it = AsyncDataSetIterator(it)
         if self.conf.pretrain:
             self.pretrain(it)
             it.reset()
@@ -356,16 +359,28 @@ class MultiLayerNetwork:
                 OptimizationAlgorithm.STOCHASTIC_GRADIENT_DESCENT):
             return self._fit_with_solver(it, epochs)
         step = self._get_train_step()
+        tbptt_on = self.conf.backprop_type in (BackpropType.TRUNCATED_BPTT,
+                                               "truncated_bptt")
+
+        def convert(ds):
+            # runs on the input-pipeline prefetch thread: host->device
+            # conversion + process-spanning globalization overlap step
+            # compute (data/pipeline.py). None = a TBPTT sequence, which
+            # converts per truncation window on the step thread instead.
+            if (tbptt_on and np.asarray(ds.features).ndim == 3
+                    and ds.features.shape[1] > self.conf.tbptt_fwd_length):
+                return None
+            return self._batch_dict(ds)
+
+        from deeplearning4j_tpu.data.pipeline import iter_prefetched
+
         for _ in range(epochs):
             it.reset()
-            while it.has_next():
-                ds = it.next()
-                if (self.conf.backprop_type in (BackpropType.TRUNCATED_BPTT, "truncated_bptt")
-                        and np.asarray(ds.features).ndim == 3
-                        and ds.features.shape[1] > self.conf.tbptt_fwd_length):
+            for ds, batch in iter_prefetched(
+                    it, convert, depth=0 if single_batch else None):
+                if batch is None:
                     self._fit_tbptt(ds, step)
                     continue
-                batch = self._batch_dict(ds)
                 # reference runs `iterations` optimizer passes per minibatch
                 # (StochasticGradientDescent.java:55)
                 for _i in range(max(1, g.iterations)):
@@ -388,19 +403,25 @@ class MultiLayerNetwork:
         tbptt = self.conf.backprop_type in (BackpropType.TRUNCATED_BPTT,
                                             "truncated_bptt")
         solver = Solver(self)
+
+        def convert(ds):
+            # mirror the SGD path's condition: TBPTT only engages for
+            # 3-D sequences longer than the truncation window (the
+            # pipeline re-raises this on the step thread)
+            if (tbptt and np.asarray(ds.features).ndim == 3
+                    and ds.features.shape[1] > self.conf.tbptt_fwd_length):
+                raise ValueError(
+                    "TRUNCATED_BPTT requires "
+                    "STOCHASTIC_GRADIENT_DESCENT; second-order solvers "
+                    "would differentiate the full sequence")
+            return self._batch_dict(ds)
+
+        from deeplearning4j_tpu.data.pipeline import iter_prefetched
+
         for _ in range(epochs):
             it.reset()
-            while it.has_next():
-                ds = it.next()
-                # mirror the SGD path's condition: TBPTT only engages for
-                # 3-D sequences longer than the truncation window
-                if (tbptt and np.asarray(ds.features).ndim == 3
-                        and ds.features.shape[1] > self.conf.tbptt_fwd_length):
-                    raise ValueError(
-                        "TRUNCATED_BPTT requires "
-                        "STOCHASTIC_GRADIENT_DESCENT; second-order solvers "
-                        "would differentiate the full sequence")
-                solver.optimize(self._batch_dict(ds), rng=self._next_rng())
+            for _ds, batch in iter_prefetched(it, convert):
+                solver.optimize(batch, rng=self._next_rng())
                 for lst in self.listeners:
                     lst.iteration_done(self, self.iteration_count)
             self.epoch_count += 1

@@ -126,55 +126,61 @@ class ParameterAveragingTrainer:
 
         self._average = jax.jit(average)
 
+    def _convert(self, ds):
+        """Prefetch-thread batch prep (data/pipeline.py): replica
+        truncation, device conversion, and the data-axis placement all
+        overlap the local step running on the step thread."""
+        n = self.n_replicas
+        b = ds.num_examples()
+        per = b // n
+        if per == 0:
+            raise ValueError(
+                f"batch of {b} examples cannot be split over {n} "
+                f"replicas — use batches of at least {n} examples")
+        if per * n != b and not self._warned_truncation:
+            import warnings
+
+            warnings.warn(
+                f"batch size {b} is not divisible by {n} replicas; "
+                f"the last {b - per * n} examples of each such batch "
+                f"are dropped", stacklevel=2)
+            self._warned_truncation = True
+        m = per * n
+
+        def trunc(arrs):
+            return None if arrs is None else [
+                None if a is None else a[:m] for a in arrs]
+
+        if isinstance(ds, MultiDataSet):
+            tds = MultiDataSet(trunc(ds.features), trunc(ds.labels),
+                               trunc(ds.features_masks),
+                               trunc(ds.labels_masks))
+            batch = self.net._batch_dict(tds)
+        else:
+            tds = DataSet(
+                ds.features[:m], ds.labels[:m],
+                None if ds.features_mask is None else ds.features_mask[:m],
+                None if ds.labels_mask is None else ds.labels_mask[:m])
+            if hasattr(self.net, "_to_mds"):
+                # ComputationGraph: multi-input batch format (tuples)
+                batch = self.net._batch_dict(self.net._to_mds(tds))
+            else:
+                batch = self.net._batch_dict(tds)
+        return jax.tree.map(
+            lambda x: jax.device_put(
+                x, NamedSharding(self.mesh, P("data"))), batch)
+
     def fit(self, data, epochs: int = 1):
         """Each incoming minibatch is split across replicas (the RDD
         partition analogue); every k local steps the replicas are averaged."""
+        from deeplearning4j_tpu.data.pipeline import iter_prefetched
+
         if isinstance(data, DataSet):
             data = ListDataSetIterator([data])
         it: DataSetIterator = data
-        n = self.n_replicas
         for _ in range(epochs):
             it.reset()
-            while it.has_next():
-                ds = it.next()
-                b = ds.num_examples()
-                per = b // n
-                if per == 0:
-                    raise ValueError(
-                        f"batch of {b} examples cannot be split over {n} "
-                        f"replicas — use batches of at least {n} examples")
-                if per * n != b and not self._warned_truncation:
-                    import warnings
-
-                    warnings.warn(
-                        f"batch size {b} is not divisible by {n} replicas; "
-                        f"the last {b - per * n} examples of each such batch "
-                        f"are dropped", stacklevel=2)
-                    self._warned_truncation = True
-                m = per * n
-
-                def trunc(arrs):
-                    return None if arrs is None else [
-                        None if a is None else a[:m] for a in arrs]
-
-                if isinstance(ds, MultiDataSet):
-                    tds = MultiDataSet(trunc(ds.features), trunc(ds.labels),
-                                       trunc(ds.features_masks),
-                                       trunc(ds.labels_masks))
-                    batch = self.net._batch_dict(tds)
-                else:
-                    tds = DataSet(
-                        ds.features[:m], ds.labels[:m],
-                        None if ds.features_mask is None else ds.features_mask[:m],
-                        None if ds.labels_mask is None else ds.labels_mask[:m])
-                    if hasattr(self.net, "_to_mds"):
-                        # ComputationGraph: multi-input batch format (tuples)
-                        batch = self.net._batch_dict(self.net._to_mds(tds))
-                    else:
-                        batch = self.net._batch_dict(tds)
-                batch = jax.tree.map(
-                    lambda x: jax.device_put(
-                        x, NamedSharding(self.mesh, P("data"))), batch)
+            for _ds, batch in iter_prefetched(it, self._convert):
                 rng = self.net._next_rng()
                 (self._stacked_params, self._stacked_opt, self._stacked_state,
                  losses) = self._local_step(

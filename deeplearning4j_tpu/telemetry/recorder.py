@@ -38,6 +38,14 @@ exactly like the predict path, and the flat-across-prompt-buckets
 property of the decode_step timings is the "decode cost independent of
 prompt length" gate in tier-1.
 
+The input pipeline (data/pipeline.py) names an ``input_wait`` span
+around EVERY batch dequeue in the fit loops: `pipelined` (false = the
+synchronous fallback, where the span covers the whole host conversion +
+device put — the stall it measures IS the input path) and `buffered`
+(post-dequeue queue occupancy, pipelined only). Steady-state p99 of the
+pipelined spans ~= 0 on a compute-bound workload is the starve-proof
+gate the bench's `input_pipeline` mode records.
+
 Serving also names three `span` events per batch: `queue` (the head
 request's wait — what the batcher's max-wait deadline bounds),
 `batch_assemble` (padding into the bucket), and `forward` (the jit call;

@@ -84,6 +84,34 @@ def test_serve_latency_is_lower_is_better():
                           threshold=0.1)["regressions"]
 
 
+def test_input_pipeline_rows_direction():
+    """INPUT artifact rows (bench input_pipeline): the input_wait stall
+    percentiles are lower-is-better (growth past threshold = the step
+    loop started starving), by flag and by name pattern; the speedup
+    row stays higher-is-better (a falling pipelined/sync ratio is the
+    overlap regression)."""
+    old = _lines(input_pipeline_input_wait_p99_ms={
+        "value": 0.05, "lower_is_better": True})
+    worse = _lines(input_pipeline_input_wait_p99_ms={
+        "value": 12.0, "lower_is_better": True})
+    (row,) = benchdiff.diff(old, worse, threshold=0.1)["regressions"]
+    assert "lower is better" in row["reason"]
+    # name-pattern fallback for summary-reconstructed rows (flag lost)
+    assert benchdiff.diff(
+        _lines(input_pipeline_input_wait_p99_ms={"value": 0.05}),
+        _lines(input_pipeline_input_wait_p99_ms={"value": 12.0}),
+        threshold=0.1)["regressions"]
+    # the speedup headline keeps the default direction
+    assert benchdiff.diff(
+        _lines(input_pipeline_speedup={"value": 1.54}),
+        _lines(input_pipeline_speedup={"value": 1.02}),
+        threshold=0.1)["regressions"]
+    assert benchdiff.diff(
+        _lines(input_pipeline_speedup={"value": 1.54}),
+        _lines(input_pipeline_speedup={"value": 1.7}),
+        threshold=0.1)["regressions"] == []
+
+
 def test_reshard_artifact_rows_are_lower_is_better():
     """RESHARD artifact rows (cli reshard --artifact): bytes_moved /
     bytes_lower_bound / plan_us GROWING past threshold regresses — a

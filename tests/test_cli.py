@@ -69,6 +69,24 @@ class TestCliRoundTrip:
         p = np.array([[float(v) for v in r] for r in rows])
         np.testing.assert_allclose(p.sum(axis=1), 1.0, atol=1e-4)
 
+    def test_train_prefetch_depth_knob(self, tmp_path, blob_csv,
+                                       conf_json):
+        """--prefetch-depth installs the pipeline depth override (0 =
+        synchronous fallback) and training still lands a model."""
+        from deeplearning4j_tpu.data import pipeline as data_pipeline
+
+        model = str(tmp_path / "model_sync.zip")
+        prev = data_pipeline.set_prefetch_depth(None)
+        try:
+            rc = main(["train", "--conf", conf_json, "--input", blob_csv,
+                       "--model", model, "--num-classes", "2",
+                       "--prefetch-depth", "0"])
+            assert rc == 0
+            assert data_pipeline.prefetch_depth() == 0
+        finally:
+            data_pipeline.set_prefetch_depth(prev)
+        assert (tmp_path / "model_sync.zip").exists()
+
     def test_missing_model_flag_errors(self, blob_csv, conf_json):
         with pytest.raises(SystemExit):
             main(["train", "--conf", conf_json, "--input", blob_csv,

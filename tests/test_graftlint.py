@@ -473,6 +473,31 @@ def decode_step_fetch(step_out, slots):
     for slot, value in zip(slots, toks.tolist()):
         slot.emit(value)
 """),
+    ("G020", """\
+def fit(net, it, step):
+    while it.has_next():
+        ds = it.next()
+        batch = net._batch_dict(ds)
+        placed = jax.device_put(batch)
+        step(placed)
+""", """\
+from deeplearning4j_tpu.data.pipeline import iter_prefetched
+
+
+def fit(net, it, step):
+    for ds, batch in iter_prefetched(it, net._batch_dict):
+        step(batch)
+
+
+def stage_epoch(net, data):
+    # whole-epoch staging (fit_scanned), not a step loop
+    return [net._batch_dict(ds) for ds in data]
+
+
+def fit_tbptt(net, ds, step, L):
+    for t0 in range(0, ds.features.shape[1], L):
+        step(net._batch_dict(ds.slice_time(t0, L)))
+"""),
     ("G018", """\
 from deeplearning4j_tpu.util.orbax_checkpoint import host_materialize
 
@@ -511,7 +536,7 @@ def test_rule_fires_on_positive_not_negative(rule, pos, neg):
 
 def test_every_rule_has_fixture_coverage():
     assert {r for r, _, _ in FIXTURES} == set(RULE_DOCS) == {
-        f"G{i:03d}" for i in range(1, 20)}
+        f"G{i:03d}" for i in range(1, 21)}
 
 
 def test_g015_blessed_sites_are_exempt():
@@ -562,6 +587,24 @@ def test_g019_scope_and_batch_boundary_carveout():
              "    for r in results:\n"
              "        r.block_until_ready()\n")
     assert "G019" not in rules_in(other, serving)
+
+
+def test_g020_blessed_paths_and_loop_shape():
+    """The pipeline's own synchronous fallback (data/) and the
+    AsyncDataSetIterator adapter are the blessed conversion sites; the
+    same step-loop source flags anywhere else, and a non-has_next while
+    loop never engages the rule."""
+    _, pos, _ = next(f for f in FIXTURES if f[0] == "G020")
+    assert "G020" not in rules_in(
+        pos, "deeplearning4j_tpu/data/pipeline.py")
+    assert "G020" not in rules_in(
+        pos, "deeplearning4j_tpu/datasets/async_iterator.py")
+    assert "G020" in rules_in(pos)  # the default parallel/ fixture path
+    assert "G020" in rules_in(pos, "deeplearning4j_tpu/nn/multilayer.py")
+    other = ("def drain(q, net):\n"
+             "    while q:\n"
+             "        net._batch_dict(q.pop())\n")
+    assert "G020" not in rules_in(other)
 
 
 def test_g018_blessed_paths_are_exempt():

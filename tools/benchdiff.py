@@ -62,10 +62,12 @@ DEFAULT_THRESHOLD = 0.10
 # covers rows reconstructed from a summary line (which keeps only the
 # value) — p50/p99/_ms latency and retrace counts from SERVE artifacts,
 # plus RESHARD artifact rows (cli reshard dry run): bytes_moved /
-# bytes_lower_bound / plan-time _us growth is the regression direction.
+# bytes_lower_bound / plan-time _us growth is the regression direction,
+# and INPUT artifact rows (bench input_pipeline): input_wait stall
+# percentiles growing past threshold is the starvation regression.
 _LOWER_IS_BETTER_RE = re.compile(
     r"(_p\d+_ms$|_ms$|latency|recompiles|bytes_moved$|bytes_lower_bound$"
-    r"|_us$|_ttft_|occupancy)")
+    r"|_us$|_ttft_|occupancy|input_wait)")
 
 
 def _lower_is_better(metric: str, old: dict, new: dict) -> bool:

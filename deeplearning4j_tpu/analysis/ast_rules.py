@@ -1048,6 +1048,63 @@ def g020_sync_input_in_step_loop(tree, imports, path):
     return out
 
 
+# --------------------------------------------------------------- G021
+
+# Weight-swap discipline: serving replicas read their params through the
+# engine's double-buffered WeightStore (serving/fleet.py), read ONCE per
+# batch so a live hot-swap flips between batches and every request
+# serves against ONE coherent generation. A direct write to a live
+# `.params` reference, or a `resume_from` restore into a serving net
+# outside the blessed path, bypasses the standby-slot restore, the
+# shape/placement validation, the atomic flip, AND the `weight_swap`
+# telemetry record — the swap happens (or half-happens) invisibly, mid-
+# batch, with no rollback.
+_G021_BLESSED = ("deeplearning4j_tpu/serving/fleet.py",)
+
+
+def g021_weight_swap_path(tree, imports, path):
+    """Param publish/flip outside the blessed swap path (serving/ files
+    only; serving/fleet.py exempt): (a) assignment to a `.params`
+    attribute — a direct write to what a worker serves; (b) any
+    `.resume_from(...)` call — restoring INTO a serving net must route
+    through fleet.restore_for_serving / fleet.hot_swap. Reading params
+    (`ws.params`, `net.params is None`) never flags."""
+    norm = path.replace("\\", "/")
+    if "/serving/" not in norm or any(b in norm for b in _G021_BLESSED):
+        return []
+    out = []
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.Assign, ast.AugAssign)):
+            targets = (node.targets if isinstance(node, ast.Assign)
+                       else [node.target])
+            for t in targets:
+                if isinstance(t, ast.Attribute) and t.attr == "params":
+                    out.append((
+                        "G021", node,
+                        "direct write to a live param reference in "
+                        "serving code: bypasses the WeightStore double "
+                        "buffer — a replica mid-batch can observe a "
+                        "half-swapped param set and there is no "
+                        "validation, generation record, or rollback",
+                        "publish through serving/fleet.py: "
+                        "hot_swap(engine, ckpt) restores into a shadow "
+                        "net, validates, and flips atomically"))
+        elif isinstance(node, ast.Call) \
+                and isinstance(node.func, ast.Attribute) \
+                and node.func.attr == "resume_from":
+            out.append((
+                "G021", node,
+                "resume_from on a net inside serving code: restores "
+                "INTO the served params outside the blessed swap path "
+                "(no double buffer, no shape/placement validation, no "
+                "weight_swap telemetry, old weights unrecoverable on a "
+                "bad checkpoint)",
+                "route restores through serving/fleet."
+                "restore_for_serving (startup) or fleet.hot_swap "
+                "(live)"))
+    return out
+
+
 # stage-3 AST rules (G010-G014) live in spmd_rules.py and register here;
 # the import sits below every helper they borrow lazily, so importing
 # either module first resolves cleanly.
@@ -1062,7 +1119,8 @@ ALL_RULES = [g001_traced_bool, g002_host_sync, g003_float64_drift,
              g009_rendezvous_routing,
              g016_hardcoded_block_literals,
              g017_serving_hot_path, g019_decode_loop_sync,
-             g020_sync_input_in_step_loop] + SPMD_RULES
+             g020_sync_input_in_step_loop,
+             g021_weight_swap_path] + SPMD_RULES
 
 RULE_DOCS = {
     "G001": "python control flow / bool()/float()/int() on traced values",
@@ -1088,6 +1146,10 @@ RULE_DOCS = {
             "fit step loops (while has_next) bypassing the data/ input "
             "pipeline — the pipeline's own sync fallback and the "
             "AsyncDataSetIterator adapter are the blessed sites",
+    "G021": "param publish/flip outside the blessed serving/fleet.py "
+            "swap path: direct `.params` assignment or `resume_from` "
+            "in serving/ bypasses the double-buffered WeightStore "
+            "(validation, atomic flip, weight_swap telemetry)",
     **SPMD_RULE_DOCS,
 }
 

@@ -198,6 +198,24 @@ def _build_parser() -> argparse.ArgumentParser:
     sv.add_argument("--prefill-chunk", type=int, default=None,
                     help="prompt chunk length for interleaved prefill "
                          "(a lattice seq bucket; default: the largest)")
+    sv.add_argument("--watch-checkpoint", action="store_true",
+                    help="fleet operations: keep watching --checkpoint "
+                         "for newly committed steps and hot-swap each "
+                         "one live (serving/fleet.CheckpointWatcher — "
+                         "double-buffered restore off the request path, "
+                         "atomic flip, zero dropped requests; a step "
+                         "failing validation is rejected with the old "
+                         "weights still serving)")
+    sv.add_argument("--autoscale-max", type=int, default=0, metavar="N",
+                    help="fleet operations: run a FleetSupervisor that "
+                         "heals dead replicas and autoscales between "
+                         "--replicas and N replicas from telemetry "
+                         "queue-depth/p99 (0 = self-healing only, no "
+                         "autoscaling)")
+    sv.add_argument("--chaos", default=None, metavar="SPEC",
+                    help="inject replica-scoped faults (distributed/"
+                         "faults.py grammar, e.g. 'r0:kill@batch4') — "
+                         "the self-healing demo/test hook")
     sv.add_argument("--multiprocess", type=int, default=None, metavar="N",
                     help="dry run: print the N-process serving fleet "
                          "plan (one engine per process on the "
@@ -626,18 +644,41 @@ def _cmd_serve(args) -> int:
             max_new_tokens=args.max_new_tokens,
             page_size=args.page_size,
             prefill_chunk=args.prefill_chunk,
-            replicas=args.replicas, checkpoint=args.checkpoint)
+            replicas=args.replicas, checkpoint=args.checkpoint,
+            faults=args.chaos)
         n = engine.warmup()
         print(f"warmed {n} prefill/decode shapes")
     else:
         engine = InferenceEngine(net, lattice, replicas=args.replicas,
                                  max_wait_ms=args.max_wait_ms,
                                  sequence=args.sequence,
-                                 checkpoint=args.checkpoint)
+                                 checkpoint=args.checkpoint,
+                                 faults=args.chaos)
         if args.warmup_features:
             n = engine.warmup(_parse_warmup_features(args.warmup_features,
                                                      args.sequence))
             print(f"warmed {n} bucket shapes")
+    supervisor = watcher = None
+    if args.autoscale_max or args.chaos:
+        from deeplearning4j_tpu.serving import (AutoscalePolicy,
+                                                FleetSupervisor)
+
+        policy = None
+        if args.autoscale_max:
+            policy = AutoscalePolicy(min_replicas=args.replicas,
+                                     max_replicas=args.autoscale_max)
+        supervisor = FleetSupervisor(engine, policy=policy).run_in_thread()
+        print("fleet supervisor up"
+              + (f" (autoscale {args.replicas}..{args.autoscale_max})"
+                 if policy else " (self-healing only)"), flush=True)
+    if args.watch_checkpoint:
+        if not args.checkpoint:
+            raise SystemExit("--watch-checkpoint needs --checkpoint (the "
+                             "directory the training fleet publishes to)")
+        from deeplearning4j_tpu.serving import CheckpointWatcher
+
+        watcher = CheckpointWatcher(engine, args.checkpoint).start()
+        print(f"hot-swap watcher on {args.checkpoint}", flush=True)
     server = ServingServer(engine, port=args.port, host=args.host).start()
     print(f"serving on {server.url} "
           f"(replicas={args.replicas}, buckets={args.buckets}, "
@@ -651,6 +692,10 @@ def _cmd_serve(args) -> int:
         threading.Event().wait()
     except KeyboardInterrupt:
         print("draining...", flush=True)
+        if watcher is not None:
+            watcher.stop()
+        if supervisor is not None:
+            supervisor.stop()
         server.stop()
     return 0
 

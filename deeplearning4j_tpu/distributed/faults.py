@@ -20,6 +20,23 @@ inside a traced program).
                          ClusterClient (coordinator reaps it; its slot
                          becomes claimable)
 
+Serving chaos (ISSUE 13) reuses the same grammar with an `r` scope
+prefix — the victim is a REPLICA (a serving worker thread inside one
+engine, serving/engine.py) rather than a fleet process, and the trigger
+counts that replica's own work units instead of training steps:
+
+    r0:kill@batch3       replica 0 dies MID-BATCH while running its 3rd
+                         assembled batch (a thread cannot be SIGKILLed:
+                         the engine fails that batch's requests loudly
+                         and lets the thread die — serving/fleet.py)
+    r1:hang@batch2       replica 1 wedges mid-batch (reaped by the fleet
+                         supervisor's heartbeat staleness bound)
+    r0:kill@decode5      a generation replica dies mid-decode at its 5th
+                         decode step (active slots fail, pages release)
+
+Replica faults take only kill/hang with a batch/decode trigger; process
+faults keep the step trigger — mixing the two is a parse error.
+
 The schedule travels to fleet members through the env contract
 (`bootstrap.ENV_FAULTS`, set by `launcher.launch_local(faults=...)`);
 each process filters the schedule by its own `ENV_PROCESS_ID`, so one
@@ -61,38 +78,54 @@ EXIT_ERROR = "error"
 RESUMABLE_EXIT_CODE = 75
 
 
+# trigger units per scope: process faults fire on training steps,
+# replica faults on a serving worker's own batch / decode-step counters
+SCOPES = ("process", "replica")
+REPLICA_UNITS = ("batch", "decode")
+
+
 @dataclass(frozen=True)
 class Fault:
-    """One scheduled fault: what, to whom, and when."""
+    """One scheduled fault: what, to whom, and when. ``process_id`` names
+    the victim within its scope — a fleet process for scope "process", a
+    serving replica index for scope "replica"."""
 
     process_id: int
     kind: str  # one of KINDS
-    step: Optional[int] = None      # kill/hang trigger step
+    step: Optional[int] = None      # kill/hang trigger count
     seconds: Optional[float] = None  # delay-connect sleep
+    scope: str = "process"          # one of SCOPES
+    unit: str = "step"              # "step" | "batch" | "decode"
 
     def spec(self) -> str:
-        s = f"p{self.process_id}:{self.kind}"
+        prefix = "p" if self.scope == "process" else "r"
+        s = f"{prefix}{self.process_id}:{self.kind}"
         if self.step is not None:
-            s += f"@step{self.step}"
+            s += f"@{self.unit}{self.step}"
         if self.seconds is not None:
             s += f":{self.seconds:g}"
         return s
 
 
 def parse_fault(spec: str) -> Fault:
-    """Parse one `pN:kind[@stepK][:seconds]` spec (see module docstring)."""
+    """Parse one `pN:kind[@stepK][:seconds]` / `rN:kind@batchK|decodeK`
+    spec (see module docstring)."""
     spec = spec.strip()
     head, _, rest = spec.partition(":")
-    if not head.startswith("p") or not head[1:].isdigit():
-        raise ValueError(f"fault spec {spec!r}: expected 'p<N>:<kind>...'")
+    if not head[:1] in ("p", "r") or not head[1:].isdigit():
+        raise ValueError(f"fault spec {spec!r}: expected 'p<N>:<kind>...' "
+                         "or 'r<N>:<kind>...'")
+    scope = "process" if head[0] == "p" else "replica"
     process_id = int(head[1:])
-    kind, step, seconds = rest, None, None
+    kind, step, seconds, unit = rest, None, None, "step"
     if "@" in rest:
         kind, _, when = rest.partition("@")
-        if when.startswith("step"):
-            when = when[4:]
+        for u in ("step",) + REPLICA_UNITS:
+            if when.startswith(u):
+                unit, when = u, when[len(u):]
+                break
         if not when.isdigit():
-            raise ValueError(f"fault spec {spec!r}: bad step {when!r}")
+            raise ValueError(f"fault spec {spec!r}: bad trigger {when!r}")
         step = int(when)
     elif ":" in rest:
         kind, _, secs = rest.partition(":")
@@ -100,12 +133,25 @@ def parse_fault(spec: str) -> Fault:
     if kind not in KINDS:
         raise ValueError(f"fault spec {spec!r}: unknown kind {kind!r} "
                          f"(one of {', '.join(KINDS)})")
-    if kind in ("kill", "hang") and step is None:
-        raise ValueError(f"fault spec {spec!r}: {kind} needs '@step<N>'")
-    if kind == "delay-connect" and seconds is None:
-        raise ValueError(f"fault spec {spec!r}: delay-connect needs "
-                         "':<seconds>'")
-    return Fault(process_id, kind, step=step, seconds=seconds)
+    if scope == "replica":
+        if kind not in ("kill", "hang"):
+            raise ValueError(f"fault spec {spec!r}: replica faults take "
+                             "only kill/hang")
+        if step is None or unit not in REPLICA_UNITS:
+            raise ValueError(f"fault spec {spec!r}: replica faults need "
+                             "'@batch<N>' or '@decode<N>'")
+    else:
+        if unit != "step":
+            raise ValueError(f"fault spec {spec!r}: process faults "
+                             "trigger on '@step<N>', not {unit!r}")
+        if kind in ("kill", "hang") and step is None:
+            raise ValueError(f"fault spec {spec!r}: {kind} needs "
+                             "'@step<N>'")
+        if kind == "delay-connect" and seconds is None:
+            raise ValueError(f"fault spec {spec!r}: delay-connect needs "
+                             "':<seconds>'")
+    return Fault(process_id, kind, step=step, seconds=seconds,
+                 scope=scope, unit=unit)
 
 
 class FaultSchedule:
@@ -137,7 +183,14 @@ class FaultSchedule:
         return ";".join(f.spec() for f in self.faults)
 
     def for_process(self, process_id: int) -> List[Fault]:
-        return [f for f in self.faults if f.process_id == process_id]
+        return [f for f in self.faults if f.process_id == process_id
+                and f.scope == "process"]
+
+    def for_replica(self, replica_index: int) -> List[Fault]:
+        """Replica-scoped faults targeting one serving worker (the
+        serving engine's chaos hooks — serving/fleet.py)."""
+        return [f for f in self.faults if f.process_id == replica_index
+                and f.scope == "replica"]
 
     def kill_scheduled(self, process_id: int) -> bool:
         return any(f.kind == "kill" for f in self.for_process(process_id))

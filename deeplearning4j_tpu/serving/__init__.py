@@ -23,6 +23,13 @@ story neither provides:
   `GenerationEngine`: prefill/decode-split autoregressive serving —
   chunked prefills interleaved into running decode batches over the
   KV cache (nn/decode.py steps), same zero-retrace discipline.
+* `fleet.py`    — zero-downtime fleet operations (ISSUE 13): live
+  weight hot-swap through a double-buffered `WeightStore` (reshard-
+  aware restore off the request path, atomic flip between batches,
+  typed `weight_swap` telemetry), replica self-healing (chaos specs
+  from distributed/faults.py, heartbeat-driven reap/requeue/respawn
+  with zero retraces), and telemetry-driven autoscaling (pure
+  hysteresis decisions over queue depth + recent p99).
 * `server.py`   — the stdlib ThreadingHTTPServer front door
   (`POST /predict`, streaming `POST /generate`), same lifecycle idiom
   as `ui/server.py`.
@@ -49,21 +56,37 @@ from deeplearning4j_tpu.serving.engine import (
     InferenceEngine,
     QueueFullError,
 )
+from deeplearning4j_tpu.serving.fleet import (
+    AutoscalePolicy,
+    CheckpointWatcher,
+    FleetSupervisor,
+    ReplicaFaultInjector,
+    WeightStore,
+    WeightSwapError,
+    hot_swap,
+)
 from deeplearning4j_tpu.serving.kvcache import CachePlan, PagePool
 from deeplearning4j_tpu.serving.server import ServingServer
 
 __all__ = [
+    "AutoscalePolicy",
     "Batcher",
     "Bucket",
     "BucketLattice",
     "CachePlan",
+    "CheckpointWatcher",
     "DecodeSlots",
+    "FleetSupervisor",
     "GenRequest",
     "GenerationEngine",
     "InferenceEngine",
     "PagePool",
     "PendingRequest",
     "QueueFullError",
+    "ReplicaFaultInjector",
     "ServingServer",
+    "WeightStore",
+    "WeightSwapError",
+    "hot_swap",
     "plan_batch",
 ]

@@ -369,6 +369,19 @@ class Batcher:
                 bucket=list(batch.bucket.key()), n_real=batch.n_real)
         return batch
 
+    def requeue(self, requests) -> None:
+        """Put already-admitted requests BACK at the FIFO head — the
+        dead-replica queue drain (serving/fleet.py): batches a reaped
+        replica never ran dissolve back into pending requests, keeping
+        their original enqueue times (their queue-wait telemetry stays
+        honest), and live replicas pick them up on the next cut. Works
+        even while draining: these requests were admitted before the
+        close and the drain flush owes them a completion."""
+        with self._cv:
+            for r in reversed(list(requests)):
+                self._pending.appendleft(r)
+            self._cv.notify_all()
+
     # ------------------------------------------------------------- drain
     def close(self) -> None:
         """Begin draining: refuse new submits, flush pending groups on

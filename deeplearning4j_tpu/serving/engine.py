@@ -23,6 +23,18 @@ error, the HTTP layer returns 500, a telemetry `error` event keeps the
 full traceback) and the replica keeps serving the next batch — one
 poisoned input cannot take the replica down with it.
 
+Fleet operations (ISSUE 13, serving/fleet.py): every replica reads its
+params through the engine's double-buffered `WeightStore` exactly ONCE
+per batch — the live hot-swap flips that reference between batches, so
+each request event records the single coherent `weight_gen` it served
+against. Replicas carry a lifecycle (warming → serving → draining /
+dead → retired), a heartbeat, and an optional chaos injector
+(replica-scoped `distributed/faults.py` specs); the `FleetSupervisor`
+reaps dead/hung replicas (queued batches drain back to the batcher),
+respawns them through the SAME jit wrappers (zero new traces), and the
+autoscale loop grows/drains the replica set through `add_replica` /
+`retire_replica` (a retiring replica finishes its queued work first).
+
 jax imports stay inside methods: the module is importable under the
 graftlint AST stubs and costs tools nothing.
 """
@@ -40,6 +52,9 @@ import numpy as np
 from deeplearning4j_tpu.serving.batcher import (Batch, Batcher, DecodeSlots,
                                                 GenRequest)
 from deeplearning4j_tpu.serving.buckets import Bucket, BucketLattice
+from deeplearning4j_tpu.serving.fleet import (ReplicaFaultInjector,
+                                              ReplicaKilled, WeightStore,
+                                              restore_for_serving)
 from deeplearning4j_tpu.serving.kvcache import CachePlan
 
 
@@ -50,18 +65,31 @@ class QueueFullError(RuntimeError):
 
 class _Replica:
     """One forward worker: its own jit wrapper (own compile cache), its
-    own batch queue, its own trace counter."""
+    own batch queue, its own trace counter. Params come from the
+    engine's double-buffered `WeightStore` — read ONCE per batch, so a
+    hot-swap flip lands between batches, never inside one. Lifecycle
+    (`warming`/`serving`/`draining`/`dead`/`retired`), heartbeat, and
+    the chaos injector are what `serving/fleet.FleetSupervisor`
+    supervises."""
 
-    def __init__(self, index: int, net, recorder):
+    def __init__(self, index: int, net, recorder, weights: WeightStore,
+                 faults: ReplicaFaultInjector | None = None):
         import jax
 
         self.index = index
         self.net = net
         self.recorder = recorder
+        self.weights = weights
+        self.faults = faults
         self.queue: queue.Queue = queue.Queue()
         self.trace_count = 0
         self.served = 0
         self.failed = 0
+        self.batches_run = 0
+        self.alive = True
+        self.lifecycle = "warming"
+        self.last_beat = 0.0
+        self.current_batch: Batch | None = None
         self._seen_shapes: set = set()
         fwd = net.inference_fn()
 
@@ -78,14 +106,44 @@ class _Replica:
     def _shape_key(self, feats: np.ndarray, mask) -> tuple:
         return (feats.shape, str(feats.dtype), mask is not None)
 
+    def fail_batch(self, batch: Batch, exc_or_msg, *, clock,
+                   weight_gen: int | None = None) -> None:
+        """Fail every request of one batch loudly (worker death, reaped
+        hang, drain with no live replica) — each future carries the
+        error, telemetry keeps the record."""
+        self.failed += batch.n_real
+        if isinstance(exc_or_msg, BaseException):
+            self.recorder.error(f"replica:{self.index}", exc=exc_or_msg)
+            err = "".join(traceback.format_exception_only(
+                type(exc_or_msg), exc_or_msg)).strip()
+        else:
+            err = str(exc_or_msg)
+            self.recorder.error(f"replica:{self.index}", error=err)
+        t_done = clock()
+        for r in batch.requests:
+            r.error = err
+            r.t_done = t_done
+            self._request_event(r, batch, None, ok=False, error=err,
+                               weight_gen=weight_gen)
+            r.done.set()
+
     def run_batch(self, batch: Batch, *, clock, sequence: bool) -> None:
         rec = self.recorder
+        self.current_batch = batch
+        self.last_beat = clock()
+        self.batches_run += 1
+        # the ONE read of the published weight set this batch serves
+        # against — the hot-swap flip is atomic relative to it
+        ws = self.weights.current
         key = self._shape_key(batch.features, batch.mask)
         first = key not in self._seen_shapes
         t0 = time.perf_counter()
         try:
             with rec.span("forward", bucket=list(batch.bucket.key()),
                           replica=self.index, n_real=batch.n_real):
+                if self.faults is not None:
+                    self.faults.check(self.index, "batch",
+                                      self.batches_run)
                 if first:
                     # the first execution of a bucket shape includes its
                     # compile — span-named so the warmed compile count is
@@ -93,24 +151,30 @@ class _Replica:
                     with rec.span("compile",
                                   bucket=list(batch.bucket.key()),
                                   replica=self.index):
-                        y = self._jit(self.net.params, self.net.state,
+                        y = self._jit(ws.params, ws.state,
                                       batch.features, batch.mask)
                         rows = np.asarray(y)  # batch-boundary fetch
                     self._seen_shapes.add(key)
                 else:
-                    y = self._jit(self.net.params, self.net.state,
+                    y = self._jit(ws.params, ws.state,
                                   batch.features, batch.mask)
                     rows = np.asarray(y)  # batch-boundary fetch
+        except ReplicaKilled as exc:
+            # injected replica death: the in-flight batch fails (the
+            # BOUNDED failure set), the thread dies; the supervisor
+            # requeues this replica's queue and respawns it. Death is
+            # marked BEFORE the futures complete so a waiter that saw
+            # the failure also sees the dead replica.
+            self.current_batch = None
+            self.alive = False
+            self.lifecycle = "dead"
+            self.fail_batch(batch, exc, clock=clock,
+                            weight_gen=ws.generation)
+            raise
         except Exception as exc:  # worker dying mid-batch: contain it
-            self.failed += batch.n_real
-            rec.error(f"replica:{self.index}", exc=exc)
-            err = "".join(traceback.format_exception_only(type(exc), exc)).strip()
-            t_done = clock()
-            for r in batch.requests:
-                r.error = err
-                r.t_done = t_done
-                self._request_event(r, batch, None, ok=False, error=err)
-                r.done.set()
+            self.fail_batch(batch, exc, clock=clock,
+                            weight_gen=ws.generation)
+            self.current_batch = None
             return
         forward_s = time.perf_counter() - t0
         t_done = clock()
@@ -121,20 +185,29 @@ class _Replica:
             r.result = out
             r.t_done = t_done
             self.served += 1
-            self._request_event(r, batch, forward_s, ok=True)
+            self._request_event(r, batch, forward_s, ok=True,
+                               weight_gen=ws.generation)
             r.done.set()
+        self.current_batch = None
+        self.last_beat = clock()
 
     def _request_event(self, r, batch: Batch, forward_s, *, ok,
-                       error: str | None = None) -> None:
+                       error: str | None = None,
+                       weight_gen: int | None = None) -> None:
         """The per-request telemetry record — the ONLY source the
         traffic-replay bench reads latency from (serving/replay.py
-        reconstructs p50/p99/QPS from these events alone)."""
+        reconstructs p50/p99/QPS from these events alone). `weight_gen`
+        names the published weight generation the batch served against
+        — the hot-swap flip's visibility in the request stream."""
         fields = dict(
             ok=ok, bucket=list(batch.bucket.key()),
             replica=self.index, n_real=batch.n_real,
             queue_s=round(r.t_assembled - r.t_enqueue, 6),
             batch_assemble_s=round(batch.assemble_seconds, 6),
             total_s=round(r.t_done - r.t_enqueue, 6))
+        if weight_gen is None:
+            weight_gen = self.weights.generation
+        fields["weight_gen"] = weight_gen
         if forward_s is not None:
             fields["forward_s"] = round(forward_s, 6)
         if batch.bucket.seq is not None:
@@ -146,13 +219,21 @@ class _Replica:
 
     # ---------------------------------------------------------- lifecycle
     def start(self, clock, sequence: bool) -> None:
+        self.last_beat = clock()
+
         def loop():
             while True:
                 batch = self.queue.get()
                 if batch is None:
+                    if self.lifecycle != "dead":
+                        self.lifecycle = "retired"
                     return
-                self.run_batch(batch, clock=clock, sequence=sequence)
+                try:
+                    self.run_batch(batch, clock=clock, sequence=sequence)
+                except ReplicaKilled:
+                    return  # dead: the supervisor requeues + respawns
 
+        self.lifecycle = "serving"
         self._thread = threading.Thread(target=loop, daemon=True,
                                         name=f"serve-replica-{self.index}")
         self._thread.start()
@@ -160,6 +241,16 @@ class _Replica:
     def join(self, timeout: float | None = None) -> None:
         if self._thread is not None:
             self._thread.join(timeout)
+
+    def describe(self, now: float | None = None) -> dict:
+        """One /healthz row: lifecycle, counters, heartbeat age."""
+        out = {"index": self.index, "state": self.lifecycle,
+               "alive": self.alive, "served": self.served,
+               "failed": self.failed, "batches_run": self.batches_run}
+        if now is not None:
+            out["last_beat_age_s"] = round(max(0.0, now - self.last_beat),
+                                           3)
+        return out
 
 
 class InferenceEngine:
@@ -174,7 +265,7 @@ class InferenceEngine:
     def __init__(self, net, lattice: BucketLattice | None = None, *,
                  replicas: int = 1, max_wait_ms: float = 5.0,
                  sequence: bool = False, checkpoint: str | None = None,
-                 recorder=None):
+                 faults=None, recorder=None):
         if recorder is None:
             from deeplearning4j_tpu.telemetry import get_default
 
@@ -185,37 +276,44 @@ class InferenceEngine:
             net.init()
         self.restored_step = 0
         if checkpoint is not None:
-            # any-mesh checkpoint restore: the checkpoint may have been
-            # written by a 2x4 training fleet; the portable resharding
-            # engine (reshard/) plans its placement onto this serving
-            # process's one-device mesh and orbax reads only the slices
-            # it needs — the train-anywhere/serve-here handoff, with the
-            # reshard_plan on the telemetry record
-            import jax
-
-            from deeplearning4j_tpu.parallel.mesh import make_mesh
-
-            # this process's OWN first device: in a serving fleet
-            # (serve --multiprocess) jax.devices()[0] belongs to rank 0
-            # and is not addressable here
-            self.restored_step = int(net.resume_from(
-                checkpoint,
-                target_mesh=make_mesh({"data": 1},
-                                      devices=jax.local_devices())))
+            # any-mesh checkpoint restore through the blessed fleet
+            # path: the checkpoint may have been written by a 2x4
+            # training fleet; the portable resharding engine (reshard/)
+            # plans its placement onto this serving process's own
+            # one-device mesh and orbax reads only the slices it needs
+            self.restored_step = restore_for_serving(net, checkpoint)
         self.net = net
+        # the double-buffered published weight set every replica reads
+        # from — live hot-swap (serving/fleet.hot_swap) flips it
+        self.weights = WeightStore(net.params, net.state,
+                                   step=self.restored_step)
         self.lattice = lattice or BucketLattice()
         self.batcher = Batcher(self.lattice, max_wait_ms,
                                sequence=sequence, recorder=recorder)
         self._clock = self.batcher._clock
-        self._replicas = [_Replica(i, net, recorder)
-                          for i in range(max(1, int(replicas)))]
+        self._faults = None
+        if faults is not None:
+            self._faults = (faults if isinstance(faults,
+                                                 ReplicaFaultInjector)
+                            else ReplicaFaultInjector(faults, recorder))
+        self._rcv = threading.Condition()
+        self._next_index = 0
+        self._replicas = [self._new_replica()
+                          for _ in range(max(1, int(replicas)))]
         self._rr = 0
         self._dispatcher: threading.Thread | None = None
         self._started = False
+        self._draining = False
         self._feature_template: np.ndarray | None = None
         recorder.meta(role="serving-engine", replicas=len(self._replicas),
                       sequence=sequence, lattice=self.lattice.describe(),
                       restored_step=self.restored_step)
+
+    def _new_replica(self) -> _Replica:
+        r = _Replica(self._next_index, self.net, self.recorder,
+                     self.weights, faults=self._faults)
+        self._next_index += 1
+        return r
 
     # ------------------------------------------------------------- warmup
     def warmup(self, example_features) -> int:
@@ -227,24 +325,36 @@ class InferenceEngine:
         replay must add zero."""
         ex = np.asarray(example_features)
         self._feature_template = ex
+        return sum(self._warm_replica(r) for r in self._replicas)
+
+    def _warm_replica(self, replica: _Replica) -> int:
+        """Compile every lattice bucket this replica has not yet seen
+        (warmup, add_replica, and the supervisor's respawn-re-warm all
+        route here; a respawn compiles NOTHING — the jit executables
+        survive a thread death in-process)."""
+        ex = self._feature_template
+        if ex is None:
+            return 0
+        replica.lifecycle = ("warming" if replica.lifecycle != "serving"
+                             else replica.lifecycle)
         tail = ex.shape[1:] if self.sequence else ex.shape
+        ws = self.weights.current
         compiles = 0
-        for replica in self._replicas:
-            for bucket in self.lattice.shapes():
-                feats, mask = self._zeros_for(bucket, tail, ex.dtype)
-                batch = Batch(bucket, feats, mask, [])
-                key = replica._shape_key(feats, mask)
-                if key in replica._seen_shapes:
-                    continue
-                with self.recorder.span("compile",
-                                        bucket=list(bucket.key()),
-                                        replica=replica.index,
-                                        warmup=True):
-                    y = replica._jit(self.net.params, self.net.state,
-                                     batch.features, batch.mask)
-                    np.asarray(y)  # batch-boundary fetch
-                replica._seen_shapes.add(key)
-                compiles += 1
+        for bucket in self.lattice.shapes():
+            feats, mask = self._zeros_for(bucket, tail, ex.dtype)
+            batch = Batch(bucket, feats, mask, [])
+            key = replica._shape_key(feats, mask)
+            if key in replica._seen_shapes:
+                continue
+            with self.recorder.span("compile",
+                                    bucket=list(bucket.key()),
+                                    replica=replica.index,
+                                    warmup=True):
+                y = replica._jit(ws.params, ws.state,
+                                 batch.features, batch.mask)
+                np.asarray(y)  # batch-boundary fetch
+            replica._seen_shapes.add(key)
+            compiles += 1
         return compiles
 
     def _zeros_for(self, bucket: Bucket, tail: tuple, dtype):
@@ -267,16 +377,41 @@ class InferenceEngine:
                 batch = self.batcher.next_batch()
                 if batch is None:
                     break  # draining and empty
-                replica = self._replicas[self._rr % len(self._replicas)]
-                self._rr += 1
-                replica.queue.put(batch)
-            for r in self._replicas:
+                if not self._dispatch_batch(batch):
+                    # draining with zero live replicas left
+                    self._replicas[0].fail_batch(
+                        batch, "no live replica during drain",
+                        clock=self._clock)
+            with self._rcv:
+                targets = list(self._replicas)
+            for r in targets:
                 r.queue.put(None)
 
         self._dispatcher = threading.Thread(target=dispatch, daemon=True,
                                             name="serve-dispatch")
         self._dispatcher.start()
         return self
+
+    def _dispatch_batch(self, batch: Batch) -> bool:
+        """Round-robin one batch over LIVE replicas only — dead,
+        draining, and retired workers never receive new batches. The
+        pick AND the queue put happen under the replica lock, so a
+        concurrent retire's drain sentinel can never slip between them
+        and strand the batch behind it. Blocks (condition-notified by
+        respawn/add) while no replica is servable; returns False only
+        when the engine is draining and no replica will come back."""
+        with self._rcv:
+            while True:
+                serving = [r for r in self._replicas
+                           if r.alive and r.lifecycle == "serving"]
+                if serving:
+                    replica = serving[self._rr % len(serving)]
+                    self._rr += 1
+                    replica.queue.put(batch)
+                    return True
+                if self._draining:
+                    return False
+                self._rcv.wait(timeout=0.05)
 
     def submit(self, features, mask=None, request_id=None):
         features = np.asarray(features)
@@ -302,15 +437,115 @@ class InferenceEngine:
                                f"{req.error}")
         return req.result
 
+    # ---------------------------------------------------- fleet lifecycle
+    # The FleetSupervisor's contract surface (serving/fleet.py): reap a
+    # dead/hung worker, respawn it, grow/drain the replica set.
+
+    def fleet_workers(self) -> list:
+        with self._rcv:
+            return list(self._replicas)
+
+    def fleet_snapshot(self) -> dict:
+        """The autoscale loop's engine-side signals."""
+        with self._rcv:
+            n_serving = sum(1 for r in self._replicas
+                            if r.alive and r.lifecycle == "serving")
+            n_replicas = sum(1 for r in self._replicas
+                             if r.alive and r.lifecycle
+                             in ("warming", "serving"))
+        return {"queue_depth": self.batcher.depth,
+                "n_serving": n_serving, "n_replicas": n_replicas}
+
+    def fleet_reap(self, replica: _Replica, reason: str = "died") -> int:
+        """Take a dead/hung replica out of dispatch: fail its in-flight
+        batch (the hang case — a wedged thread can never complete it;
+        the kill path already failed its own), then drain its QUEUED
+        batches back to the batcher FIFO head, where live replicas pick
+        them up. Returns the requeued request count."""
+        with self._rcv:
+            replica.alive = False
+            replica.lifecycle = "dead"
+        inflight = replica.current_batch
+        if inflight is not None:
+            replica.current_batch = None
+            replica.fail_batch(inflight, f"replica {replica.index} "
+                                         f"reaped ({reason})",
+                               clock=self._clock)
+        requeued = []
+        while True:
+            try:
+                b = replica.queue.get_nowait()
+            except queue.Empty:
+                break
+            if b is not None:
+                requeued.extend(b.requests)
+        if requeued:
+            self.batcher.requeue(requeued)
+        return len(requeued)
+
+    def fleet_respawn(self, replica: _Replica) -> _Replica:
+        """Bring a reaped replica back: fresh queue + thread over the
+        SAME jit wrappers (compiled executables survive a thread death
+        in-process), warmup re-run before re-admission — it compiles
+        nothing, so the trace counter stays frozen — then re-admit to
+        dispatch."""
+        replica.queue = queue.Queue()
+        replica.batches_run = 0
+        replica.current_batch = None
+        replica.alive = True
+        replica.lifecycle = "warming"
+        self._warm_replica(replica)
+        replica.start(self._clock, self.sequence)
+        with self._rcv:
+            self._rcv.notify_all()
+        return replica
+
+    def add_replica(self) -> _Replica:
+        """Scale UP one replica: build, warm every lattice bucket
+        (warmup-flagged compiles — the zero-retrace accounting is
+        unchanged), start, admit to dispatch."""
+        with self._rcv:
+            replica = self._new_replica()
+            self._replicas.append(replica)
+        self._warm_replica(replica)
+        if self._started:
+            replica.start(self._clock, self.sequence)
+        with self._rcv:
+            self._rcv.notify_all()
+        return replica
+
+    def retire_replica(self) -> _Replica | None:
+        """Scale DOWN one replica, gracefully: the newest serving
+        replica stops receiving batches (lifecycle `draining`),
+        finishes everything already in its queue, and its thread exits
+        — queued work is never dropped. The last live replica is never
+        retired."""
+        with self._rcv:
+            serving = [r for r in self._replicas
+                       if r.alive and r.lifecycle == "serving"]
+            if len(serving) <= 1:
+                return None
+            replica = serving[-1]
+            replica.lifecycle = "draining"
+            # the sentinel lands under the same lock the dispatcher
+            # picks+puts under: no batch can follow it into the queue
+            replica.queue.put(None)
+        return replica
+
     # -------------------------------------------------------------- drain
     def drain(self, timeout: float = 30.0) -> None:
         """Graceful shutdown: refuse new requests, flush every pending
         batch through the replicas, join the threads. Every admitted
         request completes (or fails loudly) before this returns."""
+        self._draining = True
+        with self._rcv:
+            self._rcv.notify_all()
         self.batcher.close()
         if self._dispatcher is not None:
             self._dispatcher.join(timeout)
-        for r in self._replicas:
+        for r in self.fleet_workers():
+            if r.lifecycle == "dead":
+                continue  # a wedged thread never joins; it is a daemon
             r.join(timeout)
         self.recorder.event("span", name="drain", ok=True, seconds=0.0,
                             served=self.served, failed=self.failed)
@@ -329,8 +564,11 @@ class InferenceEngine:
         return sum(r.failed for r in self._replicas)
 
     def stats(self) -> dict:
+        now = self._clock()
+        with self._rcv:
+            fleet = [r.describe(now) for r in self._replicas]
         return {
-            "replicas": len(self._replicas),
+            "replicas": len(fleet),
             "served": self.served,
             "failed": self.failed,
             "queue_depth": self.batcher.depth,
@@ -338,6 +576,8 @@ class InferenceEngine:
             "restored_step": self.restored_step,
             "lattice": self.lattice.describe(),
             "sequence": self.sequence,
+            "fleet": fleet,
+            "weights": self.weights.describe(),
         }
 
 
@@ -361,7 +601,8 @@ class _GenWorker:
 
     def __init__(self, index: int, net, lattice: BucketLattice,
                  plan: CachePlan, prefill_chunk: int, max_queue: int,
-                 recorder):
+                 recorder, weights: WeightStore | None = None,
+                 faults: ReplicaFaultInjector | None = None):
         import jax
         import jax.numpy as jnp
 
@@ -372,6 +613,8 @@ class _GenWorker:
         self.prefill_chunk = prefill_chunk
         self.max_queue = max_queue
         self.recorder = recorder
+        self.weights = weights or WeightStore(net.params, net.state)
+        self.faults = faults
         self.pool = plan.make_pool()
         self.slots = DecodeSlots(plan.n_slots)
         self.cache = net.init_kv_cache(plan.n_slots, plan.capacity)
@@ -379,6 +622,11 @@ class _GenWorker:
         self.served = 0
         self.failed = 0
         self.tokens_out = 0
+        self.decode_steps_run = 0
+        self.alive = True
+        self.lifecycle = "warming"
+        self.last_beat = 0.0
+        self.current_batch = None  # the active row set mid-step
         self._seen_shapes: set = set()
         self.pending: deque[GenRequest] = deque()
         self._cv = threading.Condition()
@@ -424,6 +672,7 @@ class _GenWorker:
         once, before traffic. After this the trace counter is frozen —
         a mixed prompt/output-length replay must add zero."""
         compiles = 0
+        ws = self.weights.current
         rows = np.zeros(1, np.int32)
         start = np.zeros(1, np.int32)
         for Tb in self.chunk_buckets():
@@ -434,7 +683,7 @@ class _GenWorker:
                                     bucket=[1, Tb], replica=self.index,
                                     warmup=True):
                 tok, cache = self._prefill_jit(
-                    self.net.params, self.net.state, self.cache,
+                    ws.params, ws.state, self.cache,
                     np.zeros((1, Tb), np.int32),
                     np.zeros((1, Tb), np.float32), rows, start,
                     np.asarray([Tb - 1], np.int32))
@@ -449,7 +698,7 @@ class _GenWorker:
                                     shape=[B, self.plan.capacity],
                                     replica=self.index, warmup=True):
                 tok, cache = self._decode_jit(
-                    self.net.params, self.net.state, self.cache,
+                    ws.params, ws.state, self.cache,
                     np.zeros(B, np.int32), scratch)
                 np.asarray(tok)  # batch-boundary fetch
                 self.cache = cache
@@ -515,11 +764,12 @@ class _GenWorker:
         final = slot.start + n_real >= L
         key = ("prefill", Tc)
         first = key not in self._seen_shapes
+        ws = self.weights.current
         try:
             with self.recorder.span("prefill_chunk", bucket=[1, Tc],
                                     start=slot.start, replica=self.index,
                                     final=final):
-                args = (self.net.params, self.net.state, self.cache,
+                args = (ws.params, ws.state, self.cache,
                         padded_tokens, bucket_kmask,
                         np.asarray([slot_idx], np.int32),
                         np.asarray([slot.start], np.int32),
@@ -561,17 +811,37 @@ class _GenWorker:
             slot = self.slots.slots[i]
             padded_tokens[i] = slot.last_token
             pos[i] = slot.pos
+        ws = self.weights.current
+        self.decode_steps_run += 1
+        self.current_batch = list(active)
         try:
             with self.recorder.span("decode_step", replica=self.index,
                                     n_active=len(active)):
+                if self.faults is not None:
+                    self.faults.check(self.index, "decode",
+                                      self.decode_steps_run)
                 tok, cache = self._decode_jit(
-                    self.net.params, self.net.state, self.cache,
+                    ws.params, ws.state, self.cache,
                     padded_tokens, pos)
                 toks = np.asarray(tok)  # batch-boundary fetch
+        except ReplicaKilled as exc:
+            # injected mid-decode death: every active slot fails (pages
+            # released by _fail_slot), the thread dies; the supervisor
+            # respawns — pending requests stay queued with the worker.
+            # Death is marked BEFORE the futures complete so a waiter
+            # that saw the failure also sees the dead worker.
+            self.current_batch = None
+            self.alive = False
+            self.lifecycle = "dead"
+            for i in active:
+                self._fail_slot(i, exc, clock)
+            raise
         except Exception as exc:
             for i in active:
                 self._fail_slot(i, exc, clock)
+            self.current_batch = None
             return
+        self.current_batch = None
         self.cache = cache
         now = clock()
         for i in active:
@@ -627,30 +897,67 @@ class _GenWorker:
         self.recorder.request(req.request_id, **fields)
 
     def start(self, clock) -> None:
+        self.last_beat = clock()
+
         def loop():
             while True:
+                self.last_beat = clock()
                 self._admit(clock)
                 progressed = False
-                pi = self.slots.next_prefill()
-                if pi is not None:
-                    self._run_prefill_chunk_bucketed(pi, clock)
-                    progressed = True
-                active = self.slots.decoding()
-                if active:
-                    self._decode_batch_step(active, clock)
-                    progressed = True
+                try:
+                    pi = self.slots.next_prefill()
+                    if pi is not None:
+                        self._run_prefill_chunk_bucketed(pi, clock)
+                        progressed = True
+                    active = self.slots.decoding()
+                    if active:
+                        self._decode_batch_step(active, clock)
+                        progressed = True
+                except ReplicaKilled:
+                    return  # dead: the fleet supervisor respawns
                 if progressed:
                     continue
                 with self._cv:
                     if self._closed and not self.pending \
                             and not self.slots.busy():
+                        if self.lifecycle != "dead":
+                            self.lifecycle = "retired"
                         return
                     if not self.pending or self.slots.free_index() is None:
                         self._cv.wait(timeout=0.05)
 
+        self.lifecycle = "serving"
         self._thread = threading.Thread(target=loop, daemon=True,
                                         name=f"gen-replica-{self.index}")
         self._thread.start()
+
+    def respawn(self, clock) -> None:
+        """Fleet-supervisor respawn: fresh thread over the SAME jit
+        wrappers and KV cache (warmup re-runs and compiles nothing —
+        every shape is already seen), pending requests continue from
+        the worker's own queue."""
+        self.alive = True
+        self.lifecycle = "warming"
+        self.current_batch = None
+        self.decode_steps_run = 0
+        self.warmup(clock)
+        self.start(clock)
+        with self._cv:
+            self._cv.notify_all()
+
+    def reap(self, reason: str, clock) -> int:
+        """Fail every occupied slot (pages released) — the hang case,
+        where the wedged thread can never finish them. Pending requests
+        stay queued for the respawned thread. Returns 0 (nothing is
+        re-dispatched elsewhere: the queue IS this worker's)."""
+        self.alive = False
+        self.lifecycle = "dead"
+        self.current_batch = None
+        exc = RuntimeError(f"gen replica {self.index} reaped ({reason})")
+        for i, s in enumerate(self.slots.slots):
+            if s is not None:
+                self._fail_slot(i, exc, clock)
+        return 0
 
     def close(self) -> None:
         with self._cv:
@@ -665,6 +972,16 @@ class _GenWorker:
     def depth(self) -> int:
         with self._cv:
             return len(self.pending)
+
+    def describe(self, now: float | None = None) -> dict:
+        out = {"index": self.index, "state": self.lifecycle,
+               "alive": self.alive, "served": self.served,
+               "failed": self.failed,
+               "decode_steps_run": self.decode_steps_run}
+        if now is not None:
+            out["last_beat_age_s"] = round(max(0.0, now - self.last_beat),
+                                           3)
+        return out
 
 
 class GenerationEngine:
@@ -688,7 +1005,7 @@ class GenerationEngine:
                  pool_pages: int | None = None,
                  prefill_chunk: int | None = None, max_queue: int = 64,
                  replicas: int = 1, checkpoint: str | None = None,
-                 recorder=None):
+                 faults=None, recorder=None):
         if recorder is None:
             from deeplearning4j_tpu.telemetry import get_default
 
@@ -701,15 +1018,17 @@ class GenerationEngine:
             net.init()
         self.restored_step = 0
         if checkpoint is not None:
-            import jax
-
-            from deeplearning4j_tpu.parallel.mesh import make_mesh
-
-            self.restored_step = int(net.resume_from(
-                checkpoint,
-                target_mesh=make_mesh({"data": 1},
-                                      devices=jax.local_devices())))
+            # the blessed fleet restore path (any-mesh checkpoint onto
+            # this process's own one-device mesh)
+            self.restored_step = restore_for_serving(net, checkpoint)
         self.net = net
+        self.weights = WeightStore(net.params, net.state,
+                                   step=self.restored_step)
+        self._faults = None
+        if faults is not None:
+            self._faults = (faults if isinstance(faults,
+                                                 ReplicaFaultInjector)
+                            else ReplicaFaultInjector(faults, recorder))
         self.lattice = lattice
         chunk = (lattice.max_seq if prefill_chunk is None
                  else int(prefill_chunk))
@@ -720,7 +1039,8 @@ class GenerationEngine:
         self._clock = time.monotonic
         self._workers = [
             _GenWorker(i, net, lattice, self.plan, chunk, max_queue,
-                       recorder)
+                       recorder, weights=self.weights,
+                       faults=self._faults)
             for i in range(max(1, int(replicas)))]
         self._rr = 0
         self._started = False
@@ -789,11 +1109,29 @@ class GenerationEngine:
                                f"{req.error}")
         return list(req.emitted)
 
+    # ---------------------------------------------------- fleet lifecycle
+    def fleet_workers(self) -> list:
+        return list(self._workers)
+
+    def fleet_snapshot(self) -> dict:
+        n_serving = sum(1 for w in self._workers
+                        if w.alive and w.lifecycle == "serving")
+        return {"queue_depth": sum(w.depth for w in self._workers),
+                "n_serving": n_serving, "n_replicas": n_serving}
+
+    def fleet_reap(self, worker, reason: str = "died") -> int:
+        return worker.reap(reason, self._clock)
+
+    def fleet_respawn(self, worker) -> None:
+        worker.respawn(self._clock)
+
     # -------------------------------------------------------------- drain
     def drain(self, timeout: float = 30.0) -> None:
         for w in self._workers:
             w.close()
         for w in self._workers:
+            if w.lifecycle == "dead":
+                continue  # a wedged daemon thread never joins
             w.join(timeout)
         self.recorder.event("span", name="drain", ok=True, seconds=0.0,
                             served=self.served, failed=self.failed)
@@ -812,6 +1150,7 @@ class GenerationEngine:
         return sum(w.failed for w in self._workers)
 
     def stats(self) -> dict:
+        now = self._clock()
         pools = [w.pool.describe() for w in self._workers]
         return {
             "replicas": len(self._workers),
@@ -824,5 +1163,7 @@ class GenerationEngine:
             "lattice": self.lattice.describe(),
             "cache": self.plan.describe(),
             "page_pools": pools,
+            "fleet": [w.describe(now) for w in self._workers],
+            "weights": self.weights.describe(),
             "generate": True,
         }

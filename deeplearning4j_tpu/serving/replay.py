@@ -32,6 +32,18 @@ occupancy (from `page_pool` events), and the decode-step span medians
 that prove decode cost independent of prompt length. Artifact:
 SERVE_r02-style, written by `run_generation_replay` /
 tools/trafficreplay.py --generate / bench.py serving_generate.
+
+The FLEET replay (r18, ISSUE 13) is the zero-downtime operations
+bench: `run_fleet_replay` drives the SAME seeded bursty trace through
+two arms — a fixed-replica baseline and an autoscaling arm
+(serving/fleet.FleetSupervisor) that also absorbs a replica-kill chaos
+spec and a mid-traffic weight hot-swap — and `reconstruct_fleet`
+extends the scoreboard with `swap_ms` (the off-path restore cost),
+`respawn_ms`, `failed_requests` (the chaos kill's BOUNDED in-flight
+loss), autoscale occupancy (mean replicas held / max, from `autoscale`
+events), and the weight generations visible in `request` events.
+Artifact: SERVE_r03-style, gated by tools/benchdiff.py (all the new
+rows are lower-is-better except QPS).
 """
 
 from __future__ import annotations
@@ -504,14 +516,17 @@ def run_replay(*, model: str = "lm", seed: int = 0, n_requests: int = 60,
                lengths=(8, 16, 32), batch_sizes=(1, 2, 4),
                max_wait_ms: float = 4.0, replicas: int = 1,
                telemetry_path: str, artifact_path: str | None = None,
-               checkpoint: str | None = None, emit=None) -> dict:
+               checkpoint: str | None = None, chaos: str | None = None,
+               emit=None) -> dict:
     """End-to-end: build the tiny model, warm the bucket lattice, replay
     the seeded trace over HTTP, drain, reconstruct from the telemetry
     JSONL, optionally write the SERVE artifact. `emit` (a callable
     taking a metric-line dict) lets bench.py mirror each line through
-    its own pipeline. rc semantics: this function raises on setup
-    errors; a zero-`n_ok` replay is reported, not raised — the caller
-    gates on the numbers."""
+    its own pipeline. `chaos` is a replica-scoped fault spec string
+    (`r0:kill@batch3` — distributed/faults.py grammar): the faults fire
+    inside the replicas and a FleetSupervisor heals them live. rc
+    semantics: this function raises on setup errors; a zero-`n_ok`
+    replay is reported, not raised — the caller gates on the numbers."""
     from deeplearning4j_tpu.serving.buckets import BucketLattice
     from deeplearning4j_tpu.serving.engine import InferenceEngine
     from deeplearning4j_tpu.serving.server import ServingServer
@@ -545,19 +560,34 @@ def run_replay(*, model: str = "lm", seed: int = 0, n_requests: int = 60,
 
     engine = InferenceEngine(net, lattice, replicas=replicas,
                              max_wait_ms=max_wait_ms, sequence=sequence,
-                             checkpoint=checkpoint, recorder=rec)
+                             checkpoint=checkpoint, faults=chaos,
+                             recorder=rec)
     example = make_features(0, max(lengths) if sequence else 0)
     warm = engine.warmup(example)
     server = ServingServer(engine, port=0).start()
+    supervisor = None
+    if chaos is not None:
+        # chaos without a healer would just bleed: the supervisor reaps
+        # the injected deaths and respawns, live, during the replay
+        from deeplearning4j_tpu.serving.fleet import (FleetSupervisor,
+                                                      RespawnBackoff)
+
+        supervisor = FleetSupervisor(
+            engine, death_after_s=1.0,
+            backoff=RespawnBackoff(base_s=0.01, jitter_frac=0.0),
+            recorder=rec).run_in_thread(0.02)
     trace = make_trace(seed, n_requests, mean_gap_s=mean_gap_s,
                        burst=burst, lengths=lengths)
     try:
         client = replay_http(server.url, trace,
                              make_features=make_features)
     finally:
+        if supervisor is not None:
+            supervisor.stop()
         server.stop()
         rec.close()
-    scoreboard = reconstruct(telemetry_path)
+    scoreboard = reconstruct_fleet(telemetry_path) if chaos is not None \
+        else reconstruct(telemetry_path)
     scoreboard["client"] = client
     scoreboard["warmed_buckets"] = warm
     lines = metric_lines(scoreboard)
@@ -569,3 +599,232 @@ def run_replay(*, model: str = "lm", seed: int = 0, n_requests: int = 60,
         scoreboard["artifact"] = artifact_path
     scoreboard["lines"] = lines
     return scoreboard
+
+
+# ---------------------------------------------------------- fleet replay
+
+def reconstruct_fleet(telemetry_path: str) -> dict:
+    """The fleet-operations scoreboard — `reconstruct` plus the ISSUE 13
+    rows, every one from the telemetry JSONL alone:
+
+    * `swap_ms` — the slowest successful `weight_swap` restore (the
+      off-request-path cost of picking up a new checkpoint); `n_swaps`
+      counts them, `swap_rejected` the validation refusals;
+    * `respawn_ms` — the slowest `replica-respawn` fault event (reap →
+      re-warm → re-admit), `n_respawns` / `n_replica_deaths` alongside;
+    * `autoscale_occupancy` — mean of `n_replicas / max_replicas` over
+      `autoscale` events (how much fleet the traffic actually held),
+      plus `scale_ups` / `scale_downs`;
+    * `weight_generations` — the distinct `weight_gen` values in
+      `request` events: a hot-swap's flip is visible here or it never
+      reached traffic.
+    """
+    sb = reconstruct(telemetry_path)
+    swap_ms, respawn_ms, occ = [], [], []
+    swaps_rejected = deaths = ups = downs = 0
+    gens = set()
+    with open(telemetry_path) as fh:
+        for raw in fh:
+            raw = raw.strip()
+            if not raw.startswith("{"):
+                continue
+            try:
+                ev = json.loads(raw)
+            except json.JSONDecodeError:
+                continue
+            kind = ev.get("event")
+            if kind == "weight_swap":
+                if ev.get("ok"):
+                    swap_ms.append(float(ev.get("restore_ms", 0.0)))
+                else:
+                    swaps_rejected += 1
+            elif kind == "fault":
+                if ev.get("kind") == "replica-respawn":
+                    respawn_ms.append(float(ev.get("respawn_ms", 0.0)))
+                elif ev.get("kind") == "replica-dead":
+                    deaths += 1
+            elif kind == "autoscale":
+                total = ev.get("max_replicas") or 0
+                if total:
+                    occ.append(float(ev.get("n_replicas", 0)) / total)
+                if ev.get("action", 0) > 0:
+                    ups += 1
+                elif ev.get("action", 0) < 0:
+                    downs += 1
+            elif kind == "request" and "weight_gen" in ev:
+                gens.add(int(ev["weight_gen"]))
+    sb.update({
+        "swap_ms": round(max(swap_ms), 3) if swap_ms else 0.0,
+        "n_swaps": len(swap_ms),
+        "swap_rejected": swaps_rejected,
+        "respawn_ms": round(max(respawn_ms), 3) if respawn_ms else 0.0,
+        "n_respawns": len(respawn_ms),
+        "n_replica_deaths": deaths,
+        "autoscale_occupancy": (round(sum(occ) / len(occ), 4)
+                                if occ else 0.0),
+        "scale_ups": ups,
+        "scale_downs": downs,
+        "weight_generations": sorted(gens),
+    })
+    return sb
+
+
+def fleet_metric_lines(fixed: dict, autoscale: dict,
+                       prefix: str = "fleet") -> list:
+    """Bench metric lines for the two-arm fleet replay. QPS rows stay
+    higher-is-better; everything the fleet SPENDS — latency, restore
+    and respawn wall-clock, failed requests, held replicas, retraces —
+    carries the lower_is_better flag benchdiff inverts on."""
+    return [
+        {"metric": f"{prefix}_fixed_qps", "value": fixed["qps"],
+         "unit": "req/sec", "n_ok": fixed["n_ok"],
+         "n_failed": fixed["n_failed"]},
+        {"metric": f"{prefix}_fixed_p99_ms", "value": fixed["p99_ms"],
+         "unit": "ms", "lower_is_better": True},
+        {"metric": f"{prefix}_autoscale_qps", "value": autoscale["qps"],
+         "unit": "req/sec", "n_ok": autoscale["n_ok"],
+         "n_failed": autoscale["n_failed"]},
+        {"metric": f"{prefix}_autoscale_p99_ms",
+         "value": autoscale["p99_ms"], "unit": "ms",
+         "lower_is_better": True},
+        {"metric": f"{prefix}_autoscale_occupancy",
+         "value": autoscale["autoscale_occupancy"], "unit": "fraction",
+         "lower_is_better": True, "scale_ups": autoscale["scale_ups"],
+         "scale_downs": autoscale["scale_downs"]},
+        {"metric": f"{prefix}_swap_ms", "value": autoscale["swap_ms"],
+         "unit": "ms", "lower_is_better": True,
+         "n_swaps": autoscale["n_swaps"]},
+        {"metric": f"{prefix}_respawn_ms",
+         "value": autoscale["respawn_ms"], "unit": "ms",
+         "lower_is_better": True,
+         "n_respawns": autoscale["n_respawns"]},
+        {"metric": f"{prefix}_failed_requests",
+         "value": autoscale["n_failed"], "unit": "count",
+         "lower_is_better": True, "n_ok": autoscale["n_ok"]},
+        {"metric": f"{prefix}_recompiles_after_warmup",
+         "value": (fixed["recompiles_after_warmup"]
+                   + autoscale["recompiles_after_warmup"]),
+         "unit": "count", "lower_is_better": True,
+         "warmup_compiles": (fixed["warmup_compiles"]
+                             + autoscale["warmup_compiles"])},
+    ]
+
+
+def run_fleet_replay(*, seed: int = 0, n_requests: int = 120,
+                     burst: int = 8, mean_gap_s: float = 0.004,
+                     batch_sizes=(1, 2, 4), max_wait_ms: float = 3.0,
+                     autoscale_max: int = 3,
+                     chaos: str | None = "r0:kill@batch4",
+                     hot_swap_after: int | None = None,
+                     telemetry_path: str,
+                     artifact_path: str | None = None,
+                     emit=None) -> dict:
+    """The SERVE_r03 bench: the SAME seeded bursty trace through two
+    arms —
+
+    * **fixed** — one replica, no supervisor (the SERVE_r01-style
+      baseline);
+    * **autoscale** — starts at one replica under a `FleetSupervisor`
+      (AutoscalePolicy up to `autoscale_max`), absorbs the replica-kill
+      `chaos` spec mid-traffic, and hot-swaps a freshly published
+      checkpoint (the net's own weights re-saved at a new step — the
+      train-fleet-publishes handoff) after `hot_swap_after` completed
+      requests (default: half the trace).
+
+    Each arm records to its own telemetry file (`<path>.fixed` /
+    `<path>.autoscale`) and reconstructs from it ALONE; the artifact is
+    the combined `fleet_*` metric-line set + gate summary."""
+    import tempfile
+
+    from deeplearning4j_tpu.serving.buckets import BucketLattice
+    from deeplearning4j_tpu.serving.engine import InferenceEngine
+    from deeplearning4j_tpu.serving.fleet import (AutoscalePolicy,
+                                                  FleetSupervisor,
+                                                  RespawnBackoff,
+                                                  hot_swap)
+    from deeplearning4j_tpu.serving.server import ServingServer
+    from deeplearning4j_tpu.telemetry import Recorder
+    from deeplearning4j_tpu.util.orbax_checkpoint import ShardedCheckpointer
+
+    if hot_swap_after is None:
+        hot_swap_after = n_requests // 2
+    trace = make_trace(seed, n_requests, mean_gap_s=mean_gap_s,
+                       burst=burst, lengths=(8,))
+    feat_rng = np.random.default_rng(seed + 1)
+    feats = feat_rng.normal(size=(n_requests, 8)).astype(np.float32)
+
+    def make_features(i, seq_len):
+        return feats[i]
+
+    def run_arm(arm: str) -> dict:
+        tpath = f"{telemetry_path}.{arm}"
+        rec = Recorder(tpath)
+        rec.meta(role="trafficreplay-fleet", arm=arm, seed=seed,
+                 n_requests=n_requests, burst=burst,
+                 autoscale_max=autoscale_max,
+                 chaos=chaos if arm == "autoscale" else None)
+        net = _tiny_mlp()
+        engine = InferenceEngine(
+            net, BucketLattice(batch_sizes=batch_sizes),
+            max_wait_ms=max_wait_ms, replicas=1,
+            faults=chaos if arm == "autoscale" else None, recorder=rec)
+        engine.warmup(make_features(0, 0))
+        server = ServingServer(engine, port=0).start()
+        supervisor = swapper = None
+        if arm == "autoscale":
+            supervisor = FleetSupervisor(
+                engine, death_after_s=1.0,
+                policy=AutoscalePolicy(max_replicas=autoscale_max),
+                backoff=RespawnBackoff(base_s=0.01, jitter_frac=0.0),
+                recorder=rec).run_in_thread(0.02)
+            # the "training fleet publishes a step" half of the story:
+            # the serving weights re-saved under a NEW step number, hot-
+            # swapped once `hot_swap_after` requests completed
+            ckdir = tempfile.mkdtemp(prefix="fleet_publish_")
+            publish_net = engine.net.clone()
+            publish_net.iteration_count = engine.restored_step + 1
+            ShardedCheckpointer(ckdir).save(
+                publish_net, publish_net.iteration_count, host=True)
+
+            def swap_when_due():
+                import time as _t
+
+                deadline = _t.monotonic() + 60.0
+                while _t.monotonic() < deadline:
+                    if engine.served >= hot_swap_after:
+                        hot_swap(engine, ckdir)
+                        return
+                    _t.sleep(0.002)
+
+            import threading as _th
+
+            swapper = _th.Thread(target=swap_when_due, daemon=True,
+                                 name="fleet-replay-swap")
+            swapper.start()
+        try:
+            client = replay_http(server.url, trace,
+                                 make_features=make_features)
+        finally:
+            if swapper is not None:
+                swapper.join(timeout=60)
+            if supervisor is not None:
+                supervisor.stop()
+            server.stop()
+            rec.close()
+        sb = reconstruct_fleet(tpath)
+        sb["client"] = client
+        sb["telemetry"] = tpath
+        return sb
+
+    fixed = run_arm("fixed")
+    autoscale = run_arm("autoscale")
+    lines = fleet_metric_lines(fixed, autoscale)
+    if emit is not None:
+        for line in lines:
+            emit(line)
+    out = {"fixed": fixed, "autoscale": autoscale, "lines": lines,
+           "n_ok": fixed["n_ok"] + autoscale["n_ok"]}
+    if artifact_path:
+        out["summary"] = write_artifact(artifact_path, lines)
+        out["artifact"] = artifact_path
+    return out

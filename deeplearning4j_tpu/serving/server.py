@@ -23,10 +23,20 @@ Endpoints (all JSON):
                         queue are saturated (kvcache.py — exhaustion
                         queues or refuses, never crashes), 404 when the
                         engine has no generation path.
-    GET  /healthz       {"status", "replicas", "lattice", "served", ...}
-    GET  /stats         the engine's full counter dict
+    GET  /healthz       {"status", "replicas", "lattice", "served", ...,
+                        "fleet": [per-replica {index, state (warming/
+                        serving/draining/dead/retired), alive, counters,
+                        last_beat_age_s}], "weights": {generation, step,
+                        last_swap_ts}} — the fleet-operations view
+                        (serving/fleet.py): current weight generation,
+                        last hot-swap timestamp, replica lifecycles
+    GET  /stats         the engine's full counter dict (same fleet rows)
     POST /drain         begin graceful drain (stop admitting; pending
                         batches flush); the server keeps answering GETs
+
+Every 503 (draining, KV-cache saturation) carries a ``Retry-After``
+header: the condition is transient — a drained server's traffic moves
+to its replacement, a saturated pool frees as requests complete.
 
 Run with ``ServingServer(engine, port=0).start()``; ``.url`` gives the
 bound address. ``stop()`` drains the engine then closes the listener.
@@ -45,6 +55,11 @@ import numpy as np
 # max-wait + forward time; a hit means the engine lost the batch
 REQUEST_TIMEOUT_S = 60.0
 
+# Retry-After seconds on every 503 (drain / saturation): drains flush in
+# well under this, and a retrying client that waits it out lands on the
+# replacement fleet member
+RETRY_AFTER_S = 5
+
 
 class _Handler(BaseHTTPRequestHandler):
     server_version = "dl4jtpu-serve/1.0"
@@ -56,11 +71,17 @@ class _Handler(BaseHTTPRequestHandler):
     def serving(self) -> "ServingServer":
         return self.server.serving_server
 
-    def _json(self, obj, code: int = 200) -> None:
+    def _json(self, obj, code: int = 200, headers=()) -> None:
         body = json.dumps(obj).encode()
         self.send_response(code)
         self.send_header("Content-Type", "application/json")
         self.send_header("Content-Length", str(len(body)))
+        if code == 503:
+            # a draining / saturated fleet is a transient condition: tell
+            # well-behaved clients when to come back (RFC 9110 §10.2.3)
+            self.send_header("Retry-After", str(RETRY_AFTER_S))
+        for k, v in headers:
+            self.send_header(k, v)
         self.end_headers()
         self.wfile.write(body)
 

@@ -112,6 +112,47 @@ def test_input_pipeline_rows_direction():
         threshold=0.1)["regressions"] == []
 
 
+def test_fleet_rows_direction():
+    """FLEET artifact rows (trafficreplay --fleet, SERVE_r03):
+    swap_ms/respawn_ms ride the `_ms` rule, autoscale occupancy the
+    `occupancy` rule, and failed_requests has its own name pattern —
+    all lower-is-better by flag AND by summary-reconstructed name
+    (dropped traffic growing is never an improvement); the two QPS arms
+    stay higher-is-better."""
+    for metric in ("fleet_swap_ms", "fleet_respawn_ms",
+                   "fleet_autoscale_occupancy"):
+        worse = benchdiff.diff(
+            _lines(**{metric: {"value": 10.0, "lower_is_better": True}}),
+            _lines(**{metric: {"value": 20.0, "lower_is_better": True}}),
+            threshold=0.1)["regressions"]
+        assert worse, f"{metric} growth did not regress"
+        # summary-reconstructed rows keep only the value: name pattern
+        bare = benchdiff.diff(_lines(**{metric: {"value": 10.0}}),
+                              _lines(**{metric: {"value": 20.0}}),
+                              threshold=0.1)["regressions"]
+        assert bare, f"{metric} name pattern lost its direction"
+        better = benchdiff.diff(_lines(**{metric: {"value": 10.0}}),
+                                _lines(**{metric: {"value": 5.0}}),
+                                threshold=0.1)["regressions"]
+        assert better == [], f"{metric} improvement flagged"
+    # failed requests rising from zero ALWAYS regresses (no ratio
+    # exists for a zero base — any dropped request is a drop)
+    (row,) = benchdiff.diff(
+        _lines(fleet_failed_requests={"value": 0}),
+        _lines(fleet_failed_requests={"value": 3}),
+        threshold=0.1)["regressions"]
+    assert "lower is better" in row["reason"]
+    # QPS arms keep the default direction
+    assert benchdiff.diff(
+        _lines(fleet_autoscale_qps={"value": 50.0}),
+        _lines(fleet_autoscale_qps={"value": 30.0}),
+        threshold=0.1)["regressions"]
+    assert benchdiff.diff(
+        _lines(fleet_fixed_qps={"value": 50.0}),
+        _lines(fleet_fixed_qps={"value": 55.0}),
+        threshold=0.1)["regressions"] == []
+
+
 def test_reshard_artifact_rows_are_lower_is_better():
     """RESHARD artifact rows (cli reshard --artifact): bytes_moved /
     bytes_lower_bound / plan_us GROWING past threshold regresses — a

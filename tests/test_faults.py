@@ -48,6 +48,24 @@ def test_parse_every_fault_kind():
     assert parse_fault("p1:kill@3") == Fault(1, "kill", step=3)
 
 
+def test_parse_replica_scope_specs_round_trip():
+    """The serving chaos grammar (ISSUE 13): `r` scope targets a
+    REPLICA, triggering on its own batch/decode counters; specs
+    round-trip through spec() and schedules filter by scope."""
+    f = parse_fault("r0:kill@batch3")
+    assert f == Fault(0, "kill", step=3, scope="replica", unit="batch")
+    assert f.spec() == "r0:kill@batch3"
+    assert parse_fault("r1:hang@batch2").spec() == "r1:hang@batch2"
+    assert parse_fault("r0:kill@decode5").unit == "decode"
+    sched = FaultSchedule.parse("p1:kill@step3;r1:kill@batch2")
+    # scope filtering: a replica spec never targets a process and
+    # vice versa, even with a matching index
+    assert [f.spec() for f in sched.for_process(1)] == ["p1:kill@step3"]
+    assert [f.spec() for f in sched.for_replica(1)] == ["r1:kill@batch2"]
+    assert sched.for_replica(0) == []
+    assert sched.to_env() == "p1:kill@step3;r1:kill@batch2"
+
+
 @pytest.mark.parametrize("bad", [
     "kill@step3",          # no process
     "p1:kill",             # kill needs a step
@@ -55,6 +73,11 @@ def test_parse_every_fault_kind():
     "p1:oom@step2",        # unknown kind
     "px:kill@step1",       # bad process id
     "p1:kill@stepX",       # bad step
+    "r1:drop-heartbeat",   # replica scope takes only kill/hang
+    "r1:delay-connect:1",  # replica scope takes only kill/hang
+    "r1:kill@step3",       # replica faults trigger on batch/decode
+    "p1:kill@batch3",      # process faults trigger on steps
+    "r1:kill",             # replica kill needs a trigger
 ])
 def test_parse_rejects_malformed_specs(bad):
     with pytest.raises(ValueError):

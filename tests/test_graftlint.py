@@ -1,5 +1,5 @@
 """Tier-1 gate for graftlint (ISSUE 2 + the ISSUE 5 SPMD rules): every
-AST rule G001-G015 proven on a positive AND a negative fixture, the
+AST rule G001-G021 proven on a positive AND a negative fixture, the
 suppression + baseline machinery, the stage-2 jaxpr audit over every
 public entry point, and the package itself held lint-clean (zero
 non-baselined findings). The stage-3 collective audit has its own gate
@@ -514,6 +514,25 @@ def read_one(net, params):
     placed = jax.tree.map(jax.device_put, net.params, net._param_sh)
     return w, s, placed
 """),
+    ("G021", """\
+def adopt_new_weights(worker, new_params, ckpt_dir):
+    worker.net.params = new_params     # direct live-param write
+    worker.net.resume_from(ckpt_dir)   # restore outside the swap path
+""", """\
+def serve_one(self, batch):
+    ws = self.weights.current          # the ONE read per batch
+    return self._jit(ws.params, ws.state, batch.features)
+
+
+def swap(engine, ckpt_dir):
+    from deeplearning4j_tpu.serving import fleet
+    return fleet.hot_swap(engine, ckpt_dir)  # the blessed path
+
+
+def init_if_needed(net):
+    if net.params is None:             # reading params never flags
+        net.init()
+"""),
 ]
 
 
@@ -522,6 +541,7 @@ def read_one(net, params):
 RULE_FIXTURE_PATHS = {
     "G017": "deeplearning4j_tpu/serving/_graftlint_fixture.py",
     "G019": "deeplearning4j_tpu/serving/_graftlint_fixture.py",
+    "G021": "deeplearning4j_tpu/serving/_graftlint_fixture.py",
 }
 
 
@@ -536,7 +556,7 @@ def test_rule_fires_on_positive_not_negative(rule, pos, neg):
 
 def test_every_rule_has_fixture_coverage():
     assert {r for r, _, _ in FIXTURES} == set(RULE_DOCS) == {
-        f"G{i:03d}" for i in range(1, 21)}
+        f"G{i:03d}" for i in range(1, 22)}
 
 
 def test_g015_blessed_sites_are_exempt():
@@ -621,6 +641,26 @@ def test_g018_blessed_paths_are_exempt():
         src, "deeplearning4j_tpu/util/model_serializer.py")
     assert "G018" in rules_in(src)  # the default parallel/ fixture path
     assert "G018" in rules_in(src, "deeplearning4j_tpu/serving/engine.py")
+
+
+def test_g021_scope_and_blessed_swap_path():
+    """G021 is serving/-only (a training loop assigning net.params is
+    legitimate elsewhere), serving/fleet.py is THE blessed publish/flip
+    site, and both halves fire independently: the `.params` assignment
+    without resume_from, and resume_from without an assignment."""
+    _, pos, _ = next(f for f in FIXTURES if f[0] == "G021")
+    serving = RULE_FIXTURE_PATHS["G021"]
+    assert "G021" in rules_in(pos, serving)
+    assert "G021" in rules_in(pos, "deeplearning4j_tpu/serving/engine.py")
+    assert "G021" not in rules_in(pos)  # parallel/ default: out of scope
+    assert "G021" not in rules_in(
+        pos, "deeplearning4j_tpu/nn/multilayer.py")
+    assert "G021" not in rules_in(
+        pos, "deeplearning4j_tpu/serving/fleet.py")  # the blessed path
+    assign_only = "def f(w, p):\n    w.net.params = p\n"
+    resume_only = "def f(net, d):\n    return net.resume_from(d)\n"
+    assert "G021" in rules_in(assign_only, serving)
+    assert "G021" in rules_in(resume_only, serving)
 
 
 def test_g016_tuning_layer_and_scope():

@@ -21,13 +21,14 @@ violation — `best_us` exceeding the entry's own `default_us`, which the
 kerneltune harness guarantees never happens in a healthy sweep.
 
 SERVE artifacts (tools/trafficreplay.py / bench.py serving_replay /
-serving_generate — the same metric-line + summary shape) diff through
+serving_generate, and the --fleet SERVE_r03 shape) diff through
 the same path with INVERTED direction for their latency rows: a line
-carrying `lower_is_better: true`, or a
-`*_p50_ms`/`*_p99_ms`/`*_ttft_*_ms`/`*recompiles`/`*occupancy`-shaped
+carrying `lower_is_better: true`, or a `*_p50_ms`/`*_p99_ms`/
+`*_ttft_*_ms`/`*recompiles`/`*occupancy`/`*failed_requests`-shaped
 name recovered from a summary line, regresses when its value GROWS past
 the threshold (and a retrace count rising from 0 always regresses).
-QPS and tokens/sec stay higher-is-better.
+The fleet rows `swap_ms`/`respawn_ms` ride the `_ms` rule. QPS and
+tokens/sec stay higher-is-better.
 
 What counts as a regression (bench metrics are higher-is-better unless
 flagged lower-is-better as above):
@@ -63,11 +64,14 @@ DEFAULT_THRESHOLD = 0.10
 # value) — p50/p99/_ms latency and retrace counts from SERVE artifacts,
 # plus RESHARD artifact rows (cli reshard dry run): bytes_moved /
 # bytes_lower_bound / plan-time _us growth is the regression direction,
-# and INPUT artifact rows (bench input_pipeline): input_wait stall
-# percentiles growing past threshold is the starvation regression.
+# INPUT artifact rows (bench input_pipeline): input_wait stall
+# percentiles growing past threshold is the starvation regression,
+# and FLEET rows (trafficreplay --fleet, SERVE_r03): swap_ms /
+# respawn_ms ride the _ms rule, autoscale occupancy the occupancy rule,
+# and failed_requests growing is dropped traffic — never an improvement.
 _LOWER_IS_BETTER_RE = re.compile(
     r"(_p\d+_ms$|_ms$|latency|recompiles|bytes_moved$|bytes_lower_bound$"
-    r"|_us$|_ttft_|occupancy|input_wait)")
+    r"|_us$|_ttft_|occupancy|input_wait|failed_requests$)")
 
 
 def _lower_is_better(metric: str, old: dict, new: dict) -> bool:

@@ -8,6 +8,8 @@
     python tools/trafficreplay.py --generate --artifact SERVE_r02.json
     python tools/trafficreplay.py --generate --prompt-lens 8,32 \
         --output-lens 4,16 --slots 4                   # generation replay
+    python tools/trafficreplay.py --chaos r0:kill@batch4  # self-healing
+    python tools/trafficreplay.py --fleet --artifact SERVE_r03.json
 
 Replays a SEEDED mixed-length / bursty request trace against a freshly
 started serving stack (engine + HTTP front door, serving/), drains, and
@@ -22,6 +24,16 @@ seeded prompt-length x output-length mix streamed through POST
 /generate, with headline tokens/sec (higher-is-better), time-to-first-
 token p50/p99 and peak cache-page occupancy (both lower-is-better —
 benchdiff inverts), and the same zero-retrace row.
+
+`--chaos SPEC` injects replica-scoped faults (the distributed/faults.py
+grammar: `r0:kill@batch4`, `r1:hang@batch2`, `;`-joined) into the
+replay's serving replicas, with a live FleetSupervisor healing them —
+the self-healing smoke run. `--fleet` runs the ZERO-DOWNTIME OPERATIONS
+bench instead (serving/fleet.py): the same bursty trace through a
+fixed-replica baseline arm and an autoscaling arm that also absorbs a
+replica kill and a mid-traffic weight hot-swap; the SERVE_r03-shaped
+artifact adds `swap_ms`, `respawn_ms`, `failed_requests`, and autoscale
+occupancy rows (all lower-is-better).
 
 Output: one JSON metric line per number (the bench.py idiom) ending
 with the gate-carrying summary line; `--artifact` also writes them as a
@@ -84,15 +96,37 @@ def main(argv=None) -> int:
                     help="decode slots per generation replica")
     ap.add_argument("--page-size", type=int, default=16,
                     help="KV-cache page size (tokens per page)")
+    ap.add_argument("--chaos", default=None, metavar="SPEC",
+                    help="replica-scoped fault spec(s) to inject "
+                         "(distributed/faults.py grammar, e.g. "
+                         "'r0:kill@batch4'); a FleetSupervisor heals "
+                         "them live during the replay")
+    ap.add_argument("--fleet", action="store_true",
+                    help="run the zero-downtime fleet-operations bench "
+                         "(fixed vs autoscaling arm, replica-kill chaos "
+                         "+ mid-traffic hot-swap; SERVE_r03 artifact)")
+    ap.add_argument("--autoscale-max", type=int, default=3,
+                    help="autoscaling arm's replica ceiling (--fleet)")
     args = ap.parse_args(argv)
 
     os.environ.setdefault("JAX_PLATFORMS", "cpu")
-    from deeplearning4j_tpu.serving.replay import (run_generation_replay,
+    from deeplearning4j_tpu.serving.replay import (run_fleet_replay,
+                                                   run_generation_replay,
                                                    run_replay)
 
     tpath = args.telemetry or os.path.join(
         tempfile.mkdtemp(prefix="trafficreplay_"), "telemetry.jsonl")
-    if args.generate:
+    if args.fleet:
+        scoreboard = run_fleet_replay(
+            seed=args.seed, n_requests=args.requests, burst=args.burst,
+            mean_gap_s=args.mean_gap_ms / 1000.0,
+            batch_sizes=tuple(int(b) for b in args.buckets.split(",")),
+            max_wait_ms=args.max_wait_ms,
+            autoscale_max=args.autoscale_max,
+            chaos=args.chaos or "r0:kill@batch4",
+            telemetry_path=tpath, artifact_path=args.artifact,
+            emit=lambda line: print(json.dumps(line), flush=True))
+    elif args.generate:
         scoreboard = run_generation_replay(
             seed=args.seed, n_requests=args.requests, burst=args.burst,
             mean_gap_s=args.mean_gap_ms / 1000.0,
@@ -112,7 +146,7 @@ def main(argv=None) -> int:
             batch_sizes=tuple(int(b) for b in args.buckets.split(",")),
             max_wait_ms=args.max_wait_ms, replicas=args.replicas,
             telemetry_path=tpath, artifact_path=args.artifact,
-            checkpoint=args.checkpoint,
+            checkpoint=args.checkpoint, chaos=args.chaos,
             emit=lambda line: print(json.dumps(line), flush=True))
     from deeplearning4j_tpu.telemetry.artifact import build_summary
 

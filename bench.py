@@ -1384,6 +1384,126 @@ def bench_input_pipeline() -> None:
                 "regressions": summary.get("regressions", 0)})
 
 
+def bench_placement_search() -> None:
+    """Automatic placement search bench (reshard/search.py): the
+    predicted-vs-measured rank gate on the launcher matrix's device
+    grids (2x2 -> 4, 3x2 -> 6, 2x4 -> 8 virtual devices — the same
+    single-process-equivalent-grid idiom the stage-3 collective audit
+    compiles its fleet entries on; cross-process model placement is
+    still guarded off, so the multi-process half of the search is
+    proven by the elastic re-plan timeline test instead).
+
+    Per grid: search the builtin `lm` profile under the FORWARD
+    objective (this container cannot execute TP train steps — the
+    pre-existing donation-alias class — so the measured step is the
+    forward pass and the cost model scores the matching surface), then
+    run the top-2 predicted placements plus the deliberately-bad
+    control (the worst-ranked feasible candidate) each in its own
+    subprocess (reshard/bench_arm.py) and compare orderings. A pair
+    counts as a RANK VIOLATION only when the prediction separates it
+    confidently (score ratio >= 2x) and the measurement inverts it past
+    a 15% noise band — CPU containers promise ordering, never absolute
+    ms. Any violation exits 1; the PLAN artifact (benchdiff-diffable:
+    scores/ms/violations are lower-is-better, winner changes are named)
+    lands next to the BENCH ones."""
+    from deeplearning4j_tpu.reshard.search import (
+        BUILTIN_PROFILES,
+        FleetShape,
+        Objective,
+        emit_search_event,
+        search_placement,
+    )
+    from deeplearning4j_tpu.serving.replay import write_artifact
+
+    here = os.path.dirname(os.path.abspath(__file__))
+    artifact = os.environ.get(
+        "DL4J_TPU_PLAN_ARTIFACT", os.path.join(here, "PLAN_r01.json"))
+    GRIDS = (("2x2", 4), ("3x2", 6), ("2x4", 8))
+    MARGIN = 2.0      # predicted score ratio that arms a pair
+    NOISE_TOL = 0.15  # measured inversion slack (CPU noise band)
+    BATCH = 48
+    objective = Objective(global_batch=BATCH, step="forward",
+                          zero1_options=(False,))
+    lines = []
+    total_violations = 0
+    for grid, n in GRIDS:
+        t0 = time.perf_counter()
+        result = search_placement(BUILTIN_PROFILES["lm"], FleetShape(1, n),
+                                  objective=objective)
+        emit_search_event(result, path="bench", grid=grid,
+                          search_ms=(time.perf_counter() - t0) * 1e3)
+        arms = list(result.candidates[:2])
+        control = result.candidates[-1]
+        if control.describe() not in {a.describe() for a in arms}:
+            arms.append(control)
+        measured = []
+        for cand in arms:
+            spec = {"devices": n, "placement": cand.placement.to_json(),
+                    "batch": BATCH, "repeats": 8, "seed": 0}
+            env = dict(os.environ)
+            env.setdefault("JAX_PLATFORMS", "cpu")
+            out = subprocess.run(
+                [sys.executable, "-m",
+                 "deeplearning4j_tpu.reshard.bench_arm",
+                 json.dumps(spec)],
+                capture_output=True, text=True, timeout=420, env=env)
+            payload = [l for l in out.stdout.splitlines()
+                       if l.startswith("RESULT ")]
+            if out.returncode != 0 or not payload:
+                raise RuntimeError(
+                    f"placement bench arm {cand.describe()} on grid "
+                    f"{grid} failed (rc={out.returncode}):\n"
+                    + (out.stderr or out.stdout)[-2000:])
+            res = json.loads(payload[-1][len("RESULT "):])
+            measured.append(res["ms_per_step"])
+        violations = 0
+        concordant = discordant = 0
+        for i in range(len(arms)):
+            for j in range(i + 1, len(arms)):
+                si, sj = float(arms[i].score), float(arms[j].score)
+                if measured[i] < measured[j]:
+                    concordant += 1
+                elif measured[i] > measured[j]:
+                    discordant += 1
+                separated = (si == 0 and sj > 0) or \
+                    (si > 0 and sj / si >= MARGIN)
+                if separated and measured[i] > measured[j] * (1 + NOISE_TOL):
+                    violations += 1
+        tau = round((concordant - discordant)
+                    / max(1, concordant + discordant), 3)
+        total_violations += violations
+        best = result.best
+        lines.append({
+            "metric": f"plan_winner::{grid}", "value": float(best.score),
+            "lower_is_better": True, "winner": best.describe(),
+            "candidates": len(result.candidates),
+            "pruned": len(result.pruned), "devices": n})
+        for cand, ms in zip(arms, measured):
+            lines.append({"metric":
+                          f"plan_predicted::{grid}::{cand.describe()}",
+                          "value": float(cand.score),
+                          "lower_is_better": True})
+            lines.append({"metric":
+                          f"plan_measured_ms::{grid}::{cand.describe()}",
+                          "value": ms, "lower_is_better": True})
+        lines.append({"metric": f"plan_rank_kendall_tau::{grid}",
+                      "value": tau})
+    lines.append({"metric": "plan_predicted_rank_violations",
+                  "value": total_violations, "lower_is_better": True,
+                  "margin": MARGIN, "noise_tol": NOISE_TOL})
+    for line in lines:
+        _emit_info(line)
+    summary = write_artifact(artifact, lines)
+    _emit_info({"metric": "placement_search_artifact", "path": artifact,
+                "regressions": summary.get("regressions", 0),
+                "rank_violations": total_violations})
+    if total_violations:
+        raise SystemExit(
+            f"placement_search: {total_violations} predicted-vs-measured "
+            "rank violation(s) — the cost model ordered a confidently-"
+            "separated pair against the measurement")
+
+
 MODES = {
     "lenet": bench_lenet,
     "vgg16": bench_vgg16,
@@ -1402,6 +1522,7 @@ MODES = {
     "serving_replay": bench_serving_replay,
     "serving_generate": bench_serving_generate,
     "input_pipeline": bench_input_pipeline,
+    "placement_search": bench_placement_search,
 }
 
 

@@ -1,5 +1,6 @@
-"""The G001-G009 + G016 AST rules (G010-G015 live in spmd_rules.py and
-register into ALL_RULES/RULE_DOCS at the bottom of this module).
+"""The G001-G009 + G016-G022 AST rules (G010-G015 + G018 live in
+spmd_rules.py and register into ALL_RULES/RULE_DOCS at the bottom of
+this module).
 
 Every rule errs toward PRECISION over recall: a lint gate that cries
 wolf gets suppressed wholesale, while a quiet one keeps running in CI
@@ -1105,6 +1106,112 @@ def g021_weight_swap_path(tree, imports, path):
     return out
 
 
+# --------------------------------------------------------------- G022
+
+# Placement discipline at the USER-FACING layers: examples/, cli/, and
+# the elastic runtime are where mesh layouts get hand-guessed — exactly
+# the habit the automatic placement search (reshard/search.py) retires.
+# A raw `jax.sharding.Mesh(...)` construction, or an axis-role dict
+# literal ({"data": ..., "model": ...}) fed to a mesh builder /
+# set_mesh, bypasses Placement validation (PlacementError feasibility)
+# AND the search's ranking+telemetry — the layout ships unvalidated and
+# unrecorded. The blessed spellings are `planner.Placement.of/
+# from_json` (validated declarative data; set_mesh consumes it
+# directly) and `search_placement`/`searched_global_mesh` (the ranked
+# search). Library internals (parallel/, reshard/, distributed/
+# global_mesh) stay out of scope: they IMPLEMENT the blessed paths.
+_G022_SCOPE_FRAGMENTS = ("/examples/", "/cli/")
+_G022_SCOPE_SUFFIXES = ("distributed/elastic.py",)
+_G022_ROLE_NAMES = frozenset({"data", "model", "pipe", "seq", "expert"})
+_G022_MESH_CALL_TAILS = frozenset({"Mesh", "make_mesh", "make_global_mesh",
+                                   "set_mesh"})
+_G022_BLESSED_TAILS = frozenset({"search_placement",
+                                 "searched_global_mesh"})
+
+
+def _g022_call_tail(func: ast.AST) -> str:
+    if isinstance(func, ast.Attribute):
+        return func.attr
+    if isinstance(func, ast.Name):
+        return func.id
+    return ""
+
+
+def _g022_is_blessed(node: ast.Call) -> bool:
+    """Placement.of / Placement.from_json / search entry points."""
+    func = node.func
+    tail = _g022_call_tail(func)
+    if tail in _G022_BLESSED_TAILS:
+        return True
+    if isinstance(func, ast.Attribute) and tail in ("of", "from_json"):
+        base = func.value
+        base_name = (base.attr if isinstance(base, ast.Attribute)
+                     else getattr(base, "id", ""))
+        return base_name == "Placement"
+    return False
+
+
+def _g022_role_dict(arg: ast.AST) -> bool:
+    """A dict literal whose string keys are ALL placement roles (and at
+    least one) — the hand-written axis/role map shape. Comprehensions,
+    parsed variables, and non-role dicts never flag."""
+    if not isinstance(arg, ast.Dict) or not arg.keys:
+        return False
+    keys = []
+    for k in arg.keys:
+        if not (isinstance(k, ast.Constant) and isinstance(k.value, str)):
+            return False
+        keys.append(k.value)
+    return all(k in _G022_ROLE_NAMES for k in keys)
+
+
+def g022_handrolled_placement(tree, imports, path):
+    """Hand-constructed placements at the user-facing layers (examples/,
+    cli/, distributed/elastic.py): (a) a raw `jax.sharding.Mesh(...)`
+    constructor call; (b) an axis-role dict literal passed to
+    make_mesh / make_global_mesh / set_mesh / Mesh. Route through
+    `planner.Placement.of` (validated declarative data — set_mesh
+    consumes the Placement directly) or `search_placement`/
+    `searched_global_mesh` (the ranked search), whose own calls are
+    exempt."""
+    # leading slash so relative paths ("examples/foo.py") match too
+    norm = "/" + path.replace("\\", "/").lstrip("/")
+    if not (any(f in norm for f in _G022_SCOPE_FRAGMENTS)
+            or any(norm.endswith(s) for s in _G022_SCOPE_SUFFIXES)):
+        return []
+    out = []
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call) or _g022_is_blessed(node):
+            continue
+        name = imports.canon(node.func) or ""
+        tail = _g022_call_tail(node.func)
+        if name == "jax.sharding.Mesh" or name.endswith("sharding.Mesh"):
+            out.append(("G022", node,
+                        "raw `jax.sharding.Mesh(...)` construction in a "
+                        "user-facing layer: the layout skips Placement "
+                        "validation (PlacementError feasibility) and the "
+                        "placement search's ranking + telemetry",
+                        "declare the layout as planner.Placement.of(...) "
+                        "and feed it to set_mesh, or let "
+                        "search_placement pick it"))
+            continue
+        if tail not in _G022_MESH_CALL_TAILS:
+            continue
+        for arg in list(node.args) + [k.value for k in node.keywords]:
+            if _g022_role_dict(arg):
+                out.append(("G022", node,
+                            f"hand-written axis-role dict literal fed to "
+                            f"`{tail}` in a user-facing layer — an "
+                            "unvalidated, unranked mesh layout (the "
+                            "habit the automatic placement search "
+                            "retires)",
+                            "build the layout with planner.Placement.of "
+                            "(set_mesh consumes it directly) or take "
+                            "the search_placement winner"))
+                break
+    return out
+
+
 # stage-3 AST rules (G010-G014) live in spmd_rules.py and register here;
 # the import sits below every helper they borrow lazily, so importing
 # either module first resolves cleanly.
@@ -1120,7 +1227,8 @@ ALL_RULES = [g001_traced_bool, g002_host_sync, g003_float64_drift,
              g016_hardcoded_block_literals,
              g017_serving_hot_path, g019_decode_loop_sync,
              g020_sync_input_in_step_loop,
-             g021_weight_swap_path] + SPMD_RULES
+             g021_weight_swap_path,
+             g022_handrolled_placement] + SPMD_RULES
 
 RULE_DOCS = {
     "G001": "python control flow / bool()/float()/int() on traced values",
@@ -1150,6 +1258,11 @@ RULE_DOCS = {
             "swap path: direct `.params` assignment or `resume_from` "
             "in serving/ bypasses the double-buffered WeightStore "
             "(validation, atomic flip, weight_swap telemetry)",
+    "G022": "hand-constructed Mesh(...) / axis-role dict literals in "
+            "the user-facing layers (examples/, cli/, "
+            "distributed/elastic.py) outside the blessed "
+            "planner.Placement / search_placement paths — unvalidated, "
+            "unranked mesh layouts",
     **SPMD_RULE_DOCS,
 }
 

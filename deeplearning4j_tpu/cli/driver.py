@@ -32,6 +32,14 @@ mesh, restore and serve on any other):
         --target-mesh data=1            # dry-run: print the plan +
                                         # bytes moved vs lower bound
 
+Placement search (reshard/search.py — the cost model picks the mesh):
+
+    python -m deeplearning4j_tpu.cli plan --model mlp --fleet 2x4 \
+        [--global-batch 24] [--hbm-gb 16] [--artifact PLAN_r01.json]
+                                        # dry-run: ranked top-k
+                                        # candidate table (memory /
+                                        # collective bytes / bubble)
+
 Distributed runtimes (reference Train.java `-runtime local|spark|hadoop`
 + cli-spark/SparkTrain.java; here the TPU-native equivalents):
 
@@ -224,6 +232,42 @@ def _build_parser() -> argparse.ArgumentParser:
     sv.add_argument("--local-devices", type=int, default=4,
                     help="virtual CPU devices per process in the "
                          "--multiprocess plan (default 4)")
+
+    pl = sub.add_parser(
+        "plan", help="dry-run the automatic placement search "
+                     "(reshard/search.py): enumerate every valid "
+                     "dp x tp x pp x sp x ep placement for a model + "
+                     "fleet shape, rank them with the per-step cost "
+                     "model, and print the top-k table with the score "
+                     "breakdown (memory, collective bytes, bubble). "
+                     "Nothing is placed: the search is a pure function "
+                     "and builtin profiles need no jax backend")
+    pl.add_argument("--model", "-m", default=None,
+                    help="builtin profile name (mlp, lm — jax-free) or "
+                         "a trained model zip to profile")
+    pl.add_argument("--conf", "-c", default=None,
+                    help="model configuration JSON to profile instead "
+                         "of --model")
+    pl.add_argument("--type", choices=["multi_layer_network",
+                                       "computation_graph"],
+                    default="multi_layer_network")
+    pl.add_argument("--fleet", required=True,
+                    help="fleet shape PxK (processes x devices each), "
+                         "e.g. 2x4; plain N means 1xN")
+    pl.add_argument("--global-batch", type=int, default=None,
+                    help="per-step global batch the cost model sizes "
+                         "activations and microbatches with")
+    pl.add_argument("--hbm-gb", type=float, default=None,
+                    help="per-device HBM budget in GiB; candidates "
+                         "whose memory estimate exceeds it are pruned")
+    pl.add_argument("--no-zero1", action="store_true",
+                    help="drop the zero1 weight-update-sharding "
+                         "variants from the candidate set")
+    pl.add_argument("--top", type=int, default=5,
+                    help="table rows to print (default 5)")
+    pl.add_argument("--artifact", default=None,
+                    help="also write the ranked scores as a PLAN "
+                         "artifact (JSONL) for tools/benchdiff")
 
     rs = sub.add_parser(
         "reshard", help="dry-run the portable resharding planner: map a "
@@ -723,6 +767,114 @@ def _predict_via_server(args, feats) -> "np.ndarray":
     return np.asarray(rows, np.float32)
 
 
+def _plan_profile(args):
+    """Resolve `plan`'s model argument: a builtin pure-data profile
+    (no jax import — the laptop-plans-a-pod path), a trained zip, or a
+    config JSON built + profiled in-process."""
+    from deeplearning4j_tpu.reshard.search import BUILTIN_PROFILES
+
+    if bool(args.model) == bool(args.conf):
+        raise SystemExit(
+            "plan needs exactly one of --model (a builtin profile name: "
+            f"{sorted(BUILTIN_PROFILES)}, or a trained zip) or --conf "
+            "(a config JSON)")
+    if args.model and args.model in BUILTIN_PROFILES:
+        return BUILTIN_PROFILES[args.model]
+    from deeplearning4j_tpu.reshard.search import profile_net
+
+    if args.model:
+        return profile_net(_load_model(args.model),
+                           name=os.path.basename(args.model))
+    from deeplearning4j_tpu.nn.conf.graph_conf import (
+        ComputationGraphConfiguration,
+    )
+    from deeplearning4j_tpu.nn.conf.neural_net_configuration import (
+        MultiLayerConfiguration,
+    )
+    from deeplearning4j_tpu.nn.graph import ComputationGraph
+    from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+
+    with open(_fetch_input(args.conf)) as f:
+        conf_json = f.read()
+    if args.type == "computation_graph":
+        net = ComputationGraph(
+            ComputationGraphConfiguration.from_json(conf_json))
+    else:
+        net = MultiLayerNetwork(
+            MultiLayerConfiguration.from_json(conf_json))
+    return profile_net(net.init(), name=os.path.basename(args.conf))
+
+
+def _cmd_plan(args) -> int:
+    """`plan --model --fleet` dry run: the ranked placement table with
+    its score breakdown plus benchdiff-consumable PLAN metric lines
+    (scores and search time are lower-is-better rows; the winner row
+    carries the placement description for winner-change diffs)."""
+    import json as _json
+    import time
+
+    from deeplearning4j_tpu.reshard.search import (
+        FleetShape,
+        Objective,
+        SearchError,
+        emit_search_event,
+        search_placement,
+    )
+    from deeplearning4j_tpu.telemetry.artifact import build_summary
+
+    profile = _plan_profile(args)
+    try:
+        fleet = FleetShape.parse(args.fleet)
+    except ValueError as exc:
+        raise SystemExit(f"plan: {exc}") from None
+    obj_kwargs = {}
+    if args.global_batch is not None:
+        obj_kwargs["global_batch"] = args.global_batch
+    if args.hbm_gb is not None:
+        obj_kwargs["hbm_bytes_per_device"] = int(args.hbm_gb * (1 << 30))
+    if args.no_zero1:
+        obj_kwargs["zero1_options"] = (False,)
+    objective = Objective(**obj_kwargs)
+    t0 = time.perf_counter()
+    try:
+        result = search_placement(profile, fleet, objective=objective)
+    except SearchError as exc:
+        # "no feasible placement fits the HBM budget" and friends: a
+        # refused plan is a usage error, never a traceback
+        raise SystemExit(f"plan: {exc}") from None
+    search_ms = round((time.perf_counter() - t0) * 1e3, 3)
+    emit_search_event(result, path="cli", search_ms=search_ms)
+
+    for line in result.table_lines(args.top):
+        print(line)
+    best = result.best
+    lines = [
+        {"metric": "plan_candidates", "value": len(result.candidates),
+         "fleet": fleet.describe(), "profile": result.profile_name},
+        {"metric": "plan_pruned", "value": len(result.pruned)},
+        {"metric": "plan_winner_score", "value": float(best.score),
+         "lower_is_better": True, "winner": best.describe(),
+         "memory_bytes": float(best.memory_bytes),
+         "collective_bytes": float(best.collective_bytes),
+         "bubble_cost": float(best.bubble_cost),
+         "idle_cost": float(best.idle_cost)},
+        {"metric": "plan_search_ms", "value": search_ms,
+         "lower_is_better": True},
+    ]
+    for c in result.candidates:
+        lines.append({"metric": f"plan_score::{c.describe()}",
+                      "value": float(c.score), "lower_is_better": True})
+    out = [_json.dumps(line) for line in lines]
+    out.append(_json.dumps(build_summary(lines)))
+    for line in out:
+        print(line)
+    if args.artifact:
+        with open(args.artifact, "w") as fh:
+            fh.write("\n".join(out) + "\n")
+        print(f"# wrote PLAN artifact to {args.artifact}")
+    return 0
+
+
 def _cmd_reshard(args) -> int:
     """`reshard --checkpoint --target-mesh` dry run: plan the
     checkpoint->mesh redistribution through reshard/planner.py and
@@ -872,7 +1024,7 @@ def main(argv=None) -> int:
     args._raw_argv = list(sys.argv[1:] if argv is None else argv)
     return {"train": _cmd_train, "test": _cmd_test,
             "predict": _cmd_predict, "serve": _cmd_serve,
-            "reshard": _cmd_reshard,
+            "reshard": _cmd_reshard, "plan": _cmd_plan,
             "coordinator": _cmd_coordinator}[args.command](args)
 
 

@@ -28,8 +28,14 @@ stays off the hot collective path (arXiv:1810.11112):
    config registry, and relaunches at N' = max(survivors,
    min_processes) — topping up with *replacement* workers when the
    floor requires it (control-plane rank adoption:
-   `ClusterClient(replace_dead=True)`).
-4. **Rejoining workers** restore the latest checkpoint through the
+   `ClusterClient(replace_dead=True)`). The re-form re-*plans* the
+   placement for the new fleet shape instead of reusing the old roles
+   (`_replan` -> `reshard/search.py`, journaled as
+   `elastic/placement/<gen>` and named in the `reform` fault event).
+4. **Rejoining workers** build the searched placement's global mesh
+   (`searched_global_mesh` — every process derives the identical
+   winner rank-independently and emits a `placement_search` event) and
+   restore the latest checkpoint through the
    portable resharding engine (`net.resume_from(ckpt,
    target_mesh=mesh)` — `reshard/` plans the recorded checkpoint
    placement onto this generation's N'-process mesh and each process
@@ -64,6 +70,70 @@ ENV_TOTAL_STEPS = "DL4J_TPU_ELASTIC_TOTAL_STEPS"
 
 
 # ------------------------------------------------------------ worker side
+
+def searched_global_mesh(net=None, *, objective=None):
+    """The elastic re-*plan* (ROADMAP "automatic placement search"):
+    instead of inheriting the dead generation's hand-specified roles, a
+    (re-)formed generation searches the best placement for its OWN
+    fleet shape — `reshard/search.search_placement` over
+    (process_count, local device count) — and builds the global mesh
+    the winner names. The search is rank- and clock-independent, so
+    every member computes the identical winner without coordination
+    (the same discipline as `plan_reshard`), and each emits the typed
+    `placement_search` telemetry event before any mesh exists — the
+    per-generation record tests/test_elastic.py reads back.
+
+    Returns ``(mesh, axes, result)``: the process-spanning Mesh, the
+    role->axis dict for ``net.set_mesh(mesh, axes=axes)``, and the full
+    ranked `SearchResult` (``result.winner`` is the Placement).
+    """
+    import time
+
+    import jax
+
+    from deeplearning4j_tpu.distributed.global_mesh import make_global_mesh
+    from deeplearning4j_tpu.reshard import search as search_mod
+
+    fleet = search_mod.FleetShape(jax.process_count(),
+                                  len(jax.local_devices()))
+    profile = (search_mod.profile_net(net) if net is not None
+               else search_mod.GENERIC_PROFILE)
+    t0 = time.perf_counter()
+    result = _search_with_batch_fallback(profile, fleet, objective)
+    search_mod.emit_search_event(
+        result, path="elastic",
+        search_ms=(time.perf_counter() - t0) * 1e3,
+        process_id=jax.process_index(),
+        num_processes=jax.process_count())
+    winner = result.winner
+    mesh = make_global_mesh(dict(winner.mesh_axes))
+    axes = {role: ax for role, ax in winner.roles}
+    return mesh, axes, result
+
+
+def _search_with_batch_fallback(profile, fleet, objective):
+    """A re-plan must never kill the fleet over a MODELING mismatch:
+    when every candidate dies on batch divisibility (the objective's
+    proxy batch, not the worker's real one), re-model with the nearest
+    batch that tiles the fleet and search again. Genuine infeasibility
+    (e.g. nothing fits the HBM budget) still raises."""
+    import dataclasses
+
+    from deeplearning4j_tpu.reshard import search as search_mod
+
+    objective = objective or search_mod.Objective()
+    try:
+        return search_mod.search_placement(profile, fleet,
+                                           objective=objective)
+    except search_mod.SearchError:
+        b = objective.global_batch
+        rounded = -(-b // fleet.n_devices) * fleet.n_devices
+        if rounded == b:
+            raise
+        return search_mod.search_placement(
+            profile, fleet,
+            objective=dataclasses.replace(objective,
+                                          global_batch=rounded))
 
 def run_elastic_steps(net, batch_for_step, total_steps: int, *,
                       checkpoint_dir: str, checkpoint_every: int = 1):
@@ -263,11 +333,38 @@ class ElasticSupervisor:
                     f"fleet did not finish within {self.max_reforms} "
                     f"re-forms; exit classes per generation: "
                     f"{[h.exit_classes for h in generations]}")
+            replan = self._replan(n_next, gen=gen + 1)
+            self.coordinator.record_config(
+                f"elastic/placement/{gen + 1}", replan.winner.to_json())
             rec.fault("reform", gen=gen + 1, n_processes=n_next,
                       survivors=survivors, replacements=replacements,
-                      dead=g.dead, prior_exit_classes=g.exit_classes)
+                      dead=g.dead, prior_exit_classes=g.exit_classes,
+                      placement=replan.winner.describe())
             gen += 1
             n = n_next
+
+    def _replan(self, n_processes: int, *, gen: int):
+        """The supervisor half of the elastic re-plan: rank the next
+        generation's fleet shape BEFORE relaunching — the re-formed
+        workers re-derive the identical winner rank-independently
+        through `searched_global_mesh` — and put the search on the
+        record (`placement_search` event, path="reform") plus the
+        durable coordinator journal. With no model in-process the
+        generic profile ranks data-axis coverage + the zero1 choice,
+        which is exact under the spanning data-role-only constraint."""
+        import time
+
+        from deeplearning4j_tpu.reshard import search as search_mod
+
+        fleet = search_mod.FleetShape(n_processes,
+                                      self.local_device_count or 1)
+        t0 = time.perf_counter()
+        result = _search_with_batch_fallback(search_mod.GENERIC_PROFILE,
+                                             fleet, None)
+        search_mod.emit_search_event(
+            result, path="reform", gen=gen,
+            search_ms=(time.perf_counter() - t0) * 1e3)
+        return result
 
 
 def worker_total_steps(default: Optional[int] = None) -> int:

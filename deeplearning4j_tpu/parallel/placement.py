@@ -170,7 +170,22 @@ def configure_mesh(net, mesh, *, zero1=False, axes=None, n_microbatches=None,
         resolve_rules,
         shard_params,
     )
+    from deeplearning4j_tpu.reshard.planner import Placement
 
+    if isinstance(mesh, Placement):
+        # the automatic-placement-search contract (reshard/search.py):
+        # `search_placement(...).winner` feeds set_mesh unmodified — the
+        # Placement carries the mesh shape (axes named by role), the
+        # role map, and the zero1 choice, so the devices-side Mesh and
+        # the axes dict are derived here, never hand-constructed by the
+        # caller (graftlint G022 guards the call sites)
+        from deeplearning4j_tpu.parallel.mesh import make_mesh
+
+        placement = mesh
+        mesh = make_mesh(dict(placement.mesh_axes), devices=jax.devices())
+        if axes is None:
+            axes = {r: a for r, a in placement.roles}
+        zero1 = bool(zero1 or placement.zero1)
     if getattr(net, "_pp_plan", None) is not None:
         exit_pipeline(net)
     # re-placement detection: a net whose params were already PLACED by

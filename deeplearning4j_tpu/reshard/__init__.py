@@ -20,8 +20,16 @@ Three layers:
    `serving/engine.py` accepts checkpoints written under any training
    mesh.
 
-Importing this package is jax-free (planner is pure stdlib; executor
-imports jax lazily) so tools and the graftlint stubs stay cheap.
+Layer 0, one level up: `reshard.search` — the automatic placement
+search. It enumerates every `Placement` a fleet shape admits, prunes
+with the SAME `PlacementError` validation, and ranks the survivors
+with a pure-stdlib per-step cost model; `search_placement(...).winner`
+feeds `set_mesh` unmodified, the CLI `plan` subcommand prints the
+ranked table, and the elastic supervisor re-plans with it at N -> N'.
+
+Importing this package is jax-free (planner and search are pure
+stdlib; executor imports jax lazily) so tools and the graftlint stubs
+stay cheap.
 """
 
 from deeplearning4j_tpu.reshard.planner import (  # noqa: F401
@@ -37,4 +45,18 @@ from deeplearning4j_tpu.reshard.planner import (  # noqa: F401
     ReshardPlan,
     plan_leaf,
     plan_reshard,
+)
+from deeplearning4j_tpu.reshard.search import (  # noqa: F401
+    BUILTIN_PROFILES,
+    FleetShape,
+    ModelProfile,
+    Objective,
+    ParamLeaf,
+    ScoredCandidate,
+    SearchError,
+    SearchResult,
+    enumerate_placements,
+    profile_net,
+    score_placement,
+    search_placement,
 )

@@ -1,0 +1,85 @@
+"""One measured arm of the `placement_search` bench (bench.py).
+
+Run in its OWN process so each arm gets a fresh jax platform with
+exactly the grid's virtual device count:
+
+    python -m deeplearning4j_tpu.reshard.bench_arm '<spec json>'
+
+The spec names the device count, the candidate `Placement` (JSON), and
+the workload (the builtin "lm" profile's transformer dims + batch).
+The arm builds the net, feeds the Placement to `set_mesh` UNMODIFIED —
+the same integration contract tier-1 proves for training parity — and
+times the forward step (warm, then `repeats` timed calls, median
+reported). The forward step is the measured surface because this
+container cannot execute TP train steps (the pre-existing
+donation-alias XlaRuntimeError class the reshard matrix already
+documents); the search side mirrors it with `Objective(step="forward")`
+so predicted and measured rank the same quantity.
+
+Prints one `RESULT {json}` line: {"placement", "ms_per_step", "times_ms",
+"devices"} — the parent bench mode reads it back.
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+import time
+
+
+def run_arm(spec: dict) -> dict:
+    from deeplearning4j_tpu.util.virtual_devices import ensure_cpu_devices
+
+    ensure_cpu_devices(int(spec["devices"]))
+
+    import numpy as np
+
+    import jax
+
+    from deeplearning4j_tpu.models.transformer import transformer_lm
+    from deeplearning4j_tpu.reshard.planner import Placement
+    from deeplearning4j_tpu.reshard.search import (
+        _LM_D,
+        _LM_FF,
+        _LM_H,
+        _LM_L,
+        _LM_T,
+        _LM_V,
+    )
+
+    placement = Placement.from_json(spec["placement"])
+    batch = int(spec.get("batch", 48))
+    repeats = int(spec.get("repeats", 8))
+
+    net = transformer_lm(vocab_size=_LM_V, d_model=_LM_D, n_heads=_LM_H,
+                         n_layers=_LM_L, d_ff=_LM_FF, max_length=_LM_T)
+    net.init()
+    net.set_mesh(placement)  # the searched winner's consumption contract
+
+    rng = np.random.default_rng(int(spec.get("seed", 0)))
+    toks = np.asarray(rng.integers(0, _LM_V, (batch, _LM_T)), np.int32)
+    jax.block_until_ready(net.output(toks))  # compile + warm
+    times = []
+    for _ in range(repeats):
+        t0 = time.perf_counter()
+        jax.block_until_ready(net.output(toks))
+        times.append((time.perf_counter() - t0) * 1e3)
+    times.sort()
+    return {"placement": placement.describe(),
+            "devices": int(spec["devices"]),
+            "ms_per_step": round(times[len(times) // 2], 4),
+            "times_ms": [round(t, 4) for t in times]}
+
+
+def main(argv=None) -> int:
+    argv = sys.argv[1:] if argv is None else argv
+    if len(argv) != 1:
+        sys.stderr.write("usage: bench_arm '<spec json>'\n")
+        return 2
+    result = run_arm(json.loads(argv[0]))
+    print("RESULT " + json.dumps(result), flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

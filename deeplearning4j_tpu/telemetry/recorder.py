@@ -28,6 +28,7 @@ Event schema — one JSON object per line, every event carrying
 | `request` | one served inference request (serving/engine.py): `id`, `ok`, `bucket` ([batch, seq]), `replica`, `queue_s` (enqueue -> batch cut), `batch_assemble_s` (host-side padding), `forward_s` (jitted forward incl. batch-boundary fetch), `total_s` (enqueue -> result), `seq_len`/`padded_seq` for sequence models, `weight_gen` (the published weight generation the batch served against — serving/fleet.py), `error` on a failed batch — the ONLY record serving/replay.py reconstructs p50/p99/QPS from. Generation requests carry `kind: "generate"` plus `prompt_len`, `prompt_bucket`, `new_tokens`, and `ttft_s` (enqueue -> first token, i.e. the prefill's final chunk) — the rows tokens/sec and TTFT percentiles reconstruct from |
 | `page_pool` | KV-cache page accounting snapshot (serving/kvcache.py), emitted on every reserve/release: `replica`, `pages_total`, `page_size`, `pages_in_use`, `pages_peak` — the cache-occupancy headline's only source |
 | `reshard_plan` | a portable-resharding plan (reshard/) put on the record BEFORE any transfer: `path` ("live" / "checkpoint"), `src`/`dst` placement descriptions, `n_leaves`, per-action counts, `bytes_total`, `bytes_moved`, `bytes_lower_bound`; the transfer itself runs inside a `span` named `reshard` carrying the same byte fields |
+| `placement_search` | one automatic-placement-search run (reshard/search.py) put on the record BEFORE any mesh is built: `path` ("cli" = the `plan` dry-run, "elastic" = a worker's per-generation re-plan, "reform" = the supervisor's pre-relaunch search, "bench" = the placement_search bench), `fleet` ("2x4"), `profile`, `candidates_considered` / `candidates_feasible` / `pruned`, `winner` (the placement description), the winner's score breakdown (`winner_score`, `winner_memory_bytes`, `winner_collective_bytes`, `winner_bubble_cost`, `winner_idle_cost`), and `search_ms` — the elastic timeline test asserts one per worker per generation |
 | `host_gather` | a full-value host materialization of genuinely SHARDED leaves (util/orbax_checkpoint.host_materialize): `n_leaves`, `bytes` — resharded restore paths must show ZERO of these (asserted by the elastic timeline test) |
 | `weight_swap` | one live hot-swap attempt (serving/fleet.hot_swap): `ok`, `step` (the checkpoint step restored), `restore_ms` (shadow-net restore + validation, all OFF the request path), `generation` (the WeightStore generation after a flip / still serving after a rejection), `error` on rejection — paired with the `weight_gen` field every serving `request` event carries, the flip's visibility in the traffic record |
 | `autoscale` | one fleet-supervisor autoscale tick (serving/fleet.FleetSupervisor): `n_serving`, `n_replicas`, `queue_depth`, `p99_ms` (the decision inputs), `action` (+1 grew / -1 drained / 0), `max_replicas` — the occupancy bench row's only source; replica self-healing rides `fault` events (`replica-kill`/`replica-hang` when an injected fault fires, `replica-dead` with the requeued count when the supervisor reaps, `replica-respawn` with `respawn_ms` on re-admission) |

@@ -23,16 +23,22 @@ from deeplearning4j_tpu.parallel.data_parallel import (
     ParameterAveragingTrainer,
 )
 from deeplearning4j_tpu.parallel.mesh import make_mesh
+from deeplearning4j_tpu.reshard.search import FleetShape, search_placement
 
 rng = np.random.default_rng(0)
 x = rng.random((64, 32, 32, 3), dtype=np.float32)
 y = np.eye(10, dtype=np.float32)[rng.integers(0, 10, 64)]
 batches = ListDataSetIterator([DataSet(x, y)] * 4)
 
-mesh = make_mesh({"data": min(8, len(jax.devices()))})
-
 net = resnet20()
 net.init()
+
+# the cost model picks the mesh (automatic placement search,
+# reshard/search.py): pure dp over every visible device wins this
+# fleet shape, and the trainers consume the winner's axes instead of a
+# hand-guessed layout
+search = search_placement(net, FleetShape(1, min(8, len(jax.devices()))))
+mesh = make_mesh(dict(search.winner.mesh_axes))
 DataParallelTrainer(net, mesh).fit(batches)        # in-step allreduce
 print("allreduce DP loss:", net.score_value)
 print("sharded eval accuracy:", net.evaluate(DataSet(x, y)).accuracy())

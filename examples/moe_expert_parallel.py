@@ -27,7 +27,7 @@ import jax
 
 from deeplearning4j_tpu.datasets.api import DataSet
 from deeplearning4j_tpu.models.transformer import transformer_moe_lm
-from deeplearning4j_tpu.parallel.mesh import make_mesh
+from deeplearning4j_tpu.reshard.planner import Placement
 
 VOCAB, SEQ, BATCH = 512, 64, 8
 
@@ -44,11 +44,15 @@ net = transformer_moe_lm(
 )
 net.init()
 
-# data x expert: batch sharded over 'data', experts over 'expert'
-mesh = make_mesh({"data": 2, "expert": 4})
-net.set_mesh(mesh, axes={"data": "data", "expert": "expert"})
+# data x expert: batch sharded over 'data', experts over 'expert' — a
+# declarative Placement (reshard/planner.py) the unified set_mesh entry
+# consumes directly, instead of a hand-constructed mesh + role dict
+placement = Placement.of({"data": 2, "expert": 4},
+                         {"data": "data", "expert": "expert"})
+net.set_mesh(placement)
 
-print(f"devices: {len(jax.devices())}, mesh: {dict(mesh.shape)}")
+print(f"devices: {len(jax.devices())}, "
+      f"mesh: {dict(placement.mesh_axes)}")
 print("expert tensor sharding:",
       net.params["blk0_moe"]["We1"].sharding.spec)
 

@@ -24,6 +24,7 @@ from deeplearning4j_tpu.parallel.mesh import make_mesh
 from deeplearning4j_tpu.parallel.sequence_parallel import (
     SequenceParallelTrainer,
 )
+from deeplearning4j_tpu.reshard.planner import Placement
 
 VOCAB, SEQ, BATCH = 512, 256, 4
 
@@ -32,10 +33,13 @@ toks = np.asarray(rng.integers(0, VOCAB, (BATCH, SEQ)), np.int32)
 ds = DataSet(toks, np.roll(toks, -1, axis=1))
 
 # 2-D mesh: batch over 'data', time over 'seq' (degrade gracefully on
-# hosts with few devices — e.g. one real chip)
+# hosts with few devices — e.g. one real chip). The layout is declared
+# as a validated Placement (reshard/planner.py), never a raw axis dict.
 n = min(8, len(jax.devices()))
 data_ax = 2 if n >= 4 else 1
-mesh = make_mesh({"data": data_ax, "seq": n // data_ax})
+placement = Placement.of({"data": data_ax, "seq": n // data_ax},
+                         {"data": "data", "seq": "seq"})
+mesh = make_mesh(dict(placement.mesh_axes))
 
 # the conf carries the axis name: attention becomes the K/V ring, the
 # positional encodings offset by each shard's global position

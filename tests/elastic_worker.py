@@ -51,13 +51,20 @@ def main() -> int:
     from deeplearning4j_tpu.datasets.api import DataSet
     from deeplearning4j_tpu.distributed.global_mesh import (
         local_shard,
-        make_global_mesh,
         spans_processes,
     )
     from tests.cluster_worker import build_net
 
     net = build_net()
-    mesh = make_global_mesh({"data": -1})
+    # the elastic re-plan: search the best placement for THIS
+    # generation's fleet shape (rank-independent — every member derives
+    # the identical winner and emits a placement_search event) instead
+    # of hand-specifying the roles; the objective models the run's real
+    # global batch
+    from deeplearning4j_tpu.reshard.search import Objective
+
+    mesh, axes, _search = elastic.searched_global_mesh(
+        net, objective=Objective(global_batch=GLOBAL_BATCH))
     assert spans_processes(mesh), "mesh does not span processes"
     # restore THROUGH the portable resharding engine: the checkpoint may
     # have been written by a different fleet size (N=3 -> N'=2 re-form),
@@ -66,7 +73,7 @@ def main() -> int:
     # host gathers (tests/test_elastic.py asserts both from telemetry)
     start = net.resume_from(ckpt_dir, target_mesh=mesh)
     print(f"p{pid}: resuming from step {start}/{total_steps}", flush=True)
-    net.set_mesh(mesh)
+    net.set_mesh(mesh, axes=axes)
 
     def local_batch(step):
         x, y = batch_for_step(step)

@@ -181,6 +181,56 @@ def test_reshard_artifact_rows_are_lower_is_better():
                           threshold=0.1)["regressions"] == []
 
 
+def test_plan_artifact_rows_direction():
+    """PLAN artifact rows (cli plan / bench placement_search): scores,
+    predicted scores, and measured ms are lower-is-better by flag AND
+    by summary-reconstructed name; a rank-violation count regresses on
+    ANY increase (even from a nonzero base — stricter than the retrace
+    rise-from-zero rule); the Kendall tau row stays higher-is-better;
+    and a changed winner string is NAMED as a change, never silent."""
+    for metric in ("plan_winner_score", "plan_score::8 (data=data) p1",
+                   "plan_predicted::2x4::8 (data=data) p1",
+                   "plan_measured_ms::2x4::8 (data=data) p1"):
+        worse = benchdiff.diff(
+            _lines(**{metric: {"value": 100.0}}),
+            _lines(**{metric: {"value": 200.0}}),
+            threshold=0.1)["regressions"]
+        assert worse, f"{metric} growth did not regress"
+        better = benchdiff.diff(
+            _lines(**{metric: {"value": 100.0}}),
+            _lines(**{metric: {"value": 50.0}}),
+            threshold=0.1)["regressions"]
+        assert better == [], f"{metric} improvement flagged"
+    # rank violations: any increase regresses, zero or nonzero base
+    (row,) = benchdiff.diff(
+        _lines(plan_predicted_rank_violations={"value": 0}),
+        _lines(plan_predicted_rank_violations={"value": 1}),
+        threshold=0.5)["regressions"]
+    assert "lower is better" in row["reason"]
+    assert benchdiff.diff(
+        _lines(plan_predicted_rank_violations={"value": 1}),
+        _lines(plan_predicted_rank_violations={"value": 2}),
+        threshold=10.0)["regressions"], \
+        "nonzero-base violation increase slipped through"
+    # tau falling past threshold regresses (higher-is-better default)
+    assert benchdiff.diff(
+        _lines(**{"plan_rank_kendall_tau::2x4": {"value": 1.0}}),
+        _lines(**{"plan_rank_kendall_tau::2x4": {"value": 0.3}}),
+        threshold=0.1)["regressions"]
+    # winner change: named in changes, not a regression by itself
+    result = benchdiff.diff(
+        _lines(**{"plan_winner::2x4": {"value": 100.0,
+                                       "winner": "8 (data=data) p1"}}),
+        _lines(**{"plan_winner::2x4": {
+            "value": 100.0, "winner": "4x2 (data=data,model=model) p1"}}),
+        threshold=0.1)
+    assert result["regressions"] == []
+    (chg,) = result["changes"]
+    assert chg["field"] == "winner"
+    assert chg["old"] == "8 (data=data) p1"
+    assert chg["new"] == "4x2 (data=data,model=model) p1"
+
+
 def test_serve_recompiles_rising_from_zero_always_regress():
     """A retrace count has no ratio base at 0 — ANY rise means the
     bucket lattice leaked and must trip regardless of threshold."""

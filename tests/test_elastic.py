@@ -164,6 +164,37 @@ def test_kill_one_worker_fleet_reforms_and_resumes(tmp_path):
     all_fleet = [e for p in range(3) for e in _events(f"{fleet_log}.p{p}")]
     assert not [e for e in all_fleet + sup_events
                 if e["event"] == "host_gather"]
+    # 7. the elastic re-PLAN (ISSUE 14): every generation SEARCHED its
+    # placement instead of inheriting roles — worker 0 emits one
+    # placement_search event per generation (path=elastic), and the
+    # re-formed N'=2 generation's winner is the searched 4-device
+    # 2-process data placement the resumed run trained through (the
+    # same mesh the old hand-specified path built, so the resume parity
+    # asserted above IS the searched-placement resume)
+    searches = [e for e in p0_events
+                if e["event"] == "placement_search"]
+    assert len(searches) == 2, searches  # one per generation
+    assert all(e["path"] == "elastic" for e in searches)
+    assert searches[0]["fleet"] == "3x2" \
+        and searches[0]["winner"] == "6 (data=data) p3"
+    assert searches[1]["fleet"] == "2x2" \
+        and searches[1]["winner"] == "4 (data=data) p2"
+    assert all(e["candidates_considered"] >= e["candidates_feasible"]
+               for e in searches)
+    # 8. the supervisor's own re-plan is on the record BEFORE the
+    # relaunch: a placement_search (path=reform) for gen 1, the reform
+    # fault event names the winner, and the durable coordinator
+    # journaled it
+    sup_searches = [e for e in sup_events
+                    if e["event"] == "placement_search"]
+    assert [e["path"] for e in sup_searches] == ["reform"]
+    assert sup_searches[0]["gen"] == 1 \
+        and sup_searches[0]["winner"] == "4 (data=data) p2"
+    assert sup_events.index(sup_searches[0]) < sup_events.index(reform[0])
+    assert reform[0]["placement"] == "4 (data=data) p2"
+    journaled = sup.coordinator.read_config("elastic/placement/1")
+    assert journaled["mesh_axes"] == [["data", 4]]
+    assert journaled["process_count"] == 2
 
 
 def test_checkpoint_under_spanning_mesh_restores_on_one_process(tmp_path):

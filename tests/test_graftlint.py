@@ -1,5 +1,5 @@
 """Tier-1 gate for graftlint (ISSUE 2 + the ISSUE 5 SPMD rules): every
-AST rule G001-G021 proven on a positive AND a negative fixture, the
+AST rule G001-G022 proven on a positive AND a negative fixture, the
 suppression + baseline machinery, the stage-2 jaxpr audit over every
 public entry point, and the package itself held lint-clean (zero
 non-baselined findings). The stage-3 collective audit has its own gate
@@ -533,6 +533,40 @@ def init_if_needed(net):
     if net.params is None:             # reading params never flags
         net.init()
 """),
+    ("G022", """\
+def run(net, devices):
+    mesh = jax.sharding.Mesh(devices, ("data",))     # raw ctor
+    net.set_mesh(mesh, axes={"data": "data"})        # role-dict literal
+
+
+def train(net):
+    from deeplearning4j_tpu.parallel.mesh import make_mesh
+    return make_mesh({"data": 2, "model": 4})        # role-dict literal
+""", """\
+from deeplearning4j_tpu.reshard.planner import Placement
+from deeplearning4j_tpu.reshard.search import FleetShape, search_placement
+
+
+def run(net, fleet_spec):
+    result = search_placement(net, FleetShape.parse(fleet_spec))
+    net.set_mesh(result.winner)            # the searched winner
+
+
+def declare(net):
+    # the validated declarative spelling: Placement.of IS the blessed
+    # home of the role-dict literal
+    placement = Placement.of({"data": 2, "expert": 4},
+                             {"data": "data", "expert": "expert"})
+    net.set_mesh(placement)
+
+
+def parsed(net, make_mesh, axes):
+    # parsed/derived dicts (CLI --mesh) and comprehensions never flag
+    mesh = make_mesh(axes)
+    net.set_mesh(mesh, axes={r: r for r in axes})
+    opts = {"data": "d.csv"}               # a non-mesh dict is silent
+    return opts
+"""),
 ]
 
 
@@ -542,6 +576,7 @@ RULE_FIXTURE_PATHS = {
     "G017": "deeplearning4j_tpu/serving/_graftlint_fixture.py",
     "G019": "deeplearning4j_tpu/serving/_graftlint_fixture.py",
     "G021": "deeplearning4j_tpu/serving/_graftlint_fixture.py",
+    "G022": "deeplearning4j_tpu/cli/_graftlint_fixture.py",
 }
 
 
@@ -556,7 +591,7 @@ def test_rule_fires_on_positive_not_negative(rule, pos, neg):
 
 def test_every_rule_has_fixture_coverage():
     assert {r for r, _, _ in FIXTURES} == set(RULE_DOCS) == {
-        f"G{i:03d}" for i in range(1, 22)}
+        f"G{i:03d}" for i in range(1, 23)}
 
 
 def test_g015_blessed_sites_are_exempt():
@@ -661,6 +696,51 @@ def test_g021_scope_and_blessed_swap_path():
     resume_only = "def f(net, d):\n    return net.resume_from(d)\n"
     assert "G021" in rules_in(assign_only, serving)
     assert "G021" in rules_in(resume_only, serving)
+
+
+def test_g022_scope_and_blessed_paths():
+    """G022 covers the user-facing layers only — examples/, cli/, and
+    distributed/elastic.py (library internals IMPLEMENT the blessed
+    paths and stay silent) — and both halves fire independently: the
+    raw Mesh ctor without a role dict, and a role-dict literal without
+    a raw ctor. Placement.of keeps its role-dict literals."""
+    _, pos, neg = next(f for f in FIXTURES if f[0] == "G022")
+    cli = RULE_FIXTURE_PATHS["G022"]
+    assert "G022" in rules_in(pos, cli)
+    assert "G022" in rules_in(pos, "examples/data_parallel_training.py")
+    assert "G022" in rules_in(
+        pos, "deeplearning4j_tpu/distributed/elastic.py")
+    # out of scope: the library layers that implement the blessed paths
+    assert "G022" not in rules_in(pos)  # parallel/ default fixture path
+    assert "G022" not in rules_in(
+        pos, "deeplearning4j_tpu/parallel/mesh.py")
+    assert "G022" not in rules_in(
+        pos, "deeplearning4j_tpu/distributed/global_mesh.py")
+    raw_only = ("def f(devices):\n"
+                "    return jax.sharding.Mesh(devices, ('data',))\n")
+    dict_only = ("def f(net, mesh):\n"
+                 "    net.set_mesh(mesh, axes={'data': 'data'})\n")
+    blessed = ("from deeplearning4j_tpu.reshard.planner import Placement\n"
+               "def f(net):\n"
+               "    net.set_mesh(Placement.of({'data': 8},\n"
+               "                              {'data': 'data'}))\n")
+    assert "G022" in rules_in(raw_only, cli)
+    assert "G022" in rules_in(dict_only, cli)
+    assert "G022" not in rules_in(blessed, cli)
+
+
+def test_g022_user_facing_layers_sweep_clean():
+    """The rule's whole scope — examples/ (outside the package sweep)
+    plus cli/ and distributed/elastic.py — holds zero G022 findings:
+    every mesh the user-facing layers build now routes through
+    Placement / the search."""
+    targets = [os.path.join(ROOT, "examples"),
+               os.path.join(PKG, "cli"),
+               os.path.join(PKG, "distributed", "elastic.py")]
+    new, _old = lint_report(targets, load_baseline(BASELINE), root=ROOT)
+    hits = [f for f in new if f.rule == "G022"]
+    assert not hits, "G022 findings in user-facing layers:\n" + "\n".join(
+        f.format() for f in hits)
 
 
 def test_g016_tuning_layer_and_scope():

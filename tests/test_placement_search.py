@@ -1,0 +1,438 @@
+"""Automatic placement search (ISSUE 14): the cost model picks the
+mesh, not just moves to it.
+
+Three proof layers:
+
+1. **Pure cost model** — exact-rational memory/collective/bubble
+   accounting checked against HAND-COMPUTED values on a 2-layer toy
+   profile at three fleet shapes; feasibility prunes (zero1 x TP,
+   non-dividing axes, spanning data-only, HBM budget) refuse before any
+   plan exists; the ranking is rank-independent (simulated
+   process_index 0 vs 1) and the whole search stage imports under a
+   poisoned `jax`.
+2. **Integration** — `search_placement(...).winner` is a `Placement`
+   that `set_mesh` consumes UNMODIFIED: the dp winner trains to
+   reference parity, a TP placement actually shards params.
+3. **Surfaces** — the CLI `plan` dry-run prints the ranked table,
+   writes a benchdiff-consumable PLAN artifact, emits the
+   `placement_search` telemetry event, and refuses infeasible requests
+   as usage errors; the COMMITTED PLAN_r01.json parses, carries zero
+   predicted-rank violations, and a doctored violation trips benchdiff
+   exit 1 (the always-regress contract).
+
+The predicted-vs-measured gate itself runs in bench.py
+`placement_search` (it spawns an arm subprocess per candidate); the
+elastic re-plan is asserted by tests/test_elastic.py's timeline test.
+"""
+
+import json
+import os
+import subprocess
+import sys
+from fractions import Fraction
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.reshard.planner import Placement, PlacementError
+from deeplearning4j_tpu.reshard.search import (
+    BUILTIN_PROFILES,
+    FleetShape,
+    ModelProfile,
+    Objective,
+    ParamLeaf,
+    SearchError,
+    enumerate_placements,
+    score_placement,
+    search_placement,
+)
+
+pytestmark = pytest.mark.reshard
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+PLAN_ARTIFACT = os.path.join(ROOT, "PLAN_r01.json")
+
+# ------------------------------------------------------ the 2-layer toy
+# param_bytes = (8*16 + 16 + 16*8 + 6) * 4 = 278 * 4 = 1112
+# activation width = 16 + 8 = 24 (last dims of the ndim>=2 leaves)
+TOY = ModelProfile(
+    name="toy",
+    leaves=(ParamLeaf("l0/W", (8, 16)), ParamLeaf("l0/b", (16,)),
+            ParamLeaf("l1/W", (16, 8)), ParamLeaf("norm/g", (6,))),
+    n_layers=2, seq_len=1, supports=("data", "model", "pipe"),
+    rules=((r".*l\d/W$", (None, "model")), (r".*l\d/b$", ("model",))))
+
+OBJ = Objective(global_batch=8)  # compute_weight 1/16, hbm default
+
+
+def _candidate(result, desc):
+    for c in result.candidates:
+        if c.describe() == desc:
+            return c
+    raise AssertionError(
+        f"{desc} not in {[c.describe() for c in result.candidates]}")
+
+
+# ---------------------------------------------------------- enumeration
+
+def test_enumeration_uses_all_devices_and_prunes_for_free():
+    """Feasibility IS the planner's PlacementError validation: every
+    candidate covers the fleet's full device grid, zero1 variants exist
+    only for pure-dp assignments, and a process-spanning fleet prunes
+    non-data roles with the set_mesh guard's reason."""
+    candidates, pruned = enumerate_placements(FleetShape(1, 8))
+    assert candidates
+    for p in candidates:
+        assert p.n_devices == 8
+        if p.zero1:
+            assert {r for r, _ in p.roles} == {"data"}
+    spanning, span_pruned = enumerate_placements(FleetShape(2, 4))
+    assert all({r for r, _ in p.roles} == {"data"} for p in spanning)
+    reasons = [reason for _, reason in span_pruned]
+    assert any("'data' role only" in r for r in reasons)
+
+
+def test_non_dividing_axes_prune_with_the_planner_error():
+    """The builtin lm profile's d_model=80 cannot split 3 or 6 ways:
+    the 3x2 grid's tp3/tp6 assignments die as PlacementError prunes
+    (the target-dim-not-divisible class), never as scored candidates."""
+    res = search_placement(BUILTIN_PROFILES["lm"], FleetShape(1, 6),
+                           objective=Objective(global_batch=48))
+    assert {c.describe() for c in res.candidates} == {
+        "6 (data=data) p1", "6 (data=data) p1+zero1",
+        "3x2 (data=data,model=model) p1"}
+    assert any("does not divide" in reason for _, reason in res.pruned)
+
+
+# ------------------------------------------- hand-computed cost model
+
+def test_cost_model_dp_tp_hand_computed():
+    """Exact-rational accounting vs hand-computed values (fleet 1x4,
+    B=8): dp2 x tp2 shards every matched leaf 2-way, pays the grad ring
+    on dp and two activation allreduces per layer on tp."""
+    res = search_placement(TOY, FleetShape(1, 4), objective=OBJ)
+    c = _candidate(res, "2x2 (data=data,model=model) p1")
+    # params/device: (512/2 + 64/2 + 512/2)/1 + 24 unmatched = 568
+    assert c.params_bytes == Fraction(568)
+    assert c.moments_bytes == Fraction(1136)       # 2x params (no zero1)
+    # activations: rows 8/2=4, width 24, f32
+    assert c.activation_bytes == Fraction(4 * 24 * 4)
+    assert c.memory_bytes == Fraction(568 + 568 + 1136 + 384)
+    # collectives: dp ring 2*568*(1/2) + tp 2 passes * 2 layers * 384
+    # * (1/2) = 568 + 768
+    assert c.collective_bytes == Fraction(568 + 768)
+    # both axes divide real work -> no idle, no pp -> no bubble
+    assert c.idle_cost == 0 and c.bubble_cost == 0
+    assert c.score == Fraction(1336)
+
+    dp4 = _candidate(res, "4 (data=data) p1")
+    assert dp4.params_bytes == Fraction(1112)
+    assert dp4.collective_bytes == Fraction(2 * 1112 * 3, 4)  # 1668
+    assert dp4.score == Fraction(1668)
+    # the toy's verdict: the sharded layouts beat pure dp4 — the
+    # search finds non-obvious winners (dp2 x pp2 cheapest: tiny p2p +
+    # a 1/5 bubble at 4 microbatches)
+    assert c.score < dp4.score
+    assert res.best.describe() == "2x2 (data=data,pipe=pipe) p1"
+
+    z = _candidate(res, "4 (data=data) p1+zero1")
+    # zero1: moments shard 4-way, but the param all-gather costs
+    # another 1112*(3/4) on the wire
+    assert z.moments_bytes == Fraction(2 * 1112, 4)
+    assert z.collective_bytes == Fraction(1668) + Fraction(3 * 1112, 4)
+
+
+def test_cost_model_pp_bubble_hand_computed():
+    """dp2 x pp2 (fleet 1x4): stage-split params, 4 microbatches, the
+    GPipe bubble term = (pp-1)/(n_micro+pp-1) x per-device compute x
+    compute_weight, stage-boundary p2p on the wire."""
+    res = search_placement(TOY, FleetShape(1, 4), objective=OBJ)
+    c = _candidate(res, "2x2 (data=data,pipe=pipe) p1")
+    assert c.params_bytes == Fraction(1112, 2)          # stage split
+    # rows 4 over n_micro 4 -> 1 row/micro; act = 1*24*4 = 96
+    assert c.activation_bytes == Fraction(96)
+    # dp ring 2*556*(1/2)=556; pp p2p = 1 pass * 96*4*(1/2) = 192
+    assert c.collective_bytes == Fraction(556 + 192)
+    # compute C = 2*8*1112 = 17792; denom dp*pp = 4 -> 4448/device;
+    # bubble = (1/5) * 4448 * (1/16)
+    assert c.bubble_cost == Fraction(4448, 5 * 16)
+    # all 4 devices carry real work -> no idle
+    assert c.idle_cost == 0
+    assert c.score == Fraction(748) + Fraction(4448, 80)
+
+
+def test_cost_model_idle_penalty_and_forward_surface():
+    """A model axis whose rules shard nothing leaves its devices
+    redundant (idle penalty); the forward objective drops gradient and
+    optimizer terms and halves the activation collectives."""
+    no_tp = ModelProfile(name="plain", leaves=TOY.leaves, n_layers=2,
+                         supports=("data", "model"), rules=())
+    # compute_weight 1 here: the toy is miniature (its wire bytes swamp
+    # its compute proxy), so the structural claim — a redundant axis is
+    # penalized by the compute it wastes — is asserted at unit weight
+    res = search_placement(no_tp, FleetShape(1, 4),
+                           objective=Objective(global_batch=8,
+                                               compute_weight=Fraction(1)))
+    c = _candidate(res, "2x2 (data=data,model=model) p1")
+    # tp shards nothing: compute divides over dp only -> half the
+    # devices are redundant. C=17792, compute_dev=C/2, idle=C/2-C/4
+    assert c.idle_cost == Fraction(17792, 4)
+    assert res.best.describe() == "4 (data=data) p1"  # dp wins here
+
+    fwd = search_placement(
+        TOY, FleetShape(1, 4),
+        objective=Objective(global_batch=8, step="forward",
+                            zero1_options=(False,)))
+    c = _candidate(fwd, "2x2 (data=data,model=model) p1")
+    assert c.moments_bytes == 0
+    assert c.memory_bytes == Fraction(568 + 384)   # params + activations
+    # one activation pass: 1 * 2 layers * 384 * (1/2); no grad ring
+    assert c.collective_bytes == Fraction(384)
+
+
+def test_hbm_budget_rejects_and_no_feasible_is_a_search_error():
+    tight = Objective(global_batch=8, hbm_bytes_per_device=2000)
+    res = search_placement(TOY, FleetShape(1, 4), objective=tight)
+    # dp4 (4448 B/device) dies; the sharded candidates survive
+    assert "4 (data=data) p1" not in {c.describe()
+                                      for c in res.candidates}
+    assert any("HBM budget" in reason for _, reason in res.pruned)
+    with pytest.raises(SearchError, match="no feasible placement"):
+        search_placement(TOY, FleetShape(1, 4),
+                         objective=Objective(global_batch=8,
+                                             hbm_bytes_per_device=10))
+
+
+def test_batch_and_layers_divisibility_prunes():
+    assert score_placement(TOY, Placement.of({"data": 4},
+                                             {"data": "data"}),
+                           OBJ, FleetShape(1, 4))
+    with pytest.raises(PlacementError, match="does not divide over"):
+        score_placement(TOY, Placement.of({"data": 3}, {"data": "data"}),
+                        OBJ, FleetShape(1, 3))
+    with pytest.raises(PlacementError, match="pipeline stages"):
+        score_placement(
+            TOY, Placement.of({"pipe": 4}, {"pipe": "pipe"}),
+            Objective(global_batch=8, microbatch_factor=1),
+            FleetShape(1, 4))
+
+
+# ------------------------------------------------ determinism + purity
+
+def test_search_is_rank_independent():
+    """Same discipline as plan_reshard: the ranking is a pure function
+    of (profile, fleet, objective) — byte-identical under simulated
+    process_index 0 vs 1, which is what lets every fleet member derive
+    the elastic re-plan winner without coordination."""
+    from deeplearning4j_tpu.analysis.collective_audit import \
+        simulated_process_index
+
+    results = []
+    for pid in (0, 1):
+        with simulated_process_index(pid):
+            results.append(search_placement(TOY, FleetShape(2, 2),
+                                            objective=OBJ))
+    assert results[0].to_json() == results[1].to_json()
+    assert results[0].winner == results[1].winner
+
+
+def test_search_stage_is_pure_stdlib():
+    """The whole search stage — module import, enumeration, scoring,
+    ranking — under a poisoned `jax`: the CLI plans a pod placement
+    without a backend, and the lint stubs import it for free."""
+    code = (
+        "import os, sys, types\n"
+        "poison = types.ModuleType('jax')\n"
+        "def _boom(*a, **k): raise AssertionError('jax imported')\n"
+        "poison.__getattr__ = lambda n: _boom()\n"
+        "sys.modules['jax'] = poison\n"
+        "for name in ('deeplearning4j_tpu', 'deeplearning4j_tpu.reshard'):\n"
+        "    mod = types.ModuleType(name)\n"
+        "    mod.__path__ = [os.path.join(os.getcwd(),\n"
+        "                                 *name.split('.'))]\n"
+        "    sys.modules[name] = mod\n"
+        "from deeplearning4j_tpu.reshard.search import (\n"
+        "    BUILTIN_PROFILES, FleetShape, Objective, search_placement)\n"
+        "res = search_placement(BUILTIN_PROFILES['lm'],\n"
+        "                       FleetShape.parse('2x4'),\n"
+        "                       objective=Objective(global_batch=48))\n"
+        "print(res.winner.describe())\n")
+    out = subprocess.run([sys.executable, "-c", code],
+                         capture_output=True, text=True, cwd=ROOT,
+                         timeout=60)
+    assert out.returncode == 0, out.stderr
+    assert out.stdout.strip() == "8 (data=data) p2"
+
+
+# ------------------------------------------------- set_mesh integration
+
+def test_winner_feeds_set_mesh_and_trains_to_parity():
+    """The acceptance contract: `search_placement(...).winner` goes to
+    `set_mesh` UNMODIFIED and the placed net optimizes to the same
+    params as the unplaced reference (float32 reduction-order
+    tolerance, the test_distributed bound)."""
+    from deeplearning4j_tpu.datasets.api import DataSet
+    from tests.cluster_worker import C, F, build_net
+
+    rng = np.random.default_rng(0)
+    x = rng.random((24, F), dtype=np.float32)
+    y = np.eye(C, dtype=np.float32)[rng.integers(0, C, 24)]
+
+    ref = build_net().init()
+    for _ in range(2):
+        ref.fit(DataSet(x, y))
+
+    net = build_net().init()
+    result = search_placement(net, FleetShape(1, 8),
+                              objective=Objective(global_batch=24))
+    net.set_mesh(result.winner)
+    for _ in range(2):
+        net.fit(DataSet(x, y))
+    np.testing.assert_allclose(np.asarray(net.params_flat()),
+                               np.asarray(ref.params_flat()), atol=1e-5)
+
+
+def test_set_mesh_consumes_tp_placement():
+    """A declarative TP Placement actually places: the mesh is built
+    from the placement's axes and rule-matched leaves arrive sharded."""
+    import jax
+
+    from deeplearning4j_tpu.models.transformer import transformer_lm
+
+    net = transformer_lm(vocab_size=32, d_model=16, n_heads=2,
+                         n_layers=1, d_ff=32, max_length=8)
+    net.init()
+    net.set_mesh(Placement.of({"data": 2, "model": 2},
+                              {"data": "data", "model": "model"}))
+    assert set(net._mesh.axis_names) == {"data", "model"}
+    sharded = [l for l in jax.tree.leaves(net.params)
+               if not l.sharding.is_fully_replicated]
+    assert sharded, "no leaf took the TP sharding from the Placement"
+
+
+# -------------------------------------------------------- CLI + events
+
+def test_cli_plan_dry_run_table_and_artifact(tmp_path, capsys):
+    from deeplearning4j_tpu.cli import driver
+    from deeplearning4j_tpu.telemetry import artifact
+
+    art = str(tmp_path / "PLAN_test.json")
+    rc = driver.main(["plan", "--model", "lm", "--fleet", "2x4",
+                      "--global-batch", "48", "--artifact", art])
+    out = capsys.readouterr().out
+    assert rc == 0
+    assert "placement search: lm on fleet 2x4" in out
+    assert "coll B/step" in out and "bubble" in out  # score breakdown
+    rows = artifact.load(art)
+    assert rows["plan_candidates"]["value"] == 2
+    assert rows["plan_winner_score"]["winner"] == "8 (data=data) p2"
+    assert rows["plan_winner_score"].get("lower_is_better")
+    assert rows["plan_search_ms"]["value"] >= 0
+    assert any(m.startswith("plan_score::") for m in rows)
+
+
+def test_cli_plan_usage_and_no_feasible_errors(tmp_path):
+    from deeplearning4j_tpu.cli import driver
+
+    with pytest.raises(SystemExit, match="exactly one of"):
+        driver.main(["plan", "--fleet", "2x4"])
+    with pytest.raises(SystemExit, match="no feasible placement"):
+        driver.main(["plan", "--model", "mlp", "--fleet", "2x4",
+                     "--hbm-gb", "0.0000001"])
+    with pytest.raises(SystemExit, match="expected PxK"):
+        driver.main(["plan", "--model", "mlp", "--fleet", "2x4x2"])
+
+
+def test_cli_plan_emits_placement_search_event():
+    from deeplearning4j_tpu.cli import driver
+    from deeplearning4j_tpu.telemetry.recorder import Recorder, set_default
+
+    rec = Recorder()
+    prev = set_default(rec)
+    try:
+        driver.main(["plan", "--model", "mlp", "--fleet", "1x8"])
+    finally:
+        set_default(prev)
+    events = [e for e in rec.events if e["event"] == "placement_search"]
+    assert len(events) == 1
+    ev = events[0]
+    assert ev["path"] == "cli" and ev["fleet"] == "1x8"
+    assert ev["candidates_considered"] == \
+        ev["candidates_feasible"] + ev["pruned"]
+    # the mlp profile's verdict on one process x 8 devices: dp4 x tp2
+    # halves the grad ring for less than its activation psums cost
+    assert ev["winner"] == "4x2 (data=data,model=model) p1"
+    assert "winner_collective_bytes" in ev and "search_ms" in ev
+
+
+def test_supervisor_replan_is_deterministic_and_journals():
+    """The supervisor half of the elastic re-plan (no fleet spawn): the
+    generic-profile search for a fleet shape is deterministic and emits
+    the placement_search event with path=reform."""
+    from deeplearning4j_tpu.distributed.elastic import ElasticSupervisor
+    from deeplearning4j_tpu.telemetry.recorder import Recorder, set_default
+
+    sup = ElasticSupervisor(["true"], n_processes=3, min_processes=2,
+                            checkpoint_dir="/tmp", total_steps=1,
+                            local_device_count=2)
+    rec = Recorder()
+    prev = set_default(rec)
+    try:
+        result = sup._replan(2, gen=1)
+    finally:
+        set_default(prev)
+        sup.close()
+    assert result.winner.describe() == "4 (data=data) p2"
+    events = [e for e in rec.events if e["event"] == "placement_search"]
+    assert len(events) == 1 and events[0]["path"] == "reform"
+    assert events[0]["gen"] == 1
+
+
+# ---------------------------------------------- the committed artifact
+
+def test_committed_plan_artifact_parses_and_gates(tmp_path):
+    """PLAN_r01.json (bench.py placement_search on this container): it
+    parses, the predicted-vs-measured gate passed (zero rank
+    violations, every grid's Kendall tau positive), the winner rows
+    name pure-dp placements, and benchdiff (a) self-diffs clean and
+    (b) trips exit-style regression on a doctored rank violation."""
+    sys.path.insert(0, os.path.join(ROOT, "tools"))
+    try:
+        import benchdiff
+    finally:
+        sys.path.pop(0)
+    from deeplearning4j_tpu.telemetry import artifact
+
+    rows = artifact.load(PLAN_ARTIFACT)
+    assert rows["plan_predicted_rank_violations"]["value"] == 0
+    for grid in ("2x2", "3x2", "2x4"):
+        assert rows[f"plan_winner::{grid}"]["winner"].endswith(
+            "(data=data) p1")
+        assert rows[f"plan_rank_kendall_tau::{grid}"]["value"] > 0
+        assert rows[f"plan_winner::{grid}"]["candidates"] >= 2
+    # the 3x2 grid proves the non-dividing prune reached the artifact
+    assert rows["plan_winner::3x2"]["pruned"] >= 1
+
+    self_diff = benchdiff.diff(rows, rows)
+    assert not self_diff["regressions"]
+
+    doctored = dict(rows)
+    bad = dict(rows["plan_predicted_rank_violations"])
+    bad["value"] = 1
+    doctored["plan_predicted_rank_violations"] = bad
+    win = dict(rows["plan_winner::2x4"])
+    win["winner"] = "4x2 (data=data,model=model) p1"
+    doctored["plan_winner::2x4"] = win
+    result = benchdiff.diff(rows, doctored)
+    assert any(r["metric"] == "plan_predicted_rank_violations"
+               for r in result["regressions"])
+    assert any(r["field"] == "winner" for r in result["changes"])
+    # and the violation regresses even from a NONZERO base (stricter
+    # than the retrace rise-from-zero rule)
+    worse = dict(doctored)
+    worse2 = dict(bad)
+    worse2["value"] = 2
+    worse["plan_predicted_rank_violations"] = worse2
+    again = benchdiff.diff(doctored, worse)
+    assert any(r["metric"] == "plan_predicted_rank_violations"
+               for r in again["regressions"])

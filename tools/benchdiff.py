@@ -30,6 +30,15 @@ the threshold (and a retrace count rising from 0 always regresses).
 The fleet rows `swap_ms`/`respawn_ms` ride the `_ms` rule. QPS and
 tokens/sec stay higher-is-better.
 
+PLAN artifacts (cli `plan --artifact` / bench.py placement_search —
+the automatic placement search) diff the same way: per-candidate
+score rows (`plan_score::...`, `plan_predicted::...`), measured
+step rows (`plan_measured_ms::...`), and the winner-score rows are
+lower-is-better; a changed `winner` string field is always NAMED as a
+change; and `predicted_rank_violations` regresses on ANY increase
+(like retraces — the cost model ordered a confidently-separated pair
+against the measurement).
+
 What counts as a regression (bench metrics are higher-is-better unless
 flagged lower-is-better as above):
 
@@ -71,7 +80,16 @@ DEFAULT_THRESHOLD = 0.10
 # and failed_requests growing is dropped traffic — never an improvement.
 _LOWER_IS_BETTER_RE = re.compile(
     r"(_p\d+_ms$|_ms$|latency|recompiles|bytes_moved$|bytes_lower_bound$"
-    r"|_us$|_ttft_|occupancy|input_wait|failed_requests$)")
+    r"|_us$|_ttft_|occupancy|input_wait|failed_requests$"
+    r"|plan_predicted|plan_winner|plan_score|plan_measured"
+    r"|rank_violations$)")
+
+# Metrics where ANY growth regresses regardless of threshold: a
+# predicted-vs-measured rank violation (PLAN artifacts, bench.py
+# placement_search) means the cost model confidently ordered a pair
+# against the measurement — like a retrace count, there is no
+# acceptable increase.
+_ALWAYS_REGRESS_RE = re.compile(r"rank_violations$")
 
 
 def _lower_is_better(metric: str, old: dict, new: dict) -> bool:
@@ -196,9 +214,13 @@ def diff(old_lines: dict, new_lines: dict,
                 # the threshold is the regression direction; a retrace
                 # count rising from 0 always regresses (no ratio exists
                 # for a zero base — any retrace means the bucket lattice
-                # leaked)
+                # leaked), and rank-violation counts regress on ANY
+                # increase (the placement cost model ordered a
+                # confidently-separated pair against the measurement)
                 grew_past = ((o > 0 and (n - o) / o > threshold + slack)
-                             or (o == 0 and n > 0))
+                             or (o == 0 and n > 0)
+                             or (n > o
+                                 and _ALWAYS_REGRESS_RE.search(str(metric))))
                 if grew_past:
                     row["reason"] = (
                         f"{field} grew"
@@ -220,6 +242,15 @@ def diff(old_lines: dict, new_lines: dict,
                 regressions.append(row)
             else:
                 changes.append(row)
+        # PLAN artifacts carry the winning placement as a string field:
+        # a changed winner is always NAMED (a change, not a regression —
+        # the scores decide regressions)
+        o_win, n_win = old.get("winner"), new.get("winner")
+        if isinstance(o_win, str) and isinstance(n_win, str) \
+                and o_win != n_win:
+            changes.append({"metric": metric, "field": "winner",
+                            "old": o_win, "new": n_win,
+                            "delta_pct": None})
         if new.get("regression") and not old.get("regression"):
             regressions.append({"metric": metric, "field": "regression",
                                 "old": False, "new": True, "delta_pct": None,

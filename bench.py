@@ -1541,6 +1541,57 @@ def _probe_backend() -> str:
         return "unknown"
 
 
+def _trace_check(tpath: str, rec, collected: list) -> int:
+    """Run `tracetool check` (subprocess — the CLI contract itself is
+    what CI exercises) over the sweep's telemetry, write the TRACE
+    artifact, and fold the detector rows into the metric record.
+    Returns 1 when a gating anomaly (post-warmup retrace / rank skew)
+    fired, 0 otherwise."""
+    here = os.path.dirname(os.path.abspath(__file__))
+    artifact = os.environ.get(
+        "DL4J_TPU_TRACE_ARTIFACT", os.path.join(here, "TRACE_r01.json"))
+    out = subprocess.run(
+        [sys.executable, os.path.join(here, "tools", "tracetool.py"),
+         "check", tpath, "--json", "--fail-on", "retrace,straggler"],
+        capture_output=True, text=True, timeout=300)
+    try:
+        payload = json.loads(out.stdout)
+    except (ValueError, TypeError):
+        rec.error("trace_check", error=f"rc={out.returncode}",
+                  traceback_str=(out.stderr or out.stdout or "")[-4000:])
+        return 1 if out.returncode else 0
+    findings = payload.get("findings", [])
+    subprocess.run(
+        [sys.executable, os.path.join(here, "tools", "tracetool.py"),
+         "stats", tpath, "--artifact", artifact],
+        capture_output=True, text=True, timeout=300)
+    skews = [f.get("skew_ms", 0.0) for f in findings
+             if f.get("anomaly") == "straggler"]
+    lines = [
+        {"metric": "trace_anomaly_count", "value": len(findings),
+         "unit": "count", "lower_is_better": True,
+         "gating": payload.get("gating", 0)},
+        {"metric": "straggler_skew_ms",
+         "value": round(max(skews), 3) if skews else 0.0, "unit": "ms",
+         "lower_is_better": True},
+    ]
+    for f in findings:
+        rec.anomaly(f.get("anomaly", "unknown"),
+                    **{k: v for k, v in f.items() if k != "anomaly"})
+    for line in lines:
+        print(json.dumps(line), flush=True)
+        rec.metric(line)
+        collected.append(json.dumps(line))
+    if out.returncode == 1:
+        print(json.dumps({"metric": "trace_check",
+                          "error": f"{payload.get('gating')} gating "
+                                   "anomaly(ies): retrace/rank-skew in "
+                                   "the sweep's own telemetry"}),
+              flush=True)
+        return 1
+    return 0
+
+
 def _run_all() -> int:
     """Run each mode in a subprocess (isolated jax platform init).
 
@@ -1676,6 +1727,14 @@ def _run_all() -> int:
                               else ""}),
                   flush=True)
             rc = 1
+    # the sweep audits its OWN telemetry (ISSUE 15): tracetool check
+    # over the shared log + the fleet modes' .pN shards — a post-warmup
+    # retrace in the serving replays or cross-process rank skew in the
+    # fleet modes fails the sweep even when every mode exited 0 (the
+    # zero-retrace and lockstep contracts' runtime witnesses). Spike
+    # kinds stay informational: a contended CPU host's input stalls are
+    # the environment, not the code.
+    rc = max(rc, _trace_check(tpath, rec, collected))
     # gate-carrying trailing summary (telemetry/artifact.py): the driver
     # keeps the END of the captured stdout, so early lines scroll out of
     # the artifact (r4 lost the LeNet line; r5 lost five modes' gate

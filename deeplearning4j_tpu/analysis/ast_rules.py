@@ -1,4 +1,4 @@
-"""The G001-G009 + G016-G022 AST rules (G010-G015 + G018 live in
+"""The G001-G009 + G016-G023 AST rules (G010-G015 + G018 live in
 spmd_rules.py and register into ALL_RULES/RULE_DOCS at the bottom of
 this module).
 
@@ -1212,6 +1212,103 @@ def g022_handrolled_placement(tree, imports, path):
     return out
 
 
+# --------------------------------------------------------------- G023
+
+# Telemetry schema discipline: the fleet-timeline tooling
+# (telemetry/trace.py merge/stats/anomaly/Perfetto, tools/tracetool.py)
+# classifies every record it merges by its event kind and span name.
+# An event("...")/span("...") literal invented at a call site is a
+# record the registered schema (recorder.py EVENT_KINDS/SPAN_NAMES +
+# the docstring table) doesn't know — it parses as noise, joins no
+# tree, and silently falls out of stats and anomaly detection. The
+# blessed home of new kinds/names is the registry itself: telemetry/
+# is exempt (it IS the schema), and dynamic names (f-strings like the
+# bench sweep's `mode:<name>` spans) are uncheckable statically and
+# stay silent.
+_G023_EXEMPT = ("deeplearning4j_tpu/telemetry/",)
+_G023_SETS: dict = {}
+
+
+def _g023_registered():
+    """(EVENT_KINDS, SPAN_NAMES) from the registry, cached; resolves
+    under the stage-1 no-jax stubs (telemetry/ is stdlib-pure). An
+    unresolvable registry disables the rule rather than crashing the
+    lint."""
+    if "sets" not in _G023_SETS:
+        try:
+            from deeplearning4j_tpu.telemetry.recorder import (EVENT_KINDS,
+                                                               SPAN_NAMES)
+            _G023_SETS["sets"] = (EVENT_KINDS, SPAN_NAMES)
+        except Exception:  # pragma: no cover - broken stub layouts
+            _G023_SETS["sets"] = None
+    return _G023_SETS["sets"]
+
+
+def _g023_str_arg(node: ast.AST):
+    return node.value if (isinstance(node, ast.Constant)
+                          and isinstance(node.value, str)) else None
+
+
+def g023_unregistered_telemetry_names(tree, imports, path):
+    """An `<obj>.event("<kind>")` whose kind literal is not a
+    registered EVENT_KIND, or an `<obj>.span("<name>")` /
+    `event("span", name="<name>")` whose name literal is not a
+    registered SPAN_NAME, outside telemetry/. Non-literal (variable /
+    f-string) names and non-string first arguments (`re.Match.span(0)`)
+    never flag."""
+    norm = path.replace("\\", "/")
+    if any(b in norm for b in _G023_EXEMPT):
+        return []
+    sets = _g023_registered()
+    if sets is None:
+        return []
+    event_kinds, span_names = sets
+    out = []
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call) \
+                or not isinstance(node.func, ast.Attribute) \
+                or node.func.attr not in ("span", "event") \
+                or not node.args:
+            continue
+        lit = _g023_str_arg(node.args[0])
+        if lit is None:
+            continue
+        if node.func.attr == "span":
+            if lit not in span_names:
+                out.append(("G023", node,
+                            f"span name {lit!r} is not in the registered "
+                            "schema (telemetry/recorder.py SPAN_NAMES): "
+                            "the fleet-timeline tooling cannot classify "
+                            "it — it joins no stats row, no tree, no "
+                            "anomaly rule",
+                            "register the name in SPAN_NAMES (and the "
+                            "recorder docstring table) first, or reuse "
+                            "an existing span name"))
+            continue
+        if lit not in event_kinds:
+            out.append(("G023", node,
+                        f"event kind {lit!r} is not in the registered "
+                        "schema (telemetry/recorder.py EVENT_KINDS): "
+                        "merged timelines parse it as noise",
+                        "register the kind in EVENT_KINDS (and the "
+                        "recorder docstring table) first, or use a "
+                        "typed Recorder method"))
+        elif lit == "span":
+            for kw in node.keywords:
+                if kw.arg != "name":
+                    continue
+                name_lit = _g023_str_arg(kw.value)
+                if name_lit is not None and name_lit not in span_names:
+                    out.append(("G023", node,
+                                f"span name {name_lit!r} (via "
+                                "event(\"span\", name=...)) is not in "
+                                "the registered schema "
+                                "(telemetry/recorder.py SPAN_NAMES)",
+                                "register the name in SPAN_NAMES (and "
+                                "the recorder docstring table) first"))
+    return out
+
+
 # stage-3 AST rules (G010-G014) live in spmd_rules.py and register here;
 # the import sits below every helper they borrow lazily, so importing
 # either module first resolves cleanly.
@@ -1228,7 +1325,8 @@ ALL_RULES = [g001_traced_bool, g002_host_sync, g003_float64_drift,
              g017_serving_hot_path, g019_decode_loop_sync,
              g020_sync_input_in_step_loop,
              g021_weight_swap_path,
-             g022_handrolled_placement] + SPMD_RULES
+             g022_handrolled_placement,
+             g023_unregistered_telemetry_names] + SPMD_RULES
 
 RULE_DOCS = {
     "G001": "python control flow / bool()/float()/int() on traced values",
@@ -1263,6 +1361,11 @@ RULE_DOCS = {
             "distributed/elastic.py) outside the blessed "
             "planner.Placement / search_placement paths — unvalidated, "
             "unranked mesh layouts",
+    "G023": "telemetry event kinds / span names invented at the call "
+            "site: an event(\"...\")/span(\"...\") string literal "
+            "outside telemetry/ that is not in the registered schema "
+            "(recorder.py EVENT_KINDS/SPAN_NAMES) — the fleet-timeline "
+            "tooling cannot classify such records",
     **SPMD_RULE_DOCS,
 }
 

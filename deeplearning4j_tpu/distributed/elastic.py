@@ -295,7 +295,9 @@ class ElasticSupervisor:
     # ------------------------------------------------------------- run
     def run(self) -> ElasticRunResult:
         from deeplearning4j_tpu.distributed.launcher import launch_local
-        from deeplearning4j_tpu.telemetry.recorder import get_default
+        from deeplearning4j_tpu.telemetry.recorder import (ENV_VAR,
+                                                           get_default)
+        from deeplearning4j_tpu.telemetry.trace import StragglerWatch
 
         rec = get_default()
         generations: List[FleetGeneration] = []
@@ -303,6 +305,14 @@ class ElasticSupervisor:
         n = self.n_processes
         env = dict(self.extra_env)
         env.setdefault(ENV_TOTAL_STEPS, str(self.total_steps))
+        # the heartbeat-path straggler consumer: while a generation
+        # runs, tail its per-process telemetry shards and put typed
+        # `anomaly` events on the record the moment the fleet's step
+        # completions skew (or a member stalls) — the supervisor sees a
+        # sick generation BEFORE the launch deadline reaps it
+        tpath = env.get(ENV_VAR) or os.environ.get(ENV_VAR)
+        watch = (StragglerWatch(tpath, recorder=rec)
+                 if tpath else None)
         while True:
             self.coordinator.record_config(GEN_KEY, gen)
             with rec.span("elastic_generation", gen=gen,
@@ -313,7 +323,14 @@ class ElasticSupervisor:
                     timeout=self.gen_timeout, grace=self.grace,
                     death_grace=self.death_grace,
                     faults=self.faults if gen == 0 else None,
-                    extra_env=env, echo=self.echo, cwd=self.cwd)
+                    extra_env=env, echo=self.echo, cwd=self.cwd,
+                    on_poll=watch.poll if watch is not None else None)
+                if watch is not None:
+                    # one forced pass over the generation's full record
+                    # so a skew that landed between polls still makes
+                    # the journal before the re-form decision
+                    watch.poll(force=True)
+                    span["straggler_anomalies"] = len(watch.findings)
                 g = FleetGeneration(
                     gen=gen, n_processes=n, results=results,
                     exit_classes=[r.exit_class for r in results])
@@ -339,7 +356,9 @@ class ElasticSupervisor:
             rec.fault("reform", gen=gen + 1, n_processes=n_next,
                       survivors=survivors, replacements=replacements,
                       dead=g.dead, prior_exit_classes=g.exit_classes,
-                      placement=replan.winner.describe())
+                      placement=replan.winner.describe(),
+                      straggler_anomalies=(len(watch.findings)
+                                           if watch is not None else 0))
             gen += 1
             n = n_next
 

@@ -129,7 +129,9 @@ def launch_local(argv: Sequence[str], n_processes: int = 2, *,
                  echo: Optional[Callable[[str], None]] = None,
                  cwd: Optional[str] = None,
                  faults=None,
-                 death_grace: Optional[float] = None) -> List[ProcessResult]:
+                 death_grace: Optional[float] = None,
+                 on_poll: Optional[Callable[[], None]] = None
+                 ) -> List[ProcessResult]:
     """Run ``argv`` as an N-process rendezvous fleet on this host.
 
     Every child gets the env contract (coordinator on a free local port
@@ -155,6 +157,13 @@ def launch_local(argv: Sequence[str], n_processes: int = 2, *,
     collective until the coordination service aborts them ~60 s later,
     and the full wall-clock ``timeout`` is the only other bound. None
     (the default) keeps the deadline as the sole reaper.
+
+    ``on_poll``: a callback invoked on every monitor pass while the
+    fleet runs — the elastic supervisor's straggler watch
+    (telemetry/trace.StragglerWatch.poll) tails the per-process
+    telemetry shards here and puts `anomaly` events on the record while
+    a skewing generation is still alive. Exceptions are contained: a
+    broken watcher never kills the launch.
     """
     from deeplearning4j_tpu.telemetry.recorder import get_default
 
@@ -207,6 +216,11 @@ def launch_local(argv: Sequence[str], n_processes: int = 2, *,
                         rc not in (0, faults_mod.RESUMABLE_EXIT_CODE):
                     death_at = time.monotonic() + death_grace
                     span["death_grace_tripped_by"] = i
+            if on_poll is not None:
+                try:
+                    on_poll()
+                except Exception:
+                    pass  # the watch is advisory; the launch is not
             if pending:
                 time.sleep(0.05)
         stragglers = [i for i, p in enumerate(procs) if p.poll() is None]

@@ -72,6 +72,12 @@ class Batch:
     requests: list
     t_cut: float = 0.0
     assemble_seconds: float = 0.0
+    # correlation handoff (telemetry/recorder.py): the trace this batch
+    # roots and the span the replica thread's `forward` parents to —
+    # the cut's `queue` -> `batch_assemble` chain and the forward/
+    # request events become ONE tree across the thread boundary
+    trace_id: str | None = None
+    parent_span: str | None = None
 
     @property
     def n_real(self) -> int:
@@ -358,15 +364,25 @@ class Batcher:
         if self._recorder is not None:
             # span names documented in telemetry/recorder.py: `queue` is
             # the head request's wait (the latency the deadline bounds),
-            # `batch_assemble` the host-side padding cost
-            self._recorder.event(
+            # `batch_assemble` the host-side padding cost. The cut roots
+            # a TRACE: queue -> batch_assemble here, then the replica
+            # thread's forward/compile/request events join the tree
+            # through the Batch's correlation handoff fields.
+            rec = self._recorder
+            batch.trace_id = f"b{next(_req_counter)}"
+            q_sid = rec.new_span_id()
+            a_sid = rec.new_span_id()
+            batch.parent_span = a_sid
+            rec.event(
                 "span", name="queue", ok=True,
                 seconds=round(batch.t_cut - group[0].t_enqueue, 6),
-                n_requests=len(group))
-            self._recorder.event(
+                n_requests=len(group), trace_id=batch.trace_id,
+                span_id=q_sid)
+            rec.event(
                 "span", name="batch_assemble", ok=True,
                 seconds=round(batch.assemble_seconds, 6),
-                bucket=list(batch.bucket.key()), n_real=batch.n_real)
+                bucket=list(batch.bucket.key()), n_real=batch.n_real,
+                trace_id=batch.trace_id, span_id=a_sid, parent_id=q_sid)
         return batch
 
     def requeue(self, requests) -> None:

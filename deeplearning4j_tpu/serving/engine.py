@@ -128,6 +128,17 @@ class _Replica:
             r.done.set()
 
     def run_batch(self, batch: Batch, *, clock, sequence: bool) -> None:
+        # the cross-thread correlation handoff: the batcher rooted this
+        # batch's trace (queue -> batch_assemble on the dispatcher
+        # thread); everything this replica thread emits for it —
+        # forward, nested compile, the per-request events — joins that
+        # tree (telemetry/recorder.py; warmup batches carry no trace
+        # and the context is a no-op)
+        with self.recorder.trace(batch.trace_id,
+                                 parent_id=batch.parent_span):
+            self._run_batch(batch, clock=clock, sequence=sequence)
+
+    def _run_batch(self, batch: Batch, *, clock, sequence: bool) -> None:
         rec = self.recorder
         self.current_batch = batch
         self.last_beat = clock()
@@ -746,7 +757,17 @@ class _GenWorker:
 
     # ----------------------------------------------------------- compute
     def _run_prefill_chunk_bucketed(self, slot_idx: int, clock) -> None:
-        """One bucket-shaped prompt chunk for one slot. The argument
+        """One bucket-shaped prompt chunk for one slot, under the
+        request's trace context — its prefill_chunk spans (and any
+        nested compile) correlate to the request id the final `request`
+        event carries, so a generation's prefill tree reconstructs from
+        the JSONL alone."""
+        req = self.slots.slots[slot_idx].request
+        with self.recorder.trace(req.request_id):
+            self._prefill_chunk_inner(slot_idx, clock)
+
+    def _prefill_chunk_inner(self, slot_idx: int, clock) -> None:
+        """The chunk itself. The argument
         names and the enclosing span keep the G017/G019 contract
         visible: the jit sees only padded bucket arrays, and the only
         host fetch is the one batch-boundary np.asarray of the
@@ -885,6 +906,10 @@ class _GenWorker:
                        error: str | None = None) -> None:
         fields = dict(
             ok=ok, kind="generate", replica=self.index,
+            # the generation trace key: the prefill_chunk spans carry
+            # the same id, so the request's tree joins by trace_id even
+            # though completion happens on the decode path
+            trace_id=req.request_id,
             prompt_len=req.prompt_len,
             prompt_bucket=self.lattice.seq_bucket(req.prompt_len),
             new_tokens=len(req.emitted),

@@ -23,6 +23,15 @@ Endpoints (all JSON):
                         queue are saturated (kvcache.py — exhaustion
                         queues or refuses, never crashes), 404 when the
                         engine has no generation path.
+    GET  /metrics       Prometheus text exposition (version 0.0.4),
+                        backed by the pure-stdlib rolling-histogram
+                        registry (telemetry/metrics.py): request
+                        latency histogram + live p50/p99 (fed straight
+                        off the telemetry `request` event stream, no
+                        log parse on the scrape path), queue depth,
+                        KV page-pool occupancy, published weight
+                        generation/step, per-replica liveness and
+                        heartbeat age — the fleet's pager surface
     GET  /healthz       {"status", "replicas", "lattice", "served", ...,
                         "fleet": [per-replica {index, state (warming/
                         serving/draining/dead/retired), alive, counters,
@@ -96,6 +105,14 @@ class _Handler(BaseHTTPRequestHandler):
             return
         if route == "/stats":
             self._json(engine.stats())
+            return
+        if route == "/metrics":
+            body = self.serving.metrics.render().encode()
+            self.send_response(200)
+            self.send_header("Content-Type", _metrics_mod().CONTENT_TYPE)
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
             return
         self._json({"error": f"unknown path {self.path}"}, 404)
 
@@ -218,6 +235,119 @@ class _Handler(BaseHTTPRequestHandler):
             pass  # client went away mid-stream; the engine finishes anyway
 
 
+def _metrics_mod():
+    from deeplearning4j_tpu.telemetry import metrics
+    return metrics
+
+
+class ServingMetrics:
+    """The /metrics backing store for one engine: a MetricsRegistry
+    whose request-latency histograms are fed LIVE from the telemetry
+    event stream (`Recorder.add_sink` — no log parse, no device sync
+    on the scrape path) and whose fleet gauges (queue depth, page-pool
+    occupancy, weight generation, per-replica liveness) are scraped
+    from `engine.stats()` at collection time."""
+
+    def __init__(self, engine):
+        m = _metrics_mod()
+        self.engine = engine
+        self.registry = m.MetricsRegistry()
+        self.requests = self.registry.counter(
+            "serving_requests_total",
+            "served requests by outcome (ok/error) and kind")
+        self.latency = self.registry.histogram(
+            "serving_request_latency_seconds",
+            "end-to-end request latency (enqueue -> result)")
+        self.queue_wait = self.registry.histogram(
+            "serving_request_queue_seconds",
+            "request wait before its batch cut")
+        self.ttft = self.registry.histogram(
+            "serving_ttft_seconds",
+            "generation time-to-first-token (enqueue -> first token)")
+        self.anomalies = self.registry.counter(
+            "serving_anomalies_total",
+            "anomaly events on the record, by kind (telemetry/trace.py)")
+        self.queue_depth = self.registry.gauge(
+            "serving_queue_depth", "pending requests in the batcher")
+        self.replicas = self.registry.gauge(
+            "serving_replicas", "replica count by lifecycle state")
+        self.replica_up = self.registry.gauge(
+            "serving_replica_up",
+            "1 while the replica is alive and serving traffic")
+        self.replica_beat_age = self.registry.gauge(
+            "serving_replica_last_beat_age_seconds",
+            "seconds since the replica's last heartbeat")
+        self.weight_generation = self.registry.gauge(
+            "serving_weight_generation",
+            "published WeightStore generation (hot-swap flips bump it)")
+        self.weight_step = self.registry.gauge(
+            "serving_weight_step",
+            "training step of the published weight set")
+        self.pool_pages = self.registry.gauge(
+            "serving_page_pool_pages",
+            "KV-cache page pool occupancy (in_use/total/peak)")
+        self.trace_count = self.registry.gauge(
+            "serving_trace_count",
+            "compiled-trace count (frozen after warmup: any growth "
+            "mid-traffic is a retrace)")
+        self.registry.add_collector(self._collect)
+
+    # ------------------------------------------------------- live events
+    def on_event(self, ev: dict) -> None:
+        """The recorder sink: request events feed the latency
+        histograms on the emitting thread; anomaly events bump their
+        counter. Cheap (a few float appends) and exception-contained by
+        the recorder."""
+        kind = ev.get("event")
+        if kind == "request":
+            outcome = "ok" if ev.get("ok") else "error"
+            self.registry.inc(self.requests, 1.0, outcome=outcome,
+                              kind=str(ev.get("kind", "predict")))
+            if "total_s" in ev:
+                self.registry.observe(self.latency, float(ev["total_s"]))
+            if "queue_s" in ev:
+                self.registry.observe(self.queue_wait,
+                                      float(ev["queue_s"]))
+            if "ttft_s" in ev:
+                self.registry.observe(self.ttft, float(ev["ttft_s"]))
+        elif kind == "anomaly":
+            self.registry.inc(self.anomalies, 1.0,
+                              kind=str(ev.get("kind", "unknown")))
+
+    # ---------------------------------------------------------- scraping
+    def _collect(self) -> None:
+        stats = self.engine.stats()
+        self.queue_depth.set(stats.get("queue_depth", 0))
+        self.trace_count.set(stats.get("trace_count", 0))
+        weights = stats.get("weights") or {}
+        self.weight_generation.set(weights.get("generation", 0))
+        self.weight_step.set(weights.get("step", 0))
+        states: dict = {}
+        self.replica_up.clear()
+        self.replica_beat_age.clear()
+        for row in stats.get("fleet", []):
+            states[row["state"]] = states.get(row["state"], 0) + 1
+            idx = str(row.get("index", "?"))
+            up = 1.0 if row.get("alive") and row.get("state") == "serving" \
+                else 0.0
+            self.replica_up.set(up, replica=idx)
+            if "last_beat_age_s" in row:
+                self.replica_beat_age.set(row["last_beat_age_s"],
+                                          replica=idx)
+        self.replicas.clear()
+        for state, n in states.items():
+            self.replicas.set(n, state=state)
+        self.pool_pages.clear()
+        for i, pool in enumerate(stats.get("page_pools", [])):
+            for field in ("pages_in_use", "pages_total", "pages_peak"):
+                if field in pool:
+                    self.pool_pages.set(pool[field], replica=str(i),
+                                        kind=field)
+
+    def render(self) -> str:
+        return self.registry.render()
+
+
 def _argmax_last(out: np.ndarray):
     """Class index/indices over the last axis — the `predict` view of
     the raw output ([V] -> int, [T, V] -> [T] ints)."""
@@ -235,6 +365,12 @@ class ServingServer:
     def __init__(self, engine, port: int = 0, host: str = "127.0.0.1"):
         self.engine = engine
         self.draining = False
+        # the /metrics surface: live latency histograms off the
+        # telemetry stream + fleet gauges scraped from engine.stats()
+        self.metrics = ServingMetrics(engine)
+        recorder = getattr(engine, "recorder", None)
+        if recorder is not None and hasattr(recorder, "add_sink"):
+            recorder.add_sink(self.metrics.on_event)
         self._httpd = ThreadingHTTPServer((host, port), _Handler)
         self._httpd.serving_server = self
         self._thread: Optional[threading.Thread] = None

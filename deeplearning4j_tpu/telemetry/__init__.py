@@ -1,13 +1,18 @@
-"""Run telemetry: typed JSONL event recording for training and bench.
+"""Run telemetry: typed JSONL event recording for training and bench,
+plus the fleet-wide trace timeline built on top of it.
 
-`recorder` (Recorder/span API, process default) and `artifact` (bench
-summary/parsing) are stdlib-only and import eagerly; `TelemetryListener`
-pulls in the listener protocol and resolves lazily so the tools' no-jax
-package stubs can import this package.
+`recorder` (Recorder/span API, correlation fields, process default) and
+`artifact` (bench summary/parsing) are stdlib-only and import eagerly;
+`trace` (shard merge / span stats / anomaly detection / Perfetto
+export) and `metrics` (the Prometheus /metrics registry) are
+stdlib-only too and resolve lazily alongside `TelemetryListener` so
+the tools' no-jax package stubs can import this package.
 """
 
 from deeplearning4j_tpu.telemetry.recorder import (  # noqa: F401
     ENV_VAR,
+    EVENT_KINDS,
+    SPAN_NAMES,
     NullRecorder,
     Recorder,
     get_default,
@@ -19,4 +24,8 @@ def __getattr__(name):
     if name == "TelemetryListener":
         from deeplearning4j_tpu.telemetry.listener import TelemetryListener
         return TelemetryListener
+    if name in ("trace", "metrics"):
+        import importlib
+        return importlib.import_module(
+            f"deeplearning4j_tpu.telemetry.{name}")
     raise AttributeError(name)

@@ -46,9 +46,19 @@ SUMMARY_BOOKKEEPING = {"metric", "value", "unit", "vs_baseline",
 def read_artifact_text(path: str) -> str:
     """File -> raw metric-line text. Accepts bench.py stdout (JSONL),
     a telemetry log, or the driver's wrapper object whose `tail` field
-    holds the captured stdout."""
-    with open(path) as fh:
-        text = fh.read()
+    holds the captured stdout.
+
+    Sharded inputs: a multi-process fleet leaves `<path>.pN` shards and
+    often NO unsuffixed file (telemetry/recorder._process_scoped) —
+    when `path` is absent, the shards are read and concatenated in
+    process order instead (JSONL concatenation is parse-equivalent to
+    one shared log; the committed `telemetry_bench.jsonl.p0/.p1` pair
+    is the fixture)."""
+    try:
+        with open(path) as fh:
+            text = fh.read()
+    except FileNotFoundError:
+        text = _read_shards(path)
     try:
         wrapper = json.loads(text)
         if isinstance(wrapper, dict) and "tail" in wrapper:
@@ -56,6 +66,30 @@ def read_artifact_text(path: str) -> str:
     except json.JSONDecodeError:
         pass
     return text
+
+
+def _read_shards(path: str) -> str:
+    """Concatenated `<path>.p*` shard text, numeric process order.
+    Raises the original FileNotFoundError shape when no shards exist
+    either."""
+    import glob as _glob
+    import re as _re
+
+    shards = []
+    for cand in _glob.glob(_glob.escape(path) + ".p*"):
+        m = _re.match(r"\.p(\d+)$", cand[len(path):])
+        if m:
+            shards.append((int(m.group(1)), cand))
+    if not shards:
+        raise FileNotFoundError(
+            f"no artifact at {path} (and no {path}.p* shards)")
+    parts = []
+    for _, shard in sorted(shards):
+        with open(shard) as fh:
+            text = fh.read()
+        parts.append(text if text.endswith("\n") or not text
+                     else text + "\n")
+    return "".join(parts)
 
 
 def parse_metric_lines(text: str):

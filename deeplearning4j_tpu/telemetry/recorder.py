@@ -32,6 +32,29 @@ Event schema — one JSON object per line, every event carrying
 | `host_gather` | a full-value host materialization of genuinely SHARDED leaves (util/orbax_checkpoint.host_materialize): `n_leaves`, `bytes` — resharded restore paths must show ZERO of these (asserted by the elastic timeline test) |
 | `weight_swap` | one live hot-swap attempt (serving/fleet.hot_swap): `ok`, `step` (the checkpoint step restored), `restore_ms` (shadow-net restore + validation, all OFF the request path), `generation` (the WeightStore generation after a flip / still serving after a rejection), `error` on rejection — paired with the `weight_gen` field every serving `request` event carries, the flip's visibility in the traffic record |
 | `autoscale` | one fleet-supervisor autoscale tick (serving/fleet.FleetSupervisor): `n_serving`, `n_replicas`, `queue_depth`, `p99_ms` (the decision inputs), `action` (+1 grew / -1 drained / 0), `max_replicas` — the occupancy bench row's only source; replica self-healing rides `fault` events (`replica-kill`/`replica-hang` when an injected fault fires, `replica-dead` with the requeued count when the supervisor reaps, `replica-respawn` with `respawn_ms` on re-admission) |
+| `anomaly` | one detector finding (telemetry/trace.py) put on the record by whoever ran the detector — the elastic supervisor's straggler watch, `tracetool check`, or the bench sweep: `kind` ("straggler" / "retrace" / "input_wait_spike" / "queue_spike"), `process`, and the kind's evidence fields (`step`+`skew_ms` for stragglers, the offending span's name/seconds for retraces and spikes) |
+
+**Correlation fields** (the fleet-timeline contract, tools/tracetool.py):
+every event MAY carry `trace_id` / `span_id` / `parent_id`. `span()`
+allocates a fresh `span_id` per region and stamps `parent_id` from the
+thread-local span stack, so nested spans (`forward` → `compile`) become
+real trees without caller plumbing; `trace(trace_id, parent_id=...)`
+installs a thread-local trace context so work handed across threads
+(batch cut on the dispatcher, forward on a replica) stays one tree —
+the serving batcher roots a trace per cut batch (`queue` →
+`batch_assemble` → `forward`/`request`), generation requests trace by
+their request id, and `step` events carry `trace_id: "step-<n>"` so the
+SAME global step correlates across fleet processes by id join. Events
+emitted outside any context carry no correlation fields (the process
+run id is the implicit root).
+
+**Registered schema** (graftlint G023): `EVENT_KINDS` and `SPAN_NAMES`
+below are the ONLY event kinds / span names code outside `telemetry/`
+may emit as string literals — an unknown literal is a lint finding, so
+the fleet-timeline tooling (merge, stats, anomaly detection, Perfetto
+export) never meets a name it cannot classify. Dynamic names
+(f-strings like the bench sweep's `mode:<name>` spans) are exempt from
+the static check and parse as opaque spans.
 
 Generation serving adds two hot-loop span names: `prefill_chunk` (one
 bucket-shaped prompt chunk — `bucket`, `start`, `final`, `replica`) and
@@ -72,11 +95,42 @@ import io
 import json
 import os
 import sys
+import threading
 import time
 import traceback as _tb
 from collections import deque
 
 ENV_VAR = "DL4J_TPU_TELEMETRY"
+
+# ------------------------------------------------------ registered schema
+# The closed set of event kinds and span names the package emits —
+# graftlint G023 holds every string-literal `event("...")`/`span("...")`
+# outside telemetry/ to these sets, so the fleet-timeline tooling
+# (telemetry/trace.py) can classify every record it merges. New kinds
+# and names are REGISTERED HERE first, alongside their docstring row.
+EVENT_KINDS = frozenset({
+    "meta", "step", "span", "metric", "eval", "memory", "error", "fault",
+    "bucket_plan", "kernel_tune", "request", "page_pool", "reshard_plan",
+    "placement_search", "host_gather", "weight_swap", "autoscale",
+    "anomaly",
+})
+
+SPAN_NAMES = frozenset({
+    # compile/step spine (nn/, bench)
+    "compile", "step_scan", "profiler_trace",
+    # serving batch pipeline (serving/batcher.py, engine.py)
+    "queue", "batch_assemble", "forward", "prefill_chunk", "decode_step",
+    "drain",
+    # input pipeline (data/pipeline.py)
+    "input_wait",
+    # resharding + placement (reshard/)
+    "reshard",
+    # distributed runtime + elastic recovery (distributed/)
+    "distributed_init", "distributed_launch", "elastic_generation",
+    "elastic_resume",
+    # bench harness (bench.py)
+    "bucket_reduce", "bucket_reduce_capped", "overlap_sweep", "ab_repeat",
+})
 
 # Ring-buffer length for the in-memory mirror of emitted events; large
 # enough for a full bench sweep, bounded so a long fit() can't grow RSS.
@@ -94,7 +148,58 @@ class Recorder:
         self.run_id = run_id or f"{os.getpid():x}-{int(time.time()):x}"
         self.events: deque[dict] = deque(maxlen=keep)
         self._seq = 0
+        self._span_seq = 0
         self._fh: io.TextIOBase | None = None
+        # thread-local correlation context: the current trace id and the
+        # open-span stack (span_id of each enclosing `span()` region on
+        # THIS thread) — cross-thread handoff goes through `trace()`
+        self._tloc = threading.local()
+        # live event sinks (the /metrics registry subscribes here); a
+        # sink failure never poisons the recording path
+        self._sinks: list = []
+
+    # ------------------------------------------------- correlation context
+    def _stack(self) -> list:
+        stack = getattr(self._tloc, "stack", None)
+        if stack is None:
+            stack = self._tloc.stack = []
+        return stack
+
+    def new_span_id(self) -> str:
+        """A process-unique span id (unique within this run; merged
+        timelines key spans by (process, span_id))."""
+        self._span_seq += 1
+        return f"s{self._span_seq:x}"
+
+    @contextlib.contextmanager
+    def trace(self, trace_id: str | None, parent_id: str | None = None):
+        """Install a trace context on THIS thread: events emitted inside
+        carry `trace_id` (and `parent_id` from the span stack —
+        `parent_id` here seeds the stack with a foreign span, the
+        cross-thread handoff: the batcher's `batch_assemble` span parents
+        the replica thread's `forward`). `trace_id=None` is a no-op so
+        un-traced callers (warmup batches) need no branching."""
+        if trace_id is None:
+            yield
+            return
+        prev = getattr(self._tloc, "trace_id", None)
+        self._tloc.trace_id = trace_id
+        stack = self._stack()
+        pushed = parent_id is not None
+        if pushed:
+            stack.append(parent_id)
+        try:
+            yield
+        finally:
+            if pushed and stack and stack[-1] == parent_id:
+                stack.pop()
+            self._tloc.trace_id = prev
+
+    def add_sink(self, fn) -> None:
+        """Subscribe a live event callback (called with each emitted
+        event dict, on the emitting thread). The /metrics registry feeds
+        its rolling histograms through one of these."""
+        self._sinks.append(fn)
 
     # ------------------------------------------------------------- core
     # `kind` is positional-only so a payload field may itself be named
@@ -103,9 +208,22 @@ class Recorder:
         rec = {"event": kind, "ts": round(time.time(), 3),
                "run": self.run_id, "seq": self._seq}
         self._seq += 1
+        # ambient correlation: an active trace()/span() context stamps
+        # its ids unless the caller passed explicit ones
+        trace_id = getattr(self._tloc, "trace_id", None)
+        if trace_id is not None and "trace_id" not in fields:
+            rec["trace_id"] = trace_id
+        stack = getattr(self._tloc, "stack", None)
+        if stack and "parent_id" not in fields and "span_id" not in fields:
+            rec["parent_id"] = stack[-1]
         rec.update(fields)
         self.events.append(rec)
         self._write(rec)
+        for sink in self._sinks:
+            try:
+                sink(rec)
+            except Exception:
+                pass  # a broken sink must never break recording
         return rec
 
     def _write(self, rec: dict) -> None:
@@ -132,6 +250,10 @@ class Recorder:
     def step(self, iteration: int, score=None, **fields) -> dict:
         if score is not None:
             fields["score"] = float(score)
+        # the cross-process correlation key: every fleet member's step N
+        # carries the same trace id, so the merged timeline joins step
+        # completions by id (the straggler detector's input)
+        fields.setdefault("trace_id", f"step-{int(iteration)}")
         return self.event("step", iteration=int(iteration), **fields)
 
     def metric(self, line: dict) -> dict:
@@ -176,6 +298,13 @@ class Recorder:
         (`_write` flushes per line) so the full fault→recovery timeline
         is reconstructable from the JSONL even across SIGKILLs."""
         return self.event("fault", kind=kind, **fields)
+
+    def anomaly(self, kind: str, **fields) -> dict:
+        """An `anomaly` event: one detector finding (telemetry/trace.py)
+        put on the record live — the elastic supervisor's straggler
+        watch emits these on its heartbeat path so a skewing fleet is
+        visible in the journal BEFORE the generation dies."""
+        return self.event("anomaly", kind=kind, **fields)
 
     def kernel_tune(self, kernel: str, key: str, params: dict,
                     seconds: float | None = None, role: str = "candidate",
@@ -232,17 +361,35 @@ class Recorder:
         event with wall-clock `seconds` on exit. The yielded dict can be
         mutated to attach result fields. An exception inside the span
         emits an `error` event (full traceback) plus the span with
-        `ok: false`, then re-raises."""
+        `ok: false`, then re-raises.
+
+        Correlation: the region gets a fresh `span_id`, its `parent_id`
+        is the enclosing open span on this thread (or the foreign parent
+        a `trace()` context seeded), and events emitted INSIDE the
+        region — nested spans, errors, page_pool snapshots — parent to
+        it automatically."""
+        stack = self._stack()
+        parent = fields.pop("parent_id", None) or (stack[-1] if stack
+                                                   else None)
+        sid = fields.pop("span_id", None) or self.new_span_id()
+        ids = {"span_id": sid}
+        if parent is not None:
+            ids["parent_id"] = parent
         t0 = time.perf_counter()
+        stack.append(sid)
         try:
             yield fields
         except BaseException as exc:
             self.error(f"span:{name}", exc=exc)
+            stack.pop()
             self.event("span", name=name, ok=False,
-                       seconds=round(time.perf_counter() - t0, 6), **fields)
+                       seconds=round(time.perf_counter() - t0, 6),
+                       **ids, **fields)
             raise
+        stack.pop()
         self.event("span", name=name, ok=True,
-                   seconds=round(time.perf_counter() - t0, 6), **fields)
+                   seconds=round(time.perf_counter() - t0, 6),
+                   **ids, **fields)
 
 
 class NullRecorder(Recorder):

@@ -15,6 +15,13 @@ Stdlib ThreadingHTTPServer replacement. Endpoints (all JSON):
     GET  /weights|/flow|/activations|/tsne?sid=S  — RENDERED live views
          (self-contained HTML + SVG from ui/views.py, auto-refreshing;
          the reference's in-browser histogram/flow/activation/tsne pages)
+    GET  /timeline                 the fleet trace-timeline view: merged
+         per-process telemetry shards (telemetry/trace.py) rendered as
+         span lanes + per-span p50/p99 + anomaly findings; reads the
+         path given as UiServer(telemetry_path=...) or the
+         DL4J_TPU_TELEMETRY env var
+    GET  /timeline/data            the same merged view as JSON
+         ({processes, span_stats, anomalies})
     POST /nearestneighbors/vectors labelled vectors {labels, vectors}
     POST /nearestneighbors/query   {word, k} → {words, distances}
     GET  /sessions                 list of session ids
@@ -39,7 +46,8 @@ _INDEX_HTML = """<!doctype html>
 <html><head><title>deeplearning4j_tpu UI</title></head>
 <body><h1>deeplearning4j_tpu training UI</h1>
 <p>Views: <a href="/weights">weights</a> | <a href="/flow">flow</a> |
-<a href="/activations">activations</a> | <a href="/tsne">tsne</a>
+<a href="/activations">activations</a> | <a href="/tsne">tsne</a> |
+<a href="/timeline">timeline</a>
 (append ?sid=&lt;session&gt; to pick a session)</p>
 <p>Sessions: <span id="s"></span></p>
 <script>
@@ -111,6 +119,25 @@ class _Handler(BaseHTTPRequestHandler):
         if route == "/tsne":
             self._html(views.tsne_page(storage.get(sid, "tsne"), sid))
             return
+        if route in ("/timeline", "/timeline/data"):
+            timeline, anomalies, source = self.ui.load_timeline()
+            if route == "/timeline":
+                self._html(views.timeline_page(timeline, anomalies,
+                                               source))
+                return
+            from deeplearning4j_tpu.telemetry import trace as trace_mod
+
+            stats = (trace_mod.span_stats(timeline)
+                     if timeline is not None else {})
+            self._json({
+                "source": source,
+                "processes": (timeline.processes if timeline is not None
+                              else []),
+                "span_stats": {f"{p}::{n}": row
+                               for (p, n), row in sorted(stats.items())},
+                "anomalies": anomalies,
+            })
+            return
         for kind in ("weights", "flow", "activations", "tsne"):
             if route == f"/{kind}/data":
                 self._json(self.ui.storage.get(sid, kind) or {})
@@ -156,8 +183,12 @@ class UiServer:
     """The UI server facade (UiServer.getInstance() in the reference;
     here: instantiate + start/stop)."""
 
-    def __init__(self, port: int = 0, host: str = "127.0.0.1"):
+    def __init__(self, port: int = 0, host: str = "127.0.0.1",
+                 telemetry_path: Optional[str] = None):
         self.storage = HistoryStorage()
+        # the fleet-timeline source: explicit path beats the env var;
+        # None leaves /timeline rendering its setup hint
+        self.telemetry_path = telemetry_path
         self._httpd = ThreadingHTTPServer((host, port), _Handler)
         self._httpd.ui_server = self
         self._thread: Optional[threading.Thread] = None
@@ -189,6 +220,26 @@ class UiServer:
         self._httpd.server_close()
         if self._thread:
             self._thread.join(timeout=5)
+
+    # ------------------------------------------------------ fleet timeline
+    def load_timeline(self):
+        """(Timeline|None, anomalies, source) for the /timeline views:
+        merges the `.pN` shards of the configured telemetry path (ctor
+        arg, else DL4J_TPU_TELEMETRY) on every request — the files are
+        append-only JSONL, so a refresh IS the live view."""
+        import os
+
+        from deeplearning4j_tpu.telemetry import trace as trace_mod
+        from deeplearning4j_tpu.telemetry.recorder import ENV_VAR
+
+        path = self.telemetry_path or os.environ.get(ENV_VAR)
+        if not path:
+            return None, [], "unset"
+        try:
+            timeline = trace_mod.load_timeline(path)
+        except (FileNotFoundError, OSError):
+            return None, [], path
+        return timeline, trace_mod.detect_anomalies(timeline), path
 
     # ---------------------------------------------------- nearest neighbors
     def set_vectors(self, labels, vectors) -> None:

@@ -13,6 +13,7 @@ with zero external JS dependencies.
 
 from __future__ import annotations
 
+import json
 from typing import Optional
 
 from .components import (
@@ -122,6 +123,68 @@ def activations_page(history, sid: str) -> str:
     return StaticPageUtil.render_html(
         comps, title=f"activations — session {sid}",
         refresh_seconds=REFRESH_SECONDS)
+
+
+def timeline_page(timeline, anomalies, source: str) -> str:
+    """The fleet trace-timeline view (ISSUE 15): rendered from the
+    MERGED per-process telemetry shards (telemetry/trace.py), not a
+    listener feed — per-process span lanes, the per-(process, span)
+    p50/p99 table, and the anomaly findings table. `timeline` is a
+    trace.Timeline, `anomalies` the detect_anomalies findings."""
+    from deeplearning4j_tpu.telemetry import trace as trace_mod
+
+    comps = []
+    if timeline is None or not timeline.events:
+        comps.append(ComponentText(
+            text="no telemetry yet — start the UI server with "
+                 "telemetry_path= (or set DL4J_TPU_TELEMETRY) and run "
+                 "a fleet"))
+        return StaticPageUtil.render_html(
+            comps, title="fleet timeline",
+            refresh_seconds=REFRESH_SECONDS)
+    procs = timeline.processes
+    comps.append(ComponentText(
+        text=f"{len(timeline.events)} events from {len(procs)} "
+             f"process(es) [{', '.join(procs)}] — source {source}"))
+    # anomaly findings first: the reason a human opens this page
+    if anomalies:
+        rows = [[f.get("anomaly", ""), str(f.get("process", "")),
+                 json.dumps({k: v for k, v in f.items()
+                             if k not in ("anomaly", "process")})]
+                for f in anomalies]
+        comps.append(ComponentTable(
+            header=["anomaly", "process", "evidence"], content=rows))
+    else:
+        comps.append(ComponentText(text="0 anomalies"))
+    # span lanes: one scatter series per span name, x = seconds into
+    # the run, y = process lane index
+    lane = {p: i for i, p in enumerate(procs)}
+    base = min(float(ev.get("ts", 0.0)) for ev in timeline.events)
+    by_name: dict = {}
+    for ev in timeline.spans():
+        by_name.setdefault(str(ev.get("name")), []).append(ev)
+    top = sorted(by_name, key=lambda n: -len(by_name[n]))[:8]
+    chart = ChartScatter(title="span starts by process lane "
+                               "(top span kinds)")
+    for name in top:
+        evs = by_name[name]
+        xs = [float(ev.get("ts", 0.0)) - float(ev.get("seconds", 0.0))
+              - base for ev in evs]
+        ys = [float(lane[ev.get("process", "main")]) for ev in evs]
+        chart.add_series(name, xs, ys)
+    comps.append(chart)
+    stats = trace_mod.span_stats(timeline)
+    rows = [[p, n, str(row["count"]), f"{row['p50_ms']:.3f}",
+             f"{row['p99_ms']:.3f}", f"{row['max_ms']:.3f}"]
+            for (p, n), row in sorted(stats.items())]
+    comps.append(DecoratorAccordion(
+        title="per-span p50/p99 (ms) per process",
+        default_collapsed=False,
+        components=[ComponentTable(
+            header=["process", "span", "count", "p50_ms", "p99_ms",
+                    "max_ms"], content=rows)]))
+    return StaticPageUtil.render_html(
+        comps, title="fleet timeline", refresh_seconds=REFRESH_SECONDS)
 
 
 def tsne_page(payload, sid: str) -> str:

@@ -141,12 +141,20 @@ def test_dropout_seq32768_cfg_is_the_tentpole_config():
     assert cfg["masked"]
 
 
+_REAL_RUN = bench.subprocess.run
+
+
 def _fake_mode_run(argv, env=None, capture_output=True, text=True,
                    timeout=None):
     """Fake subprocess.run for the sweep loop: one clean mode, one
-    deterministic crasher, one wall-clock timeout."""
+    deterministic crasher, one wall-clock timeout. The sweep's OWN
+    tracetool self-audit passes through to the real CLI — the check
+    over the fake sweep's telemetry is part of the contract."""
     import subprocess as sp
     import json as _json
+    if any("tracetool" in str(a) for a in argv):
+        return _REAL_RUN(argv, env=env, capture_output=capture_output,
+                         text=text, timeout=timeout)
     mode = argv[-1]
 
     class Out:
@@ -177,11 +185,15 @@ def test_sweep_classifies_env_failures_off_tpu(monkeypatch, tmp_path):
                                          "slow": None})
     tpath = tmp_path / "tel.jsonl"
     monkeypatch.setenv("DL4J_TPU_TELEMETRY", str(tpath))
+    monkeypatch.setenv("DL4J_TPU_TRACE_ARTIFACT",
+                       str(tmp_path / "TRACE_test.json"))
     try:
         rc = bench._run_all()
     finally:
         set_default(None)
     assert rc == 0
+    # the self-audit rows rode the sweep record (clean run: 0 findings)
+    assert (tmp_path / "TRACE_test.json").exists()
     events = [_json.loads(line) for line in open(tpath)]
     errors = [e for e in events if e["event"] == "error"]
     # full stderr survives in telemetry even though the sweep passed
@@ -209,8 +221,46 @@ def test_sweep_still_fails_on_tpu(monkeypatch, tmp_path):
     monkeypatch.setattr(bench, "_probe_backend", lambda: "tpu")
     monkeypatch.setattr(bench, "MODES", {"ok": None, "crashy": None})
     monkeypatch.setenv("DL4J_TPU_TELEMETRY", str(tmp_path / "tel.jsonl"))
+    monkeypatch.setenv("DL4J_TPU_TRACE_ARTIFACT",
+                       str(tmp_path / "TRACE_test.json"))
     try:
         rc = bench._run_all()
     finally:
         set_default(None)
     assert rc == 1
+
+
+def test_sweep_trace_check_gates_on_fleet_rank_skew(monkeypatch, tmp_path):
+    """ISSUE 15 CI satellite: the sweep audits its own telemetry — a
+    rank-skew (or hang) left in the fleet modes' .pN shards fails the
+    sweep with rc=1 even when every mode exited 0."""
+    import json as _json
+    from deeplearning4j_tpu.telemetry import set_default
+
+    monkeypatch.setattr(bench.subprocess, "run", _fake_mode_run)
+    monkeypatch.setattr(bench, "_probe_backend", lambda: "cpu")
+    monkeypatch.setattr(bench, "MODES", {"ok": None})
+    tpath = tmp_path / "tel.jsonl"
+    monkeypatch.setenv("DL4J_TPU_TELEMETRY", str(tpath))
+    monkeypatch.setenv("DL4J_TPU_TRACE_ARTIFACT",
+                       str(tmp_path / "TRACE_test.json"))
+    # a fleet mode's shard pair with the pN:hang@stepK signature: p1
+    # stops at step 2 while p0 runs on (minutes of silence)
+    for proc, last in (("p0", 6), ("p1", 2)):
+        with open(f"{tpath}.{proc}", "w") as fh:
+            for s in range(1, last + 1):
+                fh.write(_json.dumps(
+                    {"event": "step", "run": proc, "seq": s,
+                     "iteration": s, "ts": 1000.0 + s * 60.0,
+                     "trace_id": f"step-{s}"}) + "\n")
+    try:
+        rc = bench._run_all()
+    finally:
+        set_default(None)
+    assert rc == 1
+    events = [_json.loads(line) for line in open(tpath)]
+    anomalies = [e for e in events if e["event"] == "anomaly"]
+    assert anomalies and anomalies[0]["kind"] == "straggler"
+    skew_rows = [e for e in events if e.get("metric")
+                 == "straggler_skew_ms"]
+    assert skew_rows and skew_rows[-1]["value"] > 0

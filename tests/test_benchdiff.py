@@ -231,6 +231,51 @@ def test_plan_artifact_rows_direction():
     assert chg["new"] == "4x2 (data=data,model=model) p1"
 
 
+def test_trace_artifact_rows_direction():
+    """TRACE artifact rows (tools/tracetool.py stats --artifact): the
+    per-(process, span) p50/p99 rows are lower-is-better via the _ms
+    rule — growth past threshold regresses, improvement is a change —
+    even when the flag was lost to a summary-line reconstruction."""
+    old = _lines(**{
+        "trace_span_p99_ms::p0::forward": {"value": 10.0},
+        "trace_span_p50_ms::p1::decode_step": {"value": 4.0}})
+    worse = _lines(**{
+        "trace_span_p99_ms::p0::forward": {"value": 14.0},
+        "trace_span_p50_ms::p1::decode_step": {"value": 4.0}})
+    result = benchdiff.diff(old, worse, threshold=0.1)
+    (row,) = result["regressions"]
+    assert row["metric"] == "trace_span_p99_ms::p0::forward"
+    assert "lower is better" in row["reason"]
+    better = _lines(**{
+        "trace_span_p99_ms::p0::forward": {"value": 6.0},
+        "trace_span_p50_ms::p1::decode_step": {"value": 4.0}})
+    result = benchdiff.diff(old, better, threshold=0.1)
+    assert result["regressions"] == [] and len(result["changes"]) == 1
+
+
+def test_anomaly_count_and_straggler_skew_regress_on_any_increase():
+    """The detector rows have NO acceptable growth: one new anomaly or
+    a 1% skew increase regresses regardless of threshold (like retraces
+    and rank violations); decreases are plain changes."""
+    old = _lines(trace_anomaly_count={"value": 0.0},
+                 straggler_skew_ms={"value": 100.0})
+    worse = _lines(trace_anomaly_count={"value": 1.0},
+                   straggler_skew_ms={"value": 101.0})
+    result = benchdiff.diff(old, worse, threshold=0.5)
+    assert {r["metric"] for r in result["regressions"]} == {
+        "trace_anomaly_count", "straggler_skew_ms"}
+    # a sub-threshold skew increase still regresses (any-increase rule)
+    assert all("grew" in r["reason"] for r in result["regressions"])
+    better = _lines(trace_anomaly_count={"value": 0.0},
+                    straggler_skew_ms={"value": 50.0})
+    result = benchdiff.diff(old, better, threshold=0.5)
+    assert result["regressions"] == []
+    # nonzero -> bigger nonzero anomaly count also regresses
+    old2 = _lines(trace_anomaly_count={"value": 10.0})
+    new2 = _lines(trace_anomaly_count={"value": 11.0})
+    assert benchdiff.diff(old2, new2, threshold=0.5)["regressions"]
+
+
 def test_serve_recompiles_rising_from_zero_always_regress():
     """A retrace count has no ratio base at 0 — ANY rise means the
     bucket lattice leaked and must trip regardless of threshold."""

@@ -410,6 +410,16 @@ def test_iter_prefetched_records_input_wait_spans():
                   and e.get("name") == "input_wait"]
     assert len(sync_spans) == 4
     assert not any(s["pipelined"] for s in sync_spans)
+    # the happy path is anomaly-free: the fleet-timeline detector over
+    # BOTH arms' telemetry finds no input_wait spike (ISSUE 15 — the
+    # INPUT replay's zero-anomaly gate; the sync arm's whole-conversion
+    # spans are exempt by design)
+    from deeplearning4j_tpu.telemetry import trace as trace_mod
+
+    for r in (rec, sync_rec):
+        findings = trace_mod.detect_anomalies(
+            trace_mod.timeline_from_events(r.events))
+        assert findings == [], findings
 
 
 def test_prefetch_depth_resolution_chain(monkeypatch):

@@ -567,6 +567,27 @@ def parsed(net, make_mesh, axes):
     opts = {"data": "d.csv"}               # a non-mesh dict is silent
     return opts
 """),
+    ("G023", """\
+def run(rec):
+    with rec.span("my_invented_phase"):       # unregistered span name
+        pass
+    rec.event("telemetry_blob", x=1)          # unregistered event kind
+    rec.event("span", name="custom_region",   # unregistered via name=
+              ok=True, seconds=0.0)
+""", """\
+def run(rec, m, mode):
+    with rec.span("compile", what="fit_scanned"):   # registered name
+        pass
+    rec.event("fault", kind="reform")               # registered kind
+    rec.event("span", name="bucket_reduce",         # registered name=
+              ok=True, seconds=0.0)
+    rec.event("anomaly", kind="straggler")          # the detector kind
+    a, b = m.span(0)              # non-string first arg (re.Match.span)
+    name = "dynamic"
+    rec.span(name)                # variable names are uncheckable
+    with rec.span(f"mode:{mode}"):  # f-strings parse as opaque spans
+        pass
+"""),
 ]
 
 
@@ -591,7 +612,7 @@ def test_rule_fires_on_positive_not_negative(rule, pos, neg):
 
 def test_every_rule_has_fixture_coverage():
     assert {r for r, _, _ in FIXTURES} == set(RULE_DOCS) == {
-        f"G{i:03d}" for i in range(1, 23)}
+        f"G{i:03d}" for i in range(1, 24)}
 
 
 def test_g015_blessed_sites_are_exempt():
@@ -740,6 +761,43 @@ def test_g022_user_facing_layers_sweep_clean():
     new, _old = lint_report(targets, load_baseline(BASELINE), root=ROOT)
     hits = [f for f in new if f.rule == "G022"]
     assert not hits, "G022 findings in user-facing layers:\n" + "\n".join(
+        f.format() for f in hits)
+
+
+def test_g023_scope_and_registry():
+    """G023 holds everywhere EXCEPT telemetry/ (the registry is the
+    blessed home of new kinds/names), checks the `event("span",
+    name=...)` spelling, and the whole package + bench.py + tools sweep
+    clean — every literal the code emits is registered."""
+    _, pos, neg = next(f for f in FIXTURES if f[0] == "G023")
+    hits = [f for f in lint_source(_PRELUDE + pos, FIXTURE_PATH)
+            if f.rule == "G023"]
+    assert len(hits) == 3  # span literal + event kind + name= kwarg
+    # the registry itself is exempt: the same source is silent there
+    assert "G023" not in rules_in(
+        pos, "deeplearning4j_tpu/telemetry/recorder.py")
+    assert "G023" not in rules_in(
+        pos, "deeplearning4j_tpu/telemetry/trace.py")
+    # in scope across the package AND outside it (bench.py, tools/)
+    assert "G023" in rules_in(pos, "deeplearning4j_tpu/serving/engine.py")
+    assert "G023" in rules_in(pos, "bench.py")
+    # the registered sets ARE the recorder's: a name added to the
+    # registry immediately stops flagging
+    from deeplearning4j_tpu.telemetry.recorder import (EVENT_KINDS,
+                                                       SPAN_NAMES)
+    assert "compile" in SPAN_NAMES and "anomaly" in EVENT_KINDS
+    assert "my_invented_phase" not in SPAN_NAMES
+
+
+def test_g023_whole_surface_sweeps_clean():
+    """Every telemetry literal the repo emits — package, bench.py,
+    examples/, and the tools — is in the registered schema."""
+    targets = [PKG, os.path.join(ROOT, "bench.py"),
+               os.path.join(ROOT, "examples"),
+               os.path.join(ROOT, "tools")]
+    new, _old = lint_report(targets, load_baseline(BASELINE), root=ROOT)
+    hits = [f for f in new if f.rule == "G023"]
+    assert not hits, "unregistered telemetry names:\n" + "\n".join(
         f.format() for f in hits)
 
 

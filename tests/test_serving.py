@@ -7,6 +7,7 @@ scoreboard reconstruction behind tools/trafficreplay.py."""
 
 import json
 import os
+import threading
 import urllib.request
 
 import numpy as np
@@ -259,6 +260,90 @@ def test_server_rejects_malformed_and_oversized(mlp_stack):
     assert e.value.code == 400
 
 
+def test_metrics_endpoint_round_trip_under_concurrent_requests(mlp_stack):
+    """GET /metrics serves Prometheus text exposition format while
+    /predict traffic runs concurrently — zero failed requests on either
+    side, and the scraped series agree with the engine's own counters
+    (ISSUE 15 acceptance: /metrics mid-replay)."""
+    from deeplearning4j_tpu.telemetry.metrics import (CONTENT_TYPE,
+                                                      parse_exposition)
+
+    _, engine, server, _ = mlp_stack
+    rng = np.random.default_rng(11)
+    failures = []
+    scrapes = []
+
+    def client(i):
+        try:
+            _post(server.url, {"features": rng.normal(size=8).tolist()})
+        except Exception as exc:  # noqa: BLE001 - recorded, not raised
+            failures.append(exc)
+
+    def scraper():
+        try:
+            with urllib.request.urlopen(f"{server.url}/metrics",
+                                        timeout=10) as r:
+                assert r.status == 200
+                assert r.headers["Content-Type"] == CONTENT_TYPE
+                scrapes.append(r.read().decode())
+        except Exception as exc:  # noqa: BLE001
+            failures.append(exc)
+
+    threads = [threading.Thread(target=client, args=(i,))
+               for i in range(12)]
+    threads += [threading.Thread(target=scraper) for _ in range(4)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=30)
+    assert not failures, failures
+    # the final scrape reflects every completed request
+    with urllib.request.urlopen(f"{server.url}/metrics", timeout=10) as r:
+        parsed = parse_exposition(r.read().decode())
+    assert parsed["serving_request_latency_seconds_count"] >= 12
+    assert parsed['serving_requests_total{kind="predict",outcome="ok"}'] \
+        >= 12
+    assert parsed["serving_weight_generation"] == \
+        engine.weights.generation
+    assert parsed['serving_replica_up{replica="0"}'] == 1.0
+    assert parsed["serving_request_latency_seconds_p99"] >= \
+        parsed["serving_request_latency_seconds_p50"] >= 0
+    # exposition shape: every histogram has its +Inf bucket and
+    # bucket counts are monotone in le
+    text = scrapes[-1]
+    assert 'serving_request_latency_seconds_bucket{le="+Inf"}' in text
+
+
+def test_request_span_tree_reconstructs_from_telemetry(mlp_stack):
+    """The correlation contract end to end on the REAL engine: a served
+    request's telemetry joins one trace — queue -> batch_assemble ->
+    {forward, request} — reconstructable as a tree from the recorder's
+    events alone (ISSUE 15: request chains become real trees)."""
+    from deeplearning4j_tpu.telemetry import trace as trace_mod
+
+    net, engine, server, rec = mlp_stack
+    out = engine.predict(np.zeros(8, np.float32), timeout=30)
+    assert out is not None
+    reqs = [e for e in rec.events if e.get("event") == "request"]
+    assert reqs and reqs[-1].get("trace_id"), \
+        "request events must carry their batch's trace id"
+    tid = reqs[-1]["trace_id"]
+    tl = trace_mod.timeline_from_events(rec.events)
+    roots = trace_mod.span_tree(tl, tid)
+    assert len(roots) == 1
+    root = roots[0]
+    assert root["event"]["name"] == "queue"
+    assert len(root["children"]) == 1
+    assemble = root["children"][0]
+    assert assemble["event"]["name"] == "batch_assemble"
+    kinds = {c["event"].get("name") or c["event"]["event"]
+             for c in assemble["children"]}
+    assert "forward" in kinds and "request" in kinds
+    # every event of the trace shares the trace id
+    members = [e for e in tl.events if e.get("trace_id") == tid]
+    assert len(members) >= 4
+
+
 # ------------------------------------------------- zero-retrace promise
 
 def test_zero_recompiles_after_warmup_across_mixed_lengths():
@@ -404,6 +489,12 @@ def test_end_to_end_replay_truncation_proof(tmp_path):
                    "serving_replay_p99_ms",
                    "serving_replay_recompiles_after_warmup"):
         assert recovered[metric]["value"] == full[metric]["value"]
+    # the happy path is anomaly-free: the fleet-timeline detector over
+    # the same telemetry finds no retrace, no straggler, no spike
+    from deeplearning4j_tpu.telemetry import trace as trace_mod
+
+    findings = trace_mod.detect_anomalies(trace_mod.load_timeline(tpath))
+    assert findings == [], findings
 
 
 # ---------------------------------------------------------------- CLI

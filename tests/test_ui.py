@@ -125,8 +125,47 @@ class TestServer:
         with urllib.request.urlopen(server.url, timeout=10) as r:
             body = r.read().decode()
         assert "deeplearning4j_tpu" in body
-        for view in ("/weights", "/flow", "/activations", "/tsne"):
+        for view in ("/weights", "/flow", "/activations", "/tsne",
+                     "/timeline"):
             assert f'href="{view}"' in body
+
+    def test_timeline_view_renders_merged_shards(self, tmp_path):
+        """The fleet-timeline page (ISSUE 15): a UI server pointed at a
+        sharded telemetry path renders the merged per-process view —
+        span stats, lanes, anomaly table — and /timeline/data serves
+        the same as JSON."""
+        import json as _json
+
+        base = str(tmp_path / "t.jsonl")
+        for p, run in (("p0", "a"), ("p1", "b")):
+            with open(f"{base}.{p}", "w") as fh:
+                fh.write(_json.dumps(
+                    {"event": "span", "name": "compile", "run": run,
+                     "seq": 0, "ts": 1.0, "seconds": 0.5}) + "\n")
+                fh.write(_json.dumps(
+                    {"event": "step", "run": run, "seq": 1,
+                     "iteration": 1, "ts": 2.0,
+                     "trace_id": "step-1"}) + "\n")
+        srv = UiServer(port=0, telemetry_path=base).start()
+        try:
+            with urllib.request.urlopen(f"{srv.url}/timeline",
+                                        timeout=10) as r:
+                body = r.read().decode()
+            assert "fleet timeline" in body
+            assert "p0" in body and "p1" in body
+            assert "0 anomalies" in body
+            data = _get(f"{srv.url}/timeline/data")
+            assert data["processes"] == ["p0", "p1"]
+            assert data["span_stats"]["p0::compile"]["p50_ms"] == 500.0
+            assert data["anomalies"] == []
+        finally:
+            srv.stop()
+
+    def test_timeline_view_without_source_renders_hint(self, server,
+                                                       monkeypatch):
+        monkeypatch.delenv("DL4J_TPU_TELEMETRY", raising=False)
+        body = self._get_html(f"{server.url}/timeline")
+        assert "no telemetry yet" in body
 
     def _get_html(self, url):
         with urllib.request.urlopen(url, timeout=10) as r:

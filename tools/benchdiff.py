@@ -39,6 +39,14 @@ change; and `predicted_rank_violations` regresses on ANY increase
 (like retraces — the cost model ordered a confidently-separated pair
 against the measurement).
 
+TRACE artifacts (tools/tracetool.py stats --artifact — the merged
+fleet timeline) diff the same way: per-(process, span) latency rows
+(`trace_span_p50_ms::...`/`trace_span_p99_ms::...`) are
+lower-is-better via the `_ms` rule, and the detector rows
+`anomaly_count` / `straggler_skew_ms` regress on ANY increase — an
+anomaly appearing, or the fleet's step skew growing at all, is never
+an improvement.
+
 What counts as a regression (bench metrics are higher-is-better unless
 flagged lower-is-better as above):
 
@@ -82,14 +90,17 @@ _LOWER_IS_BETTER_RE = re.compile(
     r"(_p\d+_ms$|_ms$|latency|recompiles|bytes_moved$|bytes_lower_bound$"
     r"|_us$|_ttft_|occupancy|input_wait|failed_requests$"
     r"|plan_predicted|plan_winner|plan_score|plan_measured"
-    r"|rank_violations$)")
+    r"|rank_violations$|anomaly_count$|trace_span_)")
 
 # Metrics where ANY growth regresses regardless of threshold: a
 # predicted-vs-measured rank violation (PLAN artifacts, bench.py
 # placement_search) means the cost model confidently ordered a pair
 # against the measurement — like a retrace count, there is no
-# acceptable increase.
-_ALWAYS_REGRESS_RE = re.compile(r"rank_violations$")
+# acceptable increase. TRACE artifacts add the detector rows: one new
+# anomaly, or any growth in the fleet's step-completion skew, is a
+# health regression however small the percentage.
+_ALWAYS_REGRESS_RE = re.compile(
+    r"(rank_violations$|anomaly_count$|straggler_skew_ms$)")
 
 
 def _lower_is_better(metric: str, old: dict, new: dict) -> bool:

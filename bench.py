@@ -1315,6 +1315,43 @@ def bench_serving_generate() -> None:
                 "client_failed": scoreboard["client"]["failed"]})
 
 
+def bench_serving_speculative() -> None:
+    """Decode raw-speed serving bench (serving/replay.py
+    run_speculative_replay): three A/B-interleaved arms of the same
+    seeded generation trace — baseline greedy decode, speculative
+    decode (n-gram draft + one fixed-shape verify step per window), and
+    the int8-quantized paged KV cache — each against its own freshly
+    warmed engine. Headlines: `accepted_tokens_per_step` (median tokens
+    emitted per verify step per active slot; > 1.0 means drafts paid
+    off), `draft_overhead_us` and `sample_us` (lower), the
+    slots-per-HBM-byte ratio of the int8 cache, and the two PARITY
+    gates — speculative and quantized greedy token streams must match
+    the baseline arm request-for-request (0 mismatches), on top of the
+    standing zero-retrace row per arm. The SERVE_r04 artifact lands
+    next to the BENCH one; the round gate is benchdiff vs the previous
+    r04 artifact."""
+    import tempfile
+
+    from deeplearning4j_tpu.serving.replay import run_speculative_replay
+
+    here = os.path.dirname(os.path.abspath(__file__))
+    artifact = os.environ.get(
+        "DL4J_TPU_SERVE_SPEC_ARTIFACT", os.path.join(here,
+                                                     "SERVE_r04.json"))
+    tpath = os.path.join(tempfile.mkdtemp(prefix="serving_speculative_"),
+                         "telemetry.jsonl")
+    scoreboard = run_speculative_replay(
+        seed=0, n_requests=24, burst=2, mean_gap_s=0.004,
+        prompt_lengths=(8, 16, 32), output_lengths=(4, 8, 16),
+        slots=4, page_size=16, speculative_k=4, repeats=2,
+        telemetry_path=tpath, artifact_path=artifact, emit=_emit_info)
+    _emit_info({"metric": "serving_speculative_artifact", "path": artifact,
+                "n_ok": scoreboard["n_ok"],
+                "parity_mismatches": scoreboard["parity_mismatches"],
+                "slots_per_hbm_byte_x": scoreboard["slots_per_hbm_byte_x"],
+                "repeats": scoreboard["repeats"]})
+
+
 def bench_input_pipeline() -> None:
     """Async input-pipeline bench (data/bench_worker.py) on the 2x4
     fleet matrix: a 2-process x 4-virtual-device fleet trains the same
@@ -1521,6 +1558,7 @@ MODES = {
     "ringhop": bench_ringhop,
     "serving_replay": bench_serving_replay,
     "serving_generate": bench_serving_generate,
+    "serving_speculative": bench_serving_speculative,
     "input_pipeline": bench_input_pipeline,
     "placement_search": bench_placement_search,
 }

@@ -1309,6 +1309,80 @@ def g023_unregistered_telemetry_names(tree, imports, path):
     return out
 
 
+# --------------------------------------------------------------- G024
+
+# Sampling discipline (serving/ only) — the sampling-side twin of
+# G019's host-sync half. Token selection belongs ON DEVICE in the one
+# fused kernel (ops/fused_sampling.fused_sample: temperature, top-k,
+# top-p and the gumbel argmax in a single pass, f32 accumulation).
+# Host-side sampling inside a decode loop — an `np.random.*` /
+# `random.*` draw, or an `argsort` / `cumsum` over fetched logits to
+# rebuild top-k/top-p by hand — ships the [slots, vocab] logit matrix
+# to the host EVERY STEP and reorders the vocab in numpy: at decode
+# rates that is the pipeline's largest avoidable transfer, and the
+# hand-rolled filter drifts from the kernel's tie-breaking.
+_G024_HOST_RNG_PREFIXES = ("numpy.random.", "random.")
+_G024_SORTISH_ATTRS = frozenset({"argsort", "cumsum"})
+_G024_SORTISH_CALLS = frozenset({"numpy.argsort", "numpy.cumsum"})
+_G024_LOGITSISH = re.compile(r"logit|prob|score", re.IGNORECASE)
+
+
+def g024_host_sampling(tree, imports, path):
+    """Host-side sampling in decode loops (serving/ files only): inside
+    a for-loop whose target or iterable mentions token-ish names
+    (token/tok/decode), flag `np.random.*` / `random.*` draws and
+    `argsort`/`cumsum` calls over logits-ish values (logit/prob/score).
+    The blessed path is ops/fused_sampling.fused_sample — one fused
+    on-device kernel per step, with host code handling only the
+    returned token ids."""
+    norm = path.replace("\\", "/")
+    if "/serving/" not in norm:
+        return []
+    out = []
+    for loop in ast.walk(tree):
+        if not isinstance(loop, (ast.For, ast.AsyncFor)):
+            continue
+        if not (_g017_mentions(loop.target, _G019_TOKENISH)
+                or _g017_mentions(loop.iter, _G019_TOKENISH)):
+            continue
+        for node in ast.walk(loop):
+            if not isinstance(node, ast.Call):
+                continue
+            name = imports.canon(node.func) or ""
+            if name.startswith(_G024_HOST_RNG_PREFIXES):
+                out.append(("G024", node,
+                            "host RNG draw inside a decode loop: token "
+                            "selection off-device means a per-step "
+                            "logit fetch and numpy-side sampling that "
+                            "drifts from the kernel's tie-breaking",
+                            "sample on device via ops/fused_sampling."
+                            "fused_sample (temperature/top-k/top-p in "
+                            "one kernel; gumbel noise from a split PRNG "
+                            "key) and distribute the returned ids"))
+                continue
+            sortish = name in _G024_SORTISH_CALLS or (
+                isinstance(node.func, ast.Attribute)
+                and node.func.attr in _G024_SORTISH_ATTRS)
+            if not sortish:
+                continue
+            over_logits = any(
+                _g017_mentions(arg, _G024_LOGITSISH)
+                for arg in list(node.args)
+                + [kw.value for kw in node.keywords]) or (
+                isinstance(node.func, ast.Attribute)
+                and _g017_mentions(node.func.value, _G024_LOGITSISH))
+            if over_logits:
+                out.append(("G024", node,
+                            "host-side top-k/top-p reconstruction "
+                            "(argsort/cumsum over logits) inside a "
+                            "decode loop: the [slots, vocab] matrix "
+                            "crosses to the host every step",
+                            "filter on device via ops/fused_sampling."
+                            "fused_sample — its top-k/top-p masking "
+                            "runs in the same kernel as the sample"))
+    return out
+
+
 # stage-3 AST rules (G010-G014) live in spmd_rules.py and register here;
 # the import sits below every helper they borrow lazily, so importing
 # either module first resolves cleanly.
@@ -1326,7 +1400,8 @@ ALL_RULES = [g001_traced_bool, g002_host_sync, g003_float64_drift,
              g020_sync_input_in_step_loop,
              g021_weight_swap_path,
              g022_handrolled_placement,
-             g023_unregistered_telemetry_names] + SPMD_RULES
+             g023_unregistered_telemetry_names,
+             g024_host_sampling] + SPMD_RULES
 
 RULE_DOCS = {
     "G001": "python control flow / bool()/float()/int() on traced values",
@@ -1366,6 +1441,11 @@ RULE_DOCS = {
             "outside telemetry/ that is not in the registered schema "
             "(recorder.py EVENT_KINDS/SPAN_NAMES) — the fleet-timeline "
             "tooling cannot classify such records",
+    "G024": "sampling discipline: host-side token sampling "
+            "(np.random/random draws, argsort/cumsum over logits) "
+            "inside decode loops in serving/ — token selection belongs "
+            "in the fused on-device kernel "
+            "(ops/fused_sampling.fused_sample)",
     **SPMD_RULE_DOCS,
 }
 

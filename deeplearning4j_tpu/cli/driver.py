@@ -206,6 +206,17 @@ def _build_parser() -> argparse.ArgumentParser:
     sv.add_argument("--prefill-chunk", type=int, default=None,
                     help="prompt chunk length for interleaved prefill "
                          "(a lattice seq bucket; default: the largest)")
+    sv.add_argument("--speculative-k", type=int, default=0, metavar="K",
+                    help="speculative decode window width (0 = off; "
+                         ">= 2: an n-gram proposer drafts K-1 tokens "
+                         "per slot and ONE fixed-shape verify step "
+                         "checks the window — greedy output stays "
+                         "bit-identical, accepted drafts cut steps)")
+    sv.add_argument("--kv-dtype", choices=["f32", "int8"], default="f32",
+                    help="KV-cache storage dtype: int8 stores "
+                         "per-page-scale quantized pages (~4x more "
+                         "decode slots per HBM byte, greedy-parity "
+                         "gated in the serving bench)")
     sv.add_argument("--watch-checkpoint", action="store_true",
                     help="fleet operations: keep watching --checkpoint "
                          "for newly committed steps and hot-swap each "
@@ -688,6 +699,7 @@ def _cmd_serve(args) -> int:
             max_new_tokens=args.max_new_tokens,
             page_size=args.page_size,
             prefill_chunk=args.prefill_chunk,
+            speculative_k=args.speculative_k, kv_dtype=args.kv_dtype,
             replicas=args.replicas, checkpoint=args.checkpoint,
             faults=args.chaos)
         n = engine.warmup()
@@ -729,6 +741,10 @@ def _cmd_serve(args) -> int:
           f"max-wait={args.max_wait_ms}ms"
           + (f", generate-slots={args.generate_slots}"
              if args.generate_slots > 0 else "")
+          + (f", speculative-k={args.speculative_k}"
+             if args.generate_slots > 0 and args.speculative_k >= 2 else "")
+          + (f", kv-dtype={args.kv_dtype}"
+             if args.generate_slots > 0 and args.kv_dtype != "f32" else "")
           + ")", flush=True)
     try:
         import threading

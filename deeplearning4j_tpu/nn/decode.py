@@ -25,9 +25,28 @@ contract for transformer stacks, on BOTH containers:
   two merge by the standard two-way LSE combine. `start` is per-row, so
   a long prompt prefills in several bucket-shaped calls — the serving
   engine interleaves decode steps between them.
+* ``make_verify_fn(net)`` — the SPECULATIVE verification body
+  ``(params, state, cache, tokens, pos) -> (probs, cache)``: K tokens
+  per row at positions ``pos..pos+K-1`` in ONE fixed-shape step. All K
+  keys are written before attending and each query row i gets
+  ``key_limit = pos+i+1``, which is exactly causal including self — so
+  row i's output is bit-identical to what i sequential decode steps
+  would produce given the same inputs. Acceptance is therefore a pure
+  host-side mask over the K output rows (serving/speculative.py); a
+  rejected draft's stale K/V is invisible (key_limit) until the next
+  verify window — which always starts at or before the stale region —
+  overwrites it.
 * ``init_cache(net, batch, capacity)`` — zeroed per-attention-layer
   K/V pytree ``{layer: {"k": [B, S, H, D], "v": ...}}`` (key position
   on axis 1 so per-position scatter writes are contiguous).
+
+All three entry fns (and ``init_cache``) take ``kv_dtype`` ("f32" |
+"int8") and ``page_size``: the int8 paged cache stores codes plus
+per-(row, page, head) f32 scales (``{"k", "k_scale", "v", "v_scale"}``
+entries), writes through ops/decode_attention.quantized_cache_update,
+and attends through `cache_attention_q8` (dequantize-in-the-scan) —
+~4x less HBM per slot, gated on greedy-sequence parity vs the f32
+cache in the serving replay.
 
 Both fns are pure (no net mutation, no rng) so an external jit owner —
 the serving engine — controls the compile cache, exactly like
@@ -60,7 +79,11 @@ from deeplearning4j_tpu.nn.conf.layers import (
 )
 from deeplearning4j_tpu.nn.training import tree_cast
 from deeplearning4j_tpu.ops.activations import get_activation
-from deeplearning4j_tpu.ops.decode_attention import cache_attention
+from deeplearning4j_tpu.ops.decode_attention import (
+    cache_attention,
+    cache_attention_q8,
+    quantized_cache_update,
+)
 
 _POINTWISE = (DenseLayer, EmbeddingLayer, LayerNormalization,
               BaseOutputLayer, ActivationLayer, DropoutLayer)
@@ -158,14 +181,66 @@ def attention_specs(net):
                                                  SelfAttentionLayer)]
 
 
-def init_cache(net, batch: int, capacity: int):
+def init_cache(net, batch: int, capacity: int, kv_dtype: str = "f32",
+               page_size: int = 16):
     """Zeroed KV cache: {layer: {"k": [batch, capacity, H, D], "v":
     ...}} in the net's compute dtype. `capacity` is the per-row key
-    budget (prompt + generated, page-quantized by the serving layer)."""
+    budget (prompt + generated, page-quantized by the serving layer).
+    kv_dtype="int8" stores int8 codes plus per-(row, page, head) f32
+    scales ({"k", "k_scale", "v", "v_scale"} entries); capacity must
+    sit on the page grid."""
+    if kv_dtype == "int8":
+        if capacity % page_size != 0:
+            raise ValueError(
+                f"int8 cache needs page-quantized capacity; {capacity} "
+                f"is not a multiple of page_size {page_size}")
+        n_pages = capacity // page_size
+        return {name: {
+            "k": jnp.zeros((batch, capacity, H, D), jnp.int8),
+            "k_scale": jnp.zeros((batch, n_pages, H), jnp.float32),
+            "v": jnp.zeros((batch, capacity, H, D), jnp.int8),
+            "v_scale": jnp.zeros((batch, n_pages, H), jnp.float32)}
+            for name, H, D in attention_specs(net)}
     dtype = net.compute_dtype
     return {name: {"k": jnp.zeros((batch, capacity, H, D), dtype),
                    "v": jnp.zeros((batch, capacity, H, D), dtype)}
             for name, H, D in attention_specs(net)}
+
+
+def _cache_write(entry, k_new, v_new, rows, positions, kv_dtype,
+                 page_size):
+    """Write k_new/v_new [b, T, H, D] at (rows x positions [b, T]) —
+    the dtype-dispatched cache scatter. Out-of-range positions (the
+    engine's inactive-row scratch / a speculative tail past capacity)
+    are dropped on both paths: the f32 scatter by jax's out-of-bounds
+    default, the int8 path inside quantized_cache_update."""
+    if kv_dtype == "int8":
+        ck, ks = quantized_cache_update(entry["k"], entry["k_scale"],
+                                        k_new, rows, positions, page_size)
+        cv, vs = quantized_cache_update(entry["v"], entry["v_scale"],
+                                        v_new, rows, positions, page_size)
+        return {"k": ck, "k_scale": ks, "v": cv, "v_scale": vs}
+    ck = entry["k"].at[rows[:, None], positions].set(
+        k_new.astype(entry["k"].dtype))
+    cv = entry["v"].at[rows[:, None], positions].set(
+        v_new.astype(entry["v"].dtype))
+    return {"k": ck, "v": cv}
+
+
+def _cache_attend(entry, qh, key_limit, kv_dtype, page_size, rows=None):
+    """Attend qh [b, H, Tq, D] against a cache entry with per-query
+    visible-key bounds — dtype-dispatched. `rows` gathers a row subset
+    first (the prefill cross-chunk path)."""
+    if kv_dtype == "int8":
+        k, v = entry["k"], entry["v"]
+        ks, vs = entry["k_scale"], entry["v_scale"]
+        if rows is not None:
+            k, v, ks, vs = k[rows], v[rows], ks[rows], vs[rows]
+        return cache_attention_q8(qh, k, v, ks, vs, key_limit, page_size)
+    k, v = entry["k"], entry["v"]
+    if rows is not None:
+        k, v = k[rows], v[rows]
+    return cache_attention(qh, k, v, key_limit)
 
 
 # ------------------------------------------------------------ shared math
@@ -312,9 +387,19 @@ def _split_heads(t, H):
     return t.reshape(b, T, H, n // H)
 
 
+def _as_seq(x):
+    """Re-expand [B, d] to [B, 1, d]. EmbeddingImpl squeezes a [B, 1]
+    index column to [B] (reference EmbeddingLayer is feed-forward), so a
+    single-token walk's activations can arrive 2-D; adding a [B, 1, d]
+    positional term to a 2-D [B, d] would BROADCAST to [B, B, d] and
+    silently hand every row past 0 row 0's features. Every handler that
+    mixes x with per-row position data goes through this first."""
+    return x[:, None, :] if x.ndim == 2 else x
+
+
 # ------------------------------------------------------------ entry fns
 
-def make_decode_fn(net):
+def make_decode_fn(net, kv_dtype: str = "f32", page_size: int = 16):
     """-> pure ``step(params, state, cache, token, pos) -> (probs,
     cache)``. token [B] int32; pos [B] int32 is the position the token
     OCCUPIES (0-based — a row whose prompt filled [0, L) decodes its
@@ -327,25 +412,28 @@ def make_decode_fn(net):
         B = token.shape[0]
         new_cache = dict(cache)
         rows = jnp.arange(B)
+        positions = pos[:, None]                           # [B, 1]
 
         def attn(name, conf, p, x):
             H, n = conf.n_heads, conf.n_out
+            x = _as_seq(x)
             qkv = x[:, 0, :] @ p["Wqkv"] + p["bqkv"]       # [B, 3n]
             q, k_new, v_new = jnp.split(qkv, 3, axis=-1)
             Dh = n // H
-            entry = new_cache[name]
-            ck = entry["k"].at[rows, pos].set(
-                k_new.reshape(B, H, Dh).astype(entry["k"].dtype))
-            cv = entry["v"].at[rows, pos].set(
-                v_new.reshape(B, H, Dh).astype(entry["v"].dtype))
-            new_cache[name] = {"k": ck, "v": cv}
+            entry = _cache_write(
+                new_cache[name], k_new.reshape(B, 1, H, Dh),
+                v_new.reshape(B, 1, H, Dh), rows, positions,
+                kv_dtype, page_size)
+            new_cache[name] = entry
             qh = q.reshape(B, H, 1, Dh)
-            o, _ = cache_attention(qh, ck, cv, (pos + 1)[:, None])
+            o, _ = _cache_attend(entry, qh, (pos + 1)[:, None],
+                                 kv_dtype, page_size)
             y = o[:, :, 0, :].reshape(B, n) @ p["Wo"] + p["bo"]
             return get_activation(conf.activation or "identity")(
                 y)[:, None, :]
 
         def posenc(name, conf, p, x):
+            x = _as_seq(x)
             d = x.shape[-1]
             if conf.learned:
                 pe = jnp.take(p["pe"], pos, axis=0)        # [B, d]
@@ -353,14 +441,14 @@ def make_decode_fn(net):
                 pe = _sinusoidal_at(pos, d, x.dtype)
             return x + pe[:, None, :]
 
-        probs = _walk(net, ops, in_name, out_name, params, state,
-                      token[:, None], attn, posenc)
+        probs = _as_seq(_walk(net, ops, in_name, out_name, params, state,
+                              token[:, None], attn, posenc))
         return probs[:, 0, :], new_cache
 
     return step
 
 
-def make_prefill_fn(net):
+def make_prefill_fn(net, kv_dtype: str = "f32", page_size: int = 16):
     """-> pure ``prefill(params, state, cache, tokens, kmask, rows,
     start, last_idx) -> (probs_last, cache)``. tokens [b, Tc] int32 (a
     bucket-shaped prompt chunk, zero-padded); kmask [b, Tc] (1 = real
@@ -384,15 +472,15 @@ def make_prefill_fn(net):
         def attn(name, conf, p, x):
             H, n = conf.n_heads, conf.n_out
             Dh = n // H
+            x = _as_seq(x)
             qkv = x @ p["Wqkv"] + p["bqkv"]                # [b, Tc, 3n]
             q, k, v = jnp.split(qkv, 3, axis=-1)
-            entry = new_cache[name]
             keep = kmask[..., None, None]
-            k_w = (_split_heads(k, H) * keep).astype(entry["k"].dtype)
-            v_w = (_split_heads(v, H) * keep).astype(entry["v"].dtype)
-            ck = entry["k"].at[rows[:, None], positions].set(k_w)
-            cv = entry["v"].at[rows[:, None], positions].set(v_w)
-            new_cache[name] = {"k": ck, "v": cv}
+            entry = _cache_write(
+                new_cache[name], _split_heads(k, H) * keep,
+                _split_heads(v, H) * keep, rows, positions,
+                kv_dtype, page_size)
+            new_cache[name] = entry
             qh = _split_heads(q, H).transpose(0, 2, 1, 3)  # [b, H, Tc, Dh]
             kh = _split_heads(k, H).transpose(0, 2, 1, 3)
             vh = _split_heads(v, H).transpose(0, 2, 1, 3)
@@ -401,13 +489,15 @@ def make_prefill_fn(net):
             # row wrote before `start` (empty on the first chunk — its
             # lse sits at the mask floor and merges to weight zero)
             limit = jnp.broadcast_to(start[:, None], (b, Tc))
-            o2, lse2 = cache_attention(qh, ck[rows], cv[rows], limit)
+            o2, lse2 = _cache_attend(entry, qh, limit, kv_dtype,
+                                     page_size, rows=rows)
             o = _merge_lse(o1, lse1, o2, lse2)
             y = o.transpose(0, 2, 1, 3).reshape(b, Tc, n)
             y = y @ p["Wo"] + p["bo"]
             return get_activation(conf.activation or "identity")(y)
 
         def posenc(name, conf, p, x):
+            x = _as_seq(x)
             d = x.shape[-1]
             if conf.learned:
                 pe = jnp.take(p["pe"], positions, axis=0)  # [b, Tc, d]
@@ -415,8 +505,62 @@ def make_prefill_fn(net):
                 pe = _sinusoidal_at(positions, d, x.dtype)
             return x + pe
 
-        probs = _walk(net, ops, in_name, out_name, params, state,
-                      tokens, attn, posenc)
+        probs = _as_seq(_walk(net, ops, in_name, out_name, params, state,
+                              tokens, attn, posenc))
         return probs[jnp.arange(b), last_idx, :], new_cache
 
     return prefill
+
+
+def make_verify_fn(net, kv_dtype: str = "f32", page_size: int = 16):
+    """-> pure ``verify(params, state, cache, tokens, pos) -> (probs,
+    cache)`` — the speculative-decode verification step. tokens [B, K]
+    int32 is each row's candidate window (its true last token followed
+    by K-1 draft tokens); pos [B] is the position the FIRST token
+    occupies. probs [B, K, V]: row i is the model's next-token output
+    after consuming tokens[:, :i+1] — bit-identical to what i+1
+    sequential `make_decode_fn` steps would produce, because all K K/Vs
+    are written first and query row i attends with key_limit pos+i+1
+    (causal including self). The host-side acceptance mask
+    (serving/speculative.py) compares argmax rows against the drafts;
+    rejected positions' stale K/V stays invisible until the next verify
+    window overwrites it."""
+    in_name, out_name, ops = _plan(net)
+
+    def verify(params, state, cache, tokens, pos):
+        B, K = tokens.shape
+        new_cache = dict(cache)
+        rows = jnp.arange(B)
+        positions = pos[:, None] + jnp.arange(K)[None, :]  # [B, K]
+
+        def attn(name, conf, p, x):
+            H, n = conf.n_heads, conf.n_out
+            Dh = n // H
+            x = _as_seq(x)
+            qkv = x @ p["Wqkv"] + p["bqkv"]                # [B, K, 3n]
+            q, k, v = jnp.split(qkv, 3, axis=-1)
+            entry = _cache_write(
+                new_cache[name], _split_heads(k, H), _split_heads(v, H),
+                rows, positions, kv_dtype, page_size)
+            new_cache[name] = entry
+            qh = _split_heads(q, H).transpose(0, 2, 1, 3)  # [B, H, K, Dh]
+            o, _ = _cache_attend(entry, qh, positions + 1, kv_dtype,
+                                 page_size)
+            y = o.transpose(0, 2, 1, 3).reshape(B, K, n)
+            y = y @ p["Wo"] + p["bo"]
+            return get_activation(conf.activation or "identity")(y)
+
+        def posenc(name, conf, p, x):
+            x = _as_seq(x)
+            d = x.shape[-1]
+            if conf.learned:
+                pe = jnp.take(p["pe"], positions, axis=0)  # [B, K, d]
+            else:
+                pe = _sinusoidal_at(positions, d, x.dtype)
+            return x + pe
+
+        probs = _walk(net, ops, in_name, out_name, params, state,
+                      tokens, attn, posenc)
+        return probs, new_cache
+
+    return verify

@@ -767,7 +767,8 @@ class ComputationGraph:
             return ys[0]
         return fwd
 
-    def incremental_decode_fn(self):
+    def incremental_decode_fn(self, kv_dtype: str = "f32",
+                              page_size: int = 16):
         """A pure jitted-step body ``(params, state, cache, token, pos)
         -> (probs, cache)`` — autoregressive decode with the KV cache as
         explicit threaded state (nn/decode.py). The productionized
@@ -775,12 +776,13 @@ class ComputationGraph:
         cache row at its own position, single-query attention against
         the cache, step cost independent of prompt length. External jit
         owners (serving/engine.py GenerationEngine) control the compile
-        cache, exactly like `inference_fn`."""
+        cache, exactly like `inference_fn`. kv_dtype="int8" reads/writes
+        the quantized paged cache."""
         from deeplearning4j_tpu.nn.decode import make_decode_fn
 
-        return make_decode_fn(self)
+        return make_decode_fn(self, kv_dtype, page_size)
 
-    def prefill_fn(self):
+    def prefill_fn(self, kv_dtype: str = "f32", page_size: int = 16):
         """The chunked-prefill twin of `incremental_decode_fn`:
         ``(params, state, cache, tokens, kmask, rows, start, last_idx)
         -> (probs_last, cache)`` fills cache rows from a bucket-shaped
@@ -788,14 +790,25 @@ class ComputationGraph:
         within-chunk attention (nn/decode.py)."""
         from deeplearning4j_tpu.nn.decode import make_prefill_fn
 
-        return make_prefill_fn(self)
+        return make_prefill_fn(self, kv_dtype, page_size)
 
-    def init_kv_cache(self, batch: int, capacity: int):
+    def verify_decode_fn(self, kv_dtype: str = "f32",
+                         page_size: int = 16):
+        """The speculative verification step ``(params, state, cache,
+        tokens [B, K], pos) -> (probs [B, K, V], cache)`` — K candidate
+        tokens per row checked in ONE fixed-shape call
+        (nn/decode.make_verify_fn)."""
+        from deeplearning4j_tpu.nn.decode import make_verify_fn
+
+        return make_verify_fn(self, kv_dtype, page_size)
+
+    def init_kv_cache(self, batch: int, capacity: int,
+                      kv_dtype: str = "f32", page_size: int = 16):
         """Zeroed decode cache for `batch` rows of `capacity` key slots
         (nn/decode.init_cache)."""
         from deeplearning4j_tpu.nn.decode import init_cache
 
-        return init_cache(self, batch, capacity)
+        return init_cache(self, batch, capacity, kv_dtype, page_size)
 
     def score(self, ds=None, training: bool = False):
         if ds is None:

@@ -601,31 +601,44 @@ class MultiLayerNetwork:
             return y
         return fwd
 
-    def incremental_decode_fn(self):
+    def incremental_decode_fn(self, kv_dtype: str = "f32",
+                              page_size: int = 16):
         """A pure jitted-step body ``(params, state, cache, token, pos)
         -> (probs, cache)`` — autoregressive decode with the KV cache as
         explicit threaded state (nn/decode.py; same contract as
         ComputationGraph.incremental_decode_fn). This is the
         productionized rnnTimeStep:2147 for attention stacks, which
-        `rnn_time_step` rejects as unable to stream causally."""
+        `rnn_time_step` rejects as unable to stream causally.
+        kv_dtype="int8" reads/writes the quantized paged cache."""
         from deeplearning4j_tpu.nn.decode import make_decode_fn
 
-        return make_decode_fn(self)
+        return make_decode_fn(self, kv_dtype, page_size)
 
-    def prefill_fn(self):
+    def prefill_fn(self, kv_dtype: str = "f32", page_size: int = 16):
         """The chunked-prefill twin of `incremental_decode_fn`:
         ``(params, state, cache, tokens, kmask, rows, start, last_idx)
         -> (probs_last, cache)`` — see nn/decode.make_prefill_fn."""
         from deeplearning4j_tpu.nn.decode import make_prefill_fn
 
-        return make_prefill_fn(self)
+        return make_prefill_fn(self, kv_dtype, page_size)
 
-    def init_kv_cache(self, batch: int, capacity: int):
+    def verify_decode_fn(self, kv_dtype: str = "f32",
+                         page_size: int = 16):
+        """The speculative verification step ``(params, state, cache,
+        tokens [B, K], pos) -> (probs [B, K, V], cache)`` — K candidate
+        tokens per row checked in ONE fixed-shape call
+        (nn/decode.make_verify_fn)."""
+        from deeplearning4j_tpu.nn.decode import make_verify_fn
+
+        return make_verify_fn(self, kv_dtype, page_size)
+
+    def init_kv_cache(self, batch: int, capacity: int,
+                      kv_dtype: str = "f32", page_size: int = 16):
         """Zeroed decode cache for `batch` rows of `capacity` key slots
         (nn/decode.init_cache)."""
         from deeplearning4j_tpu.nn.decode import init_cache
 
-        return init_cache(self, batch, capacity)
+        return init_cache(self, batch, capacity, kv_dtype, page_size)
 
     def score(self, dataset: DataSet = None, training: bool = False):
         """Loss on a dataset (reference score()). training=False uses

@@ -37,7 +37,12 @@ Entry params are kernel-specific: flash_fwd/flash_bwd take
 count varies per call while the key is (V, d), so the caps feed the same
 divisor search the defaults do); decode_attn takes ``block_k`` (the key
 block a decode step streams the paged KV cache in — page multiples
-dividing the cache capacity S, keyed on (S, head_dim)). Every resolved
+dividing the cache capacity S, keyed on (S, head_dim));
+decode_attn_q8 takes ``block_k`` too, further constrained to page-size
+multiples (the int8 cache's scale grid is per page, so a key block must
+cover whole pages); sample takes ``rows`` (the fused sampling kernel's
+row block over the [B, V] logits, keyed on (B, V) with the
+fused_layer_norm stat-row legality rule). Every resolved
 value is validated
 against the kernel's structural constraints (divisibility, lane tiling,
 unroll budget) before use; an invalid entry falls back to the
@@ -86,6 +91,11 @@ DEFAULT_XENT_BLOCK_V = 2048
 # Fused layer-norm row block (r3).
 DEFAULT_LN_ROW_BLOCK = 512
 
+# Fused sampling row block (r16): each program reduces a [rows, V]
+# logits block to `rows` token ids, so the row block trades program
+# count against the f32 score strip's VMEM footprint at wide vocabs.
+DEFAULT_SAMPLE_ROW_BLOCK = 256
+
 # Decode-attention key block (r11): single-query attention against a
 # paged KV cache streams the cache in blocks of block_k key positions
 # (page multiples) with a running-max/lse merge. The default cap keeps
@@ -128,6 +138,8 @@ KERNEL_PARAMS = {
     "fused_layer_norm": ("rows",),
     "softmax_xent": ("block_n", "block_v"),
     "decode_attn": ("block_k",),
+    "decode_attn_q8": ("block_k",),
+    "sample": ("rows",),
 }
 
 # Timing/provenance fields an entry may carry alongside its params.
@@ -380,6 +392,48 @@ def decode_block(S: int, D: int) -> int:
         if S % bk == 0:
             return bk
     return S  # unreachable: 1 divides S
+
+
+def decode_block_q8(S: int, D: int, page_size: int) -> int:
+    """Key-block length for the int8 quantized decode-attention variant
+    (ops/decode_attention.py). Same contract as `decode_block` with one
+    extra structural rule: the block must be a multiple of the cache
+    page size, because dequantization broadcasts one per-page scale
+    across each page inside a block — a block may not split a page.
+    The cache capacity S is page-quantized, so page-multiple divisors
+    always exist; the fallback takes the largest one within the swept
+    cap (deterministic, bit-identical off-TPU)."""
+    ps = max(1, int(page_size))
+    e = lookup("decode_attn_q8", S, D)
+    if e:
+        bk = e.get("block_k")
+        if (isinstance(bk, int) and 1 <= bk <= S and S % bk == 0
+                and bk % ps == 0):
+            return bk
+    if S <= DEFAULT_DECODE_BLOCK_K:
+        return S
+    cap = DEFAULT_DECODE_BLOCK_K // ps * ps
+    for bk in range(max(cap, ps), 0, -ps):
+        if S % bk == 0:
+            return bk
+    return S  # unreachable: S is a page multiple, so ps divides S
+
+
+def sample_rows(B: int, V: int) -> int:
+    """Row block for the fused sampling kernel (ops/fused_sampling.py).
+    The [1, B] token row uses (1, bn) blocks, legal only when bn is a
+    lane-tile multiple or the whole batch — the fused_layer_norm
+    stat-row rule, enforced for tuned values too."""
+    e = lookup("sample", B, V)
+    if e:
+        bn = e.get("rows")
+        if (isinstance(bn, int) and bn >= 8 and B % bn == 0
+                and (bn % LANES == 0 or bn == B)):
+            return bn
+    b = 8
+    while b * 2 <= DEFAULT_SAMPLE_ROW_BLOCK and B % (b * 2) == 0:
+        b *= 2
+    return b
 
 
 def ln_rows(N: int, C: int) -> int:

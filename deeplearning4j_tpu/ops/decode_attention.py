@@ -23,6 +23,24 @@ behind the same dispatch). Off-TPU the tuning table is inactive
 (autotune.table_active), so interpret/CPU runs always use the
 deterministic divisor-search default — bit-identical to the fallback by
 construction. Scores accumulate in f32 regardless of cache dtype.
+
+INT8 QUANTIZED CACHE (r16): the `*_q8` twins read a cache stored as
+int8 codes plus one f32 scale per (row, page, head) — per-page
+symmetric quantization, scale = maxabs/127, so a page of K (or V)
+costs page_size*D bytes instead of page_size*D*4 and HBM streaming
+shrinks ~4x (slots per HBM byte is the serving headline this feeds).
+Dequantization happens INSIDE the blocked scan body — a code block
+[bk, D] times its page scales, straight into the f32 score dot — so
+the quantized path streams codes, never a materialized f32 cache. The
+`decode_attn_q8` tuning family constrains block_k to page multiples
+(a block may not split a page's scale broadcast). Cache WRITES go
+through `quantized_cache_update`: gather the page-aligned window
+covering the new positions, dequantize, insert, zero positions past
+the write head (stale values from a previous slot tenancy must not
+inflate the fresh page's maxabs), recompute page scales, requantize,
+scatter codes + scales back. Re-rounding a page whose scale did not
+change is EXACT (round(code*s/s) == code), so settled pages do not
+drift as their neighbors fill in.
 """
 
 from __future__ import annotations
@@ -102,3 +120,149 @@ def decode_attention(q, k, v, pos):
     out, _ = cache_attention(q[:, :, None, :], k, v,
                              (pos + 1)[:, None])
     return out[:, :, 0, :]
+
+
+# ----------------------------------------------------- int8 paged cache
+
+def quantize_pages(x, page_size: int):
+    """Per-page symmetric int8 quantization of a cache tensor
+    x [B, S, H, D] (S a page multiple). Returns (codes int8 [B, S, H, D],
+    scales f32 [B, S//page_size, H]) with scale = maxabs/127 per
+    (row, page, head). Round-trip error is bounded by scale/2 per
+    element — the bound tests/test_speculative.py proves."""
+    B, S, H, D = x.shape
+    n_pages = S // page_size
+    xp = x.astype(jnp.float32).reshape(B, n_pages, page_size, H, D)
+    amax = jnp.max(jnp.abs(xp), axis=(2, 4))
+    scales = jnp.maximum(amax, 1e-8) / 127.0
+    codes = jnp.clip(jnp.round(xp / scales[:, :, None, :, None]),
+                     -127, 127).astype(jnp.int8)
+    return codes.reshape(B, S, H, D), scales
+
+
+def dequantize_pages(codes, scales, page_size: int):
+    """Inverse of `quantize_pages` (up to the rounding error):
+    codes int8 [B, S, H, D] * per-page scales [B, S//ps, H] -> f32."""
+    B, S, H, D = codes.shape
+    n_pages = S // page_size
+    cp = codes.astype(jnp.float32).reshape(B, n_pages, page_size, H, D)
+    return (cp * scales[:, :, None, :, None]).reshape(B, S, H, D)
+
+
+def quantized_cache_update(codes, scales, new_vals, rows, positions,
+                           page_size: int):
+    """Write new K (or V) values into an int8 paged cache.
+
+    codes [B, S, H, D] int8, scales [B, S//ps, H] f32; new_vals
+    [b, T, H, D]; rows [b] (distinct cache rows); positions [b, T]
+    (contiguous per row — a prefill chunk or a verify window).
+    Out-of-range positions (the engine's inactive-row scratch, or a
+    speculative tail past capacity) are DROPPED, matching the f32
+    cache's reliance on jax scatter's drop-out-of-bounds default.
+
+    The page containing a new position must be requantized (its maxabs
+    may change), so the update works on the page-aligned window that
+    covers the write: gather -> dequantize -> insert -> zero past the
+    write head (stale values from a prior tenancy of the row must not
+    set the fresh scale) -> new per-page scales -> requantize ->
+    scatter. Returns (codes, scales)."""
+    B, S, H, D = codes.shape
+    b, T = positions.shape
+    ps = page_size
+    W = min(((T + ps - 1) // ps + 1) * ps, S)
+    nw = W // ps
+    pos_min = jnp.min(positions, axis=1)
+    w0 = jnp.clip(pos_min // ps * ps, 0, S - W)
+    widx = w0[:, None] + jnp.arange(W)                     # [b, W]
+    p0 = w0 // ps
+    pidx = p0[:, None] + jnp.arange(nw)                    # [b, nw]
+    wcodes = codes[rows[:, None], widx]                    # [b, W, H, D]
+    wscales = scales[rows[:, None], pidx]                  # [b, nw, H]
+    wvals = (wcodes.astype(jnp.float32)
+             * jnp.repeat(wscales, ps, axis=1)[:, :, :, None])
+    local = positions - w0[:, None]
+    valid = (positions < S) & (local >= 0) & (local < W)
+    # invalid entries scatter to index W — out of bounds, dropped
+    local_s = jnp.where(valid, local, W)
+    wvals = wvals.at[jnp.arange(b)[:, None], local_s].set(
+        new_vals.astype(jnp.float32))
+    # zero everything past this row's write head: those positions are
+    # invisible until overwritten (key_limit), and stale garbage there
+    # would otherwise inflate the page maxabs and crush fresh precision
+    pos_max = jnp.max(jnp.where(valid, positions, -1), axis=1)
+    wvals = jnp.where((widx > pos_max[:, None])[:, :, None, None],
+                      0.0, wvals)
+    wq = wvals.reshape(b, nw, ps, H, D)
+    amax = jnp.max(jnp.abs(wq), axis=(2, 4))
+    new_scales = jnp.maximum(amax, 1e-8) / 127.0
+    qcodes = jnp.clip(jnp.round(wq / new_scales[:, :, None, :, None]),
+                      -127, 127).astype(jnp.int8).reshape(b, W, H, D)
+    codes = codes.at[rows[:, None], widx].set(qcodes)
+    scales = scales.at[rows[:, None], pidx].set(new_scales)
+    return codes, scales
+
+
+@functools.partial(jax.jit, static_argnames=("block_k", "page_size"))
+def _cache_attention_blocked_q8(q, k_codes, v_codes, k_scale, v_scale,
+                                key_limit, block_k, page_size):
+    """The int8 twin of `_cache_attention_blocked`: identical scan and
+    running-max merge, but each key block arrives as int8 codes and is
+    dequantized in the body (code * per-page scale, f32) right before
+    the score dot. block_k is a page multiple so the [B, ppb, H] scale
+    slice broadcasts across whole pages."""
+    B, S, H, D = k_codes.shape
+    Tq = q.shape[2]
+    nb = S // block_k
+    ppb = block_k // page_size
+    sm_scale = 1.0 / jnp.sqrt(jnp.float32(D))
+    qf = q.astype(jnp.float32)
+    kb = jnp.moveaxis(k_codes.reshape(B, nb, block_k, H, D), 1, 0)
+    kb = kb.transpose(0, 1, 3, 2, 4)
+    vb = jnp.moveaxis(v_codes.reshape(B, nb, block_k, H, D), 1, 0)
+    vb = vb.transpose(0, 1, 3, 2, 4)
+    ksb = jnp.moveaxis(k_scale.reshape(B, nb, ppb, H), 1, 0)  # [nb,B,ppb,H]
+    vsb = jnp.moveaxis(v_scale.reshape(B, nb, ppb, H), 1, 0)
+
+    m0 = jnp.full((B, H, Tq), _NEG_INF, jnp.float32)
+    l0 = jnp.zeros((B, H, Tq), jnp.float32)
+    acc0 = jnp.zeros((B, H, Tq, D), jnp.float32)
+
+    def body(carry, blk):
+        m, l, acc, j0 = carry
+        k_j, v_j, ks_j, vs_j = blk
+        # [B, ppb, H] -> [B, H, bk, 1]: one scale per page, per head
+        ks = jnp.repeat(ks_j, page_size, axis=1).transpose(0, 2, 1)
+        vs = jnp.repeat(vs_j, page_size, axis=1).transpose(0, 2, 1)
+        kf = k_j.astype(jnp.float32) * ks[..., None]
+        vf = v_j.astype(jnp.float32) * vs[..., None]
+        s = jnp.einsum("bhqd,bhkd->bhqk", qf, kf,
+                       preferred_element_type=jnp.float32) * sm_scale
+        idx = j0 + jnp.arange(block_k)
+        visible = idx[None, None, None, :] < key_limit[:, None, :, None]
+        s = jnp.where(visible, s, _NEG_INF)
+        m_new = jnp.maximum(m, s.max(-1))
+        alpha = jnp.exp(m - m_new)
+        p = jnp.exp(s - m_new[..., None])
+        l_new = l * alpha + p.sum(-1)
+        acc_new = acc * alpha[..., None] + jnp.einsum(
+            "bhqk,bhkd->bhqd", p, vf)
+        return (m_new, l_new, acc_new, j0 + block_k), None
+
+    (m, l, acc, _), _ = jax.lax.scan(
+        body, (m0, l0, acc0, jnp.int32(0)), (kb, vb, ksb, vsb))
+    out = jnp.where(l[..., None] > 0.0,
+                    acc / jnp.maximum(l, 1e-30)[..., None], 0.0)
+    lse = m + jnp.log(jnp.maximum(l, 1e-30))
+    return out.astype(q.dtype), lse
+
+
+def cache_attention_q8(q, k_codes, v_codes, k_scale, v_scale, key_limit,
+                       page_size: int):
+    """Multi-query attention over an int8 paged KV cache. Shapes as
+    `_cache_attention_blocked_q8`; block_k resolves through the
+    `decode_attn_q8` tuning family (page-multiple candidates; off-TPU
+    the deterministic page-multiple divisor default)."""
+    S, D = k_codes.shape[1], k_codes.shape[3]
+    bk = autotune.decode_block_q8(S, D, page_size)
+    return _cache_attention_blocked_q8(q, k_codes, v_codes, k_scale,
+                                       v_scale, key_limit, bk, page_size)

@@ -56,6 +56,8 @@ from deeplearning4j_tpu.serving.fleet import (ReplicaFaultInjector,
                                               ReplicaKilled, WeightStore,
                                               restore_for_serving)
 from deeplearning4j_tpu.serving.kvcache import CachePlan
+from deeplearning4j_tpu.serving.speculative import (NgramProposer,
+                                                    accept_greedy)
 
 
 class QueueFullError(RuntimeError):
@@ -608,12 +610,32 @@ class _GenWorker:
     token whose K/V write is routed to the scratch position
     (capacity - 1), which any real tenant overwrites before it can ever
     be attended (a token's own K/V lands at its position in the same
-    step that reads it)."""
+    step that reads it).
+
+    SPECULATIVE MODE (speculative_k >= 2): the decode step is replaced
+    by a fixed-shape VERIFY step over [n_slots, k] token windows
+    (nn/decode.make_verify_fn). Each active slot's window is its true
+    last token followed by k-1 host-side n-gram drafts
+    (serving/speculative.NgramProposer); the greedy acceptance mask
+    (`accept_greedy`) turns the k verify rows into 1..k emitted tokens
+    — each one a model argmax given exactly its prefix, so the emitted
+    stream is bit-identical to non-speculative greedy. The zero-retrace
+    contract is untouched: ONE verify shape compiles at warmup (instead
+    of the decode shape — only the step actually used is warmed), the
+    DecodeSlots machine is unchanged, and a rejected draft's cache
+    pages stay reserved by the up-front admission reservation (released
+    on the same completion/failure path as ever; its stale K/V is
+    invisible under key_limit until the next window overwrites it).
+
+    kv_dtype="int8" swaps every cache entry for the quantized paged
+    form ({"k","k_scale","v","v_scale"}) through the same three step
+    fns — shapes still lattice/page-grid points, ~4x less HBM/slot."""
 
     def __init__(self, index: int, net, lattice: BucketLattice,
                  plan: CachePlan, prefill_chunk: int, max_queue: int,
                  recorder, weights: WeightStore | None = None,
-                 faults: ReplicaFaultInjector | None = None):
+                 faults: ReplicaFaultInjector | None = None,
+                 speculative_k: int = 0):
         import jax
         import jax.numpy as jnp
 
@@ -628,12 +650,21 @@ class _GenWorker:
         self.faults = faults
         self.pool = plan.make_pool()
         self.slots = DecodeSlots(plan.n_slots)
-        self.cache = net.init_kv_cache(plan.n_slots, plan.capacity)
+        self.kv_dtype = plan.kv_dtype
+        self.speculative_k = int(speculative_k)
+        self.cache = net.init_kv_cache(plan.n_slots, plan.capacity,
+                                       plan.kv_dtype, plan.page_size)
         self.trace_count = 0
         self.served = 0
         self.failed = 0
         self.tokens_out = 0
         self.decode_steps_run = 0
+        self.verify_steps_run = 0
+        self.slot_steps = 0  # (active slot, verify step) pairs
+        self.accepted_tokens = 0
+        self.drafted_tokens = 0
+        self.draft_overhead_s = 0.0
+        self.proposer = NgramProposer()
         self.alive = True
         self.lifecycle = "warming"
         self.last_beat = 0.0
@@ -644,8 +675,9 @@ class _GenWorker:
         self._closed = False
         self._thread: threading.Thread | None = None
 
-        prefill_raw = net.prefill_fn()
-        step_raw = net.incremental_decode_fn()
+        prefill_raw = net.prefill_fn(plan.kv_dtype, plan.page_size)
+        step_raw = net.incremental_decode_fn(plan.kv_dtype,
+                                             plan.page_size)
 
         def counted_prefill(params, state, cache, padded_tokens,
                             bucket_kmask, rows, start, last_idx):
@@ -663,6 +695,22 @@ class _GenWorker:
 
         self._prefill_jit = jax.jit(counted_prefill)
         self._decode_jit = jax.jit(counted_step)
+        self._verify_jit = None
+        if self.speculative_k >= 2:
+            verify_raw = net.verify_decode_fn(plan.kv_dtype,
+                                              plan.page_size)
+
+            def counted_verify(params, state, cache, padded_windows,
+                               pos):
+                self.trace_count += 1
+                probs, cache = verify_raw(params, state, cache,
+                                          padded_windows, pos)
+                # [B, k] argmax rows: the acceptance mask's input —
+                # k verification verdicts for one batch-boundary fetch
+                return (jnp.argmax(probs, axis=-1).astype(jnp.int32),
+                        cache)
+
+            self._verify_jit = jax.jit(counted_verify)
 
     # ---------------------------------------------------------- planning
     def chunk_buckets(self) -> list:
@@ -702,7 +750,25 @@ class _GenWorker:
                 self.cache = cache
             self._seen_shapes.add(key)
             compiles += 1
-        if "decode" not in self._seen_shapes:
+        # only the step this worker actually runs is warmed: the decode
+        # shape in plain mode, the [B, k] verify shape in speculative
+        # mode — either way ONE step compile, and the trace counter is
+        # frozen after it
+        if self._verify_jit is not None:
+            if "verify" not in self._seen_shapes:
+                B, K = self.plan.n_slots, self.speculative_k
+                scratch = np.full(B, self.plan.capacity - 1, np.int32)
+                with self.recorder.span("compile", kind="verify",
+                                        shape=[B, K, self.plan.capacity],
+                                        replica=self.index, warmup=True):
+                    tok, cache = self._verify_jit(
+                        ws.params, ws.state, self.cache,
+                        np.zeros((B, K), np.int32), scratch)
+                    np.asarray(tok)  # batch-boundary fetch
+                    self.cache = cache
+                self._seen_shapes.add("verify")
+                compiles += 1
+        elif "decode" not in self._seen_shapes:
             B = self.plan.n_slots
             scratch = np.full(B, self.plan.capacity - 1, np.int32)
             with self.recorder.span("compile", kind="decode",
@@ -873,6 +939,88 @@ class _GenWorker:
             self.tokens_out += 1
             self._maybe_complete(i, clock)
 
+    def _speculative_batch_step(self, active: list, clock) -> None:
+        """One fixed-shape VERIFY step over every slot row: each active
+        row's window is [last_token, d_1..d_{k-1}] (host-side n-gram
+        drafts), inactive rows ride the scratch position like the plain
+        decode step. ONE np.asarray fetches the whole [n_slots, k]
+        argmax matrix; the greedy acceptance mask then emits 1..k
+        tokens per slot — every accepted draft is a decode step that
+        never ran. Draft proposal cost is metered host-side
+        (`draft_overhead_us`) and the per-step `draft` telemetry event
+        is what the replay's accepted_tokens_per_step headline
+        reconstructs from."""
+        B, K = self.plan.n_slots, self.speculative_k
+        padded_windows = np.zeros((B, K), np.int32)
+        pos = np.full(B, self.plan.capacity - 1, np.int32)  # scratch
+        t_draft = time.perf_counter()
+        drafts: dict = {}
+        for i in active:
+            slot = self.slots.slots[i]
+            req = slot.request
+            d = self.proposer.propose(
+                list(req.tokens) + list(req.emitted), K - 1)
+            drafts[i] = d
+            padded_windows[i, 0] = slot.last_token
+            padded_windows[i, 1:] = d
+            pos[i] = slot.pos
+        draft_s = time.perf_counter() - t_draft
+        ws = self.weights.current
+        self.decode_steps_run += 1
+        self.verify_steps_run += 1
+        self.current_batch = list(active)
+        try:
+            with self.recorder.span("verify_step", replica=self.index,
+                                    n_active=len(active), k=K):
+                if self.faults is not None:
+                    self.faults.check(self.index, "decode",
+                                      self.decode_steps_run)
+                tok, cache = self._verify_jit(
+                    ws.params, ws.state, self.cache,
+                    padded_windows, pos)
+                toks = np.asarray(tok)  # [B, k] batch-boundary fetch
+        except ReplicaKilled as exc:
+            # same containment contract as the plain decode step
+            self.current_batch = None
+            self.alive = False
+            self.lifecycle = "dead"
+            for i in active:
+                self._fail_slot(i, exc, clock)
+            raise
+        except Exception as exc:
+            for i in active:
+                self._fail_slot(i, exc, clock)
+            self.current_batch = None
+            return
+        self.current_batch = None
+        self.cache = cache
+        now = clock()
+        step_emitted = 0
+        step_accepted = 0
+        for i in active:
+            slot = self.slots.slots[i]
+            req = slot.request
+            budget = req.max_new_tokens - len(req.emitted)
+            _n_acc, emitted = accept_greedy(drafts[i], toks[i])
+            take = min(len(emitted), budget)
+            for t in emitted[:take]:
+                req.emit(int(t), now)
+                self.tokens_out += 1
+            slot.pos += take
+            slot.last_token = int(emitted[take - 1])
+            step_emitted += take
+            step_accepted += take - 1  # drafts accepted (bonus aside)
+            self._maybe_complete(i, clock)
+        self.accepted_tokens += step_emitted
+        self.drafted_tokens += (K - 1) * len(active)
+        self.slot_steps += len(active)
+        self.draft_overhead_s += draft_s
+        self.recorder.event("draft", replica=self.index, k=K,
+                            n_active=len(active), emitted=step_emitted,
+                            accepted=step_accepted,
+                            drafted=(K - 1) * len(active),
+                            overhead_us=round(draft_s * 1e6, 2))
+
     # -------------------------------------------------------- lifecycle
     def _maybe_complete(self, slot_idx: int, clock) -> None:
         slot = self.slots.slots[slot_idx]
@@ -936,7 +1084,10 @@ class _GenWorker:
                         progressed = True
                     active = self.slots.decoding()
                     if active:
-                        self._decode_batch_step(active, clock)
+                        if self._verify_jit is not None:
+                            self._speculative_batch_step(active, clock)
+                        else:
+                            self._decode_batch_step(active, clock)
                         progressed = True
                 except ReplicaKilled:
                     return  # dead: the fleet supervisor respawns
@@ -1003,6 +1154,10 @@ class _GenWorker:
                "alive": self.alive, "served": self.served,
                "failed": self.failed,
                "decode_steps_run": self.decode_steps_run}
+        if self.speculative_k >= 2:
+            out["verify_steps_run"] = self.verify_steps_run
+            out["accepted_tokens"] = self.accepted_tokens
+            out["drafted_tokens"] = self.drafted_tokens
         if now is not None:
             out["last_beat_age_s"] = round(max(0.0, now - self.last_beat),
                                            3)
@@ -1030,6 +1185,7 @@ class GenerationEngine:
                  pool_pages: int | None = None,
                  prefill_chunk: int | None = None, max_queue: int = 64,
                  replicas: int = 1, checkpoint: str | None = None,
+                 speculative_k: int = 0, kv_dtype: str = "f32",
                  faults=None, recorder=None):
         if recorder is None:
             from deeplearning4j_tpu.telemetry import get_default
@@ -1058,14 +1214,24 @@ class GenerationEngine:
         chunk = (lattice.max_seq if prefill_chunk is None
                  else int(prefill_chunk))
         lattice.prefill_buckets(chunk)  # raises on a non-lattice chunk
+        self.speculative_k = int(speculative_k)
+        if self.speculative_k == 1 or self.speculative_k < 0:
+            raise ValueError(
+                "speculative_k is 0 (off) or >= 2 (a window of the true "
+                f"last token plus k-1 drafts); got {speculative_k}")
+        if self.speculative_k > int(max_new_tokens):
+            raise ValueError(
+                f"speculative_k {speculative_k} exceeds max_new_tokens "
+                f"{max_new_tokens} — a window can never be used whole")
         self.plan = CachePlan(lattice.max_seq, max_new_tokens,
                               max(1, int(slots)), page_size,
-                              pool_pages=pool_pages)
+                              pool_pages=pool_pages, kv_dtype=kv_dtype)
         self._clock = time.monotonic
         self._workers = [
             _GenWorker(i, net, lattice, self.plan, chunk, max_queue,
                        recorder, weights=self.weights,
-                       faults=self._faults)
+                       faults=self._faults,
+                       speculative_k=self.speculative_k)
             for i in range(max(1, int(replicas)))]
         self._rr = 0
         self._started = False
@@ -1074,6 +1240,7 @@ class GenerationEngine:
                       lattice=lattice.describe(),
                       cache=self.plan.describe(),
                       prefill_chunk=chunk,
+                      speculative_k=self.speculative_k,
                       restored_step=self.restored_step)
 
     # ------------------------------------------------------------- warmup
@@ -1191,4 +1358,29 @@ class GenerationEngine:
             "fleet": [w.describe(now) for w in self._workers],
             "weights": self.weights.describe(),
             "generate": True,
+            "speculative": self._speculative_stats(),
+        }
+
+    def _speculative_stats(self) -> dict:
+        """The /stats + /metrics acceptance surface: emitted tokens per
+        verify step (the headline), draft acceptance rate, and the
+        host-side proposer overhead — all zero/off when speculative
+        decoding is disabled."""
+        if self.speculative_k < 2:
+            return {"enabled": False, "k": 0}
+        steps = sum(w.verify_steps_run for w in self._workers)
+        slot_steps = sum(w.slot_steps for w in self._workers)
+        accepted = sum(w.accepted_tokens for w in self._workers)
+        drafted = sum(w.drafted_tokens for w in self._workers)
+        # tokens beyond the 1-per-slot-step a plain decode would emit
+        bonus = accepted - slot_steps
+        overhead = sum(w.draft_overhead_s for w in self._workers)
+        return {
+            "enabled": True, "k": self.speculative_k,
+            "verify_steps": steps,
+            "accepted_tokens_per_step": (round(accepted / slot_steps, 4)
+                                         if slot_steps else 0.0),
+            "draft_acceptance_rate": (round(bonus / drafted, 4)
+                                      if drafted else 0.0),
+            "draft_overhead_us_total": round(overhead * 1e6, 1),
         }

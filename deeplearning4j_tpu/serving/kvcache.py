@@ -25,6 +25,13 @@ allocation.
   resident pages is more HBM left for replicas) reconstructs from those
   events alone.
 
+* The cache DTYPE is part of the accounting (r16): an ``int8`` paged
+  cache stores 1-byte codes plus one f32 scale per (page, head), so a
+  slot's HBM bill shrinks ~4x vs f32 — `bytes_per_slot` is the single
+  home for that arithmetic, and the replay artifact's
+  ``slots_per_hbm_byte`` uplift row (gate: >= 1.8x) is computed from
+  it, not re-derived ad hoc.
+
 Pure stdlib: importable under the graftlint AST stage's no-jax stubs.
 """
 
@@ -33,6 +40,36 @@ from __future__ import annotations
 import threading
 
 DEFAULT_PAGE_SIZE = 16
+
+KV_DTYPES = ("f32", "int8")
+
+
+def validate_kv_dtype(kv_dtype: str) -> str:
+    """The serving cache dtype knob ('f32' | 'int8'), validated once at
+    the engine front door so a typo fails at construction, not as a
+    shape error mid-replay."""
+    if kv_dtype not in KV_DTYPES:
+        raise ValueError(
+            f"kv_dtype must be one of {KV_DTYPES}, got {kv_dtype!r}")
+    return kv_dtype
+
+
+def bytes_per_slot(capacity: int, attention_specs, kv_dtype: str = "f32",
+                   page_size: int = DEFAULT_PAGE_SIZE) -> int:
+    """HBM bytes one decode slot's K+V rows cost across all attention
+    layers. `attention_specs` is the nn/decode.py list of
+    (name, n_heads, head_dim). f32: capacity*H*D*4 per tensor. int8:
+    1-byte codes plus one f32 scale per (page, head) per tensor."""
+    validate_kv_dtype(kv_dtype)
+    total = 0
+    for _name, H, D in attention_specs:
+        if kv_dtype == "f32":
+            per_tensor = capacity * H * D * 4
+        else:
+            per_tensor = (capacity * H * D
+                          + (capacity // int(page_size)) * H * 4)
+        total += 2 * per_tensor  # K and V
+    return total
 
 
 def pages_for(n_tokens: int, page_size: int) -> int:
@@ -118,17 +155,23 @@ class CachePlan:
 
     def __init__(self, max_seq_bucket: int, max_new_tokens: int,
                  n_slots: int, page_size: int = DEFAULT_PAGE_SIZE,
-                 pool_pages: int | None = None):
+                 pool_pages: int | None = None, kv_dtype: str = "f32"):
         if n_slots < 1:
             raise ValueError(f"need n_slots >= 1, got {n_slots}")
         self.page_size = int(page_size)
         self.max_new_tokens = int(max_new_tokens)
         self.n_slots = int(n_slots)
+        self.kv_dtype = validate_kv_dtype(kv_dtype)
         self.capacity = quantize(max_seq_bucket + max_new_tokens,
                                  page_size)
         self.pages_per_slot = self.capacity // self.page_size
         self.pool_pages = (self.n_slots * self.pages_per_slot
                            if pool_pages is None else int(pool_pages))
+
+    def bytes_per_slot(self, attention_specs) -> int:
+        """This plan's per-slot HBM bill (see module `bytes_per_slot`)."""
+        return bytes_per_slot(self.capacity, attention_specs,
+                              self.kv_dtype, self.page_size)
 
     def make_pool(self) -> PagePool:
         return PagePool(self.pool_pages, self.page_size)
@@ -144,4 +187,5 @@ class CachePlan:
                 "page_size": self.page_size,
                 "pages_per_slot": self.pages_per_slot,
                 "pool_pages": self.pool_pages,
-                "max_new_tokens": self.max_new_tokens}
+                "max_new_tokens": self.max_new_tokens,
+                "kv_dtype": self.kv_dtype}

@@ -44,6 +44,24 @@ loss), autoscale occupancy (mean replicas held / max, from `autoscale`
 events), and the weight generations visible in `request` events.
 Artifact: SERVE_r03-style, gated by tools/benchdiff.py (all the new
 rows are lower-is-better except QPS).
+
+The SPECULATIVE replay (r04, ISSUE 16) is the decode raw-speed bench:
+`run_speculative_replay` drives the SAME seeded generation trace
+through three interleaved arms — **baseline** (plain greedy decode,
+f32 cache), **speculative** (self-speculative n-gram drafting with one
+fixed-shape verify step per k-token window), and **quantized** (int8
+paged KV cache) — capturing every stream's emitted tokens so the two
+parity gates (speculative == baseline, quantized == baseline, both
+bit-identical under greedy) are checked against real traffic, not a
+unit fixture. `reconstruct_generation` learns the `draft` events and
+`verify_step` spans: `accepted_tokens_per_step` (median emitted tokens
+per slot per verify step — the headline, > 1.0 means speculation beat
+the one-token floor), `draft_acceptance_rate`, and `draft_overhead_us`
+(host proposer cost per step). The artifact adds
+`serving_sample_us` (the fused-sampling microbench row) and
+`serving_quantized_slots_per_hbm_byte_x` (the f32/int8 bytes-per-slot
+ratio from kvcache.bytes_per_slot — the capacity headline). Artifact:
+SERVE_r04-style, written by bench.py's `serving_speculative` mode.
 """
 
 from __future__ import annotations
@@ -263,12 +281,16 @@ def make_generation_trace(seed: int = 0, n_requests: int = 24, *,
 
 def replay_generate_http(url: str, trace, *, make_prompt,
                          time_scale: float = 1.0,
-                         timeout_s: float = 120.0) -> dict:
+                         timeout_s: float = 120.0,
+                         collect_tokens: bool = False) -> dict:
     """POST every trace entry to `url`/generate at its arrival offset
     and drain the STREAMING body (each token line arrives as the decode
     loop emits it). `make_prompt(index, prompt_len)` builds the token
     prompt — deterministic per index. Client-side counts only; the
-    scoreboard reconstructs from telemetry."""
+    scoreboard reconstructs from telemetry. With `collect_tokens` the
+    result carries a `tokens` dict (request index -> the summary line's
+    full emitted token list) — the raw material of the speculative
+    replay's greedy-parity gates."""
     t_start = time.monotonic()
 
     def one(idx_entry):
@@ -290,25 +312,29 @@ def replay_generate_http(url: str, trace, *, make_prompt,
                     lines = [json.loads(l)
                              for l in resp.read().splitlines() if l]
                 if not lines or not lines[-1].get("done"):
-                    return f"gen-{i}: stream ended without summary"
+                    return f"gen-{i}: stream ended without summary", None
                 if lines[-1].get("error"):
-                    return f"gen-{i}: {lines[-1]['error']}"
-                return None
+                    return f"gen-{i}: {lines[-1]['error']}", None
+                return None, [int(t) for t in lines[-1].get("tokens", [])]
             except urllib.error.HTTPError as exc:
                 # 503 = pool saturated + queue full: the graceful
                 # refusal contract, reported distinctly from transport
                 # errors
-                return f"gen-{i}: HTTP {exc.code}"
+                return f"gen-{i}: HTTP {exc.code}", None
             except Exception as exc:
                 last = exc
-        return f"gen-{i}: {last!r}"
+        return f"gen-{i}: {last!r}", None
 
     with concurrent.futures.ThreadPoolExecutor(_CLIENT_WORKERS) as pool:
         results = list(pool.map(one, enumerate(trace)))
-    errors = [r for r in results if r is not None]
-    return {"sent": len(results), "ok": len(results) - len(errors),
-            "failed": len(errors), "errors": errors[:5],
-            "wall_s": round(time.monotonic() - t_start, 3)}
+    errors = [err for err, _ in results if err is not None]
+    out = {"sent": len(results), "ok": len(results) - len(errors),
+           "failed": len(errors), "errors": errors[:5],
+           "wall_s": round(time.monotonic() - t_start, 3)}
+    if collect_tokens:
+        out["tokens"] = {i: toks for i, (err, toks) in enumerate(results)
+                         if err is None and toks is not None}
+    return out
 
 
 def reconstruct_generation(telemetry_path: str) -> dict:
@@ -326,11 +352,19 @@ def reconstruct_generation(telemetry_path: str) -> dict:
       the predict path's zero-retrace gate;
     * decode-step timing per prompt bucket — median `decode_step` span
       seconds, the flatness evidence for "decode cost is independent of
-      prompt length".
+      prompt length";
+    * speculative accounting, when `draft` events are on the record —
+      `accepted_tokens_per_step` (the MEDIAN of per-verify-step emitted
+      tokens per active slot: 1.0 is the plain-decode floor, anything
+      above it is decode steps the slots never ran),
+      `draft_acceptance_rate` (accepted drafts / offered drafts), and
+      `draft_overhead_us` (mean host-side proposer wall clock per
+      verify step), plus the median `verify_step` span time.
     """
     requests, compiles, warm_compiles = [], 0, 0
     occupancy_peak = 0.0
     decode_spans = []
+    draft_events, verify_spans = [], []
     with open(telemetry_path) as fh:
         for raw in fh:
             raw = raw.strip()
@@ -350,6 +384,10 @@ def reconstruct_generation(telemetry_path: str) -> dict:
                     compiles += 1
             elif kind == "span" and ev.get("name") == "decode_step":
                 decode_spans.append(ev)
+            elif kind == "span" and ev.get("name") == "verify_step":
+                verify_spans.append(ev)
+            elif kind == "draft":
+                draft_events.append(ev)
             elif kind == "page_pool":
                 total = ev.get("pages_total") or 0
                 if total:
@@ -377,6 +415,22 @@ def reconstruct_generation(telemetry_path: str) -> dict:
                       for ev in decode_spans)
         out["decode_step_ms_p50"] = round(
             1000.0 * _percentile(secs, 50), 3)
+    if draft_events:
+        per_step = sorted(
+            float(ev.get("emitted", 0)) / max(int(ev.get("n_active", 1)), 1)
+            for ev in draft_events)
+        offered = sum(int(ev.get("drafted", 0)) for ev in draft_events)
+        accepted = sum(int(ev.get("accepted", 0)) for ev in draft_events)
+        out["verify_steps"] = len(draft_events)
+        out["accepted_tokens_per_step"] = round(_percentile(per_step, 50), 4)
+        out["draft_acceptance_rate"] = round(
+            accepted / offered, 4) if offered else 0.0
+        out["draft_overhead_us"] = round(
+            sum(float(ev.get("overhead_us", 0.0)) for ev in draft_events)
+            / len(draft_events), 2)
+    if verify_spans:
+        secs = sorted(float(ev.get("seconds", 0.0)) for ev in verify_spans)
+        out["verify_step_ms_p50"] = round(1000.0 * _percentile(secs, 50), 3)
     if ok:
         first_enqueue = min(float(ev["ts"]) - float(ev["total_s"])
                             for ev in ok)
@@ -395,8 +449,11 @@ def generation_metric_lines(scoreboard: dict,
     """Bench metric lines for the generation scoreboard. tokens/sec is
     higher-is-better (the default); TTFT latency, cache-page occupancy,
     and the retrace count carry the explicit lower_is_better flag
-    benchdiff inverts on."""
-    return [
+    benchdiff inverts on. A speculative scoreboard (draft events were
+    on the record) adds `accepted_tokens_per_step` (higher) and
+    `draft_overhead_us` (lower — the `_us` suffix is also in
+    benchdiff's name-shape fallback)."""
+    lines = [
         {"metric": f"{prefix}_tokens_per_sec",
          "value": scoreboard["tokens_per_sec"], "unit": "tok/sec",
          "n_ok": scoreboard["n_ok"], "n_failed": scoreboard["n_failed"],
@@ -415,6 +472,18 @@ def generation_metric_lines(scoreboard: dict,
          "lower_is_better": True,
          "warmup_compiles": scoreboard["warmup_compiles"]},
     ]
+    if "accepted_tokens_per_step" in scoreboard:
+        lines.append(
+            {"metric": f"{prefix}_accepted_tokens_per_step",
+             "value": scoreboard["accepted_tokens_per_step"],
+             "unit": "tokens/step",
+             "verify_steps": scoreboard["verify_steps"],
+             "draft_acceptance_rate": scoreboard["draft_acceptance_rate"]})
+        lines.append(
+            {"metric": f"{prefix}_draft_overhead_us",
+             "value": scoreboard["draft_overhead_us"], "unit": "us",
+             "lower_is_better": True})
+    return lines
 
 
 def run_generation_replay(*, seed: int = 0, n_requests: int = 24,
@@ -425,6 +494,8 @@ def run_generation_replay(*, seed: int = 0, n_requests: int = 24,
                           replicas: int = 1,
                           prefill_chunk: int | None = None,
                           max_queue: int = 256,
+                          speculative_k: int = 0,
+                          kv_dtype: str = "f32",
                           telemetry_path: str,
                           artifact_path: str | None = None,
                           checkpoint: str | None = None,
@@ -433,7 +504,8 @@ def run_generation_replay(*, seed: int = 0, n_requests: int = 24,
     over the prompt-bucket lattice, the seeded generation trace over
     real HTTP with streaming reads, drain, scoreboard from telemetry
     alone, optional SERVE artifact (the SERVE_r02 shape). Same rc
-    semantics as `run_replay`."""
+    semantics as `run_replay`. `speculative_k`/`kv_dtype` pass straight
+    through to the engine (0/"f32" = the plain decode path)."""
     from deeplearning4j_tpu.serving.buckets import BucketLattice
     from deeplearning4j_tpu.serving.engine import GenerationEngine
     from deeplearning4j_tpu.serving.server import ServingServer
@@ -443,7 +515,8 @@ def run_generation_replay(*, seed: int = 0, n_requests: int = 24,
     rec.meta(role="trafficreplay-generate", seed=seed,
              n_requests=n_requests, burst=burst,
              prompt_lengths=list(prompt_lengths),
-             output_lengths=list(output_lengths))
+             output_lengths=list(output_lengths),
+             speculative_k=speculative_k, kv_dtype=kv_dtype)
     lattice = BucketLattice(batch_sizes=(1,),
                             seq_lens=sorted(set(prompt_lengths)))
     lattice.validate_attention(head_dim=16)
@@ -460,6 +533,7 @@ def run_generation_replay(*, seed: int = 0, n_requests: int = 24,
         net, lattice, slots=slots, max_new_tokens=max(output_lengths),
         page_size=page_size, prefill_chunk=prefill_chunk,
         max_queue=max_queue, replicas=replicas, checkpoint=checkpoint,
+        speculative_k=speculative_k, kv_dtype=kv_dtype,
         recorder=rec)
     warm = engine.warmup()
     server = ServingServer(engine, port=0).start()
@@ -484,6 +558,186 @@ def run_generation_replay(*, seed: int = 0, n_requests: int = 24,
         scoreboard["artifact"] = artifact_path
     scoreboard["lines"] = lines
     return scoreboard
+
+
+# -------------------------------------------------- speculative replay
+
+def _sample_microbench_us(batch: int = 8, vocab: int = 128,
+                          iters: int = 20) -> float:
+    """Best-of-N wall clock (µs) for one fused_sample call — the
+    `serving_sample_us` artifact row. Runs the real Pallas kernel on
+    TPU and the bit-identical reference path elsewhere, so the row is
+    comparable within a platform and honest about which path ran."""
+    import jax
+
+    from deeplearning4j_tpu.ops import fused_sampling
+
+    rng = np.random.default_rng(0)
+    logits = np.asarray(rng.normal(size=(batch, vocab)), np.float32)
+    noise = fused_sampling.gumbel_noise(jax.random.PRNGKey(0), batch, vocab)
+
+    # jit the wrapper: off-TPU the reference path is op-by-op eager
+    # otherwise, and eager dispatch is what gets measured, not the op
+    fn = jax.jit(lambda lg, nz: fused_sampling.fused_sample(
+        lg, nz, temperature=1.0, top_k=8, top_p=0.9))
+
+    def call():
+        return fn(logits, noise)
+
+    call().block_until_ready()  # compile outside the timed region
+    best = float("inf")
+    for _ in range(max(1, iters)):
+        t0 = time.perf_counter()
+        call().block_until_ready()
+        best = min(best, time.perf_counter() - t0)
+    return round(best * 1e6, 2)
+
+
+def run_speculative_replay(*, seed: int = 0, n_requests: int = 24,
+                           burst: int = 2, mean_gap_s: float = 0.01,
+                           prompt_lengths=(8, 16, 32),
+                           output_lengths=(4, 8, 16),
+                           slots: int = 4, page_size: int = 16,
+                           speculative_k: int = 4,
+                           repeats: int = 2,
+                           max_queue: int = 256,
+                           telemetry_path: str,
+                           artifact_path: str | None = None,
+                           emit=None) -> dict:
+    """The SERVE_r04 bench: the SAME seeded generation trace through
+    three arms, INTERLEAVED round-robin across `repeats` rounds (so
+    ambient host noise lands on every arm, not just the last one):
+
+    * **baseline** — plain greedy decode, f32 cache (`serving_generate`
+      rows: the same shape SERVE_r02 carries);
+    * **speculative** — `speculative_k`-token windows: n-gram drafts +
+      ONE fixed-shape verify step per window (`serving_speculative`
+      rows, plus `accepted_tokens_per_step` and `draft_overhead_us`);
+    * **quantized** — int8 paged KV cache (`serving_quantized` rows,
+      plus the `slots_per_hbm_byte_x` capacity ratio).
+
+    All three arms share ONE tiny-LM weight init and serve identical
+    prompts, and every stream's emitted tokens are captured — the
+    `*_parity_mismatches` rows count requests whose greedy token
+    sequence diverged from the baseline's first round (the two
+    bit-identity gates; both must be 0). Each arm appends every round
+    to its own telemetry file (`<path>.<arm>`) and reconstructs from it
+    alone. rc semantics as `run_replay`: parity failures are REPORTED
+    rows, not raises — the committed-artifact gate is benchdiff's."""
+    from deeplearning4j_tpu.nn.decode import attention_specs
+    from deeplearning4j_tpu.serving.buckets import BucketLattice
+    from deeplearning4j_tpu.serving.engine import GenerationEngine
+    from deeplearning4j_tpu.serving.kvcache import CachePlan, bytes_per_slot
+    from deeplearning4j_tpu.serving.server import ServingServer
+    from deeplearning4j_tpu.telemetry import Recorder
+
+    if speculative_k < 2:
+        raise ValueError(
+            f"need speculative_k >= 2 for the speculative arm, "
+            f"got {speculative_k}")
+    net = _tiny_lm(max_seq=max(prompt_lengths) + max(output_lengths))
+    vocab = 64
+    prompt_rng = np.random.default_rng(seed + 1)
+    prompts = prompt_rng.integers(0, vocab,
+                                  (n_requests, max(prompt_lengths)))
+
+    def make_prompt(i, plen):
+        return prompts[i, :plen].astype(np.int32)
+
+    trace = make_generation_trace(
+        seed, n_requests, mean_gap_s=mean_gap_s, burst=burst,
+        prompt_lengths=prompt_lengths, output_lengths=output_lengths)
+    arms = (("baseline", 0, "f32", "serving_generate"),
+            ("speculative", speculative_k, "f32", "serving_speculative"),
+            ("quantized", 0, "int8", "serving_quantized"))
+
+    def run_arm(name, k, dtype, rnd) -> dict:
+        tpath = f"{telemetry_path}.{name}"
+        rec = Recorder(tpath)
+        rec.meta(role="trafficreplay-speculative", arm=name, round=rnd,
+                 seed=seed, n_requests=n_requests, burst=burst,
+                 speculative_k=k, kv_dtype=dtype)
+        lattice = BucketLattice(batch_sizes=(1,),
+                                seq_lens=sorted(set(prompt_lengths)))
+        lattice.validate_attention(head_dim=16)
+        engine = GenerationEngine(
+            net, lattice, slots=slots,
+            max_new_tokens=max(output_lengths), page_size=page_size,
+            max_queue=max_queue, speculative_k=k, kv_dtype=dtype,
+            recorder=rec)
+        engine.warmup()
+        server = ServingServer(engine, port=0).start()
+        try:
+            client = replay_generate_http(server.url, trace,
+                                          make_prompt=make_prompt,
+                                          collect_tokens=True)
+        finally:
+            server.stop()
+            rec.close()
+        client["telemetry"] = tpath
+        return client
+
+    token_rounds = {name: [] for name, _, _, _ in arms}
+    for rnd in range(max(1, repeats)):
+        for name, k, dtype, _prefix in arms:
+            client = run_arm(name, k, dtype, rnd)
+            token_rounds[name].append(client.get("tokens", {}))
+
+    # parity: every arm's every round against the baseline's FIRST
+    # round — a baseline round that disagrees with itself is a
+    # determinism failure and counts too
+    reference = token_rounds["baseline"][0]
+    mismatches = {}
+    for name, _, _, _ in arms:
+        bad = 0
+        for tokens in token_rounds[name]:
+            for i, ref in reference.items():
+                if tokens.get(i) != ref:
+                    bad += 1
+        mismatches[name] = bad
+
+    scoreboards, lines = {}, []
+    for name, _k, _dtype, prefix in arms:
+        sb = reconstruct_generation(f"{telemetry_path}.{name}")
+        sb["telemetry"] = f"{telemetry_path}.{name}"
+        scoreboards[name] = sb
+        lines.extend(generation_metric_lines(sb, prefix=prefix))
+
+    # the capacity headline: how many more slots fit per HBM byte with
+    # the int8 cache, from the SAME plan the engines served under
+    plan = CachePlan(max(prompt_lengths), max(output_lengths),
+                     n_slots=slots, page_size=page_size)
+    specs = attention_specs(net)
+    f32_bytes = bytes_per_slot(plan.capacity, specs, "f32", page_size)
+    int8_bytes = bytes_per_slot(plan.capacity, specs, "int8", page_size)
+    ratio = round(f32_bytes / int8_bytes, 4)
+    lines.append(
+        {"metric": "serving_quantized_slots_per_hbm_byte_x",
+         "value": ratio, "unit": "x", "f32_bytes_per_slot": f32_bytes,
+         "int8_bytes_per_slot": int8_bytes})
+    lines.append(
+        {"metric": "serving_sample_us", "value": _sample_microbench_us(),
+         "unit": "us", "lower_is_better": True})
+    lines.append(
+        {"metric": "serving_speculative_parity_mismatches",
+         "value": mismatches["speculative"] + mismatches["baseline"],
+         "unit": "count", "lower_is_better": True,
+         "n_reference": len(reference)})
+    lines.append(
+        {"metric": "serving_quantized_parity_mismatches",
+         "value": mismatches["quantized"], "unit": "count",
+         "lower_is_better": True, "n_reference": len(reference)})
+    if emit is not None:
+        for line in lines:
+            emit(line)
+    out = {"arms": scoreboards, "parity_mismatches": mismatches,
+           "lines": lines, "repeats": max(1, repeats),
+           "n_ok": sum(sb["n_ok"] for sb in scoreboards.values()),
+           "slots_per_hbm_byte_x": ratio}
+    if artifact_path:
+        out["summary"] = write_artifact(artifact_path, lines)
+        out["artifact"] = artifact_path
+    return out
 
 
 # ----------------------------------------------------------- the harness

@@ -29,9 +29,13 @@ Endpoints (all JSON):
                         latency histogram + live p50/p99 (fed straight
                         off the telemetry `request` event stream, no
                         log parse on the scrape path), queue depth,
-                        KV page-pool occupancy, published weight
-                        generation/step, per-replica liveness and
-                        heartbeat age — the fleet's pager surface
+                        KV page-pool occupancy (raw pages + fill
+                        ratio), speculative-decode acceptance gauges
+                        (accepted tokens/step, draft acceptance rate)
+                        when the engine decodes speculatively,
+                        published weight generation/step, per-replica
+                        liveness and heartbeat age — the fleet's pager
+                        surface
     GET  /healthz       {"status", "replicas", "lattice", "served", ...,
                         "fleet": [per-replica {index, state (warming/
                         serving/draining/dead/retired), alive, counters,
@@ -290,6 +294,17 @@ class ServingMetrics:
             "serving_trace_count",
             "compiled-trace count (frozen after warmup: any growth "
             "mid-traffic is a retrace)")
+        self.pool_occupancy = self.registry.gauge(
+            "serving_page_occupancy_ratio",
+            "KV-cache page pool fill fraction (pages_in_use / "
+            "pages_total) per replica")
+        self.spec_accepted = self.registry.gauge(
+            "serving_speculative_accepted_tokens_per_step",
+            "running mean tokens emitted per verify step per active "
+            "slot (1.0 = the non-speculative floor)")
+        self.spec_acceptance = self.registry.gauge(
+            "serving_speculative_acceptance_rate",
+            "fraction of offered draft tokens the verify step accepted")
         self.registry.add_collector(self._collect)
 
     # ------------------------------------------------------- live events
@@ -338,11 +353,23 @@ class ServingMetrics:
         for state, n in states.items():
             self.replicas.set(n, state=state)
         self.pool_pages.clear()
+        self.pool_occupancy.clear()
         for i, pool in enumerate(stats.get("page_pools", [])):
             for field in ("pages_in_use", "pages_total", "pages_peak"):
                 if field in pool:
                     self.pool_pages.set(pool[field], replica=str(i),
                                         kind=field)
+            total = float(pool.get("pages_total", 0) or 0)
+            if total:
+                self.pool_occupancy.set(
+                    float(pool.get("pages_in_use", 0)) / total,
+                    replica=str(i))
+        spec = stats.get("speculative") or {}
+        if spec.get("enabled"):
+            self.spec_accepted.set(
+                float(spec.get("accepted_tokens_per_step", 0.0)))
+            self.spec_acceptance.set(
+                float(spec.get("draft_acceptance_rate", 0.0)))
 
     def render(self) -> str:
         return self.registry.render()

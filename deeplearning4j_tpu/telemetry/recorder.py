@@ -27,6 +27,7 @@ Event schema — one JSON object per line, every event carrying
 | `kernel_tune` | one kernel-autotune micro-bench measurement (tools/kerneltune.py): `kernel`, `key` (the ops/autotune.py config key), `params` (the candidate block sizes), `seconds` (per-call wall clock), `role` ("default" / "candidate" / "chosen"), free-form fields — the provenance trail behind every tuning_table.json entry |
 | `request` | one served inference request (serving/engine.py): `id`, `ok`, `bucket` ([batch, seq]), `replica`, `queue_s` (enqueue -> batch cut), `batch_assemble_s` (host-side padding), `forward_s` (jitted forward incl. batch-boundary fetch), `total_s` (enqueue -> result), `seq_len`/`padded_seq` for sequence models, `weight_gen` (the published weight generation the batch served against — serving/fleet.py), `error` on a failed batch — the ONLY record serving/replay.py reconstructs p50/p99/QPS from. Generation requests carry `kind: "generate"` plus `prompt_len`, `prompt_bucket`, `new_tokens`, and `ttft_s` (enqueue -> first token, i.e. the prefill's final chunk) — the rows tokens/sec and TTFT percentiles reconstruct from |
 | `page_pool` | KV-cache page accounting snapshot (serving/kvcache.py), emitted on every reserve/release: `replica`, `pages_total`, `page_size`, `pages_in_use`, `pages_peak` — the cache-occupancy headline's only source |
+| `draft` | one speculative verify step's draft accounting (serving/engine.py): `replica`, `k` (window width), `n_active`, `emitted` (tokens emitted this step across slots), `accepted` (accepted drafts = emitted minus the per-slot bonus token), `drafted` ((k-1) * n_active proposals offered), `overhead_us` (host-side proposer wall clock) — the `accepted_tokens_per_step` and `draft_overhead_us` bench rows reconstruct from exactly these |
 | `reshard_plan` | a portable-resharding plan (reshard/) put on the record BEFORE any transfer: `path` ("live" / "checkpoint"), `src`/`dst` placement descriptions, `n_leaves`, per-action counts, `bytes_total`, `bytes_moved`, `bytes_lower_bound`; the transfer itself runs inside a `span` named `reshard` carrying the same byte fields |
 | `placement_search` | one automatic-placement-search run (reshard/search.py) put on the record BEFORE any mesh is built: `path` ("cli" = the `plan` dry-run, "elastic" = a worker's per-generation re-plan, "reform" = the supervisor's pre-relaunch search, "bench" = the placement_search bench), `fleet` ("2x4"), `profile`, `candidates_considered` / `candidates_feasible` / `pruned`, `winner` (the placement description), the winner's score breakdown (`winner_score`, `winner_memory_bytes`, `winner_collective_bytes`, `winner_bubble_cost`, `winner_idle_cost`), and `search_ms` — the elastic timeline test asserts one per worker per generation |
 | `host_gather` | a full-value host materialization of genuinely SHARDED leaves (util/orbax_checkpoint.host_materialize): `n_leaves`, `bytes` — resharded restore paths must show ZERO of these (asserted by the elastic timeline test) |
@@ -56,13 +57,17 @@ export) never meets a name it cannot classify. Dynamic names
 (f-strings like the bench sweep's `mode:<name>` spans) are exempt from
 the static check and parse as opaque spans.
 
-Generation serving adds two hot-loop span names: `prefill_chunk` (one
-bucket-shaped prompt chunk — `bucket`, `start`, `final`, `replica`) and
+Generation serving adds three hot-loop span names: `prefill_chunk` (one
+bucket-shaped prompt chunk — `bucket`, `start`, `final`, `replica`),
 `decode_step` (one fixed-shape step over every decode slot — `replica`,
-`n_active`); their first execution per shape nests a `compile` span
-exactly like the predict path, and the flat-across-prompt-buckets
-property of the decode_step timings is the "decode cost independent of
-prompt length" gate in tier-1.
+`n_active`), and `verify_step` (one fixed-shape speculative
+verification over every slot's k-token draft window — `replica`,
+`n_active`, `k`; it REPLACES decode_step when the engine runs with
+`speculative_k >= 2`, and each one pairs with a `draft` event carrying
+the acceptance accounting); their first execution per shape nests a
+`compile` span exactly like the predict path, and the
+flat-across-prompt-buckets property of the decode_step timings is the
+"decode cost independent of prompt length" gate in tier-1.
 
 The input pipeline (data/pipeline.py) names an ``input_wait`` span
 around EVERY batch dequeue in the fit loops: `pipelined` (false = the
@@ -110,7 +115,8 @@ ENV_VAR = "DL4J_TPU_TELEMETRY"
 # and names are REGISTERED HERE first, alongside their docstring row.
 EVENT_KINDS = frozenset({
     "meta", "step", "span", "metric", "eval", "memory", "error", "fault",
-    "bucket_plan", "kernel_tune", "request", "page_pool", "reshard_plan",
+    "bucket_plan", "kernel_tune", "request", "page_pool", "draft",
+    "reshard_plan",
     "placement_search", "host_gather", "weight_swap", "autoscale",
     "anomaly",
 })
@@ -120,7 +126,7 @@ SPAN_NAMES = frozenset({
     "compile", "step_scan", "profiler_trace",
     # serving batch pipeline (serving/batcher.py, engine.py)
     "queue", "batch_assemble", "forward", "prefill_chunk", "decode_step",
-    "drain",
+    "verify_step", "drain",
     # input pipeline (data/pipeline.py)
     "input_wait",
     # resharding + placement (reshard/)

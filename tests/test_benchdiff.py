@@ -382,6 +382,65 @@ def test_diff_reads_telemetry_jsonl_logs(tmp_path):
     assert benchdiff.main([old.path, new.path]) == 1
 
 
+def test_speculative_rows_direction():
+    """SPECULATIVE artifact rows (SERVE_r04): the acceptance headline
+    `accepted_tokens_per_step` stays higher-is-better (a falling median
+    means drafts stopped paying for their verify step), the overhead
+    rows ride the `_us` rule by flag and by summary-reconstructed name,
+    and the parity gates regress on ANY growth — greedy output is
+    bit-identical by construction, so one mismatch is a correctness
+    break, not a drift."""
+    drop = benchdiff.diff(
+        _lines(serving_speculative_accepted_tokens_per_step={"value": 2.0}),
+        _lines(serving_speculative_accepted_tokens_per_step={"value": 1.2}),
+        threshold=0.1)["regressions"]
+    assert drop and drop[0]["delta_pct"] == -40.0
+    assert benchdiff.diff(
+        _lines(serving_speculative_accepted_tokens_per_step={"value": 1.5}),
+        _lines(serving_speculative_accepted_tokens_per_step={"value": 2.5}),
+        threshold=0.1)["regressions"] == []
+    for metric in ("serving_speculative_draft_overhead_us",
+                   "serving_sample_us"):
+        worse = benchdiff.diff(
+            _lines(**{metric: {"value": 40.0, "lower_is_better": True}}),
+            _lines(**{metric: {"value": 80.0, "lower_is_better": True}}),
+            threshold=0.1)["regressions"]
+        assert worse, f"{metric} growth did not regress"
+        bare = benchdiff.diff(_lines(**{metric: {"value": 40.0}}),
+                              _lines(**{metric: {"value": 80.0}}),
+                              threshold=0.1)["regressions"]
+        assert bare, f"{metric} name pattern lost its direction"
+        assert benchdiff.diff(_lines(**{metric: {"value": 40.0}}),
+                              _lines(**{metric: {"value": 20.0}}),
+                              threshold=0.1)["regressions"] == []
+    # a parity mismatch rising from ZERO always regresses (no ratio
+    # exists for a zero base — any divergence breaks the bit-identity
+    # contract), flag or summary-reconstructed bare value alike
+    for metric in ("serving_speculative_parity_mismatches",
+                   "serving_quantized_parity_mismatches"):
+        (row,) = benchdiff.diff(_lines(**{metric: {"value": 0}}),
+                                _lines(**{metric: {"value": 1}}),
+                                threshold=0.1)["regressions"]
+        assert row["metric"] == metric
+    # the int8 capacity headline stays higher-is-better
+    assert benchdiff.diff(
+        _lines(serving_quantized_slots_per_hbm_byte_x={"value": 3.9}),
+        _lines(serving_quantized_slots_per_hbm_byte_x={"value": 1.2}),
+        threshold=0.1)["regressions"]
+
+
+def test_committed_serve_r04_self_diff_is_clean(capsys):
+    """The round gate's trivial fixed point, against the real committed
+    artifact: SERVE_r04 diffed against itself reports no regression and
+    exits 0 — proving every r04 row parses and no direction rule
+    misfires on its own values."""
+    path = os.path.join(ROOT, "SERVE_r04.json")
+    rc = benchdiff.main([path, path])
+    out = capsys.readouterr().out
+    assert rc == 0
+    assert "REGRESSED" not in out
+
+
 def test_committed_r04_vs_r05_names_the_dp_regression(capsys):
     """The acceptance-criterion invocation, against the real committed
     artifacts: r05's DP-speedup flip below parity (VERDICT r5 #2) is

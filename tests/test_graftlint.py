@@ -588,6 +588,33 @@ def run(rec, m, mode):
     with rec.span(f"mode:{mode}"):  # f-strings parse as opaque spans
         pass
 """),
+    ("G024", """\
+def sample_tokens(slots, logits_batch):
+    for slot, decode_row in zip(slots, logits_batch):
+        if np.random.random() < 0.5:              # host RNG per token
+            order = np.argsort(decode_row_logits)  # host top-k rebuild
+            mass = np.cumsum(probs[order])         # host top-p rebuild
+""", """\
+from deeplearning4j_tpu.ops.fused_sampling import fused_sample
+
+
+def sample_step(slots, logits, noise):
+    ids = fused_sample(logits, noise, temperature=0.8,
+                       top_k=32, top_p=0.9)        # the blessed kernel
+    for slot, tok in zip(slots, np.asarray(ids).tolist()):
+        slot.emit(tok)
+
+
+def order_slots(slots):
+    # argsort over non-logits values in a token loop stays silent
+    for tok_batch in slots:
+        ranks = np.argsort(tok_batch.arrival_times)
+
+
+def seed_proposer(seed):
+    # host RNG OUTSIDE decode loops (setup, jitter) is not sampling
+    return np.random.default_rng(seed)
+"""),
 ]
 
 
@@ -597,6 +624,7 @@ RULE_FIXTURE_PATHS = {
     "G017": "deeplearning4j_tpu/serving/_graftlint_fixture.py",
     "G019": "deeplearning4j_tpu/serving/_graftlint_fixture.py",
     "G021": "deeplearning4j_tpu/serving/_graftlint_fixture.py",
+    "G024": "deeplearning4j_tpu/serving/_graftlint_fixture.py",
     "G022": "deeplearning4j_tpu/cli/_graftlint_fixture.py",
 }
 
@@ -612,7 +640,7 @@ def test_rule_fires_on_positive_not_negative(rule, pos, neg):
 
 def test_every_rule_has_fixture_coverage():
     assert {r for r, _, _ in FIXTURES} == set(RULE_DOCS) == {
-        f"G{i:03d}" for i in range(1, 24)}
+        f"G{i:03d}" for i in range(1, 25)}
 
 
 def test_g015_blessed_sites_are_exempt():
@@ -663,6 +691,24 @@ def test_g019_scope_and_batch_boundary_carveout():
              "    for r in results:\n"
              "        r.block_until_ready()\n")
     assert "G019" not in rules_in(other, serving)
+
+
+def test_g024_scope_and_carveouts():
+    """G024 is serving/-only: the same host-sampling source is silent
+    in ops/ (where the kernel's own reference path legitimately sorts
+    logits) and on the default path; argsort over non-logits values and
+    host RNG outside decode loops never flag."""
+    _, pos, neg = next(f for f in FIXTURES if f[0] == "G024")
+    serving = RULE_FIXTURE_PATHS["G024"]
+    assert "G024" in rules_in(pos, serving)
+    assert "G024" not in rules_in(pos)  # parallel/ default path
+    assert "G024" not in rules_in(
+        pos, "deeplearning4j_tpu/ops/fused_sampling.py")
+    # an RNG draw in a non-token loop stays G024-silent
+    other = ("def jitter(requests):\n"
+             "    for r in requests:\n"
+             "        r.delay = np.random.random()\n")
+    assert "G024" not in rules_in(other, serving)
 
 
 def test_g020_blessed_paths_and_loop_shape():

@@ -86,9 +86,13 @@ DEFAULT_THRESHOLD = 0.10
 # and FLEET rows (trafficreplay --fleet, SERVE_r03): swap_ms /
 # respawn_ms ride the _ms rule, autoscale occupancy the occupancy rule,
 # and failed_requests growing is dropped traffic — never an improvement.
+# SPECULATIVE rows (SERVE_r04) ride the _us rule (sample_us /
+# draft_overhead_us) and add _mismatches: the parity gates count greedy
+# token-stream divergences vs the baseline arm — while
+# accepted_tokens_per_step stays higher-is-better (no pattern match).
 _LOWER_IS_BETTER_RE = re.compile(
     r"(_p\d+_ms$|_ms$|latency|recompiles|bytes_moved$|bytes_lower_bound$"
-    r"|_us$|_ttft_|occupancy|input_wait|failed_requests$"
+    r"|_us$|_ttft_|occupancy|input_wait|failed_requests$|_mismatches$"
     r"|plan_predicted|plan_winner|plan_score|plan_measured"
     r"|rank_violations$|anomaly_count$|trace_span_)")
 
@@ -98,9 +102,13 @@ _LOWER_IS_BETTER_RE = re.compile(
 # against the measurement — like a retrace count, there is no
 # acceptable increase. TRACE artifacts add the detector rows: one new
 # anomaly, or any growth in the fleet's step-completion skew, is a
-# health regression however small the percentage.
+# health regression however small the percentage. Parity mismatches
+# (SERVE_r04 speculative/quantized arms) are the same class: greedy
+# output is bit-identical by construction, so a single divergence is a
+# correctness break, not a tolerable drift.
 _ALWAYS_REGRESS_RE = re.compile(
-    r"(rank_violations$|anomaly_count$|straggler_skew_ms$)")
+    r"(rank_violations$|anomaly_count$|straggler_skew_ms$"
+    r"|_parity_mismatches$)")
 
 
 def _lower_is_better(metric: str, old: dict, new: dict) -> bool:

@@ -78,6 +78,8 @@ def sweep_configs(quick: bool) -> list[dict]:
         xent = [dict(N=256, d=128, V=2560)]
         ln = [dict(N=512, C=256)]
         decode = [dict(B=4, H=2, S=256, D=32, page=16)]
+        decode_q8 = [dict(B=4, H=2, S=256, D=32, page=16)]
+        sample = [dict(B=128, V=2048)]
     else:
         flash_shapes = [
             # the T=512 flagship (transformer mode, D=64 head pairs)
@@ -102,6 +104,17 @@ def sweep_configs(quick: bool) -> list[dict]:
             dict(B=8, H=4, S=1024, D=64, page=16),
             dict(B=8, H=2, S=2048, D=128, page=16),
         ]
+        decode_q8 = [
+            # same serving grid, int8 pages: block_k candidates stay
+            # page multiples so no block splits a scale page
+            dict(B=8, H=4, S=1024, D=64, page=16),
+            dict(B=8, H=2, S=2048, D=128, page=16),
+        ]
+        sample = [
+            # fused sampling: slots x vocab logit rows per decode step
+            dict(B=256, V=8192),
+            dict(B=256, V=32768),
+        ]
     out = []
     for s in flash_shapes:
         out.append(dict(family="flash_fwd", **s))
@@ -112,6 +125,10 @@ def sweep_configs(quick: bool) -> list[dict]:
         out.append(dict(family="softmax_xent", **s))
     for s in decode:
         out.append(dict(family="decode_attn", **s))
+    for s in decode_q8:
+        out.append(dict(family="decode_attn_q8", **s))
+    for s in sample:
+        out.append(dict(family="sample", **s))
     return out
 
 
@@ -147,15 +164,26 @@ def candidates(cfg: dict) -> list[dict]:
         for bn, bv in itertools.product((256, 512, 1024, 2048),
                                         (1024, 2048, 4096)):
             outs.append({"block_n": bn, "block_v": bv})
-    elif fam == "decode_attn":
+    elif fam in ("decode_attn", "decode_attn_q8"):
         # block_k over pages: page-multiple divisors of the quantized
-        # cache capacity (the only blocks the serving grid ever needs)
+        # cache capacity (the only blocks the serving grid ever needs;
+        # the q8 variant additionally may not split a scale page, which
+        # page-multiple candidates satisfy by construction)
         S, page = cfg["S"], cfg["page"]
         bk = page
         while bk <= S:
             if S % bk == 0:
                 outs.append({"block_k": bk})
             bk *= 2
+    elif fam == "sample":
+        # row blocks: divisors of the batch that are lane-tile
+        # multiples (or the whole batch) — the sample_rows legality rule
+        B = cfg["B"]
+        bn = 8
+        while bn <= B:
+            if B % bn == 0 and (bn % autotune.LANES == 0 or bn == B):
+                outs.append({"rows": bn})
+            bn *= 2
     else:
         raise KeyError(fam)
     default = default_params(cfg)
@@ -174,8 +202,10 @@ def config_key(cfg: dict) -> str:
         return autotune.config_key(fam, cfg["N"], cfg["C"])
     if fam == "softmax_xent":
         return autotune.config_key(fam, cfg["V"], cfg["d"])
-    if fam == "decode_attn":
+    if fam in ("decode_attn", "decode_attn_q8"):
         return autotune.config_key(fam, cfg["S"], cfg["D"])
+    if fam == "sample":
+        return autotune.config_key(fam, cfg["B"], cfg["V"])
     raise KeyError(fam)
 
 
@@ -209,6 +239,11 @@ def default_params(cfg: dict) -> dict:
             return {"block_n": bn, "block_v": bv}
         if fam == "decode_attn":
             return {"block_k": autotune.decode_block(cfg["S"], cfg["D"])}
+        if fam == "decode_attn_q8":
+            return {"block_k": autotune.decode_block_q8(
+                cfg["S"], cfg["D"], cfg["page"])}
+        if fam == "sample":
+            return {"rows": autotune.sample_rows(cfg["B"], cfg["V"])}
     finally:
         if prev is None:
             os.environ.pop(autotune.ENV_TUNING, None)
@@ -270,6 +305,35 @@ def _build_call(cfg: dict):
         pos = jnp.asarray(rng.integers(0, S, (B,)), jnp.int32)
         f = jax.jit(lambda q, k, v, pos: decode_attention(q, k, v, pos))
         return lambda: f(q, k, v, pos)
+
+    if fam == "decode_attn_q8":
+        from deeplearning4j_tpu.ops.decode_attention import (
+            cache_attention_q8,
+            quantize_pages,
+        )
+        B, H, S, D = cfg["B"], cfg["H"], cfg["S"], cfg["D"]
+        page = cfg["page"]
+        q = jnp.asarray(rng.standard_normal((B, H, 1, D)) * 0.2,
+                        jnp.float32)
+        k = jnp.asarray(rng.standard_normal((B, S, H, D)) * 0.2,
+                        jnp.float32)
+        v = jnp.asarray(rng.standard_normal((B, S, H, D)) * 0.2,
+                        jnp.float32)
+        kc, ks = quantize_pages(k, page)
+        vc, vs = quantize_pages(v, page)
+        limit = jnp.asarray(rng.integers(1, S + 1, (B, 1)), jnp.int32)
+        f = jax.jit(lambda q, kc, vc, ks, vs, limit: cache_attention_q8(
+            q, kc, vc, ks, vs, limit, page))
+        return lambda: f(q, kc, vc, ks, vs, limit)
+
+    if fam == "sample":
+        from deeplearning4j_tpu.ops import fused_sampling
+        B, V = cfg["B"], cfg["V"]
+        logits = jnp.asarray(rng.standard_normal((B, V)), jnp.float32)
+        noise = fused_sampling.gumbel_noise(jax.random.PRNGKey(0), B, V)
+        f = jax.jit(lambda lg, nz: fused_sampling.fused_sample(
+            lg, nz, temperature=1.0, top_k=64, top_p=0.9))
+        return lambda: f(logits, noise)
 
     if fam == "softmax_xent":
         from deeplearning4j_tpu.ops.fused_softmax_xent import (

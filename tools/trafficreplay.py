@@ -96,6 +96,13 @@ def main(argv=None) -> int:
                     help="decode slots per generation replica")
     ap.add_argument("--page-size", type=int, default=16,
                     help="KV-cache page size (tokens per page)")
+    ap.add_argument("--speculative-k", type=int, default=0,
+                    help="speculative decode window width for "
+                         "--generate (0 = off; >= 2 drafts k-1 tokens "
+                         "per slot and verifies the window in one step)")
+    ap.add_argument("--kv-dtype", choices=("f32", "int8"), default="f32",
+                    help="KV-cache storage dtype for --generate "
+                         "(int8 = per-page-scale quantized pages)")
     ap.add_argument("--chaos", default=None, metavar="SPEC",
                     help="replica-scoped fault spec(s) to inject "
                          "(distributed/faults.py grammar, e.g. "
@@ -135,6 +142,7 @@ def main(argv=None) -> int:
             output_lengths=tuple(int(t)
                                  for t in args.output_lens.split(",")),
             slots=args.slots, page_size=args.page_size,
+            speculative_k=args.speculative_k, kv_dtype=args.kv_dtype,
             replicas=args.replicas, telemetry_path=tpath,
             artifact_path=args.artifact, checkpoint=args.checkpoint,
             emit=lambda line: print(json.dumps(line), flush=True))

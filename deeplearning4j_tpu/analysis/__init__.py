@@ -1,6 +1,6 @@
-"""graftlint — JAX/TPU static analysis for this repo (ISSUEs 2 + 5).
+"""graftlint — JAX/TPU static analysis for this repo (ISSUEs 2 + 5 + 17).
 
-Three stages:
+Four stages:
 
 1. AST pass (`ast_pass.lint_paths`): rules G001-G014 over the package —
    tracer leaks, host syncs in hot paths, float64 drift, RNG discipline,
@@ -19,6 +19,15 @@ Three stages:
    against a frozen budget (C001/C002), plus re-tracing under simulated
    process_index 0 vs 1 — a rank-divergent sequence is a fleet-DEADLOCK
    finding (C003), never a budget diff.
+4. concurrency audit (`--stage concurrency`): the host-side threaded
+   runtime. AST rules G025-G028 (concurrency_rules.py) — shared-
+   attribute races with an inferred attribute->lock guard map, blocking
+   calls under held locks, wait/notify/sleep discipline, thread
+   lifecycle — plus the whole-package lock-ORDER graph
+   (lock_audit.py): any cycle is a host deadlock (D001, the twin of
+   C003, always exits 1), sink-callback reentrancy is D002, and edges
+   are frozen in analysis/lock_order.json (`--update-locks`; drift is
+   D003). Pure stdlib; never imports jax.
 
 CLI: `python tools/graftlint.py --check deeplearning4j_tpu`. Inline
 suppression: `# graftlint: disable=G00x`; grandfathered findings live in
@@ -30,11 +39,15 @@ from deeplearning4j_tpu.analysis.ast_pass import (iter_py_files,
                                                   lint_paths, lint_report,
                                                   lint_source)
 from deeplearning4j_tpu.analysis.ast_rules import RULE_DOCS
-from deeplearning4j_tpu.analysis.core import (Finding, load_baseline,
+from deeplearning4j_tpu.analysis.concurrency_rules import (guard_map,
+                                                           guard_map_for_file)
+from deeplearning4j_tpu.analysis.core import (STAGES, Finding,
+                                              load_baseline,
                                               split_baselined,
                                               write_baseline)
 
 __all__ = [
-    "Finding", "RULE_DOCS", "iter_py_files", "lint_paths", "lint_report",
+    "Finding", "RULE_DOCS", "STAGES", "guard_map", "guard_map_for_file",
+    "iter_py_files", "lint_paths", "lint_report",
     "lint_source", "load_baseline", "split_baselined", "write_baseline",
 ]

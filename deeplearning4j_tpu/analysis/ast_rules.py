@@ -1390,6 +1390,13 @@ from deeplearning4j_tpu.analysis.spmd_rules import (  # noqa: E402
     SPMD_RULE_DOCS,
     SPMD_RULES,
 )
+# stage-4 AST rules (G025-G028, host-concurrency) live in
+# concurrency_rules.py and register the same way
+from deeplearning4j_tpu.analysis.concurrency_rules import (  # noqa: E402
+    CONC_RULE_DOCS,
+    CONC_RULE_IDS,
+    CONC_RULES,
+)
 
 ALL_RULES = [g001_traced_bool, g002_host_sync, g003_float64_drift,
              g004_rng_discipline, g005_retrace_hazards,
@@ -1401,7 +1408,7 @@ ALL_RULES = [g001_traced_bool, g002_host_sync, g003_float64_drift,
              g021_weight_swap_path,
              g022_handrolled_placement,
              g023_unregistered_telemetry_names,
-             g024_host_sampling] + SPMD_RULES
+             g024_host_sampling] + SPMD_RULES + CONC_RULES
 
 RULE_DOCS = {
     "G001": "python control flow / bool()/float()/int() on traced values",
@@ -1447,6 +1454,7 @@ RULE_DOCS = {
             "in the fused on-device kernel "
             "(ops/fused_sampling.fused_sample)",
     **SPMD_RULE_DOCS,
+    **CONC_RULE_DOCS,
 }
 
 
@@ -1462,7 +1470,8 @@ def run_rules(tree: ast.AST, source: str, path: str) -> list[Finding]:
             col = getattr(node, "col_offset", 0)
             snippet = lines[line - 1].strip() if 0 < line <= len(lines) \
                 else ""
+            stage = "concurrency" if rule_id in CONC_RULE_IDS else "ast"
             findings.append(Finding(rule_id, path, line, col, message,
-                                    fixit, snippet, stage="ast"))
+                                    fixit, snippet, stage=stage))
     findings.sort(key=lambda f: (f.path, f.line, f.rule))
     return findings

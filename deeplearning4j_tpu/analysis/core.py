@@ -18,19 +18,26 @@ import re
 _SUPPRESS_RE = re.compile(r"#\s*graftlint:\s*disable=([A-Z0-9,\s]+)")
 
 
+# Every lint stage, in execution order. The CLI's --stage choices and
+# the --rules inventory derive from this — adding a stage means adding
+# it here plus its runner in tools/graftlint.py.
+STAGES = ("ast", "jaxpr", "spmd", "concurrency")
+
+
 @dataclasses.dataclass(frozen=True)
 class Finding:
-    rule: str        # "G001".."G013" (AST pass) / "J001".."J004" (jaxpr)
-                     # / "C001".."C003" (collective audit)
+    rule: str        # "G001".."G028" (AST passes) / "J001".."J004"
+                     # (jaxpr) / "C001".."C003" (collective audit)
+                     # / "D001".."D003" (lock-order audit)
     path: str        # repo-relative posix path, or an entry-point name
     line: int        # 1-based; 0 for whole-artifact (jaxpr) findings
     col: int
     message: str
     fixit: str       # how to fix it (every rule carries one)
     snippet: str = ""
-    # which lint stage produced it ("ast" | "jaxpr" | "spmd") so --json
-    # consumers (benchdiff-style tooling) can filter without re-deriving
-    # the stage from the rule id. Excluded from `key`: baselines must
+    # which lint stage produced it (one of STAGES) so --json consumers
+    # (benchdiff-style tooling) can filter without re-deriving the
+    # stage from the rule id. Excluded from `key`: baselines must
     # stay valid if a rule migrates stages.
     stage: str = ""
 

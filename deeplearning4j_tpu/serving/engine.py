@@ -84,6 +84,10 @@ class _Replica:
         self.weights = weights
         self.faults = faults
         self.queue: queue.Queue = queue.Queue()
+        # guards the stats counters below: they are `+=`-mutated on the
+        # worker thread and read by describe()/stats() on the control
+        # plane — bare read-modify-write loses updates (G025)
+        self._mu = threading.Lock()
         self.trace_count = 0
         self.served = 0
         self.failed = 0
@@ -98,7 +102,8 @@ class _Replica:
         def counted(params, state, x, mask=None):
             # runs at TRACE time only: the retrace tell the zero-retrace
             # gate asserts on (one bump per compiled bucket shape)
-            self.trace_count += 1
+            with self._mu:
+                self.trace_count += 1
             return fwd(params, state, x, mask)
 
         self._jit = jax.jit(counted)
@@ -113,7 +118,8 @@ class _Replica:
         """Fail every request of one batch loudly (worker death, reaped
         hang, drain with no live replica) — each future carries the
         error, telemetry keeps the record."""
-        self.failed += batch.n_real
+        with self._mu:
+            self.failed += batch.n_real
         if isinstance(exc_or_msg, BaseException):
             self.recorder.error(f"replica:{self.index}", exc=exc_or_msg)
             err = "".join(traceback.format_exception_only(
@@ -144,7 +150,8 @@ class _Replica:
         rec = self.recorder
         self.current_batch = batch
         self.last_beat = clock()
-        self.batches_run += 1
+        with self._mu:
+            self.batches_run += 1
         # the ONE read of the published weight set this batch serves
         # against — the hot-swap flip is atomic relative to it
         ws = self.weights.current
@@ -197,7 +204,8 @@ class _Replica:
                 out = out[:r.length]  # drop time padding
             r.result = out
             r.t_done = t_done
-            self.served += 1
+            with self._mu:
+                self.served += 1
             self._request_event(r, batch, forward_s, ok=True,
                                weight_gen=ws.generation)
             r.done.set()
@@ -257,9 +265,11 @@ class _Replica:
 
     def describe(self, now: float | None = None) -> dict:
         """One /healthz row: lifecycle, counters, heartbeat age."""
-        out = {"index": self.index, "state": self.lifecycle,
-               "alive": self.alive, "served": self.served,
-               "failed": self.failed, "batches_run": self.batches_run}
+        with self._mu:
+            out = {"index": self.index, "state": self.lifecycle,
+                   "alive": self.alive, "served": self.served,
+                   "failed": self.failed,
+                   "batches_run": self.batches_run}
         if now is not None:
             out["last_beat_age_s"] = round(max(0.0, now - self.last_beat),
                                            3)
@@ -654,6 +664,11 @@ class _GenWorker:
         self.speculative_k = int(speculative_k)
         self.cache = net.init_kv_cache(plan.n_slots, plan.capacity,
                                        plan.kv_dtype, plan.page_size)
+        # guards the stats counters below (worker-thread `+=` vs
+        # describe()/stats() reads on the control plane — G025); never
+        # held across a jit call or a queue wait, so it orders freely
+        # against `_cv`
+        self._mu = threading.Lock()
         self.trace_count = 0
         self.served = 0
         self.failed = 0
@@ -681,14 +696,16 @@ class _GenWorker:
 
         def counted_prefill(params, state, cache, padded_tokens,
                             bucket_kmask, rows, start, last_idx):
-            self.trace_count += 1  # trace-time bump: the retrace tell
+            with self._mu:  # trace-time bump: the retrace tell
+                self.trace_count += 1
             probs, cache = prefill_raw(params, state, cache,
                                        padded_tokens, bucket_kmask,
                                        rows, start, last_idx)
             return jnp.argmax(probs, axis=-1).astype(jnp.int32), cache
 
         def counted_step(params, state, cache, padded_tokens, pos):
-            self.trace_count += 1
+            with self._mu:
+                self.trace_count += 1
             probs, cache = step_raw(params, state, cache, padded_tokens,
                                     pos)
             return jnp.argmax(probs, axis=-1).astype(jnp.int32), cache
@@ -702,7 +719,8 @@ class _GenWorker:
 
             def counted_verify(params, state, cache, padded_windows,
                                pos):
-                self.trace_count += 1
+                with self._mu:
+                    self.trace_count += 1
                 probs, cache = verify_raw(params, state, cache,
                                           padded_windows, pos)
                 # [B, k] argmax rows: the acceptance mask's input —
@@ -883,7 +901,8 @@ class _GenWorker:
             slot.last_token = int(toks[0])
             now = clock()
             req.emit(slot.last_token, now)
-            self.tokens_out += 1
+            with self._mu:
+                self.tokens_out += 1
             self._maybe_complete(slot_idx, clock)
 
     def _decode_batch_step(self, active: list, clock) -> None:
@@ -899,7 +918,8 @@ class _GenWorker:
             padded_tokens[i] = slot.last_token
             pos[i] = slot.pos
         ws = self.weights.current
-        self.decode_steps_run += 1
+        with self._mu:
+            self.decode_steps_run += 1
         self.current_batch = list(active)
         try:
             with self.recorder.span("decode_step", replica=self.index,
@@ -936,7 +956,8 @@ class _GenWorker:
             slot.pos += 1
             slot.last_token = int(toks[i])
             slot.request.emit(slot.last_token, now)
-            self.tokens_out += 1
+            with self._mu:
+                self.tokens_out += 1
             self._maybe_complete(i, clock)
 
     def _speculative_batch_step(self, active: list, clock) -> None:
@@ -966,8 +987,9 @@ class _GenWorker:
             pos[i] = slot.pos
         draft_s = time.perf_counter() - t_draft
         ws = self.weights.current
-        self.decode_steps_run += 1
-        self.verify_steps_run += 1
+        with self._mu:
+            self.decode_steps_run += 1
+            self.verify_steps_run += 1
         self.current_batch = list(active)
         try:
             with self.recorder.span("verify_step", replica=self.index,
@@ -1005,16 +1027,18 @@ class _GenWorker:
             take = min(len(emitted), budget)
             for t in emitted[:take]:
                 req.emit(int(t), now)
-                self.tokens_out += 1
+                with self._mu:
+                    self.tokens_out += 1
             slot.pos += take
             slot.last_token = int(emitted[take - 1])
             step_emitted += take
             step_accepted += take - 1  # drafts accepted (bonus aside)
             self._maybe_complete(i, clock)
-        self.accepted_tokens += step_emitted
-        self.drafted_tokens += (K - 1) * len(active)
-        self.slot_steps += len(active)
-        self.draft_overhead_s += draft_s
+        with self._mu:
+            self.accepted_tokens += step_emitted
+            self.drafted_tokens += (K - 1) * len(active)
+            self.slot_steps += len(active)
+            self.draft_overhead_s += draft_s
         self.recorder.event("draft", replica=self.index, k=K,
                             n_active=len(active), emitted=step_emitted,
                             accepted=step_accepted,
@@ -1031,7 +1055,8 @@ class _GenWorker:
         self.recorder.event("page_pool", replica=self.index,
                             **self.pool.describe())
         req.finish(clock())
-        self.served += 1
+        with self._mu:
+            self.served += 1
         self._request_event(req, ok=True)
 
     def _fail_slot(self, slot_idx: int, exc: Exception, clock) -> None:
@@ -1047,7 +1072,8 @@ class _GenWorker:
         err = "".join(traceback.format_exception_only(type(exc),
                                                       exc)).strip()
         req.finish(clock(), error=err)
-        self.failed += 1
+        with self._mu:
+            self.failed += 1
         self._request_event(req, ok=False, error=err)
 
     def _request_event(self, req: GenRequest, *, ok,
@@ -1115,7 +1141,8 @@ class _GenWorker:
         self.alive = True
         self.lifecycle = "warming"
         self.current_batch = None
-        self.decode_steps_run = 0
+        with self._mu:
+            self.decode_steps_run = 0
         self.warmup(clock)
         self.start(clock)
         with self._cv:
@@ -1150,14 +1177,15 @@ class _GenWorker:
             return len(self.pending)
 
     def describe(self, now: float | None = None) -> dict:
-        out = {"index": self.index, "state": self.lifecycle,
-               "alive": self.alive, "served": self.served,
-               "failed": self.failed,
-               "decode_steps_run": self.decode_steps_run}
-        if self.speculative_k >= 2:
-            out["verify_steps_run"] = self.verify_steps_run
-            out["accepted_tokens"] = self.accepted_tokens
-            out["drafted_tokens"] = self.drafted_tokens
+        with self._mu:
+            out = {"index": self.index, "state": self.lifecycle,
+                   "alive": self.alive, "served": self.served,
+                   "failed": self.failed,
+                   "decode_steps_run": self.decode_steps_run}
+            if self.speculative_k >= 2:
+                out["verify_steps_run"] = self.verify_steps_run
+                out["accepted_tokens"] = self.accepted_tokens
+                out["drafted_tokens"] = self.drafted_tokens
         if now is not None:
             out["last_beat_age_s"] = round(max(0.0, now - self.last_beat),
                                            3)

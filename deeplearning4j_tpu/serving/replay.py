@@ -1048,7 +1048,10 @@ def run_fleet_replay(*, seed: int = 0, n_requests: int = 120,
                     if engine.served >= hot_swap_after:
                         hot_swap(engine, ckdir)
                         return
-                    _t.sleep(0.002)
+                    # scenario driver, not a runtime component: the
+                    # served counter has no notify hook to block on,
+                    # and the loop is deadline-bounded
+                    _t.sleep(0.002)  # graftlint: disable=G027
 
             import threading as _th
 

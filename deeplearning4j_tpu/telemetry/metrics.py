@@ -153,8 +153,11 @@ class RollingHistogram:
 class MetricsRegistry:
     """Thread-safe metric set + scrape-time collectors. `render()` first
     runs every registered collector (the engine-state scrape: queue
-    depth, pool occupancy, replica liveness) under the lock, then
-    renders every metric in registration order."""
+    depth, pool occupancy, replica liveness) with NO lock held — a
+    collector reaches into engine/batcher/pool locks, and calling it
+    under `_lock` couples this lock to all of theirs (the G026/D002
+    fan-out-under-lock shape) — then renders every metric in
+    registration order under the lock."""
 
     def __init__(self):
         self._lock = threading.Lock()
@@ -194,11 +197,13 @@ class MetricsRegistry:
 
     def render(self) -> str:
         with self._lock:
-            for fn in self._collectors:
-                try:
-                    fn()
-                except Exception:
-                    pass
+            collectors = list(self._collectors)
+        for fn in collectors:
+            try:
+                fn()
+            except Exception:
+                pass
+        with self._lock:
             lines = []
             for m in self._metrics:
                 lines.extend(m.render())

@@ -153,6 +153,12 @@ class Recorder:
         self.path = path
         self.run_id = run_id or f"{os.getpid():x}-{int(time.time()):x}"
         self.events: deque[dict] = deque(maxlen=keep)
+        # serializes seq assignment, the ring buffer and the file
+        # handle: replica/dispatcher/supervisor threads all emit
+        # through one Recorder. Sinks fan out OUTSIDE the lock — a
+        # sink that takes its own lock (the /metrics registry) must
+        # never run under this one (D002 sink reentrancy).
+        self._lock = threading.Lock()
         self._seq = 0
         self._span_seq = 0
         self._fh: io.TextIOBase | None = None
@@ -174,8 +180,9 @@ class Recorder:
     def new_span_id(self) -> str:
         """A process-unique span id (unique within this run; merged
         timelines key spans by (process, span_id))."""
-        self._span_seq += 1
-        return f"s{self._span_seq:x}"
+        with self._lock:
+            self._span_seq += 1
+            return f"s{self._span_seq:x}"
 
     @contextlib.contextmanager
     def trace(self, trace_id: str | None, parent_id: str | None = None):
@@ -205,15 +212,15 @@ class Recorder:
         """Subscribe a live event callback (called with each emitted
         event dict, on the emitting thread). The /metrics registry feeds
         its rolling histograms through one of these."""
-        self._sinks.append(fn)
+        with self._lock:
+            self._sinks.append(fn)
 
     # ------------------------------------------------------------- core
     # `kind` is positional-only so a payload field may itself be named
     # "kind" (the `fault` events carry one)
     def event(self, kind: str, /, **fields) -> dict:
         rec = {"event": kind, "ts": round(time.time(), 3),
-               "run": self.run_id, "seq": self._seq}
-        self._seq += 1
+               "run": self.run_id}
         # ambient correlation: an active trace()/span() context stamps
         # its ids unless the caller passed explicit ones
         trace_id = getattr(self._tloc, "trace_id", None)
@@ -223,9 +230,15 @@ class Recorder:
         if stack and "parent_id" not in fields and "span_id" not in fields:
             rec["parent_id"] = stack[-1]
         rec.update(fields)
-        self.events.append(rec)
-        self._write(rec)
-        for sink in self._sinks:
+        with self._lock:
+            rec["seq"] = self._seq
+            self._seq += 1
+            self.events.append(rec)
+            self._write(rec)
+            sinks = list(self._sinks)
+        # fan out AFTER releasing: a sink acquiring its own lock (the
+        # /metrics histogram update) must not run under `_lock`
+        for sink in sinks:
             try:
                 sink(rec)
             except Exception:
@@ -233,6 +246,7 @@ class Recorder:
         return rec
 
     def _write(self, rec: dict) -> None:
+        # caller holds `_lock` — seq order on disk matches assignment
         if self.path is None:
             return
         if self._fh is None:
@@ -243,9 +257,10 @@ class Recorder:
         self._fh.flush()
 
     def close(self) -> None:
-        if self._fh is not None:
-            self._fh.close()
-            self._fh = None
+        with self._lock:
+            if self._fh is not None:
+                self._fh.close()
+                self._fh = None
 
     # ------------------------------------------------------ typed events
     def meta(self, **fields) -> dict:

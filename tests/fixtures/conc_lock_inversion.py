@@ -1,0 +1,29 @@
+# graftlint D001 fixture: two classes acquiring each other's locks in
+# opposite order through uniquely-named helpers — a lock-order cycle
+# the audit must report and the CLI must exit 1 on, baseline or not.
+import threading
+
+
+class PoolSide:
+    def __init__(self, registry):
+        self._lock = threading.Lock()
+        self.registry = registry
+
+    def reserve_pages(self):
+        with self._lock:
+            self.registry.bump_usage_counter()
+
+    def note_pool_state(self):
+        with self._lock:
+            return True
+
+
+class RegistrySide:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.pool = None
+
+    def bump_usage_counter(self):
+        with self._lock:
+            if self.pool is not None:
+                self.pool.note_pool_state()

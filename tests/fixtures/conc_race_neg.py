@@ -1,0 +1,27 @@
+# graftlint G025 negative fixture: the same worker with every counter
+# access (thread-side += AND the public read) under one lock.
+import threading
+
+
+class GuardedWorker:
+    def __init__(self):
+        self._mu = threading.Lock()
+        self.served = 0
+        self._thread = None
+
+    def start(self):
+        def loop():
+            for _ in range(1000):
+                with self._mu:
+                    self.served += 1
+
+        self._thread = threading.Thread(target=loop, daemon=True)
+        self._thread.start()
+
+    def stop(self):
+        if self._thread is not None:
+            self._thread.join(timeout=1.0)
+
+    def describe(self):
+        with self._mu:
+            return {"served": self.served}

@@ -1,0 +1,24 @@
+# graftlint G025 positive fixture: `served` is += mutated on the
+# worker thread and read from the public describe() with no lock.
+import threading
+
+
+class RacyWorker:
+    def __init__(self):
+        self.served = 0
+        self._thread = None
+
+    def start(self):
+        def loop():
+            for _ in range(1000):
+                self.served += 1
+
+        self._thread = threading.Thread(target=loop, daemon=True)
+        self._thread.start()
+
+    def stop(self):
+        if self._thread is not None:
+            self._thread.join(timeout=1.0)
+
+    def describe(self):
+        return {"served": self.served}

@@ -1,0 +1,20 @@
+# graftlint D002 fixture: registered sink callbacks invoked while the
+# emitter's lock is held — the sink-reentrancy shape (a sink that
+# acquires a lock runs under whatever the emitter holds). The same
+# source trips G026 when linted at a telemetry/ path.
+import threading
+
+
+class Emitter:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._sinks = []
+
+    def add_sink(self, fn):
+        with self._lock:
+            self._sinks.append(fn)
+
+    def emit(self, record):
+        with self._lock:
+            for sink in self._sinks:
+                sink(record)

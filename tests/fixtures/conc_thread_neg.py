@@ -1,0 +1,19 @@
+# graftlint G028 negative fixture: a daemon worker with a stop()
+# handle that joins the thread on shutdown.
+import threading
+
+
+class SupervisedWorker:
+    def __init__(self):
+        self._thread = None
+
+    def launch(self):
+        self._thread = threading.Thread(target=self._loop, daemon=True)
+        self._thread.start()
+
+    def _loop(self):
+        pass
+
+    def stop(self):
+        if self._thread is not None:
+            self._thread.join(timeout=1.0)

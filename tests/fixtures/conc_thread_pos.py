@@ -1,0 +1,20 @@
+# graftlint G028 positive fixture: a non-daemon thread the class never
+# joins, and a daemon thread with no stop/close/drain handle.
+import threading
+
+
+class FireAndForget:
+    def launch(self):
+        t = threading.Thread(target=self._loop)
+        t.start()
+
+    def _loop(self):
+        pass
+
+
+class BareDaemon:
+    def launch(self):
+        threading.Thread(target=self._loop, daemon=True).start()
+
+    def _loop(self):
+        pass

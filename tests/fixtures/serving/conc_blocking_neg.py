@@ -1,0 +1,34 @@
+# graftlint G026 negative fixture: the carve-outs — non-blocking
+# queue ops under the lock, waiting on the condition you HOLD, and
+# snapshot-under-lock / block-outside-it.
+import queue
+import threading
+import time
+
+
+class PoliteDispatcher:
+    def __init__(self):
+        self._cv = threading.Condition()
+        self._buf = []
+        self.q = queue.Queue(maxsize=4)
+
+    def try_drain(self):
+        with self._cv:
+            return self.q.get(block=False)
+
+    def wait_item(self):
+        with self._cv:
+            while not self._buf:
+                self._cv.wait(0.1)
+            return self._buf.pop()
+
+    def put_item(self, item):
+        with self._cv:
+            self._buf.append(item)
+            self._cv.notify()
+
+    def dispatch(self, item):
+        with self._cv:
+            target = self.q
+        target.put(item)
+        time.sleep(0.0)

@@ -1,0 +1,20 @@
+# graftlint G026 positive fixture (lives under a serving/ path, the
+# rule's scope): a blocking queue.put and a time.sleep inside a
+# held-lock body.
+import queue
+import threading
+import time
+
+
+class BlockingDispatcher:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.q = queue.Queue(maxsize=4)
+
+    def dispatch(self, item):
+        with self._lock:
+            self.q.put(item)
+
+    def backoff(self):
+        with self._lock:
+            time.sleep(0.05)

@@ -1,0 +1,25 @@
+# graftlint G027 negative fixture: wait in a while-predicate loop,
+# notify under the owning lock, and an Event.wait stop-flag loop
+# instead of a sleep poll.
+import threading
+
+
+class PatientWaiter:
+    def __init__(self):
+        self._cv = threading.Condition()
+        self._stop = threading.Event()
+        self.ready = False
+
+    def await_ready(self):
+        with self._cv:
+            while not self.ready:
+                self._cv.wait(0.5)
+
+    def set_ready(self):
+        with self._cv:
+            self.ready = True
+            self._cv.notify_all()
+
+    def idle(self):
+        while not self._stop.is_set():
+            self._stop.wait(0.05)

@@ -1,0 +1,22 @@
+# graftlint G027 positive fixture (serving/ scope): a Condition.wait
+# outside a while-predicate loop, a notify without the owning lock,
+# and a bare time.sleep polling loop.
+import threading
+import time
+
+
+class SloppyWaiter:
+    def __init__(self):
+        self._cv = threading.Condition()
+        self.ready = False
+
+    def await_once(self):
+        with self._cv:
+            self._cv.wait(0.5)
+
+    def poke(self):
+        self._cv.notify_all()
+
+    def spin(self):
+        while not self.ready:
+            time.sleep(0.01)

@@ -1,9 +1,11 @@
-"""Tier-1 gate for graftlint (ISSUE 2 + the ISSUE 5 SPMD rules): every
-AST rule G001-G022 proven on a positive AND a negative fixture, the
-suppression + baseline machinery, the stage-2 jaxpr audit over every
-public entry point, and the package itself held lint-clean (zero
-non-baselined findings). The stage-3 collective audit has its own gate
-in tests/test_spmd_lint.py.
+"""Tier-1 gate for graftlint (ISSUE 2 + the ISSUE 5 SPMD rules + the
+ISSUE 17 concurrency stage): every AST rule G001-G028 proven on a
+positive AND a negative fixture, the suppression + baseline machinery,
+the stage-2 jaxpr audit over every public entry point, and the package
+itself held lint-clean (zero non-baselined findings). The stage-3
+collective audit has its own gate in tests/test_spmd_lint.py; the
+stage-4 lock-order audit and guard-map inference have theirs in
+tests/test_concurrency_lint.py.
 
 PR 1 burned its budget reactively fixing exactly these bug classes
 (silent RNG divergence, jax API drift, modes that crashed only at real
@@ -615,6 +617,181 @@ def seed_proposer(seed):
     # host RNG OUTSIDE decode loops (setup, jitter) is not sampling
     return np.random.default_rng(seed)
 """),
+    # ------------------------------------------- stage 4 (ISSUE 17)
+    ("G025", """\
+import threading
+
+
+class RacyWorker:
+    def __init__(self):
+        self.served = 0
+        self._thread = None
+
+    def start(self):
+        def loop():
+            for _ in range(1000):
+                self.served += 1
+
+        self._thread = threading.Thread(target=loop, daemon=True)
+        self._thread.start()
+
+    def stop(self):
+        if self._thread is not None:
+            self._thread.join(timeout=1.0)
+
+    def describe(self):
+        return {"served": self.served}
+""", """\
+import threading
+
+
+class GuardedWorker:
+    def __init__(self):
+        self._mu = threading.Lock()
+        self.served = 0
+        self._thread = None
+
+    def start(self):
+        def loop():
+            for _ in range(1000):
+                with self._mu:
+                    self.served += 1
+
+        self._thread = threading.Thread(target=loop, daemon=True)
+        self._thread.start()
+
+    def stop(self):
+        if self._thread is not None:
+            self._thread.join(timeout=1.0)
+
+    def describe(self):
+        with self._mu:
+            return {"served": self.served}
+"""),
+    ("G026", """\
+import queue
+import threading
+
+
+class BlockingDispatcher:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.q = queue.Queue(maxsize=4)
+
+    def dispatch(self, item):
+        with self._lock:
+            self.q.put(item)      # blocks every lock contender
+
+    def backoff(self):
+        with self._lock:
+            time.sleep(0.05)
+""", """\
+import queue
+import threading
+
+
+class PoliteDispatcher:
+    def __init__(self):
+        self._cv = threading.Condition()
+        self._buf = []
+        self.q = queue.Queue(maxsize=4)
+
+    def try_drain(self):
+        with self._cv:
+            return self.q.get(block=False)   # non-blocking: exempt
+
+    def wait_item(self):
+        with self._cv:
+            while not self._buf:
+                self._cv.wait(0.1)           # waits on the HELD cond
+            return self._buf.pop()
+
+    def dispatch(self, item):
+        with self._cv:
+            target = self.q                  # snapshot under the lock
+        target.put(item)                     # block outside it
+"""),
+    ("G027", """\
+import threading
+
+
+class SloppyWaiter:
+    def __init__(self):
+        self._cv = threading.Condition()
+        self.ready = False
+
+    def await_once(self):
+        with self._cv:
+            self._cv.wait(0.5)    # no while-predicate re-check
+
+    def poke(self):
+        self._cv.notify_all()     # owning lock not held
+
+    def spin(self):
+        while not self.ready:
+            time.sleep(0.01)      # sleep-poll loop
+""", """\
+import threading
+
+
+class PatientWaiter:
+    def __init__(self):
+        self._cv = threading.Condition()
+        self._stop = threading.Event()
+        self.ready = False
+
+    def await_ready(self):
+        with self._cv:
+            while not self.ready:
+                self._cv.wait(0.5)
+
+    def set_ready(self):
+        with self._cv:
+            self.ready = True
+            self._cv.notify_all()
+
+    def idle(self):
+        while not self._stop.is_set():
+            self._stop.wait(0.05)   # Event stop-flag, not a sleep poll
+"""),
+    ("G028", """\
+import threading
+
+
+class FireAndForget:
+    def launch(self):
+        t = threading.Thread(target=self._loop)
+        t.start()                 # non-daemon, never joined
+
+    def _loop(self):
+        pass
+
+
+class BareDaemon:
+    def launch(self):
+        threading.Thread(target=self._loop, daemon=True).start()
+
+    def _loop(self):
+        pass
+""", """\
+import threading
+
+
+class SupervisedWorker:
+    def __init__(self):
+        self._thread = None
+
+    def launch(self):
+        self._thread = threading.Thread(target=self._loop, daemon=True)
+        self._thread.start()
+
+    def _loop(self):
+        pass
+
+    def stop(self):
+        if self._thread is not None:
+            self._thread.join(timeout=1.0)
+"""),
 ]
 
 
@@ -626,6 +803,10 @@ RULE_FIXTURE_PATHS = {
     "G021": "deeplearning4j_tpu/serving/_graftlint_fixture.py",
     "G024": "deeplearning4j_tpu/serving/_graftlint_fixture.py",
     "G022": "deeplearning4j_tpu/cli/_graftlint_fixture.py",
+    # stage-4 scoped rules: G026 (serving//data//telemetry/) and G027
+    # (serving//data/) lint their fixtures on a serving/ path
+    "G026": "deeplearning4j_tpu/serving/_graftlint_fixture.py",
+    "G027": "deeplearning4j_tpu/serving/_graftlint_fixture.py",
 }
 
 
@@ -640,7 +821,7 @@ def test_rule_fires_on_positive_not_negative(rule, pos, neg):
 
 def test_every_rule_has_fixture_coverage():
     assert {r for r, _, _ in FIXTURES} == set(RULE_DOCS) == {
-        f"G{i:03d}" for i in range(1, 25)}
+        f"G{i:03d}" for i in range(1, 29)}
 
 
 def test_g015_blessed_sites_are_exempt():
@@ -1054,17 +1235,88 @@ def test_cli_check_fails_on_findings_and_emits_json(tmp_path):
     assert payload["findings"][0]["stage"] == "ast"
 
 
+def _poisoned_jax_env(tmp_path):
+    shim = tmp_path / "shim"
+    shim.mkdir()
+    (shim / "jax.py").write_text(
+        "raise ImportError('graftlint host-only stage imported jax')\n")
+    env = dict(os.environ)
+    env["PYTHONPATH"] = f"{shim}{os.pathsep}{ROOT}"
+    return env
+
+
 def test_ast_stage_completes_without_importing_jax(tmp_path):
     """The pre-commit fast path: --stage ast (G001-G014 included) must
     never import jax. A poisoned `jax` module on PYTHONPATH turns any
     violation into a hard failure."""
-    shim = tmp_path / "shim"
-    shim.mkdir()
-    (shim / "jax.py").write_text(
-        "raise ImportError('graftlint --stage ast imported jax')\n")
-    env = dict(os.environ)
-    env["PYTHONPATH"] = f"{shim}{os.pathsep}{ROOT}"
     proc = subprocess.run(
         [sys.executable, CLI, "--check", "deeplearning4j_tpu"],
-        cwd=ROOT, env=env, capture_output=True, text=True, timeout=120)
+        cwd=ROOT, env=_poisoned_jax_env(tmp_path),
+        capture_output=True, text=True, timeout=120)
     assert proc.returncode == 0, proc.stdout + proc.stderr
+
+
+# ----------------------------------------------- stage 4 (ISSUE 17)
+
+def test_cli_concurrency_stage_gate():
+    """The tier-1 concurrency gate: the package sweeps clean under
+    --stage concurrency (G025-G028 + the lock-order audit against the
+    frozen edge set) with a non-empty lock graph."""
+    proc = _run_cli("--check", "--stage", "concurrency", "--json")
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    payload = json.loads(proc.stdout)
+    assert payload["findings"] == []
+    assert payload["lock_order_edges"], "frozen lock graph is empty"
+
+
+def test_cli_concurrency_findings_carry_stage_label(tmp_path):
+    bad = tmp_path / "racy.py"
+    bad.write_text(
+        "import threading\n\n\n"
+        "class W:\n"
+        "    def __init__(self):\n"
+        "        self.n = 0\n"
+        "        self._t = None\n\n"
+        "    def start(self):\n"
+        "        def loop():\n"
+        "            self.n += 1\n\n"
+        "        self._t = threading.Thread(target=loop, daemon=True)\n"
+        "        self._t.start()\n\n"
+        "    def stop(self):\n"
+        "        self._t.join()\n\n"
+        "    def describe(self):\n"
+        "        return self.n\n")
+    proc = _run_cli("--check", "--stage", "concurrency", "--json",
+                    str(bad))
+    assert proc.returncode == 1, proc.stdout + proc.stderr
+    payload = json.loads(proc.stdout)
+    rules = {f["rule"] for f in payload["findings"]}
+    assert "G025" in rules
+    assert all(f["stage"] == "concurrency"
+               for f in payload["findings"])
+
+
+def test_concurrency_stage_completes_without_importing_jax(tmp_path):
+    """Stage 4 is host-only analysis (AST rules + lock graph): it must
+    run with jax poisoned, exactly like stage 1."""
+    proc = subprocess.run(
+        [sys.executable, CLI, "--check", "--stage", "concurrency",
+         "deeplearning4j_tpu"],
+        cwd=ROOT, env=_poisoned_jax_env(tmp_path),
+        capture_output=True, text=True, timeout=120)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+
+
+def test_cli_rules_prints_per_stage_inventory(tmp_path):
+    """--rules is the one-stop rule inventory: every id every stage can
+    emit, grouped by stage — and it runs jax-free (doc lookups only)."""
+    proc = subprocess.run(
+        [sys.executable, CLI, "--rules"],
+        cwd=ROOT, env=_poisoned_jax_env(tmp_path),
+        capture_output=True, text=True, timeout=120)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    for stage in ("ast", "jaxpr", "spmd", "concurrency"):
+        assert f"stage {stage}:" in proc.stdout
+    for rid in ("G001", "G024", "G025", "G028",
+                "J001", "J004", "C001", "C003", "D001", "D003"):
+        assert rid in proc.stdout, f"--rules missing {rid}"

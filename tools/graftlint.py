@@ -9,16 +9,23 @@
     python tools/graftlint.py --write-baseline ...          # grandfather
     python tools/graftlint.py --update-budget               # refreeze op bounds
     python tools/graftlint.py --update-collectives          # refreeze stage 3
+    python tools/graftlint.py --check --stage concurrency   # host threads
+    python tools/graftlint.py --update-locks                # refreeze stage 4
+    python tools/graftlint.py --rules                       # rule inventory
 
 Stage `ast` (default) is pure stdlib and instant — suitable as a
-pre-commit step; it runs all AST rules G001-G016. Stage `jaxpr` traces
+pre-commit step; it runs all AST rules G001-G028. Stage `jaxpr` traces
 the jitted entry points on CPU (~1 min). Stage `spmd` runs the
 G010-G013 rules plus the collective-consistency audit
 (analysis/collective_audit.py): frozen ordered collective signatures and
 the simulated-rank divergence (deadlock) check; pass a fixture .py
 defining GRAFTLINT_SPMD_ENTRIES to divergence-check its entries instead
-of the built-ins. Exit codes: 0 clean, 1 findings (--check), 2
-usage/env error.
+of the built-ins. Stage `concurrency` (pure stdlib, like `ast`) runs
+the host-thread rules G025-G028 plus the lock-order audit
+(analysis/lock_audit.py): edges frozen in analysis/lock_order.json, a
+lock-order CYCLE (D001) always exits 1; pass explicit .py paths to
+audit fixtures without the frozen-set comparison. Exit codes: 0 clean,
+1 findings (--check) or any D001, 2 usage/env error.
 """
 
 from __future__ import annotations
@@ -57,7 +64,9 @@ def main(argv=None) -> int:
                          "findings")
     ap.add_argument("--json", action="store_true", dest="as_json",
                     help="emit findings as JSON")
-    ap.add_argument("--stage", choices=("ast", "jaxpr", "spmd", "all"),
+    ap.add_argument("--stage",
+                    choices=("ast", "jaxpr", "spmd", "concurrency",
+                             "all"),
                     default="ast")
     ap.add_argument("--baseline", default=DEFAULT_BASELINE)
     ap.add_argument("--write-baseline", action="store_true",
@@ -69,14 +78,24 @@ def main(argv=None) -> int:
     ap.add_argument("--update-collectives", action="store_true",
                     help="retrace the stage-3 entry points and refreeze "
                          "the ordered collective signatures")
+    ap.add_argument("--update-locks", action="store_true",
+                    help="rescan the package lock-order graph and "
+                         "refreeze the blessed edge set "
+                         "(analysis/lock_order.json)")
+    ap.add_argument("--rules", action="store_true",
+                    help="print the per-stage rule inventory and exit")
     args = ap.parse_args(argv)
 
-    if args.stage == "ast" and not (args.update_budget
-                                    or args.update_collectives):
+    if (args.stage in ("ast", "concurrency") or args.rules
+            or args.update_locks) and not (args.update_budget
+                                           or args.update_collectives):
         # Pre-commit path: stub the package parents so the analysis
         # modules load WITHOUT the root __init__ (which imports the full
-        # nn stack and jax). Stage 1 stays pure-stdlib-fast.
+        # nn stack and jax). Stages 1 and 4 stay pure-stdlib-fast.
         _stub_packages()
+
+    if args.rules:
+        return _print_rules()
     from deeplearning4j_tpu.analysis.ast_pass import lint_paths
     from deeplearning4j_tpu.analysis.core import (load_baseline,
                                                   split_baselined,
@@ -85,7 +104,7 @@ def main(argv=None) -> int:
     paths = args.paths or [os.path.join(ROOT, "deeplearning4j_tpu")]
     new, old, counts, signatures = [], [], {}, {}
 
-    if args.stage in ("ast", "all", "spmd"):
+    if args.stage in ("ast", "all", "spmd", "concurrency"):
         findings = lint_paths(paths, root=ROOT)
         if args.stage == "spmd":
             # the SPMD stage lints its own rule family only; G001-G009
@@ -93,6 +112,10 @@ def main(argv=None) -> int:
             from deeplearning4j_tpu.analysis.spmd_rules import \
                 SPMD_RULE_IDS
             findings = [f for f in findings if f.rule in SPMD_RULE_IDS]
+        elif args.stage == "concurrency":
+            from deeplearning4j_tpu.analysis.concurrency_rules import \
+                CONC_RULE_IDS
+            findings = [f for f in findings if f.rule in CONC_RULE_IDS]
         if args.write_baseline:
             write_baseline(args.baseline, findings)
             print(f"baselined {len(findings)} findings -> {args.baseline}")
@@ -142,12 +165,34 @@ def main(argv=None) -> int:
             cfindings, signatures = collective_audit.audit()
         new.extend(cfindings)
 
+    lock_edges: list[str] = []
+    if args.stage in ("concurrency", "all") or args.update_locks:
+        from deeplearning4j_tpu.analysis import lock_audit
+        if args.update_locks:
+            edge_strs, _ = lock_audit.current_edges()
+            lock_audit.write_locks(edge_strs)
+            print(f"froze {len(edge_strs)} lock-order edge(s) -> "
+                  f"{lock_audit.LOCKS_PATH}")
+            for s in edge_strs:
+                print(f"  {s}")
+            return 0
+        # explicit .py paths are audited as fixtures (no frozen-set
+        # comparison); the default package sweep checks for drift
+        explicit_py = [p for p in (args.paths or [])
+                       if p.endswith(".py")]
+        if explicit_py and len(explicit_py) == len(args.paths):
+            lfindings, lock_edges = lock_audit.audit_paths(explicit_py)
+        else:
+            lfindings, lock_edges = lock_audit.audit()
+        new.extend(lfindings)
+
     if args.as_json:
         print(json.dumps({
             "findings": [f.to_json() for f in new],
             "grandfathered": [f.to_json() for f in old],
             "jaxpr_op_counts": counts,
             "collective_signatures": signatures,
+            "lock_order_edges": lock_edges,
         }, indent=1))
     else:
         for f in new:
@@ -159,8 +204,51 @@ def main(argv=None) -> int:
         if signatures:
             print(f"collective audit: {len(signatures)} entry points "
                   "traced")
+        if lock_edges:
+            print(f"lock-order audit: {len(lock_edges)} edge(s)")
         print(f"graftlint: {len(new)} finding(s)")
+    # a lock-order cycle is a deadlock waiting for load — never
+    # reportable-only, regardless of --check or baseline
+    if any(f.rule == "D001" for f in new):
+        return 1
     return 1 if (new and args.check) else 0
+
+
+def _print_rules() -> int:
+    """Per-stage rule inventory (from RULE_DOCS + the audit docs)."""
+    from deeplearning4j_tpu.analysis.ast_rules import RULE_DOCS
+    from deeplearning4j_tpu.analysis.concurrency_rules import \
+        CONC_RULE_IDS
+    from deeplearning4j_tpu.analysis.lock_audit import \
+        RULE_DOCS as LOCK_DOCS
+    from deeplearning4j_tpu.analysis.spmd_rules import SPMD_RULE_IDS
+
+    # jaxpr/spmd audit rules are documented in their modules' headers;
+    # summarized here so --rules covers every id the suite can emit
+    audit_docs = {
+        "J001": "forbidden primitive (device_put/callback/transfer) in "
+                "a jitted entry point",
+        "J002": "op count over the frozen jaxpr budget",
+        "J003": "float64 value in the traced program",
+        "J004": "entry point missing from the budget file",
+        "C001": "collective signature drift vs the frozen set",
+        "C002": "entry point missing from the frozen signature file",
+        "C003": "rank-divergent collective sequence (fleet deadlock)",
+    }
+    stages = [
+        ("ast", sorted(set(RULE_DOCS) - SPMD_RULE_IDS - CONC_RULE_IDS)),
+        ("jaxpr", ["J001", "J002", "J003", "J004"]),
+        ("spmd", sorted(SPMD_RULE_IDS) + ["C001", "C002", "C003"]),
+        ("concurrency", sorted(CONC_RULE_IDS) + sorted(LOCK_DOCS)),
+    ]
+    for stage, ids in stages:
+        print(f"stage {stage}:")
+        for rid in ids:
+            doc = RULE_DOCS.get(rid) or audit_docs.get(rid) \
+                or LOCK_DOCS.get(rid, "")
+            first = doc.split(";")[0].split(" — ")[0].strip()
+            print(f"  {rid}  {first}")
+    return 0
 
 
 if __name__ == "__main__":

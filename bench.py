@@ -1474,9 +1474,20 @@ def bench_placement_search() -> None:
         if control.describe() not in {a.describe() for a in arms}:
             arms.append(control)
         measured = []
+        measured_bytes = []
         for cand in arms:
+            # cost-model calibration handoff, WINNER arm only: the arm
+            # reconciles the search's predicted per-device bytes
+            # against its measured peak (telemetry/costbook.py
+            # reconcile -> `cost_drift` event) and reports the
+            # measurement back on RESULT. The control arm's memory
+            # model is a ranking penalty, not a calibrated prediction
+            # — reconciling it would fire the drift detector on every
+            # healthy run
             spec = {"devices": n, "placement": cand.placement.to_json(),
                     "batch": BATCH, "repeats": 8, "seed": 0}
+            if cand is result.best:
+                spec["predicted_bytes"] = float(cand.memory_bytes)
             env = dict(os.environ)
             env.setdefault("JAX_PLATFORMS", "cpu")
             out = subprocess.run(
@@ -1493,6 +1504,7 @@ def bench_placement_search() -> None:
                     + (out.stderr or out.stdout)[-2000:])
             res = json.loads(payload[-1][len("RESULT "):])
             measured.append(res["ms_per_step"])
+            measured_bytes.append(res.get("measured_bytes", 0))
         violations = 0
         concordant = discordant = 0
         for i in range(len(arms)):
@@ -1515,7 +1527,7 @@ def bench_placement_search() -> None:
             "lower_is_better": True, "winner": best.describe(),
             "candidates": len(result.candidates),
             "pruned": len(result.pruned), "devices": n})
-        for cand, ms in zip(arms, measured):
+        for cand, ms, mb in zip(arms, measured, measured_bytes):
             lines.append({"metric":
                           f"plan_predicted::{grid}::{cand.describe()}",
                           "value": float(cand.score),
@@ -1523,6 +1535,22 @@ def bench_placement_search() -> None:
             lines.append({"metric":
                           f"plan_measured_ms::{grid}::{cand.describe()}",
                           "value": ms, "lower_is_better": True})
+            if mb:
+                lines.append({"metric":
+                              f"plan_measured_bytes::{grid}::"
+                              f"{cand.describe()}",
+                              "value": int(mb), "unit": "bytes",
+                              "lower_is_better": True})
+        # the winner's predicted-vs-measured memory, folded symmetric
+        # (>= 1; 0 = no measurement): the per-grid calibration headline
+        # the cost_drift events back with full provenance
+        if measured_bytes and measured_bytes[0] and best.memory_bytes > 0:
+            r = float(measured_bytes[0]) / float(best.memory_bytes)
+            lines.append({"metric": f"plan_cost_drift_ratio::{grid}",
+                          "value": round(max(r, 1.0 / r), 4),
+                          "lower_is_better": True,
+                          "predicted_bytes": float(best.memory_bytes),
+                          "measured_bytes": int(measured_bytes[0])})
         lines.append({"metric": f"plan_rank_kendall_tau::{grid}",
                       "value": tau})
     lines.append({"metric": "plan_predicted_rank_violations",
@@ -1583,14 +1611,14 @@ def _trace_check(tpath: str, rec, collected: list) -> int:
     """Run `tracetool check` (subprocess — the CLI contract itself is
     what CI exercises) over the sweep's telemetry, write the TRACE
     artifact, and fold the detector rows into the metric record.
-    Returns 1 when a gating anomaly (post-warmup retrace / rank skew)
-    fired, 0 otherwise."""
+    Returns 1 when a gating anomaly (post-warmup retrace / rank skew /
+    live-bytes leak) fired, 0 otherwise."""
     here = os.path.dirname(os.path.abspath(__file__))
     artifact = os.environ.get(
         "DL4J_TPU_TRACE_ARTIFACT", os.path.join(here, "TRACE_r01.json"))
     out = subprocess.run(
         [sys.executable, os.path.join(here, "tools", "tracetool.py"),
-         "check", tpath, "--json", "--fail-on", "retrace,straggler"],
+         "check", tpath, "--json", "--fail-on", "retrace,straggler,leak"],
         capture_output=True, text=True, timeout=300)
     try:
         payload = json.loads(out.stdout)
@@ -1613,6 +1641,7 @@ def _trace_check(tpath: str, rec, collected: list) -> int:
          "value": round(max(skews), 3) if skews else 0.0, "unit": "ms",
          "lower_is_better": True},
     ]
+    lines.extend(_memory_rows(tpath, findings))
     for f in findings:
         rec.anomaly(f.get("anomaly", "unknown"),
                     **{k: v for k, v in f.items() if k != "anomaly"})
@@ -1628,6 +1657,66 @@ def _trace_check(tpath: str, rec, collected: list) -> int:
               flush=True)
         return 1
     return 0
+
+
+def _memory_rows(tpath: str, findings: list) -> list:
+    """The sweep's memory/MFU headline rows, computed from its own
+    telemetry (the `memory`/`cost`/`request` events the modes emitted):
+    `hbm_peak_bytes` (max live bytes any process saw), `leak_count` and
+    `cost_drift_ratio` (regress on ANY increase — the rise-from-zero
+    rule), and `mfu_live` (cost-book flops over measured forward time,
+    0.0 when no device peak is on the record — CPU sweeps). Emitted
+    unconditionally so benchdiff/requote always have the row to
+    compare, even from a truncated artifact."""
+    from deeplearning4j_tpu.telemetry import trace as trace_mod
+
+    try:
+        tl = trace_mod.load_timeline(tpath)
+        report = trace_mod.memory_report(tl)
+    except Exception:
+        return []
+    peaks = [row.get("peak_bytes", 0)
+             for row in report["processes"].values()]
+    leaks = [f for f in findings if f.get("anomaly") == "leak"]
+    drifts = [f for f in findings if f.get("anomaly") == "cost_drift"]
+    worst_drift = 0.0
+    for f in drifts:
+        r = float(f.get("ratio", 0.0) or 0.0)
+        if r > 0:
+            worst_drift = max(worst_drift, r, 1.0 / r)
+    # per-forward MFU: join request events (forward wall time, bucket)
+    # with the cost book's flops for that bucket; the device peak rides
+    # the warmup memory event
+    costs, peak = {}, 0.0
+    for ev in tl.events:
+        if ev.get("event") == "cost" and ev.get("entry") == "forward":
+            costs[tuple(ev.get("shape") or [])] = float(
+                ev.get("flops", 0) or 0)
+        elif ev.get("event") == "memory" and ev.get("peak_flops"):
+            peak = max(peak, float(ev["peak_flops"]))
+    mfu_vals = []
+    if peak > 0:
+        for ev in tl.events:
+            if (ev.get("event") == "request" and ev.get("forward_s")
+                    and ev.get("bucket")):
+                fl = costs.get(tuple(ev["bucket"]), 0.0)
+                if fl > 0:
+                    mfu_vals.append(min(1.0, fl / (
+                        float(ev["forward_s"]) * peak)))
+    return [
+        {"metric": "hbm_peak_bytes", "value": max(peaks) if peaks else 0,
+         "unit": "bytes", "lower_is_better": True,
+         "samples": sum(row.get("samples", 0)
+                        for row in report["processes"].values())},
+        {"metric": "leak_count", "value": len(leaks), "unit": "count",
+         "lower_is_better": True},
+        {"metric": "cost_drift_ratio", "value": round(worst_drift, 4),
+         "lower_is_better": True},
+        {"metric": "mfu_live",
+         "value": round(sum(mfu_vals) / len(mfu_vals), 4)
+         if mfu_vals else 0.0, "unit": "fraction",
+         "forwards": len(mfu_vals)},
+    ]
 
 
 def _run_all() -> int:
@@ -1679,6 +1768,11 @@ def _run_all() -> int:
     for mode in MODES:
         env = dict(os.environ)
         env["DL4J_TPU_TELEMETRY"] = tpath
+        # every bench run carries `memory` events: the fit loops sample
+        # on this cadence (telemetry/memstat.py on_step; serving warmup
+        # samples regardless), feeding the leak/headroom detectors and
+        # the hbm_peak_bytes row below
+        env.setdefault("DL4J_TPU_MEM_EVERY", "4")
         if mode == "resnet_dp":
             # the DP-speedup bench needs a multi-device mesh; force the
             # virtual CPU cluster regardless of how many real chips exist

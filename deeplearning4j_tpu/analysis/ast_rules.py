@@ -1,6 +1,6 @@
-"""The G001-G009 + G016-G023 AST rules (G010-G015 + G018 live in
-spmd_rules.py and register into ALL_RULES/RULE_DOCS at the bottom of
-this module).
+"""The G001-G009 + G016-G024 + G029 AST rules (G010-G015 + G018 live
+in spmd_rules.py, G025-G028 in concurrency_rules.py; both register
+into ALL_RULES/RULE_DOCS at the bottom of this module).
 
 Every rule errs toward PRECISION over recall: a lint gate that cries
 wolf gets suppressed wholesale, while a quiet one keeps running in CI
@@ -1383,6 +1383,70 @@ def g024_host_sampling(tree, imports, path):
     return out
 
 
+# --------------------------------------------------------------- G029
+
+# Memory-introspection discipline — the observability twin of G002's
+# host-sync rule. `dev.memory_stats()` queries the backend allocator,
+# `jax.live_arrays()` walks EVERY live buffer in the process, and
+# `compiled.memory_analysis()` re-summarizes an executable: host work
+# measured in milliseconds, and inside a jit-traced function they
+# additionally burn in as compile-time constants (the trace sees one
+# snapshot forever). The blessed producers put the walk where the hot
+# path can't feel it: telemetry/memstat.py samples at batch boundaries
+# / on its own thread, telemetry/costbook.py harvests at warmup-time
+# compile. Everyone else consumes their cached `memory`/`cost` events.
+_G029_BLESSED = ("deeplearning4j_tpu/telemetry/memstat.py",
+                 "deeplearning4j_tpu/telemetry/costbook.py")
+_G029_INTROSPECT = frozenset({"memory_stats", "live_arrays",
+                              "memory_analysis"})
+_G029_CANON = frozenset({"jax.live_arrays"})
+
+
+def g029_memory_introspection_hot_path(tree, imports, path):
+    """A `memory_stats()` / `live_arrays()` / `memory_analysis()` call
+    inside a jit-traced function or a per-token / per-request loop.
+    Batch-boundary or warmup-time introspection (plain functions, no
+    hot loop) stays silent — that IS the sampler contract — and the
+    two blessed producer modules are exempt."""
+    norm = path.replace("\\", "/")
+    if norm.endswith(_G029_BLESSED):
+        return []
+    out = []
+    seen: set[int] = set()
+
+    def scan(scope, where):
+        for node in ast.walk(scope):
+            if not isinstance(node, ast.Call) or id(node) in seen:
+                continue
+            attr = (node.func.attr
+                    if isinstance(node.func, ast.Attribute) else None)
+            name = imports.canon(node.func) or ""
+            if attr in _G029_INTROSPECT or name in _G029_CANON:
+                seen.add(id(node))
+                out.append((
+                    "G029", node,
+                    f"device-memory introspection ({attr or name}) "
+                    f"inside {where}: a full live-buffer walk / "
+                    "allocator query on the hot path — and under jit "
+                    "it traces as a frozen compile-time constant",
+                    "sample at batch boundaries via telemetry/"
+                    "memstat.py (MemorySampler.on_step/maybe_sample) "
+                    "or harvest at warmup via telemetry/costbook.py, "
+                    "then read the cached event/ledger"))
+
+    for fn, _params in _traced_functions(tree, imports):
+        scan(fn, "a jit-traced function")
+    for loop in ast.walk(tree):
+        if not isinstance(loop, (ast.For, ast.AsyncFor)):
+            continue
+        if (_g017_mentions(loop.target, _G019_TOKENISH)
+                or _g017_mentions(loop.iter, _G019_TOKENISH)
+                or _g017_mentions(loop.target, _G017_REQUESTISH)
+                or _g017_mentions(loop.iter, _G017_REQUESTISH)):
+            scan(loop, "a per-token/per-request loop")
+    return out
+
+
 # stage-3 AST rules (G010-G014) live in spmd_rules.py and register here;
 # the import sits below every helper they borrow lazily, so importing
 # either module first resolves cleanly.
@@ -1408,7 +1472,8 @@ ALL_RULES = [g001_traced_bool, g002_host_sync, g003_float64_drift,
              g021_weight_swap_path,
              g022_handrolled_placement,
              g023_unregistered_telemetry_names,
-             g024_host_sampling] + SPMD_RULES + CONC_RULES
+             g024_host_sampling,
+             g029_memory_introspection_hot_path] + SPMD_RULES + CONC_RULES
 
 RULE_DOCS = {
     "G001": "python control flow / bool()/float()/int() on traced values",
@@ -1453,6 +1518,13 @@ RULE_DOCS = {
             "inside decode loops in serving/ — token selection belongs "
             "in the fused on-device kernel "
             "(ops/fused_sampling.fused_sample)",
+    "G029": "memory-introspection discipline: memory_stats()/"
+            "live_arrays()/memory_analysis() inside jit-traced "
+            "functions or per-token/per-request loops — a live-buffer "
+            "walk on the hot path (frozen as a constant under jit); "
+            "the blessed producers are telemetry/memstat.py (batch-"
+            "boundary sampler) and telemetry/costbook.py (warmup "
+            "harvest)",
     **SPMD_RULE_DOCS,
     **CONC_RULE_DOCS,
 }

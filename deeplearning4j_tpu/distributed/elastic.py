@@ -297,7 +297,8 @@ class ElasticSupervisor:
         from deeplearning4j_tpu.distributed.launcher import launch_local
         from deeplearning4j_tpu.telemetry.recorder import (ENV_VAR,
                                                            get_default)
-        from deeplearning4j_tpu.telemetry.trace import StragglerWatch
+        from deeplearning4j_tpu.telemetry.trace import (MemoryWatch,
+                                                        StragglerWatch)
 
         rec = get_default()
         generations: List[FleetGeneration] = []
@@ -313,6 +314,19 @@ class ElasticSupervisor:
         tpath = env.get(ENV_VAR) or os.environ.get(ENV_VAR)
         watch = (StragglerWatch(tpath, recorder=rec)
                  if tpath else None)
+        # the memory-path consumer, same shape: leaks / headroom
+        # breaches / cost drift surface as typed anomalies while the
+        # generation runs, so the supervisor's journal records a
+        # memory-sick fleet alongside a slow one
+        memwatch = (MemoryWatch(tpath, recorder=rec)
+                    if tpath else None)
+
+        def on_poll():
+            if watch is not None:
+                watch.poll()
+            if memwatch is not None:
+                memwatch.poll()
+
         while True:
             self.coordinator.record_config(GEN_KEY, gen)
             with rec.span("elastic_generation", gen=gen,
@@ -324,13 +338,16 @@ class ElasticSupervisor:
                     death_grace=self.death_grace,
                     faults=self.faults if gen == 0 else None,
                     extra_env=env, echo=self.echo, cwd=self.cwd,
-                    on_poll=watch.poll if watch is not None else None)
+                    on_poll=on_poll if tpath else None)
                 if watch is not None:
                     # one forced pass over the generation's full record
                     # so a skew that landed between polls still makes
                     # the journal before the re-form decision
                     watch.poll(force=True)
                     span["straggler_anomalies"] = len(watch.findings)
+                if memwatch is not None:
+                    memwatch.poll(force=True)
+                    span["memory_anomalies"] = len(memwatch.findings)
                 g = FleetGeneration(
                     gen=gen, n_processes=n, results=results,
                     exit_classes=[r.exit_class for r in results])

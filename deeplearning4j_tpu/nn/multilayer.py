@@ -373,6 +373,12 @@ class MultiLayerNetwork:
             return self._batch_dict(ds)
 
         from deeplearning4j_tpu.data.pipeline import iter_prefetched
+        from deeplearning4j_tpu.telemetry import get_default as _telemetry
+        from deeplearning4j_tpu.telemetry.memstat import sampler_for_net
+
+        # batch-boundary memory sampling: one modulo per iteration unless
+        # DL4J_TPU_MEM_EVERY enables the cadence (memstat.on_step)
+        mem = sampler_for_net(self, _telemetry())
 
         for _ in range(epochs):
             it.reset()
@@ -391,6 +397,7 @@ class MultiLayerNetwork:
                     self.iteration_count += 1
                     for lst in self.listeners:
                         lst.iteration_done(self, self.iteration_count)
+                    mem.on_step(self.iteration_count)
             self.epoch_count += 1
         return self
 

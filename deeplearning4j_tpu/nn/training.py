@@ -262,12 +262,31 @@ def fused_fit(net, batches, epochs):
     # (telemetry disabled — the default) makes this a no-op.
     from deeplearning4j_tpu.telemetry import get_default as _telemetry
 
-    with _telemetry().span("compile" if first_dispatch else "step_scan",
-                           what="fit_scanned", epochs=epochs,
-                           n_batches=len(batches)):
+    rec = _telemetry()
+    rng = net._next_rng()
+    with rec.span("compile" if first_dispatch else "step_scan",
+                  what="fit_scanned", epochs=epochs,
+                  n_batches=len(batches)):
         net.params, net.opt_state, net.state, losses = net._scan_fit(
-            net.params, net.opt_state, net.state, net._next_rng(), stacked,
+            net.params, net.opt_state, net.state, rng, stacked,
             n_epochs=epochs)
+    if first_dispatch:
+        # compiled-cost harvest, warmup-only: lower() AFTER the warm
+        # dispatch is a jaxpr-cache hit (no retrace); the shapes match
+        # because the scan returned same-shaped trees
+        from deeplearning4j_tpu.telemetry.costbook import CostBook
+
+        book = getattr(net, "_cost_book", None)
+        if book is None or book.recorder is not rec:
+            book = CostBook(rec)
+            try:
+                net._cost_book = book
+            except Exception:
+                pass
+        book.record("fit_scanned", [int(epochs), len(batches)],
+                    net._scan_fit,
+                    (net.params, net.opt_state, net.state, rng, stacked),
+                    kwargs={"n_epochs": epochs})
     per_epoch = losses.mean(axis=1)
     nb = len(batches)
     if net.listeners:
@@ -288,6 +307,13 @@ def fused_fit(net, batches, epochs):
             net.epoch_count += epochs
     net.score_value = losses[-1, -1]
     net._epoch_losses = per_epoch
+    # one ledger-annotated memory event per fused dispatch when the env
+    # cadence is on — the whole scan is one batch boundary
+    from deeplearning4j_tpu.telemetry.memstat import sampler_for_net
+
+    mem = sampler_for_net(net, rec)
+    if mem.mem_every > 0:
+        mem.sample("fit", iteration=net.iteration_count)
     return net
 
 

@@ -16,8 +16,12 @@ donation-alias XlaRuntimeError class the reshard matrix already
 documents); the search side mirrors it with `Objective(step="forward")`
 so predicted and measured rank the same quantity.
 
-Prints one `RESULT {json}` line: {"placement", "ms_per_step", "times_ms",
-"devices"} — the parent bench mode reads it back.
+Prints one `RESULT {json}` line: {"placement", "ms_per_step",
+"times_ms", "devices", "measured_bytes"} — plus "predicted_bytes" when
+the spec carries the search's prediction (the parent passes it for the
+WINNER arm only), in which case the arm also emits a typed
+`cost_drift` reconciliation event (telemetry/costbook.py) — the parent
+bench mode reads the measurement back.
 """
 
 from __future__ import annotations
@@ -65,10 +69,30 @@ def run_arm(spec: dict) -> dict:
         jax.block_until_ready(net.output(toks))
         times.append((time.perf_counter() - t0) * 1e3)
     times.sort()
-    return {"placement": placement.describe(),
-            "devices": int(spec["devices"]),
-            "ms_per_step": round(times[len(times) // 2], 4),
-            "times_ms": [round(t, 4) for t in times]}
+    from deeplearning4j_tpu.telemetry import costbook
+
+    measured = costbook.measured_peak_bytes()
+    result = {"placement": placement.describe(),
+              "devices": int(spec["devices"]),
+              "ms_per_step": round(times[len(times) // 2], 4),
+              "times_ms": [round(t, 4) for t in times],
+              "measured_bytes": int(measured)}
+    predicted = float(spec.get("predicted_bytes") or 0.0)
+    if predicted > 0:
+        # cost-model calibration: reconcile the search's predicted
+        # per-device bytes against this arm's measured peak (backend
+        # memory_stats on TPU, live-array total on CPU) — a typed
+        # `cost_drift` event lands on the shared telemetry record and
+        # the measurement rides RESULT back to the parent bench mode.
+        # The parent passes predicted_bytes for the WINNER arm only:
+        # the control arm's memory model is a ranking penalty, not a
+        # calibrated prediction, and must not pollute the drift record
+        from deeplearning4j_tpu.telemetry.recorder import get_default
+
+        costbook.reconcile(get_default(), int(predicted),
+                           measured_bytes=measured, source="placement")
+        result["predicted_bytes"] = predicted
+    return result
 
 
 def main(argv=None) -> int:

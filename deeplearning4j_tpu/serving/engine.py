@@ -58,6 +58,9 @@ from deeplearning4j_tpu.serving.fleet import (ReplicaFaultInjector,
 from deeplearning4j_tpu.serving.kvcache import CachePlan
 from deeplearning4j_tpu.serving.speculative import (NgramProposer,
                                                     accept_greedy)
+from deeplearning4j_tpu.telemetry.costbook import CostBook, peak_flops
+from deeplearning4j_tpu.telemetry.memstat import (MemoryLedger,
+                                                  MemorySampler)
 
 
 class QueueFullError(RuntimeError):
@@ -310,6 +313,15 @@ class InferenceEngine:
         # from — live hot-swap (serving/fleet.hot_swap) flips it
         self.weights = WeightStore(net.params, net.state,
                                    step=self.restored_step)
+        # the memory-observability spine: the ledger attributes live
+        # bytes (the weight-store read tracks hot-swaps), the sampler
+        # emits `memory` events at warmup and on the stats tick, the
+        # costbook harvests XLA cost/memory analyses at warmup
+        ledger = MemoryLedger()
+        ledger.register("params", lambda: self.weights.current.params)
+        self.memsampler = MemorySampler(recorder, ledger)
+        self.costbook = CostBook(recorder)
+        self.peak_flops = 0.0  # set at warmup from the device kind
         self.lattice = lattice or BucketLattice()
         self.batcher = Batcher(self.lattice, max_wait_ms,
                                sequence=sequence, recorder=recorder)
@@ -348,7 +360,17 @@ class InferenceEngine:
         replay must add zero."""
         ex = np.asarray(example_features)
         self._feature_template = ex
-        return sum(self._warm_replica(r) for r in self._replicas)
+        compiles = sum(self._warm_replica(r) for r in self._replicas)
+        if compiles:
+            # one post-warmup snapshot: every serving run's telemetry
+            # carries at least one `memory` event, and the MFU gauge
+            # gets its device-peak denominator
+            import jax
+
+            self.peak_flops = peak_flops(
+                getattr(jax.devices()[0], "device_kind", ""))
+            self.memsampler.sample("warmup", peak_flops=self.peak_flops)
+        return compiles
 
     def _warm_replica(self, replica: _Replica) -> int:
         """Compile every lattice bucket this replica has not yet seen
@@ -378,6 +400,14 @@ class InferenceEngine:
                 np.asarray(y)  # batch-boundary fetch
             replica._seen_shapes.add(key)
             compiles += 1
+            # cost-book harvest rides the warmup compile: lower() after
+            # the warm call is a jaxpr-cache hit (no re-trace — the
+            # frozen trace counters stay frozen), and the analyses come
+            # from the AOT executable XLA already built
+            self.costbook.record("forward", list(bucket.key()),
+                                 replica._jit,
+                                 (ws.params, ws.state, batch.features,
+                                  batch.mask))
         return compiles
 
     def _zeros_for(self, bucket: Bucket, tail: tuple, dtype):
@@ -590,6 +620,9 @@ class InferenceEngine:
         now = self._clock()
         with self._rcv:
             fleet = [r.describe(now) for r in self._replicas]
+        # the stats tick is a blessed batch boundary: rate-limited, so
+        # a tight scrape loop cannot turn /stats into a live-array walk
+        self.memsampler.maybe_sample("stats_tick")
         return {
             "replicas": len(fleet),
             "served": self.served,
@@ -601,6 +634,8 @@ class InferenceEngine:
             "sequence": self.sequence,
             "fleet": fleet,
             "weights": self.weights.describe(),
+            "memory": self.memsampler.last,
+            "peak_flops": self.peak_flops,
         }
 
 
@@ -645,7 +680,7 @@ class _GenWorker:
                  plan: CachePlan, prefill_chunk: int, max_queue: int,
                  recorder, weights: WeightStore | None = None,
                  faults: ReplicaFaultInjector | None = None,
-                 speculative_k: int = 0):
+                 speculative_k: int = 0, costbook: CostBook | None = None):
         import jax
         import jax.numpy as jnp
 
@@ -656,6 +691,7 @@ class _GenWorker:
         self.prefill_chunk = prefill_chunk
         self.max_queue = max_queue
         self.recorder = recorder
+        self.costbook = costbook or CostBook(recorder)
         self.weights = weights or WeightStore(net.params, net.state)
         self.faults = faults
         self.pool = plan.make_pool()
@@ -768,6 +804,13 @@ class _GenWorker:
                 self.cache = cache
             self._seen_shapes.add(key)
             compiles += 1
+            # warmup-time cost harvest: lower() is a jaxpr-cache hit
+            # (no trace-counter bump), the analyses are XLA's own
+            self.costbook.record("prefill", [1, Tb], self._prefill_jit,
+                                 (ws.params, ws.state, self.cache,
+                                  np.zeros((1, Tb), np.int32),
+                                  np.zeros((1, Tb), np.float32), rows,
+                                  start, np.asarray([Tb - 1], np.int32)))
         # only the step this worker actually runs is warmed: the decode
         # shape in plain mode, the [B, k] verify shape in speculative
         # mode — either way ONE step compile, and the trace counter is
@@ -786,6 +829,11 @@ class _GenWorker:
                     self.cache = cache
                 self._seen_shapes.add("verify")
                 compiles += 1
+                self.costbook.record(
+                    "verify", [B, K, self.plan.capacity],
+                    self._verify_jit,
+                    (ws.params, ws.state, self.cache,
+                     np.zeros((B, K), np.int32), scratch))
         elif "decode" not in self._seen_shapes:
             B = self.plan.n_slots
             scratch = np.full(B, self.plan.capacity - 1, np.int32)
@@ -799,6 +847,10 @@ class _GenWorker:
                 self.cache = cache
             self._seen_shapes.add("decode")
             compiles += 1
+            self.costbook.record("decode", [B, self.plan.capacity],
+                                 self._decode_jit,
+                                 (ws.params, ws.state, self.cache,
+                                  np.zeros(B, np.int32), scratch))
         return compiles
 
     # --------------------------------------------------------- admission
@@ -1255,12 +1307,21 @@ class GenerationEngine:
                               max(1, int(slots)), page_size,
                               pool_pages=pool_pages, kv_dtype=kv_dtype)
         self._clock = time.monotonic
+        self.costbook = CostBook(recorder)
         self._workers = [
             _GenWorker(i, net, lattice, self.plan, chunk, max_queue,
                        recorder, weights=self.weights,
                        faults=self._faults,
-                       speculative_k=self.speculative_k)
+                       speculative_k=self.speculative_k,
+                       costbook=self.costbook)
             for i in range(max(1, int(replicas)))]
+        # ledger: published weights + every worker's paged KV cache
+        ledger = MemoryLedger()
+        ledger.register("params", lambda: self.weights.current.params)
+        ledger.register("kv_pages",
+                        lambda: [w.cache for w in self._workers])
+        self.memsampler = MemorySampler(recorder, ledger)
+        self.peak_flops = 0.0  # set at warmup from the device kind
         self._rr = 0
         self._started = False
         recorder.meta(role="generation-engine",
@@ -1276,7 +1337,14 @@ class GenerationEngine:
         """Compile every (replica, prefill-bucket) and (replica,
         decode-shape) once. Returns the compile count; after this the
         trace counters are frozen."""
-        return sum(w.warmup(self._clock) for w in self._workers)
+        compiles = sum(w.warmup(self._clock) for w in self._workers)
+        if compiles:
+            import jax
+
+            self.peak_flops = peak_flops(
+                getattr(jax.devices()[0], "device_kind", ""))
+            self.memsampler.sample("warmup", peak_flops=self.peak_flops)
+        return compiles
 
     # ------------------------------------------------------------ serving
     def start(self) -> "GenerationEngine":
@@ -1372,6 +1440,8 @@ class GenerationEngine:
     def stats(self) -> dict:
         now = self._clock()
         pools = [w.pool.describe() for w in self._workers]
+        # rate-limited memory tick — the stats path is a batch boundary
+        self.memsampler.maybe_sample("stats_tick")
         return {
             "replicas": len(self._workers),
             "served": self.served,
@@ -1387,6 +1457,8 @@ class GenerationEngine:
             "weights": self.weights.describe(),
             "generate": True,
             "speculative": self._speculative_stats(),
+            "memory": self.memsampler.last,
+            "peak_flops": self.peak_flops,
         }
 
     def _speculative_stats(self) -> dict:

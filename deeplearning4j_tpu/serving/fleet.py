@@ -456,6 +456,12 @@ class AutoscalePolicy:
     down_p99_ms: float = float("inf")
     cooldown_up_s: float = 0.25
     cooldown_down_s: float = 2.0
+    # HBM headroom floor (0 disables). In-process replicas SHARE the
+    # device, so low headroom vetoes growth (a new replica's KV pages
+    # would land on an already-tight HBM) and, past the floor, drains
+    # one replica to free pages — the memory analogue of the latency
+    # signal, fed from the telemetry `memory` events.
+    min_headroom: float = 0.0
 
 
 @dataclass
@@ -468,11 +474,22 @@ class AutoscaleState:
 
 def autoscale_decision(policy: AutoscalePolicy, state: AutoscaleState, *,
                        queue_depth: int, p99_ms: float, n_replicas: int,
-                       now: float) -> int:
+                       now: float, headroom: Optional[float] = None) -> int:
     """The pure scale decision: +1 (grow), -1 (drain one), or 0. Mutates
     only `state` (the hysteresis marks) — fake-clock testable. A
     scale-up also arms the DOWN cooldown so a burst's tail can't
-    immediately drain what its head grew."""
+    immediately drain what its head grew. `headroom` (fraction of HBM
+    left, None = no signal) gates against `policy.min_headroom`: a
+    breach vetoes growth and drains one replica on the usual DOWN
+    cooldown — memory pressure outranks latency pressure."""
+    breached = (policy.min_headroom > 0 and headroom is not None
+                and headroom < policy.min_headroom)
+    if breached:
+        if n_replicas > policy.min_replicas \
+                and now - state.last_down_t >= policy.cooldown_down_s:
+            state.last_down_t = now
+            return -1
+        return 0
     over = (queue_depth >= policy.up_queue_depth
             or p99_ms >= policy.up_p99_ms)
     if over and n_replicas < policy.max_replicas \
@@ -504,6 +521,25 @@ def recent_p99_ms(recorder, n: int = 64) -> float:
     return lat[k]
 
 
+def recent_headroom(recorder) -> Optional[float]:
+    """Min per-device HBM headroom (1 - bytes_in_use/bytes_limit) from
+    the LATEST `memory` event in the recorder's in-memory ring — the
+    supervisor's memory signal, same shape as recent_p99_ms. None when
+    no memory event carries device limits (off-TPU, or sampling off):
+    no signal, not "plenty of room"."""
+    for ev in reversed(recorder.events):
+        if ev.get("event") != "memory":
+            continue
+        ratios = []
+        for row in (ev.get("devices") or {}).values():
+            limit = float(row.get("bytes_limit", 0) or 0)
+            if limit > 0:
+                ratios.append(
+                    1.0 - float(row.get("bytes_in_use", 0)) / limit)
+        return min(ratios) if ratios else None
+    return None
+
+
 # ------------------------------------------------------------- supervisor
 
 class FleetSupervisor:
@@ -526,10 +562,13 @@ class FleetSupervisor:
        re-runs warmup on the same jit wrappers (zero compiles: the
        executables survive a thread death) and re-admits the replica;
        a `replica-respawn` fault event carries `respawn_ms`.
-    4. **Autoscale** — when a policy is set: sample queue depth + the
-       recorder ring's recent p99, apply `autoscale_decision`, and
-       grow/drain through the engine; every tick emits a typed
-       `autoscale` event (the occupancy bench row's only source).
+    4. **Autoscale** — when a policy is set: sample queue depth, the
+       recorder ring's recent p99, and the latest `memory` event's HBM
+       headroom (recent_headroom — the memory analogue of the
+       straggler signal), apply `autoscale_decision`, and grow/drain
+       through the engine; every tick emits a typed `autoscale` event
+       (the occupancy bench row's only source) carrying the headroom
+       it acted on.
     """
 
     def __init__(self, engine, *, policy: Optional[AutoscalePolicy] = None,
@@ -591,21 +630,26 @@ class FleetSupervisor:
         if self.policy is not None:
             snap = self.engine.fleet_snapshot()
             p99 = recent_p99_ms(self.recorder)
+            headroom = recent_headroom(self.recorder)
             d = autoscale_decision(
                 self.policy, self.scale_state,
                 queue_depth=snap["queue_depth"], p99_ms=p99,
-                n_replicas=snap["n_replicas"], now=now)
+                n_replicas=snap["n_replicas"], now=now,
+                headroom=headroom)
             if d > 0:
                 self.engine.add_replica()
             elif d < 0:
                 self.engine.retire_replica()
             actions["scale"] = d
+            fields = {}
+            if headroom is not None:
+                fields["headroom"] = round(headroom, 4)
             self.recorder.event(
                 "autoscale", n_serving=snap["n_serving"] + max(0, d),
                 n_replicas=snap["n_replicas"] + d,
                 queue_depth=snap["queue_depth"],
                 p99_ms=round(p99, 3), action=d,
-                max_replicas=self.policy.max_replicas)
+                max_replicas=self.policy.max_replicas, **fields)
         return actions
 
     # ------------------------------------------------------------- live

@@ -34,8 +34,15 @@ Endpoints (all JSON):
                         (accepted tokens/step, draft acceptance rate)
                         when the engine decodes speculatively,
                         published weight generation/step, per-replica
-                        liveness and heartbeat age — the fleet's pager
-                        surface
+                        liveness and heartbeat age, and the memory/MFU
+                        surface: `serving_hbm_live_bytes`,
+                        `serving_hbm_limit_bytes` + per-device
+                        `serving_hbm_headroom_ratio` (TPU only),
+                        `serving_memory_ledger_bytes{subsystem=...}`
+                        (telemetry/memstat.py ledger), and
+                        `serving_mfu_live` (cost-book flops over
+                        measured forward time; telemetry/costbook.py)
+                        — the fleet's pager surface
     GET  /healthz       {"status", "replicas", "lattice", "served", ...,
                         "fleet": [per-replica {index, state (warming/
                         serving/draining/dead/retired), alive, counters,
@@ -305,6 +312,30 @@ class ServingMetrics:
         self.spec_acceptance = self.registry.gauge(
             "serving_speculative_acceptance_rate",
             "fraction of offered draft tokens the verify step accepted")
+        self.hbm_live = self.registry.gauge(
+            "serving_hbm_live_bytes",
+            "total live device bytes (jax.live_arrays) from the "
+            "engine's memory sampler — the ledger's ground truth")
+        self.hbm_limit = self.registry.gauge(
+            "serving_hbm_limit_bytes",
+            "per-device HBM capacity (backend memory_stats "
+            "bytes_limit; absent off-TPU)")
+        self.hbm_headroom = self.registry.gauge(
+            "serving_hbm_headroom_ratio",
+            "per-device 1 - bytes_in_use/bytes_limit — the "
+            "autoscaler's memory signal (absent off-TPU)")
+        self.ledger_bytes = self.registry.gauge(
+            "serving_memory_ledger_bytes",
+            "live bytes attributed per subsystem (params/opt_state/"
+            "kv_pages/prefetch/activations/other)")
+        self.mfu_live = self.registry.gauge(
+            "serving_mfu_live",
+            "model FLOPs utilization over recent forwards: cost-book "
+            "flops / measured forward seconds / device peak FLOPs")
+        # recent per-forward MFU samples, fed by on_event (cheap append);
+        # the gauge publishes their mean at collection time
+        from collections import deque
+        self._mfu_window = deque(maxlen=64)
         self.registry.add_collector(self._collect)
 
     # ------------------------------------------------------- live events
@@ -325,9 +356,25 @@ class ServingMetrics:
                                       float(ev["queue_s"]))
             if "ttft_s" in ev:
                 self.registry.observe(self.ttft, float(ev["ttft_s"]))
+            if "forward_s" in ev and "bucket" in ev:
+                self._observe_mfu(ev)
         elif kind == "anomaly":
             self.registry.inc(self.anomalies, 1.0,
                               kind=str(ev.get("kind", "unknown")))
+
+    def _observe_mfu(self, ev: dict) -> None:
+        """Per-forward MFU sample: the warmed cost book's flops for the
+        request's bucket over the measured forward wall time and the
+        device's peak. Dict lookups only — no analysis on this path."""
+        book = getattr(self.engine, "costbook", None)
+        peak = float(getattr(self.engine, "peak_flops", 0.0) or 0.0)
+        if book is None or peak <= 0.0:
+            return
+        flops = book.flops("forward", ev["bucket"])
+        seconds = float(ev["forward_s"] or 0.0)
+        if flops <= 0.0 or seconds <= 0.0:
+            return
+        self._mfu_window.append(book.mfu(flops, seconds, peak))
 
     # ---------------------------------------------------------- scraping
     def _collect(self) -> None:
@@ -370,6 +417,25 @@ class ServingMetrics:
                 float(spec.get("accepted_tokens_per_step", 0.0)))
             self.spec_acceptance.set(
                 float(spec.get("draft_acceptance_rate", 0.0)))
+        mem = stats.get("memory") or {}
+        if mem:
+            self.hbm_live.set(float(mem.get("live_array_bytes", 0)))
+            self.ledger_bytes.clear()
+            for subsystem, nbytes in (mem.get("ledger") or {}).items():
+                self.ledger_bytes.set(float(nbytes),
+                                      subsystem=str(subsystem))
+            self.hbm_limit.clear()
+            self.hbm_headroom.clear()
+            for dev, row in (mem.get("devices") or {}).items():
+                limit = float(row.get("bytes_limit", 0) or 0)
+                if limit > 0:
+                    self.hbm_limit.set(limit, device=str(dev))
+                    self.hbm_headroom.set(
+                        1.0 - float(row.get("bytes_in_use", 0)) / limit,
+                        device=str(dev))
+        if self._mfu_window:
+            window = list(self._mfu_window)
+            self.mfu_live.set(sum(window) / len(window))
 
     def render(self) -> str:
         return self.registry.render()

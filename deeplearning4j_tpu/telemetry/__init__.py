@@ -24,7 +24,13 @@ def __getattr__(name):
     if name == "TelemetryListener":
         from deeplearning4j_tpu.telemetry.listener import TelemetryListener
         return TelemetryListener
-    if name in ("trace", "metrics"):
+    if name in ("MemoryLedger", "MemorySampler"):
+        from deeplearning4j_tpu.telemetry import memstat
+        return getattr(memstat, name)
+    if name == "CostBook":
+        from deeplearning4j_tpu.telemetry.costbook import CostBook
+        return CostBook
+    if name in ("trace", "metrics", "memstat", "costbook"):
         import importlib
         return importlib.import_module(
             f"deeplearning4j_tpu.telemetry.{name}")

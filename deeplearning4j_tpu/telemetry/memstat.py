@@ -1,0 +1,286 @@
+"""Device-memory ledger + sampler — the `memory` event's producer.
+
+The `memory` event kind has existed in the schema since the recorder
+landed and nothing ever emitted it; this module is the missing producer,
+built so the walk it costs can NEVER land on the hot path:
+
+* `MemoryLedger` attributes live device bytes to subsystems — params,
+  optimizer state, KV-cache pages, prefetch buffers — by walking the
+  pytrees each subsystem REGISTERS (a zero-arg callable returning the
+  current tree, so hot-swapped weights and respawned caches stay
+  attributed without re-registration). Whatever the registered trees do
+  not cover is the activation envelope: the residual between the
+  live-array total and the attributed sum, i.e. XLA temp buffers,
+  donated-intermediate slack, and anything in flight.
+* `MemorySampler` snapshots `jax.live_arrays()` byte totals and the
+  backend's `memory_stats()` (TPU HBM; CPU backends return None — the
+  off-TPU fallback is live-array accounting only) and emits one ledger-
+  annotated `memory` event. Sampling happens strictly at batch
+  boundaries (the fit loops' `on_step`, the serving engine's stats
+  tick) or on the sampler's own daemon thread — never inside a jitted
+  region or a per-token loop (graftlint G029 enforces exactly that for
+  everyone OUTSIDE this file).
+
+Cadence control: the fit loops call `on_step(iteration)` every batch
+and this module decides — env `DL4J_TPU_MEM_EVERY` (int, 0/unset =
+off) names the step cadence, so the default fit loop pays one modulo
+per batch and nothing else. The serving stats tick and the sampler
+thread rate-limit through `maybe_sample` (min interval, monotonic
+clock) so a tight scrape loop cannot turn the scrape path into a
+live-array walk storm.
+
+Concurrency: `_mu` guards only the rate-limit clock and the seen-peak
+counter; the live-array walk and the event emit run OUTSIDE it (the
+recorder takes its own lock — holding `_mu` across the emit would
+couple the two, the D002 shape). The sampler thread is a daemon with
+an Event-signalled stop, joinable, and never holds `_mu` while
+sleeping.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+
+from deeplearning4j_tpu.telemetry.recorder import NullRecorder, Recorder
+
+ENV_MEM_EVERY = "DL4J_TPU_MEM_EVERY"
+
+# The closed subsystem vocabulary — the ledger map every `memory` event
+# carries uses exactly these keys (plus "activations" for the residual
+# and "other" when an explicit activation source is registered), so the
+# /metrics ledger gauge and the tracetool mem report never meet an
+# unknown label.
+SUBSYSTEMS = ("params", "opt_state", "kv_pages", "prefetch",
+              "activations", "other")
+
+
+def tree_bytes(tree) -> int:
+    """Total nbytes over a pytree's array leaves (host-side attribute
+    reads only — no device sync)."""
+    import jax
+
+    total = 0
+    for leaf in jax.tree.leaves(tree):
+        total += int(getattr(leaf, "nbytes", 0) or 0)
+    return total
+
+
+def live_array_totals() -> tuple[int, int]:
+    """(total bytes, count) over every live jax array in the process —
+    the off-TPU ground truth for HBM accounting."""
+    import jax
+
+    total = 0
+    count = 0
+    for arr in jax.live_arrays():
+        total += int(getattr(arr, "nbytes", 0) or 0)
+        count += 1
+    return total, count
+
+
+def device_memory_stats() -> dict:
+    """Per-device backend memory_stats keyed by device id (the
+    bytes_in_use / peak_bytes_in_use / bytes_limit triple). Empty on
+    backends that expose none (CPU returns None)."""
+    import jax
+
+    devices = {}
+    for dev in jax.local_devices():
+        try:
+            stats = dev.memory_stats()
+        except Exception:
+            stats = None
+        if stats:
+            devices[str(dev.id)] = {
+                k: stats[k] for k in ("bytes_in_use", "peak_bytes_in_use",
+                                      "bytes_limit") if k in stats}
+    return devices
+
+
+class MemoryLedger:
+    """Attributes live device bytes to subsystems by walking registered
+    pytree sources at snapshot time."""
+
+    def __init__(self):
+        # subsystem -> list of zero-arg callables returning the CURRENT
+        # pytree (so weight hot-swaps stay attributed); registration is
+        # setup-time, snapshots are read-only over the list
+        self._sources: dict[str, list] = {}
+        self._mu = threading.Lock()
+
+    def register(self, subsystem: str, source) -> "MemoryLedger":
+        """Register a byte source under a subsystem name. `source` is a
+        zero-arg callable returning a pytree (preferred — tracks
+        replacement) or a pytree registered as-is."""
+        if subsystem not in SUBSYSTEMS:
+            raise ValueError(f"unknown ledger subsystem {subsystem!r}; "
+                             f"one of {SUBSYSTEMS}")
+        fn = source if callable(source) else (lambda t=source: t)
+        with self._mu:
+            self._sources.setdefault(subsystem, []).append(fn)
+        return self
+
+    def attributed(self) -> dict:
+        """Per-subsystem byte totals over the registered sources (a
+        failing source contributes 0 — attribution is best-effort and
+        must never break the sampling path)."""
+        with self._mu:
+            sources = {k: list(v) for k, v in self._sources.items()}
+        out = {}
+        for subsystem, fns in sources.items():
+            total = 0
+            for fn in fns:
+                try:
+                    total += tree_bytes(fn())
+                except Exception:
+                    pass
+            out[subsystem] = total
+        return out
+
+    def breakdown(self, live_total_bytes: int) -> dict:
+        """The full ledger map for one snapshot: registered subsystems
+        plus the residual. The residual is the activation envelope
+        unless an explicit "activations" source is registered, in which
+        case it lands under "other"."""
+        out = self.attributed()
+        residual = max(0, int(live_total_bytes) - sum(out.values()))
+        key = "other" if "activations" in out else "activations"
+        out[key] = out.get(key, 0) + residual
+        return out
+
+
+class MemorySampler:
+    """Emits ledger-annotated `memory` events — at batch boundaries
+    (`on_step`), on rate-limited ticks (`maybe_sample`), or on its own
+    daemon thread (`start`/`stop`)."""
+
+    def __init__(self, recorder: Recorder, ledger: MemoryLedger | None = None,
+                 min_interval_s: float = 2.0,
+                 mem_every: int | None = None):
+        self.recorder = recorder
+        self.ledger = ledger or MemoryLedger()
+        self.min_interval_s = float(min_interval_s)
+        if mem_every is None:
+            try:
+                mem_every = int(os.environ.get(ENV_MEM_EVERY, "0") or 0)
+            except ValueError:
+                mem_every = 0
+        self.mem_every = max(0, int(mem_every))
+        # guards the rate-limit clock + peak counter ONLY — never held
+        # across the live-array walk or the recorder emit
+        self._mu = threading.Lock()
+        self._last_mono = float("-inf")
+        self._last_event: dict = {}
+        self._peak_live_bytes = 0
+        self._stop = threading.Event()
+        self._thread: threading.Thread | None = None
+
+    @property
+    def enabled(self) -> bool:
+        """False under a NullRecorder — the walk is skipped entirely,
+        matching NullRecorder.memory()'s contract."""
+        return not isinstance(self.recorder, NullRecorder)
+
+    @property
+    def peak_live_bytes(self) -> int:
+        with self._mu:
+            return self._peak_live_bytes
+
+    @property
+    def last(self) -> dict:
+        """The most recent snapshot's payload (live bytes, devices,
+        ledger) — the engines' stats()/metrics surface, so a scrape
+        reads cached numbers instead of forcing a walk."""
+        with self._mu:
+            return dict(self._last_event)
+
+    # ------------------------------------------------------------ sampling
+    def sample(self, source: str, **fields) -> dict:
+        """One snapshot now: live-array walk + backend stats + ledger
+        breakdown, emitted as a single `memory` event."""
+        if not self.enabled:
+            return {}
+        live_bytes, count = live_array_totals()
+        devices = device_memory_stats()
+        ledger = self.ledger.breakdown(live_bytes)
+        payload = dict(live_array_bytes=int(live_bytes),
+                       live_array_count=count, devices=devices,
+                       ledger=ledger,
+                       ledger_total_bytes=int(sum(ledger.values())),
+                       source=source)
+        with self._mu:
+            if live_bytes > self._peak_live_bytes:
+                self._peak_live_bytes = live_bytes
+            self._last_mono = time.monotonic()
+            self._last_event = dict(payload)
+        return self.recorder.event("memory", **payload, **fields)
+
+    def maybe_sample(self, source: str, **fields) -> dict:
+        """Rate-limited snapshot: a no-op within `min_interval_s` of the
+        previous one, so scrape/stats ticks can call it unconditionally."""
+        if not self.enabled:
+            return {}
+        with self._mu:
+            due = (time.monotonic() - self._last_mono
+                   >= self.min_interval_s)
+        if not due:
+            return {}
+        return self.sample(source, **fields)
+
+    def on_step(self, iteration: int, **fields) -> dict:
+        """The fit loops' batch-boundary hook: samples when the env
+        cadence (`DL4J_TPU_MEM_EVERY`) divides the iteration; one modulo
+        otherwise."""
+        if self.mem_every <= 0 or not self.enabled:
+            return {}
+        if int(iteration) % self.mem_every != 0:
+            return {}
+        return self.sample("fit", iteration=int(iteration), **fields)
+
+    # ------------------------------------------------------ sampler thread
+    def start(self, interval_s: float = 10.0) -> "MemorySampler":
+        """Background cadence for long-running processes with no
+        convenient batch boundary (the serving control plane). Daemon
+        thread; Event-signalled stop; one sample per interval."""
+        if self._thread is not None or not self.enabled:
+            return self
+        self._stop.clear()
+
+        def loop():
+            while not self._stop.wait(float(interval_s)):
+                try:
+                    self.sample("sampler")
+                except Exception:
+                    pass  # sampling must never kill the host process
+
+        self._thread = threading.Thread(target=loop, daemon=True,
+                                        name="mem-sampler")
+        self._thread.start()
+        return self
+
+    def stop(self, timeout: float = 5.0) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout)
+            self._thread = None
+
+
+def sampler_for_net(net, recorder) -> MemorySampler:
+    """The fit loops' cached per-net sampler: params + optimizer state
+    registered on the ledger through late-bound callables (a restore or
+    re-init swaps the trees; the callables track). Rebuilt only when
+    the process recorder changed (a test installing its own)."""
+    sampler = getattr(net, "_mem_sampler", None)
+    if sampler is not None and sampler.recorder is recorder:
+        return sampler
+    ledger = MemoryLedger()
+    ledger.register("params", lambda: getattr(net, "params", None))
+    ledger.register("opt_state", lambda: getattr(net, "opt_state", None))
+    sampler = MemorySampler(recorder, ledger)
+    try:
+        net._mem_sampler = sampler
+    except Exception:
+        pass  # slotted/frozen containers still get a working sampler
+    return sampler

@@ -20,7 +20,7 @@ Event schema — one JSON object per line, every event carrying
 | `span`   | a timed region: `name` ("compile", "step", "mode:vgg16", ...), `seconds` wall-clock, `ok`, caller fields |
 | `metric` | a bench metric line verbatim (same dict `bench._emit` prints) |
 | `eval`   | evaluation results (accuracy/f1/stats dict) |
-| `memory` | device-memory snapshot: `live_array_bytes`, `live_array_count`, per-device `memory_stats` when the backend exposes them |
+| `memory` | device-memory snapshot: `live_array_bytes`, `live_array_count`, per-device `memory_stats` when the backend exposes them (`bytes_in_use`, `peak_bytes_in_use`, `bytes_limit`; CPU backends return None — live-array accounting only). Ledger-attributed snapshots (telemetry/memstat.py) additionally carry `ledger` (per-subsystem `{params, opt_state, kv_pages, prefetch, activations, other}` byte map summing to `ledger_total_bytes`) and `source` ("fit" / "stats_tick" / "sampler") — emitted strictly at batch boundaries or on the sampler thread, never inside a jitted region (G029) |
 | `error`  | `where`, `error` (repr), `traceback` (FULL string — never truncated at the source) |
 | `fault`  | fault-injection / elastic-recovery record: `kind` (an injected fault kind from distributed/faults.py or a launcher exit class), `process_id`, `step`, free-form fields — written BEFORE the fault acts, so even a SIGKILL leaves its line |
 | `bucket_plan` | the DP-overlap bucket schedule a net was configured with (parallel/placement.py): `axis`, `n_buckets`, `bucket_bytes`, `mode`, per-bucket `{index, n_leaves, bytes}` — the per-rank collective sequence on the record before any step runs; the bench's per-bucket micro-timings ride `span` events named `bucket_reduce` (`bucket`, `bytes`, `n_leaves`, `seconds`) |
@@ -33,7 +33,9 @@ Event schema — one JSON object per line, every event carrying
 | `host_gather` | a full-value host materialization of genuinely SHARDED leaves (util/orbax_checkpoint.host_materialize): `n_leaves`, `bytes` — resharded restore paths must show ZERO of these (asserted by the elastic timeline test) |
 | `weight_swap` | one live hot-swap attempt (serving/fleet.hot_swap): `ok`, `step` (the checkpoint step restored), `restore_ms` (shadow-net restore + validation, all OFF the request path), `generation` (the WeightStore generation after a flip / still serving after a rejection), `error` on rejection — paired with the `weight_gen` field every serving `request` event carries, the flip's visibility in the traffic record |
 | `autoscale` | one fleet-supervisor autoscale tick (serving/fleet.FleetSupervisor): `n_serving`, `n_replicas`, `queue_depth`, `p99_ms` (the decision inputs), `action` (+1 grew / -1 drained / 0), `max_replicas` — the occupancy bench row's only source; replica self-healing rides `fault` events (`replica-kill`/`replica-hang` when an injected fault fires, `replica-dead` with the requeued count when the supervisor reaps, `replica-respawn` with `respawn_ms` on re-admission) |
-| `anomaly` | one detector finding (telemetry/trace.py) put on the record by whoever ran the detector — the elastic supervisor's straggler watch, `tracetool check`, or the bench sweep: `kind` ("straggler" / "retrace" / "input_wait_spike" / "queue_spike"), `process`, and the kind's evidence fields (`step`+`skew_ms` for stragglers, the offending span's name/seconds for retraces and spikes) |
+| `anomaly` | one detector finding (telemetry/trace.py) put on the record by whoever ran the detector — the elastic supervisor's straggler watch, `tracetool check`, or the bench sweep: `kind` ("straggler" / "retrace" / "input_wait_spike" / "queue_spike" / "leak" / "headroom" / "cost_drift"), `process`, and the kind's evidence fields (`step`+`skew_ms` for stragglers, the offending span's name/seconds for retraces and spikes, byte counts + growth/ratio fields for the memory kinds) |
+| `cost` | one compiled executable's cost-book entry (telemetry/costbook.py), harvested at warmup/compile time from XLA's own `cost_analysis()` / `memory_analysis()` — NEVER on the hot path (it rides the existing `compile` spans): `entry` (the jit wrapper's name: "forward", "prefill", "decode", "verify", "fit_scanned", ...), `shape` (the warmed shape key), `flops`, `bytes_accessed`, `peak_temp_bytes`, `argument_bytes`, `output_bytes`, `generated_code_bytes` — the denominators behind the MFU gauge and the capacity planner's measured-cost side |
+| `cost_drift` | one predicted-vs-measured reconciliation of the placement cost model (reshard/search.py `winner_memory_bytes` vs a measured per-device peak from later `memory`/`cost` events): `predicted_bytes`, `measured_bytes`, `ratio` (measured/predicted), `factor` (the documented tolerance band — outside [1/factor, factor] is an anomaly), `source` — emitted once after the first real step, the calibration loop closing over the search's exact-rational predictions |
 
 **Correlation fields** (the fleet-timeline contract, tools/tracetool.py):
 every event MAY carry `trace_id` / `span_id` / `parent_id`. `span()`
@@ -118,7 +120,7 @@ EVENT_KINDS = frozenset({
     "bucket_plan", "kernel_tune", "request", "page_pool", "draft",
     "reshard_plan",
     "placement_search", "host_gather", "weight_swap", "autoscale",
-    "anomaly",
+    "anomaly", "cost", "cost_drift",
 })
 
 SPAN_NAMES = frozenset({
@@ -374,6 +376,27 @@ class Recorder:
                                           "bytes_limit") if k in stats}
         return self.event("memory", live_array_bytes=int(live_bytes),
                           live_array_count=count, devices=devices, **fields)
+
+    def cost(self, entry: str, shape, **fields) -> dict:
+        """A `cost` event: one warmed executable's XLA cost book entry
+        (telemetry/costbook.py harvests flops / bytes accessed / peak
+        temp at compile time — zero hot-path cost)."""
+        return self.event("cost", entry=entry, shape=shape, **fields)
+
+    def cost_drift(self, *, predicted_bytes: int, measured_bytes: int,
+                   factor: float, source: str = "placement",
+                   **fields) -> dict:
+        """A `cost_drift` event: the placement cost model's predicted
+        per-device memory reconciled against a measured peak. `ratio`
+        (measured/predicted) outside [1/factor, factor] is the
+        detector's trigger."""
+        predicted = max(1, int(predicted_bytes))
+        ratio = float(measured_bytes) / float(predicted)
+        return self.event("cost_drift",
+                          predicted_bytes=int(predicted_bytes),
+                          measured_bytes=int(measured_bytes),
+                          ratio=round(ratio, 6), factor=float(factor),
+                          source=source, **fields)
 
     # -------------------------------------------------------------- spans
     @contextlib.contextmanager

@@ -31,11 +31,23 @@ whole fleet:
       threshold (the starve-proof contract's runtime witness);
     - `queue_spike`: a serving batch whose head request waited far past
       the batcher deadline, or an autoscale tick whose queue depth blew
-      through the spike threshold.
+      through the spike threshold;
+    - `leak`: monotonic steady-state growth of a process's live device
+      bytes across its `memory` snapshots (retained batches, an
+      unbounded cache) past a growth floor;
+    - `headroom`: a device's backend-reported `bytes_in_use` past the
+      watermark fraction of `bytes_limit` (off-TPU runs carry no limit
+      and never flag);
+    - `cost_drift`: the placement cost model's predicted per-device
+      memory vs a measured peak outside the documented factor — from
+      typed `cost_drift` events (the costbook's reconcile loop) or the
+      placement_search/memory join as a fallback.
 * **export** — `to_perfetto` emits Chrome trace-event JSON
   (`ui.perfetto.dev` opens it directly): spans as complete ("X")
   slices, requests as slices over their `total_s`, instants ("i") for
-  faults/steps/anomalies, one track per (process, replica).
+  faults/steps/anomalies, counter ("C") tracks from `memory` events
+  (live bytes + the per-subsystem ledger), one track per
+  (process, replica).
 
 Pure stdlib, no package-root imports — `tools/tracetool.py` runs this
 under the same no-jax stubs as graftlint.
@@ -261,6 +273,19 @@ class AnomalyConfig:
     #                                     producer's cold start)
     queue_spike_ms: float = 1000.0      # serving head-request wait
     queue_depth_spike: int = 64         # autoscale-tick queue depth
+    # memory detectors (telemetry/memstat.py's `memory` events)
+    leak_warmup: int = 2                # memory samples skipped per
+    #                                     process (warmup allocations,
+    #                                     compile-time temps)
+    leak_min_samples: int = 4           # steady-state samples needed
+    #                                     before monotonic growth reads
+    #                                     as a leak
+    leak_min_growth_bytes: float = 1 << 20  # total growth floor (1 MiB)
+    headroom_watermark: float = 0.92    # live/limit past this is a
+    #                                     headroom breach
+    cost_drift_factor: float = 8.0      # predicted-vs-measured memory
+    #                                     ratio band (see telemetry/
+    #                                     costbook.DEFAULT_DRIFT_FACTOR)
 
 
 def _step_completions(timeline: Timeline) -> dict:
@@ -416,6 +441,146 @@ def detect_queue_spikes(timeline: Timeline,
     return findings
 
 
+def _memory_samples(timeline: Timeline) -> dict:
+    """{process: [memory event, ...]} in timeline order."""
+    out: dict = {}
+    for ev in timeline.of_kind("memory"):
+        out.setdefault(ev.get("process", "main"), []).append(ev)
+    return out
+
+
+def detect_leaks(timeline: Timeline,
+                 config: AnomalyConfig = AnomalyConfig()) -> list:
+    """Monotonic steady-state live-bytes growth, per process: after the
+    first `leak_warmup` samples (warmup allocations and compile temps
+    ride those), `leak_min_samples`+ snapshots whose `live_array_bytes`
+    never decreases AND grows by `leak_min_growth_bytes` total is a
+    leak — something (retained batches, an unbounded cache) is pinning
+    device memory every step. One finding per process."""
+    findings = []
+    for process, samples in _memory_samples(timeline).items():
+        vals = [int(ev.get("live_array_bytes", 0) or 0)
+                for ev in samples][config.leak_warmup:]
+        if len(vals) < config.leak_min_samples:
+            continue
+        if any(b < a for a, b in zip(vals, vals[1:])):
+            continue  # any release breaks the monotonic-growth signature
+        growth = vals[-1] - vals[0]
+        if growth < config.leak_min_growth_bytes:
+            continue
+        findings.append({
+            "anomaly": "leak", "process": process,
+            "samples": len(vals),
+            "first_bytes": vals[0], "last_bytes": vals[-1],
+            "growth_bytes": growth,
+            "threshold_bytes": int(config.leak_min_growth_bytes),
+            "ts": samples[-1].get("ts")})
+    return findings
+
+
+def detect_headroom(timeline: Timeline,
+                    config: AnomalyConfig = AnomalyConfig()) -> list:
+    """HBM headroom breaches: any device whose backend-reported
+    `bytes_in_use / bytes_limit` passed the watermark (off-TPU runs
+    carry no `bytes_limit` and never flag here — live-array accounting
+    has no ceiling to breach). One finding per (process, device): the
+    FIRST breach is the evidence; repeats add nothing."""
+    findings = []
+    seen: set = set()
+    for ev in timeline.of_kind("memory"):
+        process = ev.get("process", "main")
+        for dev_id, stats in (ev.get("devices") or {}).items():
+            limit = int(stats.get("bytes_limit", 0) or 0)
+            in_use = int(stats.get("bytes_in_use", 0) or 0)
+            if limit <= 0:
+                continue
+            ratio = in_use / limit
+            if ratio <= config.headroom_watermark:
+                continue
+            key = (process, dev_id)
+            if key in seen:
+                continue
+            seen.add(key)
+            findings.append({
+                "anomaly": "headroom", "process": process,
+                "device": dev_id, "bytes_in_use": in_use,
+                "bytes_limit": limit, "ratio": round(ratio, 4),
+                "watermark": config.headroom_watermark,
+                "ts": ev.get("ts")})
+    return findings
+
+
+def detect_cost_drift(timeline: Timeline,
+                      config: AnomalyConfig = AnomalyConfig()) -> list:
+    """Cost-model drift: the placement search's predicted per-device
+    memory vs a measured peak, outside the documented factor band.
+
+    Two evidence paths. Preferred: typed `cost_drift` events (the
+    costbook's reconcile loop already computed predicted/measured/ratio
+    — each event carries its own `factor`, falling back to the config's
+    band). Fallback, for timelines where nothing reconciled live: join
+    each (process, run)'s LAST `placement_search.winner_memory_bytes`
+    against the max measured bytes from that same (process, run)'s
+    later `memory` events."""
+    findings = []
+    reconciled: set = set()
+    for ev in timeline.of_kind("cost_drift"):
+        process = ev.get("process", "main")
+        reconciled.add((process, ev.get("run")))
+        ratio = float(ev.get("ratio", 0.0) or 0.0)
+        factor = float(ev.get("factor", 0) or config.cost_drift_factor)
+        if ratio <= 0 or factor <= 1:
+            continue
+        if 1.0 / factor <= ratio <= factor:
+            continue
+        findings.append({
+            "anomaly": "cost_drift", "process": process,
+            "predicted_bytes": ev.get("predicted_bytes"),
+            "measured_bytes": ev.get("measured_bytes"),
+            "ratio": round(ratio, 4), "factor": factor,
+            "source": ev.get("source", "event"),
+            "ts": ev.get("ts")})
+    # fallback join, scoped per (process, run) — a shared bench log
+    # holds many modes' runs and a search in one must never reconcile
+    # against another's memory samples
+    searches: dict = {}
+    for ev in timeline.of_kind("placement_search"):
+        predicted = int(ev.get("winner_memory_bytes", 0) or 0)
+        if predicted > 0:
+            searches[(ev.get("process", "main"), ev.get("run"))] = ev
+    for scope, search in searches.items():
+        if scope in reconciled:
+            continue
+        process, run = scope
+        measured = 0
+        last_ts = None
+        for ev in timeline.of_kind("memory"):
+            if (ev.get("process", "main"), ev.get("run")) != scope:
+                continue
+            if float(ev.get("ts", 0.0)) < float(search.get("ts", 0.0)):
+                continue
+            per_dev = [int(s.get("peak_bytes_in_use", 0) or 0)
+                       for s in (ev.get("devices") or {}).values()]
+            cand = max(per_dev) if any(per_dev) \
+                else int(ev.get("live_array_bytes", 0) or 0)
+            if cand > measured:
+                measured = cand
+                last_ts = ev.get("ts")
+        if measured <= 0:
+            continue
+        predicted = int(search.get("winner_memory_bytes", 0) or 0)
+        ratio = measured / predicted
+        factor = config.cost_drift_factor
+        if 1.0 / factor <= ratio <= factor:
+            continue
+        findings.append({
+            "anomaly": "cost_drift", "process": process, "run": run,
+            "predicted_bytes": predicted, "measured_bytes": measured,
+            "ratio": round(ratio, 4), "factor": factor,
+            "source": "join", "ts": last_ts})
+    return findings
+
+
 def detect_anomalies(timeline: Timeline,
                      config: AnomalyConfig = AnomalyConfig()) -> list:
     """All detectors, in timeline order of evidence. Each finding is a
@@ -424,7 +589,10 @@ def detect_anomalies(timeline: Timeline,
     return (detect_stragglers(timeline, config)
             + detect_retraces(timeline)
             + detect_input_wait_spikes(timeline, config)
-            + detect_queue_spikes(timeline, config))
+            + detect_queue_spikes(timeline, config)
+            + detect_leaks(timeline, config)
+            + detect_headroom(timeline, config)
+            + detect_cost_drift(timeline, config))
 
 
 # -------------------------------------------------------- live watching
@@ -467,6 +635,67 @@ class StragglerWatch:
         fresh = []
         for f in detect_stragglers(timeline, self.config):
             key = (f.get("mode"), f.get("process"), f.get("step"))
+            if key in self._seen:
+                continue
+            self._seen.add(key)
+            self.findings.append(f)
+            fresh.append(f)
+            payload = {k: v for k, v in f.items() if k != "anomaly"}
+            self.recorder.anomaly(f["anomaly"], **payload)
+        return fresh
+
+
+class MemoryWatch:
+    """Incremental memory-anomaly detection for a LIVE fleet — the
+    elastic supervisor and fleet autoscaler consume this exactly the
+    way they consume `StragglerWatch`: each `poll()` re-reads the
+    telemetry shards, runs the leak / headroom / cost-drift detectors,
+    and emits each NEW finding exactly once as a typed `anomaly` event
+    — so a leaking or HBM-starved worker is in the journal while the
+    run is still alive."""
+
+    def __init__(self, path: str, recorder=None,
+                 config: AnomalyConfig = AnomalyConfig(),
+                 min_interval_s: float = 1.0, clock=None):
+        import time as _time
+
+        self.path = path
+        self.config = config
+        self.min_interval_s = min_interval_s
+        self._clock = clock or _time.monotonic
+        self._last_poll = float("-inf")
+        self._seen: set = set()
+        self.findings: list = []
+        if recorder is None:
+            from deeplearning4j_tpu.telemetry.recorder import get_default
+            recorder = get_default()
+        self.recorder = recorder
+
+    @staticmethod
+    def _key(f: dict) -> tuple:
+        kind = f.get("anomaly")
+        if kind == "headroom":
+            return (kind, f.get("process"), f.get("device"))
+        if kind == "cost_drift":
+            return (kind, f.get("process"), f.get("run"),
+                    f.get("predicted_bytes"))
+        return (kind, f.get("process"))  # leak: one per process
+
+    def poll(self, force: bool = False) -> list:
+        now = self._clock()
+        if not force and now - self._last_poll < self.min_interval_s:
+            return []
+        self._last_poll = now
+        try:
+            timeline = load_timeline(self.path)
+        except (FileNotFoundError, OSError):
+            return []
+        found = (detect_leaks(timeline, self.config)
+                 + detect_headroom(timeline, self.config)
+                 + detect_cost_drift(timeline, self.config))
+        fresh = []
+        for f in found:
+            key = self._key(f)
             if key in self._seen:
                 continue
             self._seen.add(key)
@@ -528,11 +757,62 @@ def to_perfetto(timeline: Timeline) -> dict:
                            "ph": "X", "pid": pid, "tid": tid,
                            "ts": us(ts - float(ev["total_s"])),
                            "dur": round(dur, 1), "args": args})
+        elif kind == "memory":
+            # counter tracks: live bytes + the ledger breakdown render
+            # as stacked area series in the Perfetto UI
+            series = {"live_array_bytes":
+                      int(ev.get("live_array_bytes", 0) or 0)}
+            for subsystem, nbytes in (ev.get("ledger") or {}).items():
+                series[f"ledger_{subsystem}"] = int(nbytes or 0)
+            events.append({"name": "device_memory", "ph": "C",
+                           "pid": pid, "tid": 0, "ts": us(ts),
+                           "args": series})
         else:
             events.append({"name": str(kind), "ph": "i", "pid": pid,
                            "tid": tid, "ts": us(ts), "s": "p",
                            "args": args})
     return {"traceEvents": events, "displayTimeUnit": "ms"}
+
+
+# --------------------------------------------------------- memory report
+
+def memory_report(timeline: Timeline) -> dict:
+    """The `tracetool mem` report: per-process memory timeline summary
+    (sample count, first/last/peak live bytes, the last ledger
+    breakdown, device limits when the backend reported them) plus the
+    compiled-cost book (per-entry flops / bytes accessed / peak temp
+    from `cost` events) and every `cost_drift` reconciliation."""
+    processes = {}
+    for process, samples in sorted(_memory_samples(timeline).items()):
+        vals = [int(ev.get("live_array_bytes", 0) or 0) for ev in samples]
+        last = samples[-1]
+        limits = {}
+        for ev in samples:
+            for dev_id, stats in (ev.get("devices") or {}).items():
+                if stats.get("bytes_limit"):
+                    limits[dev_id] = int(stats["bytes_limit"])
+        processes[process] = {
+            "samples": len(samples),
+            "first_bytes": vals[0], "last_bytes": vals[-1],
+            "peak_bytes": max(vals),
+            "growth_bytes": vals[-1] - vals[0],
+            "ledger": dict(last.get("ledger") or {}),
+            "sources": sorted({str(ev.get("source", "?"))
+                               for ev in samples}),
+            "device_limits": limits,
+        }
+    book = {}
+    for ev in timeline.of_kind("cost"):
+        key = f"{ev.get('entry', '?')}::{ev.get('shape')}"
+        book[key] = {k: ev[k] for k in
+                     ("flops", "bytes_accessed", "peak_temp_bytes",
+                      "argument_bytes", "output_bytes") if k in ev}
+    drifts = [{k: ev.get(k) for k in
+               ("process", "predicted_bytes", "measured_bytes",
+                "ratio", "factor", "source")}
+              for ev in timeline.of_kind("cost_drift")]
+    return {"processes": processes, "cost_book": book,
+            "cost_drift": drifts}
 
 
 # ------------------------------------------------------- TRACE artifacts
@@ -563,4 +843,27 @@ def metric_lines(timeline: Timeline, anomalies: list,
     lines.append({"metric": f"{prefix}_straggler_skew_ms",
                   "value": round(max(skews), 3) if skews else 0.0,
                   "unit": "ms", "lower_is_better": True})
+    # memory rows: leak_count / cost_drift_ratio regress on ANY increase
+    # (the retrace rise-from-zero rule — a leak appearing is never an
+    # improvement); hbm_peak_bytes rides only when samples exist, so
+    # memory-less timelines keep their row set unchanged
+    lines.append({"metric": f"{prefix}_leak_count",
+                  "value": sum(1 for f in anomalies
+                               if f.get("anomaly") == "leak"),
+                  "unit": "count", "lower_is_better": True})
+    drift_ratios = [max(float(f.get("ratio", 0.0) or 0.0),
+                        (1.0 / float(f["ratio"]))
+                        if float(f.get("ratio", 0.0) or 0.0) > 0 else 0.0)
+                    for f in anomalies
+                    if f.get("anomaly") == "cost_drift"]
+    lines.append({"metric": f"{prefix}_cost_drift_ratio",
+                  "value": round(max(drift_ratios), 4) if drift_ratios
+                  else 0.0,
+                  "unit": "ratio", "lower_is_better": True})
+    mem = [int(ev.get("live_array_bytes", 0) or 0)
+           for ev in timeline.of_kind("memory")]
+    if mem:
+        lines.append({"metric": f"{prefix}_hbm_peak_bytes",
+                      "value": max(mem), "unit": "bytes",
+                      "lower_is_better": True, "samples": len(mem)})
     return lines

@@ -429,6 +429,68 @@ def test_speculative_rows_direction():
         threshold=0.1)["regressions"]
 
 
+def test_memory_and_cost_rows_direction():
+    """MEM/COST rows (bench.py `_memory_rows`, tracetool metric_lines,
+    bench_arm plan rows): every byte headline — hbm_peak_bytes, the
+    mem_*_bytes family, the compiled peak_temp_bytes — is
+    lower-is-better by flag AND by summary-reconstructed name (more
+    resident HBM for the same work is a footprint regression); the MFU
+    gauge keeps the default higher-is-better direction (utilization
+    falling means the flops stopped flowing)."""
+    for metric in ("hbm_peak_bytes", "trace_hbm_peak_bytes",
+                   "mem_params_bytes", "mem_kv_pages_bytes",
+                   "serving_peak_temp_bytes",
+                   "plan_measured_bytes::2x2::8 (data=data) p1"):
+        worse = benchdiff.diff(
+            _lines(**{metric: {"value": 1 << 20,
+                               "lower_is_better": True}}),
+            _lines(**{metric: {"value": 4 << 20,
+                               "lower_is_better": True}}),
+            threshold=0.1)["regressions"]
+        assert worse, f"{metric} growth did not regress"
+        bare = benchdiff.diff(_lines(**{metric: {"value": 1 << 20}}),
+                              _lines(**{metric: {"value": 4 << 20}}),
+                              threshold=0.1)["regressions"]
+        assert bare, f"{metric} name pattern lost its direction"
+        better = benchdiff.diff(_lines(**{metric: {"value": 4 << 20}}),
+                                _lines(**{metric: {"value": 1 << 20}}),
+                                threshold=0.1)["regressions"]
+        assert better == [], f"{metric} improvement flagged"
+    # MFU dropping past threshold regresses as higher-is-better
+    assert benchdiff.diff(_lines(mfu_live={"value": 0.42}),
+                          _lines(mfu_live={"value": 0.20}),
+                          threshold=0.1)["regressions"]
+    assert benchdiff.diff(_lines(mfu_live={"value": 0.42}),
+                          _lines(mfu_live={"value": 0.55}),
+                          threshold=0.1)["regressions"] == []
+
+
+def test_leak_count_and_cost_drift_regress_on_any_increase():
+    """The memory detector rows have NO acceptable growth: a leak
+    appearing (0 -> 1) or the calibration drift widening at all
+    regresses regardless of threshold — like retraces and rank
+    violations, there is no ratio base that excuses a leak."""
+    for metric, old_v, new_v in (
+            ("leak_count", 0, 1),
+            ("trace_leak_count", 0, 1),
+            ("leak_count", 1, 2),               # nonzero base too
+            ("cost_drift_ratio", 0.0, 12.5),
+            ("trace_cost_drift_ratio", 1.5, 1.6),  # sub-threshold rise
+            ("plan_cost_drift_ratio::2x2", 0.0, 9.0)):
+        rows = benchdiff.diff(
+            _lines(**{metric: {"value": old_v}}),
+            _lines(**{metric: {"value": new_v}}),
+            threshold=10.0)["regressions"]
+        assert rows, f"{metric} {old_v}->{new_v} slipped through"
+    # decreases are plain changes, never regressions
+    for metric in ("leak_count", "cost_drift_ratio",
+                   "trace_cost_drift_ratio"):
+        assert benchdiff.diff(
+            _lines(**{metric: {"value": 5.0}}),
+            _lines(**{metric: {"value": 0.0}}),
+            threshold=0.1)["regressions"] == [], metric
+
+
 def test_committed_serve_r04_self_diff_is_clean(capsys):
     """The round gate's trivial fixed point, against the real committed
     artifact: SERVE_r04 diffed against itself reports no regression and

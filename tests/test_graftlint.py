@@ -1,5 +1,6 @@
 """Tier-1 gate for graftlint (ISSUE 2 + the ISSUE 5 SPMD rules + the
-ISSUE 17 concurrency stage): every AST rule G001-G028 proven on a
+ISSUE 17 concurrency stage + the ISSUE 18 memory-introspection rule):
+every AST rule G001-G029 proven on a
 positive AND a negative fixture, the suppression + baseline machinery,
 the stage-2 jaxpr audit over every public entry point, and the package
 itself held lint-clean (zero non-baselined findings). The stage-3
@@ -792,6 +793,44 @@ class SupervisedWorker:
         if self._thread is not None:
             self._thread.join(timeout=1.0)
 """),
+    # ------------------------------------------- ISSUE 18 (memory)
+    ("G029", """\
+import jax
+
+
+@jax.jit
+def forward(params, batch):
+    hbm = jax.devices()[0].memory_stats()     # frozen at trace time
+    return params
+
+
+def decode_all(slots):
+    for tok in slots:
+        live = sum(a.nbytes for a in jax.live_arrays())  # per-token walk
+
+
+def serve(requests, compiled):
+    for req in requests:
+        peak = compiled.memory_analysis()     # per-request re-summary
+""", """\
+import jax
+
+
+def snapshot():
+    # batch-boundary sampling OUTSIDE traced/hot contexts is the
+    # sampler contract, not a violation
+    return sum(a.nbytes for a in jax.live_arrays())
+
+
+def harvest(compiled):
+    # warmup-time harvest in a plain function
+    return compiled.memory_analysis()
+
+
+def decode_all(slots, cached_memory_event):
+    for tok in slots:
+        read = cached_memory_event["live_array_bytes"]  # cached, no walk
+"""),
 ]
 
 
@@ -821,7 +860,7 @@ def test_rule_fires_on_positive_not_negative(rule, pos, neg):
 
 def test_every_rule_has_fixture_coverage():
     assert {r for r, _, _ in FIXTURES} == set(RULE_DOCS) == {
-        f"G{i:03d}" for i in range(1, 29)}
+        f"G{i:03d}" for i in range(1, 30)}
 
 
 def test_g015_blessed_sites_are_exempt():
@@ -1014,6 +1053,49 @@ def test_g023_scope_and_registry():
                                                        SPAN_NAMES)
     assert "compile" in SPAN_NAMES and "anomaly" in EVENT_KINDS
     assert "my_invented_phase" not in SPAN_NAMES
+
+
+def test_g029_scope_and_blessed_producers():
+    """G029 is contextual: introspection flags inside jit-traced fns
+    and token/request loops anywhere, EXCEPT the two blessed producer
+    modules (memstat.py batch-boundary sampler, costbook.py warmup
+    harvest); the same walks outside those contexts — the sampler
+    contract itself — never flag."""
+    _, pos, neg = next(f for f in FIXTURES if f[0] == "G029")
+    hits = [f for f in lint_source(pos, FIXTURE_PATH)
+            if f.rule == "G029"]
+    assert len(hits) == 3  # traced fn + token loop + request loop
+    assert "G029" not in rules_in(
+        pos, "deeplearning4j_tpu/telemetry/memstat.py")
+    assert "G029" not in rules_in(
+        pos, "deeplearning4j_tpu/telemetry/costbook.py")
+    # non-blessed telemetry files are NOT exempt (unlike G023's scope)
+    assert "G029" in rules_in(
+        pos, "deeplearning4j_tpu/telemetry/trace.py")
+    # a walk at a batch boundary (plain function, no hot loop) is the
+    # design, not a finding
+    boundary = ("import jax\n\n"
+                "def sample_now():\n"
+                "    return [a.nbytes for a in jax.live_arrays()]\n")
+    assert "G029" not in rules_in(boundary)
+    # a loop over non-token/non-request names stays silent even with
+    # introspection inside (precision over recall)
+    cold = ("import jax\n\n"
+            "def audit(checkpoints):\n"
+            "    for ckpt in checkpoints:\n"
+            "        print(sum(a.nbytes for a in jax.live_arrays()))\n")
+    assert "G029" not in rules_in(cold)
+
+
+def test_g029_package_sweeps_clean():
+    """No hot-path memory introspection anywhere in the package, the
+    bench, or the tools — the only producers are the blessed modules."""
+    targets = [PKG, os.path.join(ROOT, "bench.py"),
+               os.path.join(ROOT, "tools")]
+    new, _old = lint_report(targets, load_baseline(BASELINE), root=ROOT)
+    hits = [f for f in new if f.rule == "G029"]
+    assert not hits, "hot-path memory introspection:\n" + "\n".join(
+        f.format() for f in hits)
 
 
 def test_g023_whole_surface_sweeps_clean():

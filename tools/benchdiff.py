@@ -47,6 +47,15 @@ lower-is-better via the `_ms` rule, and the detector rows
 anomaly appearing, or the fleet's step skew growing at all, is never
 an improvement.
 
+MEM/COST rows (bench.py's memory headline + tracetool's
+`trace_hbm_peak_bytes` + PLAN `plan_measured_bytes::...`) are
+lower-is-better by name — `hbm_peak_bytes`, `mem_*_bytes`,
+`peak_temp_bytes` growing past threshold is a memory regression —
+and `leak_count` / `cost_drift_ratio` regress on ANY increase (the
+retrace rise-from-zero rule: the first leak or first out-of-band
+cost-model drift moves the value off 0, which a percentage threshold
+would wave through). `mfu_live` stays higher-is-better.
+
 What counts as a regression (bench metrics are higher-is-better unless
 flagged lower-is-better as above):
 
@@ -90,11 +99,22 @@ DEFAULT_THRESHOLD = 0.10
 # draft_overhead_us) and add _mismatches: the parity gates count greedy
 # token-stream divergences vs the baseline arm — while
 # accepted_tokens_per_step stays higher-is-better (no pattern match).
+# MEM/COST rows (bench.py _memory_rows, tracetool TRACE artifacts,
+# PLAN plan_measured_bytes) are byte headlines: hbm_peak_bytes /
+# mem_*_bytes / peak_temp_bytes growing is a memory regression —
+# while mfu_live stays higher-is-better (no pattern match).
 _LOWER_IS_BETTER_RE = re.compile(
     r"(_p\d+_ms$|_ms$|latency|recompiles|bytes_moved$|bytes_lower_bound$"
     r"|_us$|_ttft_|occupancy|input_wait|failed_requests$|_mismatches$"
     r"|plan_predicted|plan_winner|plan_score|plan_measured"
-    r"|rank_violations$|anomaly_count$|trace_span_)")
+    r"|rank_violations$|anomaly_count$|trace_span_"
+    r"|hbm_peak_bytes|mem_\w*_bytes|peak_temp_bytes|leak_count"
+    r"|cost_drift_ratio)")
+
+# leak_count and cost_drift_ratio regress on ANY increase (below): a
+# run that introduces its FIRST leak or its first out-of-band
+# cost-model drift moved 0 -> n, which a percentage threshold on a
+# zero baseline would wave through — the retrace rise-from-zero rule.
 
 # Metrics where ANY growth regresses regardless of threshold: a
 # predicted-vs-measured rank violation (PLAN artifacts, bench.py
@@ -108,7 +128,7 @@ _LOWER_IS_BETTER_RE = re.compile(
 # correctness break, not a tolerable drift.
 _ALWAYS_REGRESS_RE = re.compile(
     r"(rank_violations$|anomaly_count$|straggler_skew_ms$"
-    r"|_parity_mismatches$)")
+    r"|_parity_mismatches$|leak_count$|cost_drift_ratio)")
 
 
 def _lower_is_better(metric: str, old: dict, new: dict) -> bool:

@@ -10,6 +10,7 @@
     python tools/tracetool.py export telemetry.jsonl --perfetto \
                                      [-o trace.perfetto.json]
     python tools/tracetool.py tree   telemetry.jsonl [--trace <id>]
+    python tools/tracetool.py mem    telemetry.jsonl [--json]
 
 Every subcommand takes the UNSUFFIXED telemetry path and transparently
 merges the `<path>.pN` per-process shards a fleet run leaves behind
@@ -27,14 +28,23 @@ was one process.
 * `check`  — the anomaly detector: stragglers (cross-process
   step-completion skew / a stalled process), post-warmup retraces (the
   zero-retrace contract's runtime witness), input_wait and queue
-  spikes. Exit 1 when a finding matches `--fail-on` (default: every
-  kind); the bench sweep runs this over its own telemetry with
-  `--fail-on straggler,retrace`.
+  spikes, memory leaks (monotonic steady-state live-bytes growth),
+  headroom breaches (live/limit past the watermark), and cost-model
+  drift (predicted vs measured per-device memory outside the
+  documented factor). Exit 1 when a finding matches `--fail-on`
+  (default: every kind); the bench sweep runs this over its own
+  telemetry with `--fail-on straggler,retrace,leak`. Thresholds:
+  `--skew-ms`, `--leak-min-bytes`, `--watermark`, `--drift-factor`.
 * `export --perfetto` — Chrome trace-event JSON; open the output at
-  https://ui.perfetto.dev (or chrome://tracing).
+  https://ui.perfetto.dev (or chrome://tracing). `memory` events render
+  as counter ("C") tracks: live bytes + the per-subsystem ledger.
 * `tree`   — render one correlated span tree (request → queue →
   batch_assemble → forward → compile); without `--trace`, lists the
   trace ids on the record.
+* `mem`    — the device-memory report: per-process live-bytes timeline
+  (first/last/peak, growth, last ledger breakdown, device limits), the
+  compiled-cost book (per-entry flops / bytes accessed / peak temp
+  from `cost` events), and every `cost_drift` reconciliation.
 
 Exit codes: 0 clean, 1 findings (`check`), 2 usage/IO error. Pure
 stdlib — importable under the tools' no-jax package stubs.
@@ -69,6 +79,12 @@ def _config(trace, args):
     kw = {}
     if getattr(args, "skew_ms", None) is not None:
         kw["straggler_skew_ms"] = float(args.skew_ms)
+    if getattr(args, "leak_min_bytes", None) is not None:
+        kw["leak_min_growth_bytes"] = float(args.leak_min_bytes)
+    if getattr(args, "watermark", None) is not None:
+        kw["headroom_watermark"] = float(args.watermark)
+    if getattr(args, "drift_factor", None) is not None:
+        kw["cost_drift_factor"] = float(args.drift_factor)
     return trace.AnomalyConfig(**kw)
 
 
@@ -175,6 +191,40 @@ def cmd_tree(trace, args) -> int:
     return 0
 
 
+def cmd_mem(trace, args) -> int:
+    tl = trace.load_timeline(args.path)
+    report = trace.memory_report(tl)
+    if args.as_json:
+        print(json.dumps(report, indent=1))
+        return 0
+    if not report["processes"]:
+        print("tracetool mem: no memory events on the record "
+              "(set DL4J_TPU_MEM_EVERY / run a serving engine with "
+              "telemetry enabled)")
+        return 0
+    for process, row in report["processes"].items():
+        limits = ", ".join(f"dev{d}={v}" for d, v
+                           in row["device_limits"].items()) or "none"
+        print(f"{process}: {row['samples']} sample(s)  "
+              f"first={row['first_bytes']}B last={row['last_bytes']}B "
+              f"peak={row['peak_bytes']}B growth={row['growth_bytes']}B  "
+              f"limits: {limits}")
+        for subsystem, nbytes in sorted(row["ledger"].items()):
+            print(f"  ledger {subsystem:<12} {nbytes}B")
+    if report["cost_book"]:
+        print(f"cost book ({len(report['cost_book'])} entries):")
+        for key, fields in sorted(report["cost_book"].items()):
+            detail = " ".join(f"{k}={v}" for k, v in sorted(fields.items()))
+            print(f"  {key}: {detail}")
+    for drift in report["cost_drift"]:
+        print(f"cost_drift [{drift.get('source')}]: "
+              f"predicted={drift.get('predicted_bytes')}B "
+              f"measured={drift.get('measured_bytes')}B "
+              f"ratio={drift.get('ratio')} "
+              f"(factor {drift.get('factor')})")
+    return 0
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(prog="tracetool", description=__doc__)
     sub = ap.add_subparsers(dest="cmd", required=True)
@@ -185,6 +235,12 @@ def main(argv=None) -> int:
         p.add_argument("--json", action="store_true", dest="as_json")
         p.add_argument("--skew-ms", type=float, default=None,
                        help="straggler skew threshold (default 2000)")
+        p.add_argument("--leak-min-bytes", type=float, default=None,
+                       help="leak growth floor in bytes (default 1 MiB)")
+        p.add_argument("--watermark", type=float, default=None,
+                       help="headroom breach fraction (default 0.92)")
+        p.add_argument("--drift-factor", type=float, default=None,
+                       help="cost-drift ratio band (default 8.0)")
 
     p = sub.add_parser("merge", help="merged causal timeline as JSONL")
     common(p)
@@ -217,6 +273,10 @@ def main(argv=None) -> int:
     common(p)
     p.add_argument("--trace", default=None, help="trace id to render")
     p.set_defaults(fn=cmd_tree)
+
+    p = sub.add_parser("mem", help="device-memory timeline + cost book")
+    common(p)
+    p.set_defaults(fn=cmd_mem)
 
     args = ap.parse_args(argv)
     trace = _trace_mod()

@@ -1569,6 +1569,256 @@ def bench_placement_search() -> None:
             "separated pair against the measurement")
 
 
+# Sharded-embedding + ANN-serving bench config (ISSUE 19). Sizes were
+# swept on the virtual-CPU mesh: the partition count matches the
+# corpus's natural cluster count so the refine stage probes ~nprobe/P
+# of the table — the regime where partition-then-refine beats one
+# brute-force matmul even on CPU (measured 8.4x at this config; the
+# gate floor is 5x). The smoke test runs the same code at toy sizes
+# via `_embed_run` without the full-config gates.
+EMBED_DIMS = dict(
+    vocab=131072, dim=64, n_partitions=1024, n_clusters=1024,
+    batch=1024, negative=5, window=5, seq_len=25, train_steps=20,
+    query_batch=128, qps_reps=20, k=10, recall_floor=0.95,
+    speedup_floor=5.0, ep_grid=(1, 2), lr=0.025, seed=0,
+)
+
+
+def _embed_clustered_corpus(rng, v: int, d: int, n_clusters: int):
+    """Synthetic embedding-table snapshot with cluster structure (real
+    embedding tables cluster — the recall/nprobe trade needs it)."""
+    centers = rng.normal(size=(n_clusters, d)).astype(np.float32)
+    assign = rng.integers(0, n_clusters, v)
+    noise = 0.15 * rng.normal(size=(v, d))
+    return (centers[assign] + noise).astype(np.float32)
+
+
+def _embed_run(cfg: dict, emit=None) -> dict:
+    """Run the embedding bench at `cfg` sizes; returns {"lines": [...],
+    "gates": {...}}. Shared by bench_embed (full config, gated) and the
+    tests' off-TPU smoke (toy config, ungated)."""
+    from deeplearning4j_tpu.util.virtual_devices import ensure_cpu_devices
+
+    ensure_cpu_devices(8)
+    import jax
+
+    from deeplearning4j_tpu.embedding.ann import brute_force_topk, recall_at_k
+    from deeplearning4j_tpu.embedding.corpus import (
+        prefetched,
+        sequence_pair_batches,
+        with_negatives,
+    )
+    from deeplearning4j_tpu.embedding.engine import (
+        EngineLookupView,
+        ShardedEmbeddingEngine,
+    )
+    from deeplearning4j_tpu.embedding.serving import EmbeddingServingEngine
+    from deeplearning4j_tpu.serving.buckets import BucketLattice
+    from deeplearning4j_tpu.telemetry import Recorder
+
+    emit = emit or (lambda line: None)
+    v, d = cfg["vocab"], cfg["dim"]
+    b, k_neg, window = cfg["batch"], cfg["negative"], cfg["window"]
+    steps, k = cfg["train_steps"], cfg["k"]
+    rng = np.random.default_rng(cfg["seed"])
+    events: list = []
+    rec = Recorder()
+    rec.add_sink(events.append)
+    cum = np.arange(1, v + 1, dtype=np.float64) / v   # uniform unigram
+
+    # ---------------- train: prefetched pair feed, per-ep throughput
+    lines: list = []
+    rates, mem_bytes, view = {}, {}, None
+    train_retraces = 0
+    seq_len = cfg["seq_len"]
+    pairs_per_seq = 2 * window * seq_len - window * (window + 1)
+    n_seq = (steps + 2) * b // pairs_per_seq + 3
+    for ep in cfg["ep_grid"]:
+        eng = ShardedEmbeddingEngine(v, d, ep=ep, negative=k_neg,
+                                     seed=3, recorder=rec)
+        seqs = [rng.integers(0, v, size=seq_len) for _ in range(n_seq)]
+        feed = prefetched(
+            with_negatives(
+                sequence_pair_batches(seqs, batch_size=b, window=window,
+                                      seed=5 + ep),
+                cum, k_neg, seed=7 + ep),
+            depth=4)
+        centers, contexts, negs = next(feed)
+        loss = eng.sgns_step(centers, contexts, negs, cfg["lr"])  # compile
+        jax.block_until_ready(loss)
+        tc0 = eng.trace_count
+        t0 = time.perf_counter()
+        for _ in range(steps):
+            centers, contexts, negs = next(feed)
+            loss = eng.sgns_step(centers, contexts, negs, cfg["lr"])
+        jax.block_until_ready(loss)
+        dt = time.perf_counter() - t0
+        feed.close()
+        rates[ep] = steps * b / dt
+        mem_bytes[ep] = eng.table_bytes_per_device()
+        retraces = eng.trace_count - tc0
+        spans = [e for e in events
+                 if e.get("event") == "span"
+                 and e.get("name") == "scatter_add" and e.get("ep") == ep]
+        scatter_us = (1e6 * float(np.median([e["seconds"]
+                                             for e in spans[1:]]))
+                      if len(spans) > 1 else 0.0)
+        gather_bytes = spans[-1]["ep_gather_bytes"] if spans else 0
+        lines.append({
+            "metric": ("embed_train_tokens_per_sec" if ep == 1
+                       else f"embed_train_tokens_per_sec_ep{ep}"),
+            "value": round(rates[ep], 1), "unit": "pairs/sec", "ep": ep,
+            "batch": b, "steps": steps, "negative": k_neg,
+            "retraces_after_warmup": int(retraces)})
+        lines.append({
+            "metric": f"embed_ep{ep}_ep_gather_bytes",
+            "value": int(gather_bytes), "unit": "bytes",
+            "lower_is_better": True, "ep": ep,
+            "rows_per_step": b * (2 + k_neg)})
+        lines.append({
+            "metric": f"embed_mem_table_bytes_ep{ep}",
+            "value": int(mem_bytes[ep]), "unit": "bytes",
+            "lower_is_better": True, "ep": ep})
+        if ep == 1:
+            lines.append({
+                "metric": "embed_scatter_add_us",
+                "value": round(scatter_us, 1), "unit": "us",
+                "lower_is_better": True, "n_spans": len(spans)})
+            lines.append({
+                "metric": "embed_train_recompiles_after_warmup",
+                "value": int(retraces), "unit": "count",
+                "lower_is_better": True})
+            train_retraces = int(retraces)
+            view = EngineLookupView(eng)
+    ep_grid = list(cfg["ep_grid"])
+    ratio = (mem_bytes[ep_grid[-1]] / mem_bytes[1]
+             if len(ep_grid) > 1 and mem_bytes[1] else 1.0)
+    if len(ep_grid) > 1:
+        lines.append({
+            "metric": "embed_ep_sharding_ratio", "value": round(ratio, 4),
+            "unit": "x", "expected": round(1.0 / ep_grid[-1], 4),
+            "source": "memstat ledger, per-device table bytes"})
+
+    # ---------------- serving: publish a snapshot, calibrate, measure
+    vecs = _embed_clustered_corpus(rng, v, d, cfg["n_clusters"])
+    view.set_vectors(vecs)
+    q = cfg["query_batch"]
+    buckets = tuple(sorted({1, 4, 16, q}))
+    serve = EmbeddingServingEngine(
+        view, n_partitions=cfg["n_partitions"],
+        lattice=BucketLattice(batch_sizes=buckets), k_grid=(k,),
+        recall_floor=cfg["recall_floor"], calibration_queries=q,
+        seed=1, recorder=rec)
+    serve.start()
+    tc0 = serve.trace_count
+
+    # /embed round trip: served rows must be the published snapshot rows
+    ids = np.asarray(rng.choice(v, size=min(16, q), replace=False),
+                     np.int64)
+    embed_req = serve.submit_embed(ids)
+    if not embed_req.wait(60.0) or embed_req.error:
+        raise RuntimeError(f"/embed round trip failed: {embed_req.error}")
+    got = embed_req.result["vectors"]
+    embed_exact = bool(np.allclose(got, vecs[ids], atol=1e-6))
+
+    # query set drawn like the calibration sample: corpus rows
+    qrng = np.random.default_rng(cfg["seed"] + 17)
+    queries = vecs[qrng.choice(v, size=q, replace=False)]
+    reps = cfg["qps_reps"]
+    t0 = time.perf_counter()
+    for _ in range(reps):
+        search_req = serve.submit_search(queries, k)
+        if not search_req.wait(120.0) or search_req.error:
+            raise RuntimeError(f"/search failed: {search_req.error}")
+    ann_dt = time.perf_counter() - t0
+    ann_qps = reps * q / ann_dt
+    res = search_req.result
+
+    brute = jax.jit(lambda x: brute_force_topk(vecs, x, k))
+    b_ids, _ = brute(queries)
+    jax.block_until_ready(b_ids)           # compile + exact baseline ids
+    t0 = time.perf_counter()
+    for _ in range(reps):
+        bi, bs = brute(queries)
+    jax.block_until_ready(bs)
+    brute_dt = time.perf_counter() - t0
+    brute_qps = reps * q / brute_dt
+    recall = recall_at_k(np.asarray(res["ids"]), np.asarray(b_ids))
+    search_retraces = serve.trace_count - tc0
+    serve.drain(30.0)
+
+    speedup = ann_qps / brute_qps if brute_qps else 0.0
+    lines.extend([
+        {"metric": "embed_recall_at_k", "value": round(recall, 4),
+         "unit": "recall", "k": k, "nprobe": serve.nprobe,
+         "floor": cfg["recall_floor"],
+         "calibrated_recall": serve.calibrated_recall},
+        {"metric": "embed_queries_per_sec", "value": round(ann_qps, 1),
+         "unit": "queries/sec", "query_batch": q, "k": k,
+         "nprobe": serve.nprobe, "n_partitions": serve.index.n_partitions,
+         "capacity": serve.index.capacity},
+        {"metric": "embed_brute_force_queries_per_sec",
+         "value": round(brute_qps, 1), "unit": "queries/sec",
+         "query_batch": q, "vocab": v, "dim": d},
+        {"metric": "embed_ann_speedup_vs_brute", "value": round(speedup, 2),
+         "unit": "x", "floor": cfg["speedup_floor"]},
+        {"metric": "embed_search_recompiles_after_warmup",
+         "value": int(search_retraces), "unit": "count",
+         "lower_is_better": True, "warmup_s": serve.warmup_s},
+        {"metric": "embed_endpoint_roundtrip", "value": 1.0, "unit": "ok",
+         "embed_rows_exact": embed_exact, "served": serve.served,
+         "failed_requests": serve.failed},
+    ])
+    for line in lines:
+        emit(line)
+    return {"lines": lines,
+            "gates": {"recall": recall, "speedup": speedup,
+                      "sharding_ratio": ratio,
+                      "train_retraces": train_retraces,
+                      "search_retraces": int(search_retraces),
+                      "embed_exact": embed_exact}}
+
+
+def bench_embed() -> None:
+    """Sharded embedding engine + ANN serving bench (ISSUE 19): SGNS
+    train throughput over the prefetched pair feed at ep=1 and ep=2
+    (per-device table bytes from the memstat ledger must halve),
+    then ANN /search queries/sec and recall@10 vs exact brute force
+    over a published clustered snapshot, with zero-retrace gates on
+    both the train step and the warmed search path. Writes
+    EMBED_r01.json (override: DL4J_TPU_EMBED_ARTIFACT)."""
+    from deeplearning4j_tpu.serving.replay import write_artifact
+
+    here = os.path.dirname(os.path.abspath(__file__))
+    artifact = os.environ.get(
+        "DL4J_TPU_EMBED_ARTIFACT", os.path.join(here, "EMBED_r01.json"))
+    out = _embed_run(EMBED_DIMS, emit=_emit_info)
+    summary = write_artifact(artifact, out["lines"])
+    _emit_info({"metric": "embed_artifact", "path": artifact,
+                "regressions": summary.get("regressions", 0)})
+    g = out["gates"]
+    failures = []
+    if g["recall"] < EMBED_DIMS["recall_floor"]:
+        failures.append(f"recall@{EMBED_DIMS['k']} {g['recall']:.4f} < "
+                        f"{EMBED_DIMS['recall_floor']}")
+    if g["speedup"] < EMBED_DIMS["speedup_floor"]:
+        failures.append(f"ANN speedup {g['speedup']:.2f}x < "
+                        f"{EMBED_DIMS['speedup_floor']}x vs brute force")
+    if not (0.4 <= g["sharding_ratio"] <= 0.6):
+        failures.append(f"ep{EMBED_DIMS['ep_grid'][-1]}/ep1 table-bytes "
+                        f"ratio {g['sharding_ratio']:.3f} not ~0.5")
+    if g["train_retraces"]:
+        failures.append(f"{g['train_retraces']} post-warmup retrace(s) "
+                        "on the train step")
+    if g["search_retraces"]:
+        failures.append(f"{g['search_retraces']} post-warmup retrace(s) "
+                        "on the search path")
+    if not g["embed_exact"]:
+        failures.append("/embed rows diverged from the published table")
+    if failures:
+        raise SystemExit("embed gates failed: " + "; ".join(failures))
+
+
 MODES = {
     "lenet": bench_lenet,
     "vgg16": bench_vgg16,
@@ -1589,6 +1839,7 @@ MODES = {
     "serving_speculative": bench_serving_speculative,
     "input_pipeline": bench_input_pipeline,
     "placement_search": bench_placement_search,
+    "embed": bench_embed,
 }
 
 

@@ -1447,6 +1447,113 @@ def g029_memory_introspection_hot_path(tree, imports, path):
     return out
 
 
+# --------------------------------------------------------------- G030
+
+# Sparse-embedding discipline — the data-movement twin of G016's
+# block-literal rule. An embedding step touches a handful of rows out
+# of a vocab-sized table; the two ways to lose that sparsity are (a) a
+# dense `jnp.take` gather over the full table outside the engine (at
+# ep>1 this materializes every shard's rows on every rank instead of
+# the masked-psum partial gather) and (b) densifying the sparse
+# gradient — `jnp.zeros_like(table).at[idx].add(grads)` allocates and
+# all-reduces a full table-shaped buffer where the overlap layer's
+# sparse bucket kind (parallel/overlap.plan_sparse_bucket) moves only
+# (indices, values) pairs. The blessed sites own those patterns: the
+# embedding engine internally (its scatter is per-shard, post-psum),
+# the legacy dense reference (nlp/lookup.py — the ep=1 parity anchor),
+# and the device pipeline's fused epoch step.
+_G030_BLESSED = ("deeplearning4j_tpu/embedding/",
+                 "deeplearning4j_tpu/nlp/lookup.py",
+                 "deeplearning4j_tpu/nlp/device_pipeline.py")
+# identifiers that read as a full embedding table; deliberately exact
+# (cum_table / tuning_table / a weight "W" must not match)
+_G030_TABLEISH = re.compile(
+    r"^(syn0|syn1|syn1neg|embed(ding)?s?(_table)?|emb_table|"
+    r"lookup_table|vocab_table)$")
+_G030_TABLE_NAMES = frozenset({"syn0", "syn1", "syn1neg"})
+
+
+def _g030_ident(node: ast.AST) -> str | None:
+    """The identifier text of a table-ish operand: bare name, attribute
+    leaf (`self.syn0`), or a constant subscript key (`params["table"]`)."""
+    if isinstance(node, ast.Name):
+        return node.id
+    if isinstance(node, ast.Attribute):
+        return node.attr
+    if isinstance(node, ast.Subscript):
+        sl = node.slice
+        if isinstance(sl, ast.Constant) and isinstance(sl.value, str):
+            return sl.value
+    return None
+
+
+def _g030_is_zeros_like(node: ast.AST, imports) -> bool:
+    return (isinstance(node, ast.Call)
+            and imports.canon(node.func) in ("jax.numpy.zeros_like",
+                                             "numpy.zeros_like"))
+
+
+def g030_dense_embedding_path(tree, imports, path):
+    """A full-table gather (`jnp.take(table, ...)`, `syn0[idx]`) or a
+    densified sparse gradient (`jnp.zeros_like(table).at[idx].add(g)`)
+    outside the embedding engine's blessed internals — the dense
+    pattern the sparse (indices, values) contract exists to replace."""
+    norm = path.replace("\\", "/")
+    if any(b in norm if b.endswith("/") else norm.endswith(b)
+           for b in _G030_BLESSED):
+        return []
+    out = []
+    for node in ast.walk(tree):
+        # (a) dense gather: jnp.take over a table-ish operand, or a
+        # direct subscript load of the canonical table names
+        if isinstance(node, ast.Call) \
+                and imports.canon(node.func) == "jax.numpy.take" \
+                and node.args:
+            ident = _g030_ident(node.args[0])
+            if ident and _G030_TABLEISH.match(ident):
+                out.append((
+                    "G030", node,
+                    f"dense jnp.take over the full embedding table "
+                    f"({ident!r}) outside the engine: at ep>1 this "
+                    "gathers every shard's rows on every rank",
+                    "route lookups through embedding/engine.py "
+                    "(ShardedEmbeddingEngine.embed / the step's masked "
+                    "partial gather + psum)"))
+        elif isinstance(node, ast.Subscript) \
+                and isinstance(node.ctx, ast.Load) \
+                and isinstance(node.value, ast.Name) \
+                and node.value.id in _G030_TABLE_NAMES:
+            out.append((
+                "G030", node,
+                f"direct subscript gather over embedding table "
+                f"{node.value.id!r} outside the blessed dense "
+                "reference (nlp/lookup.py)",
+                "use embedding/engine.py's sharded gather (or the "
+                "EngineLookupView accessors, which slice the padded "
+                "device table once)"))
+        # (b) densified sparse gradient:
+        # jnp.zeros_like(T).at[idx].add(values)
+        if isinstance(node, ast.Call) \
+                and isinstance(node.func, ast.Attribute) \
+                and node.func.attr == "add" \
+                and isinstance(node.func.value, ast.Subscript) \
+                and isinstance(node.func.value.value, ast.Attribute) \
+                and node.func.value.value.attr == "at" \
+                and _g030_is_zeros_like(node.func.value.value.value,
+                                        imports):
+            out.append((
+                "G030", node,
+                "sparse gradient densified into a table-shaped buffer "
+                "(zeros_like(table).at[idx].add(values)): allocates "
+                "and reduces the full vocab where only the touched "
+                "rows carry signal",
+                "keep gradients as (indices, values) pairs and move "
+                "them with parallel/overlap.sparse_bucket_reduce (the "
+                "sparse bucket kind); scatter per-shard inside "
+                "embedding/engine.py"))
+    return out
+
+
 # stage-3 AST rules (G010-G014) live in spmd_rules.py and register here;
 # the import sits below every helper they borrow lazily, so importing
 # either module first resolves cleanly.
@@ -1473,7 +1580,8 @@ ALL_RULES = [g001_traced_bool, g002_host_sync, g003_float64_drift,
              g022_handrolled_placement,
              g023_unregistered_telemetry_names,
              g024_host_sampling,
-             g029_memory_introspection_hot_path] + SPMD_RULES + CONC_RULES
+             g029_memory_introspection_hot_path,
+             g030_dense_embedding_path] + SPMD_RULES + CONC_RULES
 
 RULE_DOCS = {
     "G001": "python control flow / bool()/float()/int() on traced values",
@@ -1525,6 +1633,13 @@ RULE_DOCS = {
             "the blessed producers are telemetry/memstat.py (batch-"
             "boundary sampler) and telemetry/costbook.py (warmup "
             "harvest)",
+    "G030": "sparse-embedding discipline: dense jnp.take / subscript "
+            "gathers over full-vocab embedding tables, and sparse "
+            "gradients densified via zeros_like(table).at[].add(...), "
+            "outside the blessed engine internals (embedding/, "
+            "nlp/lookup.py, nlp/device_pipeline.py) — gradients travel "
+            "as (indices, values) pairs through the overlap layer's "
+            "sparse bucket kind",
     **SPMD_RULE_DOCS,
     **CONC_RULE_DOCS,
 }

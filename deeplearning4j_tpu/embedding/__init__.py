@@ -1,0 +1,27 @@
+"""Sharded embedding engine + device-resident ANN vector search — the
+`expert` (ep) axis's first real tenant.
+
+Layering (ARCHITECTURE.md "Embeddings & vector search"):
+
+* `engine.py`  — ep-row-sharded embedding tables under shard_map; SGNS +
+  hierarchical-softmax train steps with sparse-gather forward and
+  (indices, values) scatter-add backward; the legacy-API lookup view.
+* `ann.py`     — fixed-shape partition-then-refine ANN index (the L6
+  vptree/kdtree contract, batched): coarse centroid routing + exact
+  top-k inside the probed partitions.
+* `walks.py`   — ragged DeepWalk walks bucketed into fixed shapes
+  (serving/buckets.py-style padding) + device-side pair extraction.
+* `corpus.py`  — skip-gram pair batches fed through the data/ async
+  prefetch pipeline.
+* `serving.py` — the `/embed` + `/search` serving engine riding the
+  existing server/fleet plumbing.
+"""
+
+from deeplearning4j_tpu.embedding.engine import (  # noqa: F401
+    EngineLookupView,
+    ShardedEmbeddingEngine,
+)
+from deeplearning4j_tpu.embedding.ann import DeviceANNIndex  # noqa: F401
+from deeplearning4j_tpu.embedding.serving import (  # noqa: F401
+    EmbeddingServingEngine,
+)

@@ -1,0 +1,198 @@
+"""Device-resident ANN index: batched partition-then-refine lookup.
+
+Reproduces the reference's L6 nearest-neighbor contract (clustering/
+vptree.py's `search(target, k) -> [(distance, index)]`, kdtree's exact
+top-k) in the shape a TPU wants: instead of a pointer-chasing tree
+descent per query, a BATCH of queries runs one fixed-shape jitted
+program — coarse centroid routing (score P centroids, keep the top
+`nprobe`) followed by exact top-k scoring inside the probed partitions.
+Everything is fixed-shape — [P, cap] partitions padded with -1 ids,
+[Q, k] results — so the serving zero-retrace warmup contract holds: one
+compile per (Q, k, nprobe) triple at warmup, zero retraces after.
+
+Build is k-means (a few Lloyd iterations, on device) over the corpus,
+then capacity-capped assignment with spill: rows that overflow their
+nearest partition fall to the next-nearest with room — recall insurance
+for skewed clusters. `calibrate_nprobe` walks the nprobe ladder until a
+held-out sample reaches the recall floor, BEFORE warmup, so calibration
+compiles never count against the serving path.
+
+Metric is cosine via normalized dot product — the same normalized-
+matmul + top_k contract as nlp/lookup.InMemoryLookupTable.nearest and
+clustering/vptree's "cosinesimilarity" metric (monotonic in its
+sqrt(2(1-cos)) true-metric form, so top-k order matches exactly).
+"""
+
+from __future__ import annotations
+
+import threading
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from deeplearning4j_tpu.telemetry import get_default
+
+_NEG_INF = -1e30
+
+
+def _normalize(x, axis=-1):
+    n = jnp.linalg.norm(x, axis=axis, keepdims=True)
+    return x / jnp.maximum(n, 1e-12)
+
+
+@jax.jit
+def _kmeans_iter(centroids, vecs):
+    """One Lloyd iteration over normalized vectors (spherical k-means:
+    assign by max dot, recenter, renormalize)."""
+    scores = vecs @ centroids.T                       # [N, P]
+    assign = jnp.argmax(scores, axis=1)               # [N]
+    p = centroids.shape[0]
+    one_hot = jax.nn.one_hot(assign, p, dtype=vecs.dtype)   # [N, P]
+    sums = one_hot.T @ vecs                           # [P, D]
+    counts = one_hot.sum(axis=0)[:, None]             # [P, 1]
+    # empty partitions keep their old centroid
+    new = jnp.where(counts > 0, sums / jnp.maximum(counts, 1.0), centroids)
+    return _normalize(new), assign
+
+
+def brute_force_topk(vectors, queries, k: int):
+    """Exact cosine top-k — the recall baseline and the legacy `nearest`
+    contract, batched: one normalized matmul over the FULL table plus
+    top_k. Returns (ids [Q, k], scores [Q, k])."""
+    normed = _normalize(jnp.asarray(vectors))
+    q = _normalize(jnp.asarray(queries))
+    sims = q @ normed.T                               # [Q, V]
+    scores, idx = jax.lax.top_k(sims, k)
+    return idx.astype(jnp.int32), scores
+
+
+class DeviceANNIndex:
+    """Fixed-shape IVF (partition-then-refine) index over an [V, D]
+    corpus. `search` is jitted per (Q, k, nprobe); `trace_count` counts
+    traces for the zero-retrace gate."""
+
+    def __init__(self, centroids, part_vecs, part_ids, *, recorder=None):
+        self.centroids = centroids          # [P, D] normalized
+        self.part_vecs = part_vecs          # [P, cap, D] normalized, 0-pad
+        self.part_ids = part_ids            # [P, cap] int32, -1 pad
+        self.n_partitions, self.capacity, self.dim = part_vecs.shape
+        self._recorder = recorder if recorder is not None else get_default()
+        self._trace_count = 0
+        self._search_fns = {}
+        self._mu = threading.Lock()
+
+    # ------------------------------------------------------------- build
+    @classmethod
+    def build(cls, vectors, n_partitions: int = 64, *,
+              iters: int = 5, slack: float = 1.5, seed: int = 0,
+              recorder=None) -> "DeviceANNIndex":
+        """K-means + capacity-capped assignment with next-nearest spill.
+        `slack` scales partition capacity over the perfectly-balanced
+        V / P rows so skewed clusters keep their members."""
+        vecs = _normalize(jnp.asarray(vectors, jnp.float32))
+        v, d = vecs.shape
+        p = min(int(n_partitions), v)
+        rng = np.random.default_rng(seed)
+        init = vecs[jnp.asarray(rng.choice(v, size=p, replace=False))]
+        centroids = _normalize(init)
+        for _ in range(max(1, iters)):
+            centroids, _ = _kmeans_iter(centroids, vecs)
+
+        cap = min(v, int(np.ceil(v / p * slack)))
+        # host-side assignment (build time, not the query path): order
+        # candidates by centroid affinity, spill to the next-nearest
+        # partition with room
+        scores = np.asarray(vecs @ centroids.T)        # [V, P]
+        pref = np.argsort(-scores, axis=1)             # [V, P]
+        part_rows = [[] for _ in range(p)]
+        for row in range(v):
+            for c in pref[row]:
+                if len(part_rows[c]) < cap:
+                    part_rows[c].append(row)
+                    break
+        part_ids = np.full((p, cap), -1, np.int32)
+        host_vecs = np.asarray(vecs)
+        part_vecs = np.zeros((p, cap, d), np.float32)
+        for c, rows in enumerate(part_rows):
+            if rows:
+                part_ids[c, :len(rows)] = rows
+                part_vecs[c, :len(rows)] = host_vecs[rows]
+        return cls(centroids, jnp.asarray(part_vecs),
+                   jnp.asarray(part_ids), recorder=recorder)
+
+    # ------------------------------------------------------------- query
+    def _get_search(self, q: int, k: int, nprobe: int):
+        key = (q, k, nprobe)
+        with self._mu:
+            fn = self._search_fns.get(key)
+        if fn is None:
+            def body(centroids, part_vecs, part_ids, queries):
+                self._trace_count += 1  # trace time only
+                qn = _normalize(queries)
+                coarse = qn @ centroids.T                     # [Q, P]
+                _, probe = jax.lax.top_k(coarse, nprobe)      # [Q, nprobe]
+                cand_vecs = part_vecs[probe]        # [Q, nprobe, cap, D]
+                cand_ids = part_ids[probe].reshape(q, -1)
+                fine = jnp.einsum("qd,qncd->qnc", qn, cand_vecs)
+                fine = fine.reshape(q, -1)
+                fine = jnp.where(cand_ids >= 0, fine, _NEG_INF)
+                scores, pos = jax.lax.top_k(fine, k)
+                ids = jnp.take_along_axis(cand_ids, pos, axis=1)
+                return ids, scores
+
+            fn = jax.jit(body, static_argnums=())
+            with self._mu:
+                fn = self._search_fns.setdefault(key, fn)
+        return fn
+
+    def search(self, queries, k: int = 10, *, nprobe: int = 8):
+        """Batched ANN lookup: queries [Q, D] -> (ids [Q, k], cosine
+        scores [Q, k]), nearest-first — the vptree `search` contract,
+        batched and fixed-shape."""
+        queries = jnp.asarray(queries, jnp.float32)
+        q = int(queries.shape[0])
+        nprobe = min(int(nprobe), self.n_partitions)
+        fn = self._get_search(q, int(k), nprobe)
+        probed_bytes = (q * nprobe * self.capacity
+                        * (self.dim * 4 + 4))
+        with self._recorder.span("ann_probe", queries=q, k=int(k),
+                                 nprobe=nprobe, bytes=int(probed_bytes)):
+            ids, scores = fn(self.centroids, self.part_vecs,
+                             self.part_ids, queries)
+        return ids, scores
+
+    @property
+    def trace_count(self) -> int:
+        return self._trace_count
+
+    # -------------------------------------------------------- calibration
+    def calibrate_nprobe(self, vectors, sample_queries, k: int = 10,
+                         floor: float = 0.95,
+                         ladder=(4, 8, 16, 32, 64)) -> tuple:
+        """Walk the nprobe ladder until recall@k on `sample_queries`
+        reaches `floor` vs exact brute force. Runs BEFORE warmup so its
+        compiles never count against the serving path. Returns
+        (nprobe, recall)."""
+        exact_ids, _ = brute_force_topk(vectors, sample_queries, k)
+        exact = np.asarray(exact_ids)
+        best = (int(ladder[-1]), 0.0)
+        for nprobe in ladder:
+            if nprobe > self.n_partitions:
+                break
+            ids, _ = self.search(sample_queries, k, nprobe=nprobe)
+            r = recall_at_k(np.asarray(ids), exact)
+            best = (int(nprobe), float(r))
+            if r >= floor:
+                break
+        return best
+
+
+def recall_at_k(ann_ids: np.ndarray, exact_ids: np.ndarray) -> float:
+    """Mean |ANN ∩ exact| / k over the query batch."""
+    q, k = exact_ids.shape
+    hits = 0
+    for row in range(q):
+        hits += len(set(ann_ids[row].tolist())
+                    & set(exact_ids[row].tolist()))
+    return hits / float(q * k)

@@ -1,0 +1,130 @@
+"""Ragged-walk batching: uneven DeepWalk walks -> fixed device shapes.
+
+`graph/walkers.py` walks are ragged — CUTOFF_ON_DISCONNECTED truncates
+at dead ends, so a seeded corpus mixes lengths freely. Feeding those
+shapes straight to a jitted pair extractor would retrace per length;
+this module applies the serving/buckets.py discipline to the training
+input: a fixed LENGTH GRID, each walk padded up to the smallest bucket
+that holds it (mask marks real tokens), walks of one bucket batched
+together into fixed [B, L] blocks. The device-side skip-gram pair
+extraction then compiles ONCE per (B, L) bucket shape — the
+zero-retrace contract tests/test_embedding.py pins across a seeded
+ragged corpus.
+
+Pair extraction mirrors the fixed-window half of the SequenceVectors
+skip-gram (every (center, context) pair within `window`, both real
+tokens): the [B, L, 2*window] candidate block is built with static
+offsets on device, masked, and returned flat with a validity mask. The
+host compacts valid pairs into training batches (embedding/corpus.py)
+— the DEVICE shapes are what must stay fixed.
+"""
+
+from __future__ import annotations
+
+import threading
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+DEFAULT_LENGTH_BUCKETS = (8, 16, 32, 64)
+
+
+class WalkBucketer:
+    """Buckets ragged walks into fixed [batch, length] blocks with
+    masks. Walks longer than the top bucket are split; shorter ones pad
+    up to the smallest bucket that holds them (id 0, mask False)."""
+
+    def __init__(self, length_buckets=DEFAULT_LENGTH_BUCKETS,
+                 batch: int = 64):
+        self.length_buckets = tuple(sorted(int(b) for b in length_buckets))
+        if not self.length_buckets:
+            raise ValueError("need at least one length bucket")
+        self.batch = int(batch)
+
+    def length_bucket(self, n: int) -> int:
+        for b in self.length_buckets:
+            if n <= b:
+                return b
+        return self.length_buckets[-1]
+
+    def batches(self, walks):
+        """Yield (walk_block [batch, L] int32, mask [batch, L] bool)
+        fixed-shape batches from an iterable of ragged walks. Partial
+        batches flush with all-False mask rows."""
+        pending = {b: [] for b in self.length_buckets}
+        top = self.length_buckets[-1]
+        for walk in walks:
+            arr = np.asarray(walk, np.int32).reshape(-1)
+            # split over-long walks into top-bucket chunks
+            chunks = [arr[i:i + top] for i in range(0, max(arr.size, 1), top)]
+            for chunk in chunks:
+                if chunk.size < 2:
+                    continue
+                bucket = self.length_bucket(chunk.size)
+                pending[bucket].append(chunk)
+                if len(pending[bucket]) >= self.batch:
+                    yield self._pack(pending[bucket], bucket)
+                    pending[bucket] = []
+        for bucket, rows in pending.items():
+            if rows:
+                yield self._pack(rows, bucket)
+
+    def _pack(self, rows, bucket: int):
+        block = np.zeros((self.batch, bucket), np.int32)
+        mask = np.zeros((self.batch, bucket), bool)
+        for i, row in enumerate(rows):
+            block[i, :row.size] = row
+            mask[i, :row.size] = True
+        return block, mask
+
+
+class WalkPairExtractor:
+    """Device-side skip-gram pair extraction over a fixed [B, L] walk
+    block: returns (centers [B*L*2w], contexts [B*L*2w], valid
+    [B*L*2w]) — flat, fixed-shape, compiled once per (B, L)."""
+
+    def __init__(self, window: int = 5):
+        self.window = int(window)
+        self._fns = {}
+        self._trace_count = 0
+        self._mu = threading.Lock()
+
+    @property
+    def trace_count(self) -> int:
+        return self._trace_count
+
+    def _get_fn(self, b: int, length: int):
+        key = (b, length)
+        with self._mu:
+            fn = self._fns.get(key)
+        if fn is None:
+            window = self.window
+
+            def body(block, mask):
+                self._trace_count += 1  # trace time only
+                offsets = [o for o in range(-window, window + 1) if o != 0]
+                centers, contexts, valid = [], [], []
+                for off in offsets:
+                    shifted = jnp.roll(block, -off, axis=1)
+                    shifted_mask = jnp.roll(mask, -off, axis=1)
+                    pos = jnp.arange(length) + off
+                    in_range = (pos >= 0) & (pos < length)
+                    ok = mask & shifted_mask & in_range[None, :]
+                    centers.append(block.reshape(-1))
+                    contexts.append(jnp.where(ok, shifted, 0).reshape(-1))
+                    valid.append(ok.reshape(-1))
+                return (jnp.concatenate(centers),
+                        jnp.concatenate(contexts),
+                        jnp.concatenate(valid))
+
+            fn = jax.jit(body)
+            with self._mu:
+                fn = self._fns.setdefault(key, fn)
+        return fn
+
+    def extract(self, block: np.ndarray, mask: np.ndarray):
+        """Fixed-shape pair extraction; see class docstring."""
+        b, length = block.shape
+        fn = self._get_fn(int(b), int(length))
+        return fn(jnp.asarray(block, jnp.int32), jnp.asarray(mask, bool))

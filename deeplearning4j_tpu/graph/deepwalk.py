@@ -46,6 +46,14 @@ class DeepWalk:
             self._kw["seed"] = s
             return self
 
+        def use_engine(self, flag=True, ep: int = 1, dp: int = 1):
+            """Sharded-embedding-engine training (on by default); ep/dp
+            pick the mesh axes — see Word2Vec.Builder.use_engine."""
+            self._kw["use_engine"] = flag
+            self._kw["engine_ep"] = int(ep)
+            self._kw["engine_dp"] = int(dp)
+            return self
+
         def build(self) -> "DeepWalk":
             return DeepWalk(**self._kw)
 
@@ -54,11 +62,19 @@ class DeepWalk:
         return DeepWalk.Builder()
 
     def __init__(self, vector_size: int = 100, window_size: int = 5,
-                 learning_rate: float = 0.025, seed: int = 0):
+                 learning_rate: float = 0.025, seed: int = 0,
+                 use_engine: bool = True, engine_ep: int = 1,
+                 engine_dp: int = 1):
         self.vector_size = vector_size
         self.window_size = window_size
         self.learning_rate = learning_rate
         self.seed = seed
+        # DeepWalk is a thin front-end over the sharded embedding
+        # engine (embedding/engine.py) — the HS skip-gram step runs the
+        # engine's sparse-gather path, bit-identical to legacy at ep=1
+        self.use_engine = use_engine
+        self.engine_ep = engine_ep
+        self.engine_dp = engine_dp
         self.vectors: Optional[SequenceVectors] = None
         self.num_vertices = 0
 
@@ -87,7 +103,8 @@ class DeepWalk:
             layer_size=self.vector_size, window_size=self.window_size,
             min_word_frequency=1, epochs=epochs,
             learning_rate=self.learning_rate, negative=0, use_hs=True,
-            seed=self.seed)
+            seed=self.seed, use_engine=self.use_engine,
+            engine_ep=self.engine_ep, engine_dp=self.engine_dp)
         self.vectors.fit(seqs)
         return self
 

@@ -82,7 +82,8 @@ class SequenceVectors:
                  pipeline_chunk: int = 512, pipeline_group=None,
                  pipeline_share_negatives: bool = True,
                  pipeline_neg_oversample: float = 2.0,
-                 n_workers: int = 1):
+                 n_workers: int = 1, use_engine: bool = False,
+                 engine_ep: int = 1, engine_dp: int = 1):
         self.layer_size = layer_size
         self.window_size = window_size
         self.min_word_frequency = min_word_frequency
@@ -111,6 +112,15 @@ class SequenceVectors:
         # per-pair SGNS, most of the unshared quality at shared speed (r5)
         self.pipeline_neg_oversample = pipeline_neg_oversample
         self.n_workers = n_workers  # host-parallel vocab counting
+        # route skip-gram flushes through the sharded embedding engine
+        # (embedding/engine.py): ep/dp axes, sparse scatter-add
+        # gradients, fused scoring kernel. ep=1 is bit-identical to the
+        # legacy dense path (the parity contract tests/test_embedding.py
+        # pins); ep>1 row-shards the tables across the expert axis.
+        self.use_engine = use_engine
+        self.engine_ep = engine_ep
+        self.engine_dp = engine_dp
+        self._engine = None
         self._epoch_fn = None
 
         self.vocab: Optional[VocabCache] = None
@@ -137,9 +147,22 @@ class SequenceVectors:
         if V == 0:
             raise ValueError("Empty vocabulary — corpus too small or "
                              "min_word_frequency too high")
-        self.lookup_table = InMemoryLookupTable(
-            V + self._extra_rows(), self.layer_size, seed=self.seed,
-            use_hs=self.use_hs, negative=self.negative)
+        if self._engine_eligible():
+            from deeplearning4j_tpu.embedding.engine import (
+                EngineLookupView,
+                ShardedEmbeddingEngine,
+            )
+
+            self._engine = ShardedEmbeddingEngine(
+                V, self.layer_size, ep=self.engine_ep, dp=self.engine_dp,
+                negative=self.negative, use_hs=self.use_hs,
+                seed=self.seed)
+            self.lookup_table = EngineLookupView(self._engine)
+        else:
+            self._engine = None
+            self.lookup_table = InMemoryLookupTable(
+                V + self._extra_rows(), self.layer_size, seed=self.seed,
+                use_hs=self.use_hs, negative=self.negative)
         if self.negative > 0:
             self._cum_table = unigram_table(self.vocab)
         if self.use_hs:
@@ -151,6 +174,14 @@ class SequenceVectors:
     def _extra_rows(self) -> int:
         """Extra syn0 rows beyond the word vocab (ParagraphVectors labels)."""
         return 0
+
+    def _engine_eligible(self) -> bool:
+        """The engine serves plain skip-gram over the word vocab; CBOW,
+        label rows (ParagraphVectors), and the device pipeline keep the
+        legacy dense tables."""
+        return (self.use_engine and self.algorithm == "skipgram"
+                and self._extra_rows() == 0
+                and not self.use_device_pipeline)
 
     # ------------------------------------------------------------ training
     def _sequence_indices(self, tokens: List[str]) -> np.ndarray:
@@ -211,6 +242,18 @@ class SequenceVectors:
         return max(self.min_learning_rate, self.learning_rate * (1.0 - frac))
 
     def _flush_sg(self, centers, contexts, lr):
+        if self._engine is not None:
+            if self.use_hs:
+                loss = self._engine.hs_step(
+                    centers, self._codes[contexts],
+                    self._points[contexts], self._mask[contexts], lr)
+            else:
+                negs = sample_negatives(
+                    self._cum_table, (len(centers), self.negative),
+                    self._rng)
+                loss = self._engine.sgns_step(centers, contexts, negs, lr)
+            self.loss_history.append(loss)
+            return
         t = self.lookup_table
         if self.use_hs:
             t.syn0, t.syn1, loss = sg_hs_step(

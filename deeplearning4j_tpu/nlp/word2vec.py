@@ -102,6 +102,17 @@ class Word2Vec(SequenceVectors):
             self._kw["use_device_pipeline"] = flag
             return self
 
+        def use_engine(self, flag=True, ep: int = 1, dp: int = 1):
+            """Route skip-gram training through the sharded embedding
+            engine (embedding/engine.py; on by default). ep row-shards
+            the tables over the expert mesh axis, dp data-parallelizes
+            the pair batch with sparse (indices, values) gradient
+            exchange. ep=1 is bit-identical to the legacy dense path."""
+            self._kw["use_engine"] = flag
+            self._kw["engine_ep"] = int(ep)
+            self._kw["engine_dp"] = int(dp)
+            return self
+
         def share_negatives(self, flag=True):
             """Per-center negative sharing in the device pipeline (default
             on; False = strict per-pair sampling)."""
@@ -143,6 +154,11 @@ class Word2Vec(SequenceVectors):
         return Word2Vec.Builder()
 
     def __init__(self, **kw):
+        # Word2Vec is a thin front-end over the sharded embedding
+        # engine: skip-gram flushes run the engine's sparse-gather /
+        # scatter-add step (bit-identical to the legacy dense path at
+        # ep=1). CBOW and the device pipeline fall back automatically.
+        kw.setdefault("use_engine", True)
         super().__init__(**kw)
         self._iterator = None
         self._factory = None

@@ -42,7 +42,10 @@ decode_attn_q8 takes ``block_k`` too, further constrained to page-size
 multiples (the int8 cache's scale grid is per page, so a key block must
 cover whole pages); sample takes ``rows`` (the fused sampling kernel's
 row block over the [B, V] logits, keyed on (B, V) with the
-fused_layer_norm stat-row legality rule). Every resolved
+fused_layer_norm stat-row legality rule); neg_softmax takes ``rows``
+(the fused negative-sampling sampled-softmax kernel's row block over
+the [B, D] center/context strips, keyed on (B, D) with the same
+stat-row rule for its [1, B] positive-score row). Every resolved
 value is validated
 against the kernel's structural constraints (divisibility, lane tiling,
 unroll budget) before use; an invalid entry falls back to the
@@ -96,6 +99,12 @@ DEFAULT_LN_ROW_BLOCK = 512
 # count against the f32 score strip's VMEM footprint at wide vocabs.
 DEFAULT_SAMPLE_ROW_BLOCK = 256
 
+# Fused negative-sampling sampled-softmax row block (r19): each program
+# scores a [rows, D] center strip against its positive row and [rows, K,
+# D] negative block, so the row block trades program count against the
+# [rows, K, D] negative block's VMEM footprint.
+DEFAULT_NEG_SOFTMAX_ROW_BLOCK = 128
+
 # Decode-attention key block (r11): single-query attention against a
 # paged KV cache streams the cache in blocks of block_k key positions
 # (page multiples) with a running-max/lse merge. The default cap keeps
@@ -140,6 +149,7 @@ KERNEL_PARAMS = {
     "decode_attn": ("block_k",),
     "decode_attn_q8": ("block_k",),
     "sample": ("rows",),
+    "neg_softmax": ("rows",),
 }
 
 # Timing/provenance fields an entry may carry alongside its params.
@@ -432,6 +442,23 @@ def sample_rows(B: int, V: int) -> int:
             return bn
     b = 8
     while b * 2 <= DEFAULT_SAMPLE_ROW_BLOCK and B % (b * 2) == 0:
+        b *= 2
+    return b
+
+
+def neg_softmax_rows(B: int, D: int) -> int:
+    """Row block for the fused negative-sampling sampled-softmax kernel
+    (ops/fused_neg_softmax.py). Its [1, B] positive-score row uses
+    (1, bn) blocks, so the same stat-row legality rule as `sample_rows`
+    applies: bn a lane-tile multiple or the whole batch."""
+    e = lookup("neg_softmax", B, D)
+    if e:
+        bn = e.get("rows")
+        if (isinstance(bn, int) and bn >= 8 and B % bn == 0
+                and (bn % LANES == 0 or bn == B)):
+            return bn
+    b = 8
+    while b * 2 <= DEFAULT_NEG_SOFTMAX_ROW_BLOCK and B % (b * 2) == 0:
         b *= 2
     return b
 

@@ -222,6 +222,98 @@ def bucketed_reduce(grads, plan: BucketPlan, axis_name: str, *,
     return jax.tree_util.tree_unflatten(treedef, out)
 
 
+@dataclass(frozen=True)
+class SparseBucket:
+    """One sparse reduction unit: a gradient that travels as a fixed-shape
+    COO pair — `indices [n_rows]` naming embedding-table rows and
+    `values [n_rows, dim]` carrying their gradients — and is NEVER
+    materialized at the table's shape on the wire (the dense-softmax-
+    over-vocab anti-pattern G030 flags). Pure metadata like `Bucket`:
+    derived from static batch shapes, identical on every process."""
+
+    name: str
+    n_rows: int                   # rows per participant (fixed shape)
+    dim: int
+    n_participants: int = 1
+    index_dtype: str = "int32"
+    value_dtype: str = "float32"
+
+    @property
+    def n_bytes(self) -> int:
+        """Per-participant wire bytes: indices + values."""
+        import numpy as np
+
+        return self.n_rows * (np.dtype(self.index_dtype).itemsize
+                              + self.dim * np.dtype(self.value_dtype).itemsize)
+
+    @property
+    def gathered_bytes(self) -> int:
+        """Bytes each participant holds after the all-gather."""
+        return self.n_bytes * self.n_participants
+
+    def summary(self) -> dict:
+        """Telemetry-ready description (rides the `bucket_plan` event
+        next to the dense BucketPlan summaries)."""
+        return {
+            "kind": "sparse", "name": self.name, "n_rows": self.n_rows,
+            "dim": self.dim, "n_participants": self.n_participants,
+            "bytes": self.n_bytes, "gathered_bytes": self.gathered_bytes,
+        }
+
+
+def plan_sparse_bucket(name: str, n_rows: int, dim: int, *,
+                       n_participants: int = 1,
+                       index_dtype: str = "int32",
+                       value_dtype: str = "float32") -> SparseBucket:
+    """Plan one sparse (indices, values) bucket. Like `plan_buckets`,
+    this is pure static metadata — every process derives the identical
+    plan from the identical batch shapes."""
+    if n_rows <= 0 or dim <= 0:
+        raise ValueError(f"sparse bucket needs positive n_rows/dim, got "
+                         f"({n_rows}, {dim})")
+    if n_participants <= 0:
+        raise ValueError(f"n_participants must be positive, "
+                         f"got {n_participants}")
+    return SparseBucket(name=name, n_rows=int(n_rows), dim=int(dim),
+                        n_participants=int(n_participants),
+                        index_dtype=index_dtype, value_dtype=value_dtype)
+
+
+def sparse_bucket_reduce(indices, values, axis_name: str, *,
+                         bucket: Optional[SparseBucket] = None):
+    """Cross-replica exchange of a sparse gradient bucket: all-gather the
+    (indices, values) COO pair over `axis_name` so every participant can
+    scatter-add the rows it owns. Call inside `shard_map` with
+    `axis_name` bound.
+
+    THE blessed site for collectives on sparse embedding gradients (the
+    sparse counterpart of `bucketed_reduce`): the pair stays COO on the
+    wire — `(n * n_rows)` indices and `(n * n_rows, dim)` values — and
+    is never expanded to the table's shape (G030's densification
+    anti-pattern). Duplicate indices across participants are fine: the
+    owner's scatter-add sums them, which is exactly the dense formulation's
+    semantics. When a `bucket` plan is passed, the traced shapes are
+    checked against it so a plan built for different batch shapes fails
+    loudly at trace time."""
+    from jax import lax
+
+    if values.ndim != 2 or indices.ndim != 1 \
+            or values.shape[0] != indices.shape[0]:
+        raise ValueError(
+            f"sparse bucket expects indices [R] + values [R, D], got "
+            f"{indices.shape} / {values.shape}")
+    if bucket is not None:
+        if (indices.shape[0] != bucket.n_rows
+                or values.shape[1] != bucket.dim):
+            raise ValueError(
+                f"sparse bucket plan {bucket.name!r} is for "
+                f"({bucket.n_rows}, {bucket.dim}) rows, traced shapes are "
+                f"{indices.shape} / {values.shape}")
+    gathered_idx = lax.all_gather(indices, axis_name, tiled=True)
+    gathered_vals = lax.all_gather(values, axis_name, tiled=True)
+    return gathered_idx, gathered_vals
+
+
 def reduce_gradients(grads, axis_names, *, mean: bool = True):
     """Unbucketed cross-replica gradient mean over one or more bound
     axes — the blessed routing for manual-collective train steps that do

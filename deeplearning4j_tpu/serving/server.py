@@ -23,6 +23,21 @@ Endpoints (all JSON):
                         queue are saturated (kvcache.py — exhaustion
                         queues or refuses, never crashes), 404 when the
                         engine has no generation path.
+    POST /embed         {"ids": [...], "id": "..."?}
+                        -> {"id", "vectors", "timing"} — embedding-table
+                        row lookup (padded up to the engine's bucket
+                        lattice; the ep-sharded gather path when the
+                        engine serves a live sharded table). Requires an
+                        EmbeddingServingEngine (embedding/serving.py);
+                        404 otherwise, 400 on out-of-range ids or a
+                        batch over the lattice max, 503 while draining.
+    POST /search        {"vector": [...] | "vectors": [[...]], "k": N?,
+                        "id"?} -> {"id", "ids", "scores", "timing"} —
+                        ANN top-k over the device-resident partition-
+                        then-refine index (embedding/ann.py), nearest
+                        first by cosine. `k` must be on the engine's
+                        warmed k-grid (a foreign k would retrace); same
+                        404/400/503 envelope as /embed.
     GET  /metrics       Prometheus text exposition (version 0.0.4),
                         backed by the pure-stdlib rolling-histogram
                         registry (telemetry/metrics.py): request
@@ -136,6 +151,9 @@ class _Handler(BaseHTTPRequestHandler):
         if route == "/generate":
             self._generate()
             return
+        if route in ("/embed", "/search"):
+            self._embedding(route)
+            return
         if route != "/predict":
             self._json({"error": f"unknown path {self.path}"}, 404)
             return
@@ -238,6 +256,57 @@ class _Handler(BaseHTTPRequestHandler):
             summary["error"] = req.error
         self._line(summary)
 
+    def _embedding(self, route: str):
+        """Embedding lookups and ANN vector search, served by an
+        EmbeddingServingEngine (embedding/serving.py). Gated on the
+        submit methods the same way /generate gates on
+        submit_generate."""
+        engine = self.serving.engine
+        method = "submit_embed" if route == "/embed" else "submit_search"
+        if not hasattr(engine, method):
+            self._json({"error": "this engine does not serve embeddings "
+                                 "(start an EmbeddingServingEngine)"}, 404)
+            return
+        if self.serving.draining:
+            self._json({"error": "draining; not admitting requests"}, 503)
+            return
+        try:
+            length = int(self.headers.get("Content-Length", 0))
+            payload = json.loads(self.rfile.read(length) or b"{}")
+            if route == "/embed":
+                req = engine.submit_embed(payload["ids"],
+                                          request_id=payload.get("id"))
+            else:
+                queries = payload.get("vectors", payload.get("vector"))
+                if queries is None:
+                    raise KeyError("vector")
+                req = engine.submit_search(queries, k=payload.get("k"),
+                                           request_id=payload.get("id"))
+        except (KeyError, ValueError, TypeError) as exc:
+            self._json({"error": f"bad request body: {exc!r}"}, 400)
+            return
+        except RuntimeError as exc:
+            code = 503 if "draining" in str(exc) else 400
+            self._json({"error": str(exc)}, code)
+            return
+        if not req.wait(REQUEST_TIMEOUT_S):
+            self._json({"id": req.request_id, "error": "timed out"}, 504)
+            return
+        if req.error is not None:
+            self._json({"id": req.request_id, "error": req.error}, 500)
+            return
+        body = {"id": req.request_id,
+                "timing": {"total_s":
+                           round(req.t_done - req.t_enqueue, 6)}}
+        if route == "/embed":
+            body["vectors"] = np.asarray(
+                req.result["vectors"]).tolist()
+        else:
+            body["ids"] = np.asarray(req.result["ids"]).tolist()
+            body["scores"] = np.asarray(
+                req.result["scores"]).tolist()
+        self._json(body)
+
     def _line(self, obj) -> None:
         try:
             self.wfile.write((json.dumps(obj) + "\n").encode())
@@ -332,6 +401,19 @@ class ServingMetrics:
             "serving_mfu_live",
             "model FLOPs utilization over recent forwards: cost-book "
             "flops / measured forward seconds / device peak FLOPs")
+        # the embedding-engine data-movement surface: one latency
+        # histogram per span kind (gather / scatter_add / ann_probe —
+        # the registered recorder spans) plus a bytes-moved counter,
+        # fed live off the span event stream like the request latencies
+        self.embed_spans = {
+            name: self.registry.histogram(
+                f"serving_embedding_{name}_seconds",
+                f"embedding-engine {name} span wall time")
+            for name in ("gather", "scatter_add", "ann_probe")
+        }
+        self.embed_bytes = self.registry.counter(
+            "serving_embedding_bytes_total",
+            "bytes moved by embedding-engine spans, by span kind")
         # recent per-forward MFU samples, fed by on_event (cheap append);
         # the gauge publishes their mean at collection time
         from collections import deque
@@ -361,6 +443,14 @@ class ServingMetrics:
         elif kind == "anomaly":
             self.registry.inc(self.anomalies, 1.0,
                               kind=str(ev.get("kind", "unknown")))
+        elif kind == "span" and ev.get("name") in self.embed_spans:
+            name = ev["name"]
+            if "seconds" in ev:
+                self.registry.observe(self.embed_spans[name],
+                                      float(ev["seconds"]))
+            if ev.get("bytes"):
+                self.registry.inc(self.embed_bytes, float(ev["bytes"]),
+                                  span=str(name))
 
     def _observe_mfu(self, ev: dict) -> None:
         """Per-forward MFU sample: the warmed cost book's flops for the

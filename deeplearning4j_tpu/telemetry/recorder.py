@@ -86,6 +86,16 @@ its FIRST execution per bucket shape nests a span named `compile`, so
 the warmed compile count is reconstructable from telemetry alone — the
 zero-retrace gate in tests/test_serving.py counts exactly these).
 
+The embedding engine (embedding/) names three spans with explicit
+byte accounting, surfaced in the trace timeline and the Prometheus
+/metrics endpoint: `gather` (one sparse-gather embed lookup — `rows`,
+`ep`, `bytes` = index + row traffic), `scatter_add` (one train step's
+sparse (indices, values) update — `step` ("sgns"/"hs"), `rows`,
+`bytes` = the COO pair's wire bytes, `ep`, `ep_gather_bytes` = the
+forward gather's cross-rank row traffic at the ep axis), and
+`ann_probe` (one batched partition-then-refine ANN lookup — `queries`,
+`k`, `nprobe`, `bytes` = the probed partitions' candidate rows).
+
 The file format is append-only JSONL so concurrent writers (bench runs
 every mode in a subprocess) can share one log: each process appends
 whole lines to the path named by the ``DL4J_TPU_TELEMETRY`` env var.
@@ -138,6 +148,8 @@ SPAN_NAMES = frozenset({
     "elastic_resume",
     # bench harness (bench.py)
     "bucket_reduce", "bucket_reduce_capped", "overlap_sweep", "ab_repeat",
+    # embedding engine + ANN serving (embedding/)
+    "gather", "scatter_add", "ann_probe",
 })
 
 # Ring-buffer length for the in-memory mirror of emitted events; large

@@ -264,3 +264,30 @@ def test_sweep_trace_check_gates_on_fleet_rank_skew(monkeypatch, tmp_path):
     skew_rows = [e for e in events if e.get("metric")
                  == "straggler_skew_ms"]
     assert skew_rows and skew_rows[-1]["value"] > 0
+
+
+def test_embed_mode_registered_and_smoke_runs():
+    """ISSUE 19: the embed bench mode is in the sweep and a toy-sized
+    `_embed_run` passes its structural gates — zero post-warmup
+    retraces on both the train and /search paths, the ep=2 memstat
+    table-bytes ratio at exactly 0.5, exact /embed rows, and every row
+    family the benchdiff baseline tracks present in the output. The
+    5x ANN speedup floor is a full-size (`python bench.py embed`)
+    gate; at toy sizes brute force wins and that is expected."""
+    assert "embed" in bench.MODES
+    cfg = dict(bench.EMBED_DIMS, vocab=2048, dim=32, n_partitions=64,
+               n_clusters=64, batch=256, train_steps=3, query_batch=16,
+               qps_reps=3)
+    out = bench._embed_run(cfg)
+    g = out["gates"]
+    assert g["train_retraces"] == 0 and g["search_retraces"] == 0
+    assert g["sharding_ratio"] == 0.5
+    assert g["embed_exact"]
+    assert g["recall"] >= cfg["recall_floor"]
+    names = {row["metric"] for row in out["lines"]}
+    for family in ("embed_queries_per_sec", "embed_recall_at_k",
+                   "embed_scatter_add_us", "embed_ep2_ep_gather_bytes",
+                   "embed_mem_table_bytes_ep1", "embed_mem_table_bytes_ep2",
+                   "embed_brute_force_queries_per_sec",
+                   "embed_ann_speedup_vs_brute"):
+        assert family in names, family

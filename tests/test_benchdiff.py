@@ -513,3 +513,36 @@ def test_committed_r04_vs_r05_names_the_dp_regression(capsys):
     assert rc == 1
     assert ("REGRESSED resnet20_dp_allreduce_vs_paramavg_speedup.value: "
             "1.2067 -> 0.9597 (-20.5%)") in out
+
+
+def test_embed_rows_direction():
+    """EMBED artifact rows (bench.py embed, EMBED_r01.json): serving
+    throughput (`queries_per_sec`) and ANN quality (`recall_at_k`)
+    keep the default higher-is-better direction; the scatter-add step
+    time rides the `_us` rule and the per-device gather traffic
+    (`ep_gather_bytes`) is lower-is-better by name — growth means the
+    ep sharding stopped splitting the table."""
+    for metric in ("embed_queries_per_sec", "embed_recall_at_k"):
+        drop = benchdiff.diff(_lines(**{metric: {"value": 100.0}}),
+                              _lines(**{metric: {"value": 70.0}}),
+                              threshold=0.1)["regressions"]
+        assert drop, f"{metric} drop did not regress"
+        rise = benchdiff.diff(_lines(**{metric: {"value": 100.0}}),
+                              _lines(**{metric: {"value": 140.0}}),
+                              threshold=0.1)["regressions"]
+        assert rise == [], f"{metric} improvement flagged"
+    for metric in ("embed_scatter_add_us", "embed_ep2_ep_gather_bytes"):
+        worse = benchdiff.diff(
+            _lines(**{metric: {"value": 10.0, "lower_is_better": True}}),
+            _lines(**{metric: {"value": 20.0, "lower_is_better": True}}),
+            threshold=0.1)["regressions"]
+        assert worse, f"{metric} growth did not regress"
+        # summary-reconstructed rows keep only the value: name pattern
+        bare = benchdiff.diff(_lines(**{metric: {"value": 10.0}}),
+                              _lines(**{metric: {"value": 20.0}}),
+                              threshold=0.1)["regressions"]
+        assert bare, f"{metric} name pattern lost its direction"
+        better = benchdiff.diff(_lines(**{metric: {"value": 20.0}}),
+                                _lines(**{metric: {"value": 10.0}}),
+                                threshold=0.1)["regressions"]
+        assert better == [], f"{metric} improvement flagged"

@@ -1,0 +1,397 @@
+"""ISSUE 19 tier-1 gate for the sharded embedding subsystem
+(deeplearning4j_tpu/embedding/): ep-row-sharded SGNS/HS training that is
+BIT-identical to the legacy dense word2vec path at ep=1, memstat-ledger
+table-bytes halving at ep=2, the dp sparse (indices, values) gradient
+exchange, ragged DeepWalk walk bucketing with a zero-retrace gate over a
+seeded corpus, the fused negative-sampling kernel's parity envelope, the
+device ANN index's recall/brute-force contracts, and the /embed +
+/search serving round trip (in-process and over HTTP)."""
+
+import json
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from deeplearning4j_tpu.embedding.ann import (DeviceANNIndex,
+                                              brute_force_topk,
+                                              recall_at_k)
+from deeplearning4j_tpu.embedding.corpus import (prefetched,
+                                                 sequence_pair_batches,
+                                                 walk_pair_batches,
+                                                 with_negatives)
+from deeplearning4j_tpu.embedding.engine import (EngineLookupView,
+                                                 ShardedEmbeddingEngine)
+from deeplearning4j_tpu.embedding.serving import EmbeddingServingEngine
+from deeplearning4j_tpu.embedding.walks import (WalkBucketer,
+                                                WalkPairExtractor)
+from deeplearning4j_tpu.ops.fused_neg_softmax import (_score_body,
+                                                      neg_softmax_scores,
+                                                      supports)
+from deeplearning4j_tpu.serving.buckets import BucketLattice
+from deeplearning4j_tpu.telemetry import Recorder
+
+pytestmark = pytest.mark.embedding
+
+
+def _corpus(rng, vocab=30, n_sentences=40, length=8):
+    words = [f"w{i}" for i in range(vocab)]
+    return [" ".join(rng.choice(words, size=length))
+            for _ in range(n_sentences)]
+
+
+def _w2v(corpus, use_engine, hs):
+    from deeplearning4j_tpu.nlp.text import DefaultTokenizerFactory
+    from deeplearning4j_tpu.nlp.word2vec import Word2Vec
+
+    b = (Word2Vec.builder().iterate(corpus)
+         .tokenizer_factory(DefaultTokenizerFactory())
+         .layer_size(16).window_size(3).min_word_frequency(1)
+         .epochs(1).seed(7).use_engine(use_engine))
+    b = b.use_hierarchic_softmax(True) if hs else b.negative_sample(3)
+    model = b.build()
+    model.fit()
+    return model
+
+
+# ------------------------------------------------ ep=1 bit parity (sat. 1)
+
+@pytest.mark.parametrize("hs", [False, True], ids=["sgns", "hs"])
+def test_ep1_engine_is_bit_identical_to_legacy_dense_path(hs):
+    """The satellite-1 acceptance row: the ep=1 sharded engine through
+    the REAL Word2Vec front-end produces np.array_equal tables vs the
+    legacy InMemoryLookupTable path — same corpus, same seed, both
+    trained end to end. Masked gather + psum and the masked scatter are
+    value-preserving identities at ep=1, so this is exact, not
+    allclose."""
+    rng = np.random.default_rng(0)
+    corpus = _corpus(rng)
+    engine_model = _w2v(corpus, use_engine=True, hs=hs)
+    legacy_model = _w2v(corpus, use_engine=False, hs=hs)
+    assert engine_model._engine is not None
+    assert legacy_model._engine is None
+    assert np.array_equal(np.asarray(engine_model.lookup_table.syn0),
+                          np.asarray(legacy_model.lookup_table.syn0))
+    other = "syn1" if hs else "syn1neg"
+    assert np.array_equal(
+        np.asarray(getattr(engine_model.lookup_table, other)),
+        np.asarray(getattr(legacy_model.lookup_table, other)))
+
+
+def test_deepwalk_routes_through_engine():
+    from deeplearning4j_tpu.graph.deepwalk import DeepWalk
+    from deeplearning4j_tpu.graph.graph import Graph
+
+    g = Graph(8)
+    for i in range(8):
+        g.add_edge(i, (i + 1) % 8)
+        g.add_edge(i, (i + 3) % 8)
+    dw = (DeepWalk.builder().vector_size(8).window_size(2)
+          .seed(3).build())
+    dw.fit(g, walk_length=10)
+    assert dw.vectors._engine is not None
+    assert dw.get_vertex_vector(0).shape == (8,)
+
+
+# ------------------------------------------- ep/dp sharding correctness
+
+def _run_steps(eng, steps=4, batch=32, k=3, seed=5):
+    rng = np.random.default_rng(seed)
+    v = eng.vocab_size
+    loss = None
+    for _ in range(steps):
+        c = rng.integers(0, v, batch)
+        x = rng.integers(0, v, batch)
+        n = rng.integers(0, v, (batch, k))
+        loss = eng.sgns_step(c, x, n, 0.025)
+    jax.block_until_ready(loss)
+    return eng
+
+
+def test_ep2_is_bit_identical_to_ep1_and_halves_ledger_bytes():
+    """Row sharding is an exact reshard: each table row is owned by one
+    ep rank, gathers psum disjoint masked strips, scatters update only
+    owned rows — ep=2 training equals ep=1 bit for bit. Per-device
+    table bytes (memstat ledger) halve, and the step retraces zero
+    times after its first compile."""
+    e1 = _run_steps(ShardedEmbeddingEngine(64, 16, ep=1, negative=3,
+                                           seed=11))
+    e2 = ShardedEmbeddingEngine(64, 16, ep=2, negative=3, seed=11)
+    _run_steps(e2, steps=1)
+    tc = e2.trace_count
+    # re-run the remaining steps with identical inputs: fresh engine so
+    # the streams match, but the retrace gate watches the warm engine
+    e2b = _run_steps(ShardedEmbeddingEngine(64, 16, ep=2, negative=3,
+                                            seed=11))
+    _run_steps(e2, steps=3, seed=99)
+    assert e2.trace_count == tc, "post-warmup retrace on the ep=2 step"
+    v1, v2 = EngineLookupView(e1), EngineLookupView(e2b)
+    assert np.array_equal(np.asarray(v1.syn0), np.asarray(v2.syn0))
+    assert np.array_equal(np.asarray(v1.syn1neg), np.asarray(v2.syn1neg))
+    assert e2.table_bytes_per_device() * 2 == e1.table_bytes_per_device()
+
+
+def test_dp2_sparse_bucket_gradients_match_dp1():
+    """The dp axis ships gradients as (indices, values) pairs through
+    the overlap layer's sparse bucket kind; the combined update equals
+    the single-rank update up to float reassociation."""
+    base = _run_steps(ShardedEmbeddingEngine(64, 16, ep=1, negative=3,
+                                             seed=11))
+    dp = _run_steps(ShardedEmbeddingEngine(64, 16, ep=1, dp=2,
+                                           negative=3, seed=11))
+    np.testing.assert_allclose(
+        np.asarray(EngineLookupView(base).syn0),
+        np.asarray(EngineLookupView(dp).syn0), atol=2e-5, rtol=1e-4)
+
+
+def test_engine_emits_gather_and_scatter_spans_with_bytes():
+    events = []
+    rec = Recorder()
+    rec.add_sink(events.append)
+    eng = ShardedEmbeddingEngine(64, 16, ep=2, negative=3, seed=1,
+                                 recorder=rec)
+    _run_steps(eng, steps=2)
+    np.asarray(eng.embed(np.arange(8)))
+    spans = {e["name"]: e for e in events if e.get("event") == "span"}
+    assert spans["scatter_add"]["bytes"] > 0
+    assert spans["scatter_add"]["ep_gather_bytes"] > 0
+    assert spans["gather"]["bytes"] > 0
+
+
+# --------------------------------------- ragged walks (satellite 4)
+
+def _ragged_walks(rng, n=160, vmax=50):
+    return [rng.integers(0, vmax, size=int(length))
+            for length in rng.integers(2, 80, size=n)]
+
+
+def test_ragged_walk_batches_are_fixed_shape_per_bucket():
+    rng = np.random.default_rng(2)
+    bucketer = WalkBucketer(batch=16)
+    shapes = set()
+    for block, mask in bucketer.batches(_ragged_walks(rng)):
+        assert block.shape == mask.shape
+        assert block.shape[0] == 16
+        assert block.shape[1] in bucketer.length_buckets
+        shapes.add(block.shape)
+    # the seeded corpus exercises more than one bucket
+    assert len(shapes) > 1
+
+
+def test_zero_retraces_across_a_seeded_ragged_walk_corpus():
+    """The ISSUE 19 satellite-4 gate: after one pass over a seeded
+    ragged corpus has compiled each (batch, length-bucket) shape once,
+    a second full pass (and a differently-seeded corpus) adds ZERO
+    traces — the bucketing really does pin the device shapes."""
+    rng = np.random.default_rng(3)
+    bucketer = WalkBucketer(batch=16)
+    extractor = WalkPairExtractor(window=3)
+    walks = _ragged_walks(rng)
+
+    def consume(ws):
+        batches = list(walk_pair_batches(
+            ws, batch_size=64, bucketer=bucketer, extractor=extractor))
+        assert all(c.shape == (64,) and x.shape == (64,)
+                   for c, x in batches)
+        return batches
+
+    consume(walks)
+    warm = extractor.trace_count
+    assert warm <= len(bucketer.length_buckets)
+    consume(walks)
+    consume(_ragged_walks(np.random.default_rng(17)))
+    assert extractor.trace_count == warm, "ragged walks retraced"
+
+
+def test_prefetched_pair_feed_matches_synchronous_feed():
+    rng = np.random.default_rng(4)
+    seqs = [rng.integers(0, 40, size=12) for _ in range(20)]
+    cum = np.arange(1, 41, dtype=np.float64) / 40.0
+
+    def feed():
+        return with_negatives(
+            sequence_pair_batches(seqs, batch_size=32, window=3, seed=9),
+            cum, 3, seed=13)
+
+    sync = list(feed())
+    async_ = list(prefetched(feed(), depth=2))
+    assert len(sync) == len(async_) > 0
+    for (c0, x0, n0), (c1, x1, n1) in zip(sync, async_):
+        assert np.array_equal(c0, c1)
+        assert np.array_equal(x0, x1)
+        assert np.array_equal(n0, n1)
+        assert c0.shape == (32,) and n0.shape == (32, 3)
+
+
+# ----------------------------------- fused kernel parity (tentpole)
+
+def test_fused_neg_softmax_matches_reference_inside_envelope():
+    rng = np.random.default_rng(5)
+    b, k, d = 16, 5, 128
+    assert supports(b, k, d)
+    c = jnp.asarray(rng.normal(size=(b, d)), jnp.float32)
+    pos = jnp.asarray(rng.normal(size=(b, d)), jnp.float32)
+    neg = jnp.asarray(rng.normal(size=(b, k, d)), jnp.float32)
+    ps, ns = neg_softmax_scores(c, pos, neg)     # pallas (interpret off-TPU)
+    rps, rns = _score_body(c, pos, neg)          # pure-jnp reference
+    np.testing.assert_allclose(np.asarray(ps), np.asarray(rps), atol=1e-6)
+    np.testing.assert_allclose(np.asarray(ns), np.asarray(rns), atol=1e-6)
+
+
+def test_fused_neg_softmax_envelope_gates_cleanly():
+    # un-tiled dim falls back to the identical-math jnp reference
+    assert not supports(16, 5, 64)
+    rng = np.random.default_rng(6)
+    c = jnp.asarray(rng.normal(size=(16, 64)), jnp.float32)
+    neg = jnp.asarray(rng.normal(size=(16, 5, 64)), jnp.float32)
+    ps, ns = neg_softmax_scores(c, c, neg)
+    rps, rns = _score_body(c, c, neg)
+    assert np.array_equal(np.asarray(ps), np.asarray(rps))
+    assert np.array_equal(np.asarray(ns), np.asarray(rns))
+
+
+# --------------------------------------------- ANN index contracts
+
+def _clustered(rng, v=512, d=16, nc=16):
+    centers = rng.normal(size=(nc, d)).astype(np.float32)
+    return (centers[rng.integers(0, nc, v)]
+            + 0.1 * rng.normal(size=(v, d))).astype(np.float32)
+
+
+def test_ann_calibrates_past_recall_floor_and_full_probe_is_exact():
+    rng = np.random.default_rng(7)
+    vecs = _clustered(rng)
+    idx = DeviceANNIndex.build(vecs, n_partitions=16, seed=0)
+    queries = vecs[rng.choice(512, size=32, replace=False)]
+    nprobe, recall = idx.calibrate_nprobe(vecs, queries, k=10, floor=0.95)
+    assert recall >= 0.95
+    assert nprobe <= idx.n_partitions
+    # probing every partition recovers the exact brute-force sets
+    ids, _ = idx.search(queries, 10, nprobe=idx.n_partitions)
+    exact_ids, _ = brute_force_topk(vecs, queries, 10)
+    ann, exact = np.asarray(ids), np.asarray(exact_ids)
+    assert recall_at_k(ann, exact) == 1.0
+    for row in range(ann.shape[0]):
+        assert set(ann[row].tolist()) == set(exact[row].tolist())
+
+
+def test_ann_search_is_fixed_shape_and_trace_stable():
+    rng = np.random.default_rng(8)
+    vecs = _clustered(rng)
+    idx = DeviceANNIndex.build(vecs, n_partitions=16, seed=0)
+    q = rng.normal(size=(4, 16)).astype(np.float32)
+    ids, scores = idx.search(q, 5, nprobe=4)
+    tc = idx.trace_count
+    for _ in range(3):
+        ids, scores = idx.search(rng.normal(size=(4, 16))
+                                 .astype(np.float32), 5, nprobe=4)
+    assert idx.trace_count == tc
+    assert ids.shape == (4, 5) and scores.shape == (4, 5)
+    # nearest-first ordering, the vptree `search` contract
+    s = np.asarray(scores)
+    assert (np.diff(s, axis=1) <= 1e-6).all()
+
+
+# ------------------------------------------- serving round trips
+
+@pytest.fixture(scope="module")
+def embed_stack():
+    rng = np.random.default_rng(9)
+    vecs = _clustered(rng, v=256, d=16, nc=16)
+    rec = Recorder()
+    eng = EmbeddingServingEngine(
+        vecs, n_partitions=16, lattice=BucketLattice(batch_sizes=(1, 4, 8)),
+        k_grid=(5,), recall_floor=0.9, calibration_queries=16, seed=0,
+        recorder=rec).start()
+    from deeplearning4j_tpu.serving.server import ServingServer
+
+    server = ServingServer(eng, port=0).start()
+    yield vecs, eng, server, rec
+    server.stop()
+    eng.drain(10.0)
+
+
+def _post(url, route, payload):
+    req = urllib.request.Request(
+        f"{url}{route}", data=json.dumps(payload).encode(),
+        headers={"Content-Type": "application/json"})
+    with urllib.request.urlopen(req, timeout=30) as resp:
+        return json.loads(resp.read())
+
+
+def test_embed_endpoint_serves_exact_rows(embed_stack):
+    vecs, eng, server, _ = embed_stack
+    resp = _post(server.url, "/embed", {"ids": [3, 7, 200]})
+    np.testing.assert_allclose(np.asarray(resp["vectors"]),
+                               vecs[[3, 7, 200]], atol=1e-6)
+    assert resp["timing"]["total_s"] >= 0
+
+
+def test_search_endpoint_finds_self_and_respects_k_grid(embed_stack):
+    vecs, eng, server, _ = embed_stack
+    resp = _post(server.url, "/search", {"vector": vecs[42].tolist(),
+                                         "k": 5})
+    assert resp["ids"][0][0] == 42          # a corpus row's NN is itself
+    assert len(resp["ids"][0]) == 5
+    with pytest.raises(urllib.error.HTTPError) as e:
+        _post(server.url, "/search", {"vector": vecs[0].tolist(), "k": 7})
+    assert e.value.code == 400              # foreign k would retrace
+
+
+def test_serving_rejects_out_of_envelope_requests(embed_stack):
+    vecs, eng, server, _ = embed_stack
+    with pytest.raises(urllib.error.HTTPError) as e:
+        _post(server.url, "/embed", {"ids": [999999]})
+    assert e.value.code == 400
+    with pytest.raises(urllib.error.HTTPError) as e:
+        _post(server.url, "/embed",
+              {"ids": list(range(64))})     # over the lattice max batch
+    assert e.value.code == 400
+
+
+def test_serving_traffic_is_zero_retrace_after_warmup(embed_stack):
+    vecs, eng, server, _ = embed_stack
+    tc = eng.trace_count
+    rng = np.random.default_rng(10)
+    for n in (1, 3, 4, 8, 2):               # pad up through the lattice
+        _post(server.url, "/search",
+              {"vectors": rng.normal(size=(n, 16)).tolist()})
+        _post(server.url, "/embed",
+              {"ids": rng.integers(0, 256, n).tolist()})
+    assert eng.trace_count == tc, "post-warmup retrace in serving path"
+    stats = eng.stats()
+    assert stats["trace_count"] == tc
+    assert stats["ann"]["nprobe"] >= 1
+    assert stats["served"] >= 10 and stats["failed"] == 0
+
+
+def test_metrics_endpoint_exports_embedding_spans(embed_stack):
+    """Satellite 6: the gather/ann_probe span stream (bytes attached)
+    lands in the Prometheus exposition as latency histograms and a
+    bytes-moved counter."""
+    from deeplearning4j_tpu.telemetry.metrics import parse_exposition
+
+    _, eng, server, _ = embed_stack
+    with urllib.request.urlopen(f"{server.url}/metrics", timeout=10) as r:
+        parsed = parse_exposition(r.read().decode())
+    assert parsed["serving_embedding_gather_seconds_count"] >= 1
+    assert parsed["serving_embedding_ann_probe_seconds_count"] >= 1
+    assert parsed['serving_embedding_bytes_total{span="gather"}'] > 0
+    assert parsed['serving_embedding_bytes_total{span="ann_probe"}'] > 0
+
+
+def test_fleet_supervisor_speaks_the_engine_protocol(embed_stack):
+    from deeplearning4j_tpu.serving.fleet import FleetSupervisor
+
+    _, eng, server, _ = embed_stack
+    sup = FleetSupervisor(eng)
+    sup.poll()
+    snap = eng.fleet_snapshot()
+    assert snap["n_replicas"] == 1 and snap["n_serving"] == 1
+    (row,) = (w.describe(__import__("time").monotonic())
+              for w in eng.fleet_workers())
+    assert row["state"] == "serving" and row["alive"]

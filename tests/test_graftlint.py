@@ -1,6 +1,6 @@
 """Tier-1 gate for graftlint (ISSUE 2 + the ISSUE 5 SPMD rules + the
-ISSUE 17 concurrency stage + the ISSUE 18 memory-introspection rule):
-every AST rule G001-G029 proven on a
+ISSUE 17 concurrency stage + the ISSUE 18 memory-introspection rule +
+the ISSUE 19 sparse-embedding rule): every AST rule G001-G030 proven on a
 positive AND a negative fixture, the suppression + baseline machinery,
 the stage-2 jaxpr audit over every public entry point, and the package
 itself held lint-clean (zero non-baselined findings). The stage-3
@@ -831,6 +831,42 @@ def decode_all(slots, cached_memory_event):
     for tok in slots:
         read = cached_memory_event["live_array_bytes"]  # cached, no walk
 """),
+    # ---------------------------------------- ISSUE 19 (embeddings)
+    ("G030", """\
+import jax.numpy as jnp
+
+
+def lookup_rows(syn0, idx):
+    return jnp.take(syn0, idx, axis=0)        # dense full-table gather
+
+
+def lookup_direct(syn1neg, idx):
+    return syn1neg[idx]                       # same, spelled as subscript
+
+
+def densify_grad(embedding_table, idx, values):
+    # table-shaped zeros + scatter: the densified sparse gradient
+    return jnp.zeros_like(embedding_table).at[idx].add(values)
+""", """\
+import jax.numpy as jnp
+
+
+def lookup_weight(params, idx):
+    return jnp.take(params["W"], idx, axis=0)  # a weight, not a table
+
+
+def gather_cum(cum_table, draws):
+    return cum_table[draws]                    # sampling table, exempt
+
+
+def accumulate(W, i, g):
+    return W.at[i].add(g)                      # in-place, not zeros_like
+
+
+def engine_step(table, idx, values):
+    from deeplearning4j_tpu.parallel.overlap import sparse_bucket_reduce
+    return sparse_bucket_reduce(idx, values, "data")
+"""),
 ]
 
 
@@ -860,7 +896,7 @@ def test_rule_fires_on_positive_not_negative(rule, pos, neg):
 
 def test_every_rule_has_fixture_coverage():
     assert {r for r, _, _ in FIXTURES} == set(RULE_DOCS) == {
-        f"G{i:03d}" for i in range(1, 30)}
+        f"G{i:03d}" for i in range(1, 31)}
 
 
 def test_g015_blessed_sites_are_exempt():

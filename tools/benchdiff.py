@@ -56,6 +56,16 @@ retrace rise-from-zero rule: the first leak or first out-of-band
 cost-model drift moves the value off 0, which a percentage threshold
 would wave through). `mfu_live` stays higher-is-better.
 
+EMBED artifacts (bench.py embed — the sharded embedding engine +
+ANN serving, EMBED_r01.json) add four row families:
+`queries_per_sec` and `recall_at_k` stay higher-is-better (serving
+throughput dropping or ANN recall falling past threshold is the
+regression); `scatter_add_us` rides the `_us` rule (the sparse
+scatter-add step slowing down); and `ep_gather_bytes` is
+lower-is-better by name — the per-device gather traffic growing
+means the ep sharding stopped splitting the table (the ep=2 row
+should carry ~half the ep=1 bytes).
+
 What counts as a regression (bench metrics are higher-is-better unless
 flagged lower-is-better as above):
 
@@ -109,7 +119,7 @@ _LOWER_IS_BETTER_RE = re.compile(
     r"|plan_predicted|plan_winner|plan_score|plan_measured"
     r"|rank_violations$|anomaly_count$|trace_span_"
     r"|hbm_peak_bytes|mem_\w*_bytes|peak_temp_bytes|leak_count"
-    r"|cost_drift_ratio)")
+    r"|cost_drift_ratio|ep_gather_bytes)")
 
 # leak_count and cost_drift_ratio regress on ANY increase (below): a
 # run that introduces its FIRST leak or its first out-of-band

@@ -14,7 +14,7 @@
     python tools/graftlint.py --rules                       # rule inventory
 
 Stage `ast` (default) is pure stdlib and instant — suitable as a
-pre-commit step; it runs all AST rules G001-G029. Stage `jaxpr` traces
+pre-commit step; it runs all AST rules G001-G030. Stage `jaxpr` traces
 the jitted entry points on CPU (~1 min). Stage `spmd` runs the
 G010-G013 rules plus the collective-consistency audit
 (analysis/collective_audit.py): frozen ordered collective signatures and

@@ -80,6 +80,7 @@ def sweep_configs(quick: bool) -> list[dict]:
         decode = [dict(B=4, H=2, S=256, D=32, page=16)]
         decode_q8 = [dict(B=4, H=2, S=256, D=32, page=16)]
         sample = [dict(B=128, V=2048)]
+        neg_softmax = [dict(B=256, K=5, D=128)]
     else:
         flash_shapes = [
             # the T=512 flagship (transformer mode, D=64 head pairs)
@@ -115,6 +116,12 @@ def sweep_configs(quick: bool) -> list[dict]:
             dict(B=256, V=8192),
             dict(B=256, V=32768),
         ]
+        neg_softmax = [
+            # embedding-engine SGNS step shapes: pair-batch rows x
+            # negatives x vector length (embedding/engine.py)
+            dict(B=1024, K=5, D=128),
+            dict(B=2048, K=10, D=128),
+        ]
     out = []
     for s in flash_shapes:
         out.append(dict(family="flash_fwd", **s))
@@ -129,6 +136,8 @@ def sweep_configs(quick: bool) -> list[dict]:
         out.append(dict(family="decode_attn_q8", **s))
     for s in sample:
         out.append(dict(family="sample", **s))
+    for s in neg_softmax:
+        out.append(dict(family="neg_softmax", **s))
     return out
 
 
@@ -184,6 +193,15 @@ def candidates(cfg: dict) -> list[dict]:
             if B % bn == 0 and (bn % autotune.LANES == 0 or bn == B):
                 outs.append({"rows": bn})
             bn *= 2
+    elif fam == "neg_softmax":
+        # same row-block legality as sample: divisors of the pair-batch
+        # that are lane multiples (or the whole batch)
+        B = cfg["B"]
+        bn = 8
+        while bn <= B:
+            if B % bn == 0 and (bn % autotune.LANES == 0 or bn == B):
+                outs.append({"rows": bn})
+            bn *= 2
     else:
         raise KeyError(fam)
     default = default_params(cfg)
@@ -206,6 +224,8 @@ def config_key(cfg: dict) -> str:
         return autotune.config_key(fam, cfg["S"], cfg["D"])
     if fam == "sample":
         return autotune.config_key(fam, cfg["B"], cfg["V"])
+    if fam == "neg_softmax":
+        return autotune.config_key(fam, cfg["B"], cfg["D"])
     raise KeyError(fam)
 
 
@@ -244,6 +264,8 @@ def default_params(cfg: dict) -> dict:
                 cfg["S"], cfg["D"], cfg["page"])}
         if fam == "sample":
             return {"rows": autotune.sample_rows(cfg["B"], cfg["V"])}
+        if fam == "neg_softmax":
+            return {"rows": autotune.neg_softmax_rows(cfg["B"], cfg["D"])}
     finally:
         if prev is None:
             os.environ.pop(autotune.ENV_TUNING, None)
@@ -334,6 +356,18 @@ def _build_call(cfg: dict):
         f = jax.jit(lambda lg, nz: fused_sampling.fused_sample(
             lg, nz, temperature=1.0, top_k=64, top_p=0.9))
         return lambda: f(logits, noise)
+
+    if fam == "neg_softmax":
+        from deeplearning4j_tpu.ops.fused_neg_softmax import (
+            neg_softmax_scores,
+        )
+        B, K, D = cfg["B"], cfg["K"], cfg["D"]
+        c = jnp.asarray(rng.standard_normal((B, D)) * 0.2, jnp.float32)
+        pos = jnp.asarray(rng.standard_normal((B, D)) * 0.2, jnp.float32)
+        neg = jnp.asarray(rng.standard_normal((B, K, D)) * 0.2,
+                          jnp.float32)
+        f = jax.jit(lambda c, pos, neg: neg_softmax_scores(c, pos, neg))
+        return lambda: f(c, pos, neg)
 
     if fam == "softmax_xent":
         from deeplearning4j_tpu.ops.fused_softmax_xent import (

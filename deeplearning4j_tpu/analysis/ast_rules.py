@@ -1568,6 +1568,13 @@ from deeplearning4j_tpu.analysis.concurrency_rules import (  # noqa: E402
     CONC_RULE_IDS,
     CONC_RULES,
 )
+# stage-5 AST rules (G031-G034, precision discipline) live in
+# precision_rules.py and register the same way
+from deeplearning4j_tpu.analysis.precision_rules import (  # noqa: E402
+    PRECISION_RULE_DOCS,
+    PRECISION_RULE_IDS,
+    PRECISION_RULES,
+)
 
 ALL_RULES = [g001_traced_bool, g002_host_sync, g003_float64_drift,
              g004_rng_discipline, g005_retrace_hazards,
@@ -1581,7 +1588,8 @@ ALL_RULES = [g001_traced_bool, g002_host_sync, g003_float64_drift,
              g023_unregistered_telemetry_names,
              g024_host_sampling,
              g029_memory_introspection_hot_path,
-             g030_dense_embedding_path] + SPMD_RULES + CONC_RULES
+             g030_dense_embedding_path] + SPMD_RULES + CONC_RULES \
+    + PRECISION_RULES
 
 RULE_DOCS = {
     "G001": "python control flow / bool()/float()/int() on traced values",
@@ -1642,6 +1650,7 @@ RULE_DOCS = {
             "sparse bucket kind",
     **SPMD_RULE_DOCS,
     **CONC_RULE_DOCS,
+    **PRECISION_RULE_DOCS,
 }
 
 
@@ -1657,7 +1666,9 @@ def run_rules(tree: ast.AST, source: str, path: str) -> list[Finding]:
             col = getattr(node, "col_offset", 0)
             snippet = lines[line - 1].strip() if 0 < line <= len(lines) \
                 else ""
-            stage = "concurrency" if rule_id in CONC_RULE_IDS else "ast"
+            stage = ("concurrency" if rule_id in CONC_RULE_IDS
+                     else "precision" if rule_id in PRECISION_RULE_IDS
+                     else "ast")
             findings.append(Finding(rule_id, path, line, col, message,
                                     fixit, snippet, stage=stage))
     findings.sort(key=lambda f: (f.path, f.line, f.rule))

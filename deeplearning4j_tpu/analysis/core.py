@@ -21,14 +21,15 @@ _SUPPRESS_RE = re.compile(r"#\s*graftlint:\s*disable=([A-Z0-9,\s]+)")
 # Every lint stage, in execution order. The CLI's --stage choices and
 # the --rules inventory derive from this — adding a stage means adding
 # it here plus its runner in tools/graftlint.py.
-STAGES = ("ast", "jaxpr", "spmd", "concurrency")
+STAGES = ("ast", "jaxpr", "spmd", "concurrency", "precision")
 
 
 @dataclasses.dataclass(frozen=True)
 class Finding:
-    rule: str        # "G001".."G028" (AST passes) / "J001".."J004"
+    rule: str        # "G001".."G034" (AST passes) / "J001".."J004"
                      # (jaxpr) / "C001".."C003" (collective audit)
                      # / "D001".."D003" (lock-order audit)
+                     # / "P001".."P005", "PB01" (precision audit)
     path: str        # repo-relative posix path, or an entry-point name
     line: int        # 1-based; 0 for whole-artifact (jaxpr) findings
     col: int

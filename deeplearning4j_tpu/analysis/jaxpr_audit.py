@@ -118,6 +118,23 @@ def _build(name):
     raise KeyError(name)
 
 
+_CLOSED_CACHE: dict = {}
+
+
+def closed_jaxpr(name):
+    """Memoised closed jaxpr for one stage-2 entry point. Stage 2 and
+    the stage-5 precision audit walk the SAME entries, so under
+    `--stage all` each entry is traced exactly once (the LM-step traces
+    dominate the suite's wall time)."""
+    closed = _CLOSED_CACHE.get(name)
+    if closed is None:
+        import jax
+
+        fn, args = _build(name)
+        closed = _CLOSED_CACHE[name] = jax.make_jaxpr(fn)(*args)
+    return closed
+
+
 def _iter_eqns(jaxpr):
     """Every eqn, recursing into sub-jaxprs (pjit bodies, scan, cond
     branches, custom_vjp calls...)."""
@@ -135,11 +152,9 @@ def _iter_eqns(jaxpr):
 def trace_entry(name):
     """-> (op_count, findings-without-budget-check). Traces on the
     current (CPU) backend with abstract inputs; nothing executes."""
-    import jax
     import numpy as np
 
-    fn, args = _build(name)
-    closed = jax.make_jaxpr(fn)(*args)
+    closed = closed_jaxpr(name)
     count = 0
     findings = []
     seen_f64: set[str] = set()

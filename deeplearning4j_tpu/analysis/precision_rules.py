@@ -1,0 +1,350 @@
+"""graftlint stage 5, AST side: the G031-G034 precision-discipline rules.
+
+The mixed-precision surface (bf16 MXU operands + f32 accumulators, the
+int8 paged KV cache with per-(row, page, head) scales) is enforced by
+convention in the kernels — and a convention is exactly what a refactor
+silently drops. These rules freeze the statically visible half of the
+dtype policy; the trace-level half (accumulation dtypes, quantize
+pairing, convert churn along real dataflow) is analysis/
+precision_audit.py, which sees what the AST cannot.
+
+Pure stdlib ``ast`` like stages 1/4 — importing this module must NOT
+import jax, so `tools/graftlint.py --stage ast` (and `--changed`) stays
+a sub-second pre-commit path even with jax poisoned. ``ast_rules``
+registers these rules into ALL_RULES/RULE_DOCS at its module bottom
+(the spmd_rules/concurrency_rules pattern); shared helpers are imported
+lazily inside the rule bodies to keep that cycle clean.
+
+- G031: a `jnp.einsum`/`jnp.matmul`/`jnp.dot`/`lax.dot_general` in the
+  kernel dirs (ops/, embedding/) without `preferred_element_type`, or a
+  bare `@` (which cannot carry one) — the accumulator dtype left to the
+  backend default instead of declared. On TPU a bf16 operand pair
+  accumulated at the default output dtype is the silent-precision bug
+  class the f32-accumulation policy exists to prevent.
+- G032: float64 entering DEVICE code — `jnp.float64`, `.astype` to
+  float64, or `dtype=float64` fed to a jax call, plus `np.float64`
+  constructors in the device dirs. Host-side numpy analytics
+  (clustering/, graph/, util/ math helpers) legitimately run f64 and
+  stay out of scope; declarative name->dtype registry tables (a dict
+  literal keyed by dtype-name strings) are exempt — the drift happens
+  where a literal f64 dtype is APPLIED, which stage 2's J003 then
+  proves at trace level. This rule promotes that check to pre-commit.
+- G033: hand-rolled quantization scale math — the symmetric-int8
+  constants 127/127.0 in mul/div/clip arithmetic (or a float 128.0
+  scale) outside the blessed `ops/decode_attention.py` quantize
+  helpers. A second spelling of `maxabs/127` is how a cache writer and
+  its reader disagree about scales (the q8-scale-mismatch serving
+  failure class). Integer 128 alone is the lane tile (G016's
+  structural exemption) and round-up expressions like `(n + 127) //
+  128` never flag: only Mult/Div/clip contexts count.
+- G034: a dtype cast applied to a WHOLE params/opt_state tree —
+  `params.astype(...)`, `lax.convert_element_type(params, ...)`, or a
+  `jax.tree.map` whose mapped function casts — outside the blessed
+  reshard/ + checkpoint paths that own the dtype policy. A wholesale
+  tree cast silently rewrites every accumulator's dtype (the optimizer
+  moments included), bypassing reshard/'s per-leaf policy and the
+  checkpoint restore contract. Single-leaf casts (`params["W"]
+  .astype(...)`) never flag.
+"""
+
+from __future__ import annotations
+
+import ast
+
+PRECISION_RULE_IDS = frozenset({"G031", "G032", "G033", "G034"})
+
+# kernel dirs whose contractions must declare their accumulator dtype
+_G031_SCOPE = ("/ops/", "/embedding/")
+
+_G031_DOT_CALLS = frozenset({
+    "jax.numpy.einsum", "jax.numpy.matmul", "jax.numpy.dot",
+    "jax.numpy.tensordot", "jax.lax.dot", "jax.lax.dot_general",
+})
+
+# device-side dirs for the np.float64-constructor half of G032 (host
+# analytics dirs — clustering/, graph/, util math — legitimately run
+# f64 and are deliberately out of scope)
+_G032_DEVICE_DIRS = ("/ops/", "/nn/", "/parallel/", "/embedding/",
+                     "/distributed/", "/serving/", "/models/",
+                     "/reshard/", "/eval/")
+
+_F64_CANON = frozenset({"jax.numpy.float64", "numpy.float64"})
+_F64_STRINGS = frozenset({"float64", "f64", ">f8", "<f8", "f8"})
+
+# the finite-difference harness deliberately runs f64 (tests enable
+# x64); it is the one blessed f64 consumer of the jax API surface
+_G032_BLESSED_DIRS = ("/gradientcheck/",)
+
+_G033_BLESSED = "ops/decode_attention.py"
+_G033_CONSTS = (127, 127.0, -127, -127.0)
+
+_G034_BLESSED = ("deeplearning4j_tpu/reshard/",
+                 "deeplearning4j_tpu/util/orbax_checkpoint.py",
+                 "deeplearning4j_tpu/util/model_serializer.py")
+_G034_TREE_NAMES = frozenset({"params", "opt_state", "param_tree",
+                              "params_tree", "opt_tree"})
+_G034_TREE_MAP = frozenset({"jax.tree.map", "jax.tree_util.tree_map"})
+
+
+def _norm(path: str) -> str:
+    return path.replace("\\", "/")
+
+
+def _in_dirs(path: str, fragments) -> bool:
+    norm = "/" + _norm(path)
+    return any(frag in norm for frag in fragments)
+
+
+# ------------------------------------------------------------------ G031
+
+def g031_undeclared_accumulator(tree, imports, path):
+    """Contractions in ops/ + embedding/ without an explicit
+    `preferred_element_type` (or spelled `@`, which cannot carry one)."""
+    if not _in_dirs(path, _G031_SCOPE):
+        return []
+    out = []
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Call):
+            canon = imports.canon(node.func)
+            if canon in _G031_DOT_CALLS and not any(
+                    kw.arg == "preferred_element_type"
+                    for kw in node.keywords):
+                short = canon.split(".")[-1]
+                out.append((
+                    "G031", node,
+                    f"`{short}` in a kernel dir without "
+                    "preferred_element_type — the accumulator dtype is "
+                    "left to the backend default (bf16 operands then "
+                    "accumulate sub-f32)",
+                    "pass preferred_element_type=jnp.float32 (the f32-"
+                    "accumulation policy ops/flash_attention.py "
+                    "follows)"))
+        elif isinstance(node, ast.BinOp) and isinstance(node.op,
+                                                        ast.MatMult):
+            out.append((
+                "G031", node,
+                "`@` matmul in a kernel dir — the operator cannot "
+                "declare an accumulator dtype",
+                "spell the contraction as jnp.einsum/lax.dot_general "
+                "with preferred_element_type=jnp.float32"))
+    return out
+
+
+# ------------------------------------------------------------------ G032
+
+def _is_dtype_registry_value(node) -> bool:
+    """Is `node` a VALUE in a dict literal keyed by dtype-name strings
+    (a declarative name->dtype registry, e.g. nn/multilayer._DTYPES)?
+    The registry itself introduces no f64 — selecting from it does."""
+    from deeplearning4j_tpu.analysis.ast_rules import _parents
+
+    for parent in _parents(node):
+        if isinstance(parent, ast.Dict):
+            keys = [k for k in parent.keys if k is not None]
+            if keys and all(isinstance(k, ast.Constant)
+                            and isinstance(k.value, str) for k in keys):
+                return node in parent.values or any(
+                    v is node or node in ast.walk(v)
+                    for v in parent.values)
+        elif isinstance(parent, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                 ast.ClassDef)):
+            return False
+    return False
+
+
+def _names_f64(node, imports) -> bool:
+    if isinstance(node, (ast.Name, ast.Attribute)):
+        return imports.canon(node) in _F64_CANON
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return node.value in _F64_STRINGS
+    return False
+
+
+def g032_float64_device_drift(tree, imports, path):
+    """float64 entering device code: `jnp.float64` anywhere,
+    `.astype(float64)` / `dtype=float64` on jax calls, and np.float64
+    constructors in the device dirs."""
+    if _in_dirs(path, _G032_BLESSED_DIRS):
+        return []
+    out = []
+    device_dir = _in_dirs(path, _G032_DEVICE_DIRS)
+    fixit = ("keep device math float32/bfloat16 (the TPU dtype policy); "
+             "pin host constants with an explicit f32 dtype")
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.Name, ast.Attribute)):
+            if imports.canon(node) == "jax.numpy.float64" \
+                    and not _is_dtype_registry_value(node):
+                out.append((
+                    "G032", node,
+                    "jnp.float64 — a float64 dtype aimed at the traced "
+                    "program (stage 2's J003 class, caught pre-commit)",
+                    fixit))
+        elif isinstance(node, ast.Call):
+            canon = imports.canon(node.func)
+            if isinstance(node.func, ast.Attribute) \
+                    and node.func.attr == "astype" and node.args \
+                    and _names_f64(node.args[0], imports) and device_dir:
+                out.append((
+                    "G032", node,
+                    ".astype(float64) — an explicit widen to f64 in a "
+                    "device dir",
+                    fixit))
+            elif canon == "numpy.float64" and device_dir:
+                out.append((
+                    "G032", node,
+                    "np.float64 constructor in a device dir — the "
+                    "scalar widens every jnp expression it meets",
+                    fixit))
+            elif canon and (canon.startswith("jax.")
+                            or canon.startswith("jax.numpy.")):
+                for kw in node.keywords:
+                    if kw.arg == "dtype" and _names_f64(kw.value,
+                                                        imports):
+                        out.append((
+                            "G032", node,
+                            "dtype=float64 on a jax call — float64 "
+                            "built directly into the traced program",
+                            fixit))
+    return out
+
+
+# ------------------------------------------------------------------ G033
+
+def _is_q8_const(node) -> bool:
+    """127 in any numeric spelling; 128 only as a FLOAT (int 128 is the
+    lane tile — G016's structural constant — and round-up expressions
+    like `(n + 127) // 128 * 128` must never flag)."""
+    if not isinstance(node, ast.Constant) or isinstance(node.value, bool):
+        return False
+    v = node.value
+    if isinstance(v, int):
+        return v in (127, -127)
+    if isinstance(v, float):
+        return v in (127.0, -127.0, 128.0, -128.0)
+    return False
+
+
+def g033_hardcoded_quant_scale(tree, imports, path):
+    """Symmetric-int8 scale constants (127 in mul/div/clip, float
+    128.0) outside the blessed decode_attention quantize helpers."""
+    if _norm(path).endswith(_G033_BLESSED):
+        return []
+    out = []
+    fixit = ("route scale math through ops/decode_attention.py's "
+             "quantize_pages/dequantize_pages/quantized_cache_update — "
+             "a second spelling of maxabs/127 is how a cache writer and "
+             "reader disagree (q8-scale-mismatch)")
+    for node in ast.walk(tree):
+        if isinstance(node, ast.BinOp) \
+                and isinstance(node.op, (ast.Mult, ast.Div)) \
+                and (_is_q8_const(node.left) or _is_q8_const(node.right)):
+            out.append((
+                "G033", node,
+                "hand-rolled int8 quantization scale math (127/128 "
+                "mul-div) outside the blessed quantize helpers",
+                fixit))
+        elif isinstance(node, ast.Call) \
+                and (imports.canon(node.func) or "").endswith(".clip") \
+                and any(_is_q8_const(a) for a in node.args):
+            out.append((
+                "G033", node,
+                "hand-rolled int8 code clamp (clip to ±127) outside "
+                "the blessed quantize helpers",
+                fixit))
+    return out
+
+
+# ------------------------------------------------------------------ G034
+
+def _is_tree_expr(expr) -> bool:
+    if isinstance(expr, ast.Name):
+        return expr.id in _G034_TREE_NAMES
+    if isinstance(expr, ast.Attribute):
+        return expr.attr in _G034_TREE_NAMES
+    return False
+
+
+def _casts_inside(fn_node) -> bool:
+    """Does a mapped function (lambda or named ref is not resolvable —
+    lambdas only) cast its argument's dtype?"""
+    if not isinstance(fn_node, ast.Lambda):
+        return False
+    for sub in ast.walk(fn_node.body):
+        if isinstance(sub, ast.Call):
+            if isinstance(sub.func, ast.Attribute) \
+                    and sub.func.attr == "astype":
+                return True
+            if isinstance(sub.func, ast.Attribute) \
+                    and sub.func.attr == "convert_element_type":
+                return True
+    return False
+
+
+def g034_whole_tree_dtype_cast(tree, imports, path):
+    """Wholesale dtype casts of params/opt_state trees outside the
+    blessed reshard/ + checkpoint dtype-policy paths."""
+    norm = _norm(path)
+    if any(b in norm if b.endswith("/") else norm.endswith(b)
+           for b in _G034_BLESSED):
+        return []
+    out = []
+    fixit = ("cast per-leaf inside the blessed dtype-policy paths "
+             "(reshard/, util/orbax_checkpoint.py, "
+             "util/model_serializer.py) — a wholesale tree cast "
+             "rewrites the optimizer accumulators' dtype too")
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        canon = imports.canon(node.func)
+        if isinstance(node.func, ast.Attribute) \
+                and node.func.attr == "astype" \
+                and _is_tree_expr(node.func.value):
+            out.append((
+                "G034", node,
+                "whole params/opt_state tree .astype(...) — a "
+                "dtype-mutating cast of every leaf (accumulators "
+                "included) outside the blessed dtype-policy paths",
+                fixit))
+        elif canon == "jax.lax.convert_element_type" and node.args \
+                and _is_tree_expr(node.args[0]):
+            out.append((
+                "G034", node,
+                "lax.convert_element_type over a whole params/"
+                "opt_state tree outside the blessed dtype-policy paths",
+                fixit))
+        elif canon in _G034_TREE_MAP and len(node.args) >= 2 \
+                and any(_is_tree_expr(a) for a in node.args[1:]) \
+                and _casts_inside(node.args[0]):
+            out.append((
+                "G034", node,
+                "jax.tree.map casting a whole params/opt_state tree's "
+                "dtype outside the blessed dtype-policy paths",
+                fixit))
+    return out
+
+
+PRECISION_RULES = [g031_undeclared_accumulator, g032_float64_device_drift,
+                   g033_hardcoded_quant_scale, g034_whole_tree_dtype_cast]
+
+PRECISION_RULE_DOCS = {
+    "G031": "accumulator discipline (ops/ + embedding/): "
+            "einsum/matmul/dot/dot_general without "
+            "preferred_element_type, or a bare `@` which cannot carry "
+            "one — declare f32 accumulation where the contraction is "
+            "written",
+    "G032": "float64 drift into device code: jnp.float64, "
+            ".astype(float64), dtype=float64 on jax calls, np.float64 "
+            "constructors in device dirs (stage 2's J003 promoted to "
+            "pre-commit; host analytics dirs, gradientcheck/'s f64 "
+            "finite differences, and name->dtype registry tables "
+            "exempt)",
+    "G033": "hand-rolled int8 quantization scale math — 127/127.0 in "
+            "mul/div/clip or a float 128.0 scale outside the blessed "
+            "ops/decode_attention.py quantize helpers (the "
+            "q8-scale-mismatch class); lane-tile 128 and (n+127)//128 "
+            "round-ups never flag",
+    "G034": "dtype-mutating cast of a WHOLE params/opt_state tree "
+            "(.astype / convert_element_type / tree.map of a cast) "
+            "outside the blessed reshard/ + checkpoint dtype-policy "
+            "paths; single-leaf casts never flag",
+}

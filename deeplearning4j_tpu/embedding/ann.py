@@ -45,11 +45,13 @@ def _normalize(x, axis=-1):
 def _kmeans_iter(centroids, vecs):
     """One Lloyd iteration over normalized vectors (spherical k-means:
     assign by max dot, recenter, renormalize)."""
-    scores = vecs @ centroids.T                       # [N, P]
+    scores = jnp.einsum("nd,pd->np", vecs, centroids,
+                        preferred_element_type=jnp.float32)     # [N, P]
     assign = jnp.argmax(scores, axis=1)               # [N]
     p = centroids.shape[0]
     one_hot = jax.nn.one_hot(assign, p, dtype=vecs.dtype)   # [N, P]
-    sums = one_hot.T @ vecs                           # [P, D]
+    sums = jnp.einsum("np,nd->pd", one_hot, vecs,
+                      preferred_element_type=jnp.float32)       # [P, D]
     counts = one_hot.sum(axis=0)[:, None]             # [P, 1]
     # empty partitions keep their old centroid
     new = jnp.where(counts > 0, sums / jnp.maximum(counts, 1.0), centroids)
@@ -62,7 +64,8 @@ def brute_force_topk(vectors, queries, k: int):
     top_k. Returns (ids [Q, k], scores [Q, k])."""
     normed = _normalize(jnp.asarray(vectors))
     q = _normalize(jnp.asarray(queries))
-    sims = q @ normed.T                               # [Q, V]
+    sims = jnp.einsum("qd,vd->qv", q, normed,
+                      preferred_element_type=jnp.float32)       # [Q, V]
     scores, idx = jax.lax.top_k(sims, k)
     return idx.astype(jnp.int32), scores
 
@@ -103,7 +106,9 @@ class DeviceANNIndex:
         # host-side assignment (build time, not the query path): order
         # candidates by centroid affinity, spill to the next-nearest
         # partition with room
-        scores = np.asarray(vecs @ centroids.T)        # [V, P]
+        scores = np.asarray(jnp.einsum(
+            "vd,pd->vp", vecs, centroids,
+            preferred_element_type=jnp.float32))       # [V, P]
         pref = np.argsort(-scores, axis=1)             # [V, P]
         part_rows = [[] for _ in range(p)]
         for row in range(v):
@@ -130,11 +135,13 @@ class DeviceANNIndex:
             def body(centroids, part_vecs, part_ids, queries):
                 self._trace_count += 1  # trace time only
                 qn = _normalize(queries)
-                coarse = qn @ centroids.T                     # [Q, P]
+                coarse = jnp.einsum("qd,pd->qp", qn, centroids,
+                                    preferred_element_type=jnp.float32)
                 _, probe = jax.lax.top_k(coarse, nprobe)      # [Q, nprobe]
                 cand_vecs = part_vecs[probe]        # [Q, nprobe, cap, D]
                 cand_ids = part_ids[probe].reshape(q, -1)
-                fine = jnp.einsum("qd,qncd->qnc", qn, cand_vecs)
+                fine = jnp.einsum("qd,qncd->qnc", qn, cand_vecs,
+                                  preferred_element_type=jnp.float32)
                 fine = fine.reshape(q, -1)
                 fine = jnp.where(cand_ids >= 0, fine, _NEG_INF)
                 scores, pos = jax.lax.top_k(fine, k)

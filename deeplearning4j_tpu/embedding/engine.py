@@ -202,7 +202,9 @@ class ShardedEmbeddingEngine:
 
             g_pos = (pos_score - 1.0)[:, None]
             g_neg = neg_score[:, :, None]
-            grad_c = g_pos * pos + jnp.einsum("bko,bkd->bd", g_neg, neg)
+            grad_c = g_pos * pos + jnp.einsum(
+                "bko,bkd->bd", g_neg, neg,
+                preferred_element_type=jnp.float32)
             grad_pos = g_pos * c
             grad_neg = g_neg * c[:, None, :]
 
@@ -246,12 +248,14 @@ class ShardedEmbeddingEngine:
             c = _ep_gather(syn0, center, lo, v_local)
             nodes = _ep_gather(syn1, points, lo, v_local)
             sign = 1.0 - 2.0 * codes.astype(c.dtype)
-            logit = jnp.einsum("bd,bld->bl", c, nodes)
+            logit = jnp.einsum("bd,bld->bl", c, nodes,
+                               preferred_element_type=jnp.float32)
             p = jax.nn.sigmoid(sign * logit)
             m = mask.astype(c.dtype)
 
             g = -sign * (1.0 - p) * m
-            grad_c = jnp.einsum("bl,bld->bd", g, nodes)
+            grad_c = jnp.einsum("bl,bld->bd", g, nodes,
+                                preferred_element_type=jnp.float32)
             grad_nodes = g[:, :, None] * c[:, None, :]
 
             b, length = codes.shape
@@ -443,7 +447,8 @@ class EngineLookupView:
         normed = self._normed()
         q = jnp.asarray(query_vec, self.dtype)
         q = q / jnp.maximum(jnp.linalg.norm(q), 1e-12)
-        sims = normed @ q
+        sims = jnp.einsum("vd,d->v", normed, q,
+                          preferred_element_type=jnp.float32)
         if exclude:
             sims = sims.at[jnp.asarray(list(exclude))].set(-jnp.inf)
         vals, idx = jax.lax.top_k(sims, min(top_n, self.vocab_size))

@@ -91,7 +91,8 @@ def _cache_attention_blocked(q, k, v, key_limit, block_k):
         p = jnp.exp(s - m_new[..., None])
         l_new = l * alpha + p.sum(-1)
         acc_new = acc * alpha[..., None] + jnp.einsum(
-            "bhqk,bhkd->bhqd", p, v_j.astype(jnp.float32))
+            "bhqk,bhkd->bhqd", p, v_j.astype(jnp.float32),
+            preferred_element_type=jnp.float32)
         return (m_new, l_new, acc_new, j0 + block_k), None
 
     (m, l, acc, _), _ = jax.lax.scan(
@@ -245,7 +246,8 @@ def _cache_attention_blocked_q8(q, k_codes, v_codes, k_scale, v_scale,
         p = jnp.exp(s - m_new[..., None])
         l_new = l * alpha + p.sum(-1)
         acc_new = acc * alpha[..., None] + jnp.einsum(
-            "bhqk,bhkd->bhqd", p, vf)
+            "bhqk,bhkd->bhqd", p, vf,
+            preferred_element_type=jnp.float32)
         return (m_new, l_new, acc_new, j0 + block_k), None
 
     (m, l, acc, _), _ = jax.lax.scan(

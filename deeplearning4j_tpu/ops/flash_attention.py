@@ -1584,9 +1584,13 @@ def chunked_flash_attention_lse(q, k, v, sm_scale, causal, kmask=None,
                     qi, kj, vj, km[:, :, j * c:(j + 1) * c],
                     sm_scale, j == i)
             if o is None:
-                o, lse = o_hop.astype(jnp.float32), lse_hop
+                # stay in the kernel dtype until a merge NEEDS f32 — a
+                # single-hop row (i == 0 causal) otherwise round-trips
+                # bf16 -> f32 -> bf16 for nothing (graftlint P003)
+                o, lse = o_hop, lse_hop
             else:
-                o, lse = lse_combine(o, lse, o_hop, lse_hop)
+                o, lse = lse_combine(o.astype(jnp.float32), lse,
+                                     o_hop, lse_hop)
         outs.append(o.astype(q.dtype))
         lses.append(lse)
     return jnp.concatenate(outs, axis=1), jnp.concatenate(lses, axis=1)

@@ -57,8 +57,10 @@ def _score_body(c, pos, neg):
     exactly this — and it is verbatim nlp/lookup.sgns_step's forward):
     c/pos [bn, D], neg [bn, K, D]; returns sigmoid'd dot products
     (pos_score [bn], neg_score [bn, K])."""
-    pos_score = jax.nn.sigmoid(jnp.einsum("bd,bd->b", c, pos))
-    neg_score = jax.nn.sigmoid(jnp.einsum("bd,bkd->bk", c, neg))
+    pos_score = jax.nn.sigmoid(jnp.einsum(
+        "bd,bd->b", c, pos, preferred_element_type=jnp.float32))
+    neg_score = jax.nn.sigmoid(jnp.einsum(
+        "bd,bkd->bk", c, neg, preferred_element_type=jnp.float32))
     return pos_score, neg_score
 
 

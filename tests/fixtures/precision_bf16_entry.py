@@ -1,0 +1,40 @@
+"""Demo stage-5 fixture: a hand-written FORWARD bf16 accumulation — the
+silent-precision shape graftlint's precision stage exists to catch.
+
+`python tools/graftlint.py --check --stage precision tests/fixtures/\
+precision_bf16_entry.py` must exit non-zero with a P001 finding: the
+scan below add-accumulates its carry in bfloat16 over rows of a bf16
+dot_general, so the running sum drops low bits on every iteration — the
+loss-curve-flattens-late bug class the f32-accumulation policy (f32
+carries + preferred_element_type, the flash/decode kernels' pattern)
+prevents. No `add_any` appears (this is not an autodiff backward
+region), so the accumulation checks apply in full. Note jnp.sum would
+NOT reproduce this: it upcasts sub-f32 inputs before reducing — the bug
+needs a hand-rolled accumulator, which is exactly where it occurs.
+
+The GRAFTLINT_PRECISION_ENTRIES hook is the external-entry contract of
+analysis/precision_audit.py: {name: builder}, builder() -> (fn, args).
+"""
+
+
+def build_bf16_carry_over_dot():
+    import jax
+    import jax.numpy as jnp
+
+    def fn(x, w):
+        y = jnp.dot(x, w)  # bf16 dot (no preferred_element_type)
+
+        def body(carry, row):
+            return carry + row, ()  # bf16 running sum: drops low bits
+
+        acc, _ = jax.lax.scan(body, jnp.zeros((64,), jnp.bfloat16), y)
+        return acc
+
+    bf16 = jnp.bfloat16
+    return fn, (jax.ShapeDtypeStruct((64, 64), bf16),
+                jax.ShapeDtypeStruct((64, 64), bf16))
+
+
+GRAFTLINT_PRECISION_ENTRIES = {
+    "demo/bf16_carry_over_dot": build_bf16_carry_over_dot,
+}

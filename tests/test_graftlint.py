@@ -867,6 +867,60 @@ def engine_step(table, idx, values):
     from deeplearning4j_tpu.parallel.overlap import sparse_bucket_reduce
     return sparse_bucket_reduce(idx, values, "data")
 """),
+    # ---------------------------------------- ISSUE 20 (precision)
+    ("G031", """\
+def scores(q, k):
+    s = jnp.einsum("qd,kd->qk", q, k)      # accumulator dtype implicit
+    return s + q @ k.T                     # `@` cannot declare one
+""", """\
+def scores(q, k):
+    return jnp.einsum("qd,kd->qk", q, k,
+                      preferred_element_type=jnp.float32)
+"""),
+    ("G032", """\
+def f(x):
+    y = x.astype(jnp.float64)
+    z = jnp.zeros((2,), dtype="float64")
+    w = np.float64(3.0)
+    return y, z, w
+""", """\
+def f(x):
+    y = x.astype(jnp.float32)
+    z = jnp.zeros((2,), dtype="float32")
+    return y, z
+
+
+_DTYPES = {"float64": jnp.float64, "float32": jnp.float32}
+"""),
+    ("G033", """\
+def quantize(vals, maxabs):
+    scale = maxabs / 127.0
+    return jnp.clip(jnp.round(vals / scale), -127, 127), scale
+""", """\
+from deeplearning4j_tpu.ops.decode_attention import quantize_pages
+
+
+def quantize(vals):
+    return quantize_pages(vals)
+
+
+def round_up(n):
+    return (n + 127) // 128 * 128          # lane-tile round-up, exempt
+
+
+BLOCK = 128
+"""),
+    ("G034", """\
+def downcast(net):
+    half = net.params.astype(jnp.bfloat16)
+    opt = jax.tree.map(lambda x: x.astype(jnp.bfloat16), net.opt_state)
+    return half, opt
+""", """\
+def place(params):
+    w = params["W"].astype(jnp.bfloat16)   # single leaf, not the tree
+    moved = jax.tree.map(jnp.asarray, params)  # no cast in the mapped fn
+    return w, moved
+"""),
 ]
 
 
@@ -882,6 +936,8 @@ RULE_FIXTURE_PATHS = {
     # (serving//data/) lint their fixtures on a serving/ path
     "G026": "deeplearning4j_tpu/serving/_graftlint_fixture.py",
     "G027": "deeplearning4j_tpu/serving/_graftlint_fixture.py",
+    # G031 (accumulator discipline) is scoped to the kernel dirs
+    "G031": "deeplearning4j_tpu/ops/_graftlint_fixture.py",
 }
 
 
@@ -896,7 +952,7 @@ def test_rule_fires_on_positive_not_negative(rule, pos, neg):
 
 def test_every_rule_has_fixture_coverage():
     assert {r for r, _, _ in FIXTURES} == set(RULE_DOCS) == {
-        f"G{i:03d}" for i in range(1, 31)}
+        f"G{i:03d}" for i in range(1, 35)}
 
 
 def test_g015_blessed_sites_are_exempt():
@@ -1172,6 +1228,61 @@ def test_g016_tuning_layer_and_scope():
     assert "G016" not in rules_in(lane, "deeplearning4j_tpu/ops/x.py")
 
 
+def test_g031_scope_and_embedding_dir():
+    """G031 covers the kernel dirs only (ops/ + embedding/): a
+    contraction elsewhere legitimately inherits the backend default."""
+    _, pos, _ = next(f for f in FIXTURES if f[0] == "G031")
+    assert "G031" in rules_in(pos, RULE_FIXTURE_PATHS["G031"])
+    assert "G031" in rules_in(
+        pos, "deeplearning4j_tpu/embedding/_graftlint_fixture.py")
+    assert "G031" not in rules_in(pos)  # parallel/ default: out of scope
+    assert "G031" not in rules_in(pos, "deeplearning4j_tpu/nn/x.py")
+
+
+def test_g032_blessed_dirs_and_registry_carveout():
+    """gradientcheck/'s finite differences deliberately run f64 (tests
+    enable x64) and stay silent; the np.float64-constructor half is
+    device-dirs only (host analytics keep their f64); a name->dtype
+    registry dict is declarative, not drift."""
+    _, pos, neg = next(f for f in FIXTURES if f[0] == "G032")
+    assert "G032" in rules_in(pos)  # parallel/ is a device dir
+    assert "G032" not in rules_in(
+        pos, "deeplearning4j_tpu/gradientcheck/finite_diff.py")
+    np_ctor = "def f():\n    return np.float64(3.0)\n"
+    assert "G032" in rules_in(np_ctor, "deeplearning4j_tpu/ops/x.py")
+    assert "G032" not in rules_in(
+        np_ctor, "deeplearning4j_tpu/clustering/kmeans.py")
+    registry = '_DTYPES = {"float64": jnp.float64}\n'
+    assert "G032" not in rules_in(registry)
+
+
+def test_g033_blessed_quantize_helpers_are_exempt():
+    """ops/decode_attention.py IS where maxabs/127 lives — the rule
+    exists so there is exactly ONE spelling of the scale math."""
+    _, pos, _ = next(f for f in FIXTURES if f[0] == "G033")
+    assert "G033" in rules_in(pos)
+    assert "G033" in rules_in(pos, "deeplearning4j_tpu/serving/engine.py")
+    assert "G033" not in rules_in(
+        pos, "deeplearning4j_tpu/ops/decode_attention.py")
+    # integer 128 is the lane tile (G016's constant), never quant math
+    lane = "def f(x):\n    return x * 128\n"
+    assert "G033" not in rules_in(lane)
+
+
+def test_g034_blessed_dtype_policy_paths_are_exempt():
+    """reshard/ and the two checkpoint formats OWN the dtype policy;
+    the same wholesale tree cast flags anywhere else."""
+    _, pos, _ = next(f for f in FIXTURES if f[0] == "G034")
+    assert "G034" in rules_in(pos)
+    assert "G034" in rules_in(pos, "deeplearning4j_tpu/nn/multilayer.py")
+    assert "G034" not in rules_in(
+        pos, "deeplearning4j_tpu/reshard/executor.py")
+    assert "G034" not in rules_in(
+        pos, "deeplearning4j_tpu/util/orbax_checkpoint.py")
+    assert "G034" not in rules_in(
+        pos, "deeplearning4j_tpu/util/model_serializer.py")
+
+
 def test_g014_retry_loop_scoped_to_distributed():
     """The uncapped-retry half of G014 applies to distributed/ only
     (the elastic rejoin path); a bounded Backoff loop stays clean."""
@@ -1433,8 +1544,9 @@ def test_cli_rules_prints_per_stage_inventory(tmp_path):
         cwd=ROOT, env=_poisoned_jax_env(tmp_path),
         capture_output=True, text=True, timeout=120)
     assert proc.returncode == 0, proc.stdout + proc.stderr
-    for stage in ("ast", "jaxpr", "spmd", "concurrency"):
+    for stage in ("ast", "jaxpr", "spmd", "concurrency", "precision"):
         assert f"stage {stage}:" in proc.stdout
-    for rid in ("G001", "G024", "G025", "G028",
-                "J001", "J004", "C001", "C003", "D001", "D003"):
+    for rid in ("G001", "G024", "G025", "G028", "G031", "G034",
+                "J001", "J004", "C001", "C003", "D001", "D003",
+                "P001", "P005", "PB01"):
         assert rid in proc.stdout, f"--rules missing {rid}"

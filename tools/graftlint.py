@@ -11,10 +11,13 @@
     python tools/graftlint.py --update-collectives          # refreeze stage 3
     python tools/graftlint.py --check --stage concurrency   # host threads
     python tools/graftlint.py --update-locks                # refreeze stage 4
+    python tools/graftlint.py --check --stage precision     # dtype dataflow
+    python tools/graftlint.py --update-precision            # refreeze stage 5
+    python tools/graftlint.py --changed                     # diff-scoped fast
     python tools/graftlint.py --rules                       # rule inventory
 
 Stage `ast` (default) is pure stdlib and instant — suitable as a
-pre-commit step; it runs all AST rules G001-G030. Stage `jaxpr` traces
+pre-commit step; it runs all AST rules G001-G034. Stage `jaxpr` traces
 the jitted entry points on CPU (~1 min). Stage `spmd` runs the
 G010-G013 rules plus the collective-consistency audit
 (analysis/collective_audit.py): frozen ordered collective signatures and
@@ -24,7 +27,16 @@ of the built-ins. Stage `concurrency` (pure stdlib, like `ast`) runs
 the host-thread rules G025-G028 plus the lock-order audit
 (analysis/lock_audit.py): edges frozen in analysis/lock_order.json, a
 lock-order CYCLE (D001) always exits 1; pass explicit .py paths to
-audit fixtures without the frozen-set comparison. Exit codes: 0 clean,
+audit fixtures without the frozen-set comparison. Stage `precision`
+runs the dtype-discipline rules G031-G034 plus the precision-flow
+audit (analysis/precision_audit.py): per-entry dtype profiles frozen
+in analysis/precision_budget.json, sub-f32 accumulation chains (P001),
+int8 quantize/dequantize pairing (P002), convert churn (P003),
+widening collectives (P004), and rank-divergent profiles (P005, the
+C003 deadlock class); pass a fixture .py defining
+GRAFTLINT_PRECISION_ENTRIES to profile its entries instead.
+`--changed [REF]` scopes the lint to .py files touched since REF
+(default HEAD) — the sub-second pre-commit mode. Exit codes: 0 clean,
 1 findings (--check) or any D001, 2 usage/env error.
 """
 
@@ -66,7 +78,7 @@ def main(argv=None) -> int:
                     help="emit findings as JSON")
     ap.add_argument("--stage",
                     choices=("ast", "jaxpr", "spmd", "concurrency",
-                             "all"),
+                             "precision", "all"),
                     default="ast")
     ap.add_argument("--baseline", default=DEFAULT_BASELINE)
     ap.add_argument("--write-baseline", action="store_true",
@@ -82,13 +94,23 @@ def main(argv=None) -> int:
                     help="rescan the package lock-order graph and "
                          "refreeze the blessed edge set "
                          "(analysis/lock_order.json)")
+    ap.add_argument("--update-precision", action="store_true",
+                    help="retrace the stage-5 entry points and refreeze "
+                         "the per-entry precision manifest "
+                         "(analysis/precision_budget.json)")
+    ap.add_argument("--changed", nargs="?", const="HEAD", default=None,
+                    metavar="REF",
+                    help="lint only .py files touched since REF "
+                         "(default HEAD: staged + unstaged + untracked) "
+                         "— the sub-second pre-commit mode")
     ap.add_argument("--rules", action="store_true",
                     help="print the per-stage rule inventory and exit")
     args = ap.parse_args(argv)
 
     if (args.stage in ("ast", "concurrency") or args.rules
             or args.update_locks) and not (args.update_budget
-                                           or args.update_collectives):
+                                           or args.update_collectives
+                                           or args.update_precision):
         # Pre-commit path: stub the package parents so the analysis
         # modules load WITHOUT the root __init__ (which imports the full
         # nn stack and jax). Stages 1 and 4 stay pure-stdlib-fast.
@@ -102,9 +124,15 @@ def main(argv=None) -> int:
                                                   write_baseline)
 
     paths = args.paths or [os.path.join(ROOT, "deeplearning4j_tpu")]
+    if args.changed is not None:
+        paths = _changed_paths(args.changed)
+        if not paths:
+            print(f"graftlint: no .py files changed since {args.changed}")
+            return 0
     new, old, counts, signatures = [], [], {}, {}
+    profiles: dict = {}
 
-    if args.stage in ("ast", "all", "spmd", "concurrency"):
+    if args.stage in ("ast", "all", "spmd", "concurrency", "precision"):
         findings = lint_paths(paths, root=ROOT)
         if args.stage == "spmd":
             # the SPMD stage lints its own rule family only; G001-G009
@@ -116,6 +144,11 @@ def main(argv=None) -> int:
             from deeplearning4j_tpu.analysis.concurrency_rules import \
                 CONC_RULE_IDS
             findings = [f for f in findings if f.rule in CONC_RULE_IDS]
+        elif args.stage == "precision":
+            from deeplearning4j_tpu.analysis.precision_rules import \
+                PRECISION_RULE_IDS
+            findings = [f for f in findings
+                        if f.rule in PRECISION_RULE_IDS]
         if args.write_baseline:
             write_baseline(args.baseline, findings)
             print(f"baselined {len(findings)} findings -> {args.baseline}")
@@ -124,8 +157,9 @@ def main(argv=None) -> int:
         new.extend(n)
         old.extend(o)
 
-    needs_jax = (args.stage in ("jaxpr", "spmd", "all")
-                 or args.update_budget or args.update_collectives)
+    needs_jax = (args.stage in ("jaxpr", "spmd", "precision", "all")
+                 or args.update_budget or args.update_collectives
+                 or args.update_precision)
     if needs_jax:
         # CPU-only + virtual devices, matching the tier-1 environment,
         # before any jax backend initialization.
@@ -186,6 +220,27 @@ def main(argv=None) -> int:
             lfindings, lock_edges = lock_audit.audit()
         new.extend(lfindings)
 
+    if args.stage in ("precision", "all") or args.update_precision:
+        from deeplearning4j_tpu.analysis import precision_audit
+        if args.update_precision:
+            _, profiles = precision_audit.audit(divergence=False)
+            precision_audit.write_budget(profiles)
+            print(f"froze precision profiles for {len(profiles)} entry "
+                  f"points -> {precision_audit.BUDGET_PATH}")
+            for name, prof in sorted(profiles.items()):
+                print(f"  {name}: {sum(prof['dots'].values())} dot(s), "
+                      f"{sum(prof['converts'].values())} convert(s), "
+                      f"q8 {prof['q8']['quantize']}q/"
+                      f"{prof['q8']['dequantize']}dq")
+            return 0
+        # fixture .py paths exposing GRAFTLINT_PRECISION_ENTRIES are
+        # profiled INSTEAD of the built-ins (demo/debug runs); otherwise
+        # the frozen entries get the manifest + rank-divergence pass
+        pfindings, profiles = precision_audit.audit_paths(paths)
+        if not profiles:
+            pfindings, profiles = precision_audit.audit()
+        new.extend(pfindings)
+
     if args.as_json:
         print(json.dumps({
             "findings": [f.to_json() for f in new],
@@ -193,6 +248,7 @@ def main(argv=None) -> int:
             "jaxpr_op_counts": counts,
             "collective_signatures": signatures,
             "lock_order_edges": lock_edges,
+            "precision_profiles": profiles,
         }, indent=1))
     else:
         for f in new:
@@ -206,12 +262,38 @@ def main(argv=None) -> int:
                   "traced")
         if lock_edges:
             print(f"lock-order audit: {len(lock_edges)} edge(s)")
+        if profiles:
+            print(f"precision audit: {len(profiles)} entry points "
+                  "profiled")
         print(f"graftlint: {len(new)} finding(s)")
     # a lock-order cycle is a deadlock waiting for load — never
     # reportable-only, regardless of --check or baseline
     if any(f.rule == "D001" for f in new):
         return 1
     return 1 if (new and args.check) else 0
+
+
+def _changed_paths(ref: str) -> list[str]:
+    """Absolute paths of .py files touched since `ref` (staged +
+    unstaged via `git diff`, plus untracked). Exits 2 on a bad ref —
+    the usage-error contract."""
+    import subprocess
+
+    diff = subprocess.run(["git", "diff", "--name-only", "-z", ref, "--"],
+                          cwd=ROOT, capture_output=True, text=True)
+    if diff.returncode != 0:
+        print(diff.stderr.strip() or f"git diff {ref} failed",
+              file=sys.stderr)
+        sys.exit(2)
+    names = [n for n in diff.stdout.split("\0") if n]
+    untracked = subprocess.run(
+        ["git", "ls-files", "--others", "--exclude-standard", "-z"],
+        cwd=ROOT, capture_output=True, text=True)
+    if untracked.returncode == 0:
+        names += [n for n in untracked.stdout.split("\0") if n]
+    return sorted({os.path.join(ROOT, n) for n in names
+                   if n.endswith(".py")
+                   and os.path.isfile(os.path.join(ROOT, n))})
 
 
 def _print_rules() -> int:
@@ -221,10 +303,13 @@ def _print_rules() -> int:
         CONC_RULE_IDS
     from deeplearning4j_tpu.analysis.lock_audit import \
         RULE_DOCS as LOCK_DOCS
+    from deeplearning4j_tpu.analysis.precision_rules import \
+        PRECISION_RULE_IDS
     from deeplearning4j_tpu.analysis.spmd_rules import SPMD_RULE_IDS
 
-    # jaxpr/spmd audit rules are documented in their modules' headers;
-    # summarized here so --rules covers every id the suite can emit
+    # jaxpr/spmd/precision audit rules are documented in their modules'
+    # headers; summarized here so --rules covers every id the suite can
+    # emit
     audit_docs = {
         "J001": "forbidden primitive (device_put/callback/transfer) in "
                 "a jitted entry point",
@@ -234,12 +319,26 @@ def _print_rules() -> int:
         "C001": "collective signature drift vs the frozen set",
         "C002": "entry point missing from the frozen signature file",
         "C003": "rank-divergent collective sequence (fleet deadlock)",
+        "P001": "sub-f32 accumulation in a reduction chain (scan carry "
+                "/ reduce-over-dot / cumulative / psum operand)",
+        "P002": "broken int8 quantize<->dequantize pairing (raw-code "
+                "read, or requantize without write-head masking)",
+        "P003": "convert_element_type round-trip churn (upcast-downcast "
+                "ping-pong, intermediate otherwise unused)",
+        "P004": "collective operand wider than the entry's floating "
+                "inputs (widened bytes on the wire)",
+        "P005": "rank-divergent precision profile (fleet deadlock "
+                "class)",
+        "PB01": "precision profile drift vs the frozen manifest",
     }
     stages = [
-        ("ast", sorted(set(RULE_DOCS) - SPMD_RULE_IDS - CONC_RULE_IDS)),
+        ("ast", sorted(set(RULE_DOCS) - SPMD_RULE_IDS - CONC_RULE_IDS
+                       - PRECISION_RULE_IDS)),
         ("jaxpr", ["J001", "J002", "J003", "J004"]),
         ("spmd", sorted(SPMD_RULE_IDS) + ["C001", "C002", "C003"]),
         ("concurrency", sorted(CONC_RULE_IDS) + sorted(LOCK_DOCS)),
+        ("precision", sorted(PRECISION_RULE_IDS)
+         + ["P001", "P002", "P003", "P004", "P005", "PB01"]),
     ]
     for stage, ids in stages:
         print(f"stage {stage}:")
